@@ -1,0 +1,234 @@
+"""HF checkpoint -> stacked JAX param-tree conversion.
+
+Successor of the reference's loader + sharder front-end, built to fix its two
+checkpoint defects by construction:
+- D5: safetensors files were loaded with ``torch.load``
+  (src/model/shard_manager.py:21-24) — here safetensors is read natively;
+- D6: layer indices were parsed with ``key.split('.')[1].isdigit()``
+  (src/model/shard_manager.py:36-42), which matches no real HF name — here
+  each family has an explicit name-mapping table, golden-tested against
+  transformers reference outputs.
+
+Input is a flat ``{name: numpy array}`` state dict (from safetensors shards or
+a torch ``state_dict``); output is the stacked-layer pytree that
+``models.model.forward`` consumes.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Callable, Iterable, Mapping
+
+import numpy as np
+
+from ..core.config import ModelConfig
+
+Array = np.ndarray
+StateDict = Mapping[str, Array]
+
+
+# ---------------------------------------------------------------------------
+# State-dict loading (safetensors native, torch .bin fallback)
+# ---------------------------------------------------------------------------
+
+def load_state_dict(model_dir: str) -> dict[str, Array]:
+    """Load all weight files in a HF snapshot directory into numpy arrays."""
+    out: dict[str, Array] = {}
+    names = sorted(os.listdir(model_dir))
+    st_files = [n for n in names if n.endswith(".safetensors")]
+    bin_files = [n for n in names if n.endswith(".bin") and "training" not in n]
+    if st_files:
+        from safetensors.numpy import load_file
+
+        for name in st_files:
+            out.update(load_file(os.path.join(model_dir, name)))
+    elif bin_files:
+        import torch
+
+        for name in bin_files:
+            sd = torch.load(os.path.join(model_dir, name), map_location="cpu", weights_only=True)
+            out.update({k: v.float().numpy() for k, v in sd.items()})
+    else:
+        raise FileNotFoundError(f"no .safetensors or .bin weights under {model_dir}")
+    return out
+
+
+def torch_state_dict_to_numpy(sd: Mapping[str, Any]) -> dict[str, Array]:
+    """Convert a live torch state_dict (e.g. a transformers model in a test)
+    to numpy, upcasting to float32."""
+    return {k: np.asarray(v.detach().to("cpu").float().numpy()) for k, v in sd.items()}
+
+
+# ---------------------------------------------------------------------------
+# Family mapping tables
+# ---------------------------------------------------------------------------
+
+def _strip_prefix(sd: StateDict, prefixes: Iterable[str]) -> dict[str, Array]:
+    out = {}
+    for k, v in sd.items():
+        for p in prefixes:
+            if k.startswith(p):
+                k = k[len(p):]
+                break
+        out[k] = v
+    return out
+
+
+def _stack(sd: StateDict, template: str, num_layers: int, fn: Callable[[Array], Array]) -> np.ndarray:
+    per_layer = []
+    for i in range(num_layers):
+        key = template.format(i=i)
+        if key not in sd:
+            raise KeyError(f"missing checkpoint key {key!r}")
+        per_layer.append(fn(np.asarray(sd[key])))
+    return np.stack(per_layer)
+
+
+def convert_gpt2(sd: StateDict, cfg: ModelConfig) -> dict[str, Any]:
+    """GPT-2 uses Conv1D layers: weights are stored [in, out] already (no
+    transpose needed); c_attn fuses q,k,v along the output axis."""
+    sd = _strip_prefix(sd, ("transformer.",))
+    D, H, HD = cfg.hidden_size, cfg.num_heads, cfg.head_dim_
+
+    def q_of(w):  # [D, 3D] -> [D, H, HD]
+        return w[:, :D].reshape(D, H, HD)
+
+    def k_of(w):
+        return w[:, D : 2 * D].reshape(D, H, HD)
+
+    def v_of(w):
+        return w[:, 2 * D :].reshape(D, H, HD)
+
+    def qb_of(b):  # [3D] -> [H, HD]
+        return b[:D].reshape(H, HD)
+
+    def kb_of(b):
+        return b[D : 2 * D].reshape(H, HD)
+
+    def vb_of(b):
+        return b[2 * D :].reshape(H, HD)
+
+    L = cfg.num_layers
+    params = {
+        "embed": {
+            "wte": np.asarray(sd["wte.weight"]),
+            "wpe": np.asarray(sd["wpe.weight"]),
+        },
+        "final_norm": {
+            "scale": np.asarray(sd["ln_f.weight"]),
+            "bias": np.asarray(sd["ln_f.bias"]),
+        },
+        "blocks": {
+            "ln1": {
+                "scale": _stack(sd, "h.{i}.ln_1.weight", L, lambda x: x),
+                "bias": _stack(sd, "h.{i}.ln_1.bias", L, lambda x: x),
+            },
+            "ln2": {
+                "scale": _stack(sd, "h.{i}.ln_2.weight", L, lambda x: x),
+                "bias": _stack(sd, "h.{i}.ln_2.bias", L, lambda x: x),
+            },
+            "attn": {
+                "wq": _stack(sd, "h.{i}.attn.c_attn.weight", L, q_of),
+                "wk": _stack(sd, "h.{i}.attn.c_attn.weight", L, k_of),
+                "wv": _stack(sd, "h.{i}.attn.c_attn.weight", L, v_of),
+                "bq": _stack(sd, "h.{i}.attn.c_attn.bias", L, qb_of),
+                "bk": _stack(sd, "h.{i}.attn.c_attn.bias", L, kb_of),
+                "bv": _stack(sd, "h.{i}.attn.c_attn.bias", L, vb_of),
+                "wo": _stack(sd, "h.{i}.attn.c_proj.weight", L, lambda w: w.reshape(H, HD, D)),
+                "bo": _stack(sd, "h.{i}.attn.c_proj.bias", L, lambda x: x),
+            },
+            "mlp": {
+                "w_in": _stack(sd, "h.{i}.mlp.c_fc.weight", L, lambda x: x),
+                "b_in": _stack(sd, "h.{i}.mlp.c_fc.bias", L, lambda x: x),
+                "w_out": _stack(sd, "h.{i}.mlp.c_proj.weight", L, lambda x: x),
+                "b_out": _stack(sd, "h.{i}.mlp.c_proj.bias", L, lambda x: x),
+            },
+        },
+    }
+    return params
+
+
+def convert_llama(sd: StateDict, cfg: ModelConfig) -> dict[str, Any]:
+    """Llama/TinyLlama/Llama-3 use nn.Linear: stored [out, in] -> transpose."""
+    sd = _strip_prefix(sd, ("model.",))
+    D, H, KVH, HD = cfg.hidden_size, cfg.num_heads, cfg.num_kv_heads, cfg.head_dim_
+    L = cfg.num_layers
+    params = {
+        "embed": {"wte": np.asarray(sd["embed_tokens.weight"])},
+        "final_norm": {"scale": np.asarray(sd["norm.weight"])},
+        "blocks": {
+            "ln1": {"scale": _stack(sd, "layers.{i}.input_layernorm.weight", L, lambda x: x)},
+            "ln2": {"scale": _stack(sd, "layers.{i}.post_attention_layernorm.weight", L, lambda x: x)},
+            "attn": {
+                "wq": _stack(sd, "layers.{i}.self_attn.q_proj.weight", L, lambda w: w.T.reshape(D, H, HD)),
+                "wk": _stack(sd, "layers.{i}.self_attn.k_proj.weight", L, lambda w: w.T.reshape(D, KVH, HD)),
+                "wv": _stack(sd, "layers.{i}.self_attn.v_proj.weight", L, lambda w: w.T.reshape(D, KVH, HD)),
+                "wo": _stack(sd, "layers.{i}.self_attn.o_proj.weight", L, lambda w: w.T.reshape(H, HD, D)),
+            },
+            "mlp": {
+                "w_gate": _stack(sd, "layers.{i}.mlp.gate_proj.weight", L, lambda w: w.T),
+                "w_up": _stack(sd, "layers.{i}.mlp.up_proj.weight", L, lambda w: w.T),
+                "w_down": _stack(sd, "layers.{i}.mlp.down_proj.weight", L, lambda w: w.T),
+            },
+        },
+    }
+    if not cfg.tie_embeddings:
+        if "lm_head.weight" in sd:
+            params["lm_head"] = {"w": np.asarray(sd["lm_head.weight"]).T}
+        else:
+            # Some checkpoints tie even when config says otherwise.
+            params["lm_head"] = {"w": np.asarray(sd["embed_tokens.weight"]).T}
+    return params
+
+
+CONVERTERS: dict[str, Callable[[StateDict, ModelConfig], dict[str, Any]]] = {
+    "gpt2": convert_gpt2,
+    "llama": convert_llama,
+}
+
+
+def convert_state_dict(sd: StateDict, cfg: ModelConfig, dtype: Any = None) -> dict[str, Any]:
+    """Convert a HF state dict to our stacked param tree, cast to dtype."""
+    import jax.numpy as jnp
+
+    if cfg.family not in CONVERTERS:
+        raise ValueError(f"no converter for family {cfg.family!r}")
+    tree = CONVERTERS[cfg.family](sd, cfg)
+    target = jnp.dtype(dtype or cfg.dtype)
+    import jax
+
+    return jax.tree.map(lambda x: jnp.asarray(x, dtype=target), tree)
+
+
+def config_from_hf(hf_config: Mapping[str, Any]) -> ModelConfig:
+    """Build a ModelConfig from a HF config.json dict (gpt2 or llama-like)."""
+    arch = (hf_config.get("architectures") or [""])[0].lower()
+    model_type = hf_config.get("model_type", "")
+    if model_type == "gpt2" or "gpt2" in arch:
+        return ModelConfig(
+            family="gpt2",
+            vocab_size=hf_config["vocab_size"],
+            hidden_size=hf_config["n_embd"],
+            intermediate_size=hf_config.get("n_inner") or 4 * hf_config["n_embd"],
+            num_layers=hf_config["n_layer"],
+            num_heads=hf_config["n_head"],
+            num_kv_heads=hf_config["n_head"],
+            max_seq_len=hf_config["n_positions"],
+            norm_eps=hf_config.get("layer_norm_epsilon", 1e-5),
+            tie_embeddings=True,
+        )
+    if model_type == "llama" or "llama" in arch:
+        return ModelConfig(
+            family="llama",
+            vocab_size=hf_config["vocab_size"],
+            hidden_size=hf_config["hidden_size"],
+            intermediate_size=hf_config["intermediate_size"],
+            num_layers=hf_config["num_hidden_layers"],
+            num_heads=hf_config["num_attention_heads"],
+            num_kv_heads=hf_config.get("num_key_value_heads", hf_config["num_attention_heads"]),
+            max_seq_len=hf_config.get("max_position_embeddings", 4096),
+            rope_theta=hf_config.get("rope_theta", 10000.0),
+            norm_eps=hf_config.get("rms_norm_eps", 1e-5),
+            tie_embeddings=hf_config.get("tie_word_embeddings", False),
+        )
+    raise ValueError(f"unsupported HF model_type {model_type!r}")

@@ -1,0 +1,225 @@
+"""Typed configuration system.
+
+The reference hard-codes every knob (ports at src/master/node.py:15 and
+src/worker/node.py:35, model id and shard count at run_master.py:17, heartbeat
+period at src/worker/node.py:273, timeouts at src/master/node.py:117 and
+src/network/protocol.py:77) and its planned YAML/JSON config system
+(plan.md:70-73) never landed.  Here every knob lives in one typed dataclass
+tree, loadable from JSON/YAML files or CLI-style ``key=value`` overrides.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from dataclasses import dataclass, field
+from typing import Any
+
+try:  # yaml is available in the image; gate anyway.
+    import yaml
+
+    _HAVE_YAML = True
+except Exception:  # pragma: no cover
+    _HAVE_YAML = False
+
+
+@dataclass(frozen=True)
+class ModelConfig:
+    """Architecture hyperparameters for a decoder-only transformer.
+
+    One dataclass covers every supported family (GPT-2, TinyLlama, Llama-2,
+    Llama-3); ``family`` selects the block flavour (LayerNorm+learned-pos vs
+    RMSNorm+RoPE+GQA).
+    """
+
+    family: str = "gpt2"  # "gpt2" | "llama"
+    vocab_size: int = 50257
+    hidden_size: int = 768
+    intermediate_size: int = 3072
+    num_layers: int = 12
+    num_heads: int = 12
+    num_kv_heads: int = 12  # < num_heads => grouped-query attention
+    head_dim: int | None = None  # default hidden_size // num_heads
+    max_seq_len: int = 1024
+    rope_theta: float = 10000.0
+    norm_eps: float = 1e-5
+    tie_embeddings: bool = True
+    dtype: str = "bfloat16"
+    # MoE (expert parallelism); num_experts == 0 -> dense MLP.
+    num_experts: int = 0
+    num_experts_per_token: int = 2
+
+    @property
+    def head_dim_(self) -> int:
+        return self.head_dim if self.head_dim is not None else self.hidden_size // self.num_heads
+
+    @property
+    def q_per_kv(self) -> int:
+        return self.num_heads // self.num_kv_heads
+
+
+@dataclass(frozen=True)
+class MeshConfig:
+    """Logical device mesh.  Axes follow the scaling-book convention:
+
+    - ``data``:  batch sharding (data parallelism)
+    - ``pipe``:  pipeline stages (the reference's layer-sharding, done right)
+    - ``model``: tensor parallelism (attention heads / MLP hidden)
+    - ``seq``:   sequence/context parallelism (ring attention)
+    - ``expert``: expert parallelism for MoE layers
+    """
+
+    data: int = 1
+    pipe: int = 1
+    model: int = 1
+    seq: int = 1
+    expert: int = 1
+
+    @property
+    def axis_names(self) -> tuple[str, ...]:
+        return ("data", "pipe", "model", "seq", "expert")
+
+    @property
+    def shape(self) -> tuple[int, ...]:
+        return (self.data, self.pipe, self.model, self.seq, self.expert)
+
+    @property
+    def num_devices(self) -> int:
+        n = 1
+        for s in self.shape:
+            n *= s
+        return n
+
+
+@dataclass(frozen=True)
+class RuntimeConfig:
+    """Serving/runtime knobs (decode loop, KV cache, microbatching)."""
+
+    max_seq_len: int = 1024
+    max_decode_steps: int = 64
+    batch_size: int = 1
+    microbatches: int = 1  # pipeline microbatches per step
+    temperature: float = 0.0  # 0 => greedy
+    top_k: int = 0
+    top_p: float = 1.0
+    kv_cache_dtype: str = "bfloat16"
+    kv_host_spill: bool = False  # spill KV blocks to host DRAM
+    remat: bool = False  # jax.checkpoint on decoder blocks
+    seed: int = 0
+
+
+@dataclass(frozen=True)
+class ClusterConfig:
+    """Control-plane knobs.  Replaces the reference's hard-coded ports and
+    timers (src/master/node.py:15, src/worker/node.py:35,273)."""
+
+    coordinator_host: str = "0.0.0.0"
+    coordinator_port: int = 65432
+    heartbeat_interval_s: float = 5.0
+    heartbeat_timeout_s: float = 15.0  # deadline eviction (reference never evicts, D10)
+    connect_retry_s: float = 5.0
+    connect_max_retries: int = 5
+    task_timeout_s: float = 60.0
+    # jax.distributed settings for multi-host slices
+    distributed_coordinator: str | None = None
+    num_processes: int = 1
+    process_id: int = 0
+
+
+@dataclass(frozen=True)
+class CheckpointConfig:
+    """Shard-store / conversion knobs (successor of shard_info.json,
+    src/model/shard_manager.py:63-74)."""
+
+    cache_dir: str = "./models"
+    shard_dir: str = "./shards"
+    num_shards: int = 2
+    quantization: str | None = None  # None | "int8" | "int4"
+    quant_block_size: int = 128
+
+
+@dataclass(frozen=True)
+class Config:
+    """Root config: everything the framework needs in one place."""
+
+    model: ModelConfig = field(default_factory=ModelConfig)
+    mesh: MeshConfig = field(default_factory=MeshConfig)
+    runtime: RuntimeConfig = field(default_factory=RuntimeConfig)
+    cluster: ClusterConfig = field(default_factory=ClusterConfig)
+    checkpoint: CheckpointConfig = field(default_factory=CheckpointConfig)
+    model_id: str = "gpt2"
+
+
+def _dataclass_from_dict(cls: type, data: dict[str, Any]) -> Any:
+    """Recursively build a (frozen) dataclass from a plain dict, rejecting
+    unknown keys so config typos fail loudly."""
+    fields = {f.name: f for f in dataclasses.fields(cls)}
+    unknown = set(data) - set(fields)
+    if unknown:
+        raise ValueError(f"unknown config keys for {cls.__name__}: {sorted(unknown)}")
+    kwargs: dict[str, Any] = {}
+    for name, value in data.items():
+        ftype = fields[name].type
+        target = _nested_dataclass(ftype)
+        if target is not None and isinstance(value, dict):
+            kwargs[name] = _dataclass_from_dict(target, value)
+        else:
+            kwargs[name] = value
+    return cls(**kwargs)
+
+
+_NESTED = {
+    "ModelConfig": ModelConfig,
+    "MeshConfig": MeshConfig,
+    "RuntimeConfig": RuntimeConfig,
+    "ClusterConfig": ClusterConfig,
+    "CheckpointConfig": CheckpointConfig,
+}
+
+
+def _nested_dataclass(ftype: Any) -> type | None:
+    name = ftype if isinstance(ftype, str) else getattr(ftype, "__name__", "")
+    return _NESTED.get(name)
+
+
+def config_to_dict(cfg: Any) -> dict[str, Any]:
+    return dataclasses.asdict(cfg)
+
+
+def load_config(path: str | None = None, overrides: list[str] | None = None) -> Config:
+    """Load a :class:`Config` from a JSON/YAML file plus dotted overrides.
+
+    Overrides look like ``model.num_layers=24`` or ``mesh.pipe=4``; values are
+    parsed as JSON when possible, else kept as strings.
+    """
+    data: dict[str, Any] = {}
+    if path is not None:
+        with open(path) as f:
+            if path.endswith((".yaml", ".yml")):
+                if not _HAVE_YAML:  # pragma: no cover
+                    raise RuntimeError("yaml not available; use JSON config")
+                data = yaml.safe_load(f) or {}
+            else:
+                data = json.load(f)
+    for ov in overrides or []:
+        key, _, raw = ov.partition("=")
+        if not _:
+            raise ValueError(f"override must be key=value, got {ov!r}")
+        try:
+            value = json.loads(raw)
+        except json.JSONDecodeError:
+            value = raw
+        node = data
+        parts = key.split(".")
+        for p in parts[:-1]:
+            node = node.setdefault(p, {})
+        node[parts[-1]] = value
+    return _dataclass_from_dict(Config, data)
+
+
+def save_config(cfg: Config, path: str) -> None:
+    with open(path, "w") as f:
+        if path.endswith((".yaml", ".yml")) and _HAVE_YAML:
+            yaml.safe_dump(config_to_dict(cfg), f)
+        else:
+            json.dump(config_to_dict(cfg), f, indent=2)

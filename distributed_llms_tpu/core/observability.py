@@ -1,0 +1,130 @@
+"""Structured logging + metrics.
+
+The reference logs with bare ``print()`` throughout (src/master/node.py:36,
+197, 206, 215) and its Prometheus/ELK plans (implementation.md:34-41,
+:146-157) never landed.  Here: std ``logging`` with an optional JSON
+formatter, and an in-process metrics registry (counters, gauges, histogram
+summaries) that the coordinator exports over its control-plane endpoint —
+tokens/s, p50/p95 hop latency, HBM occupancy, per-stage step time.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+import time
+from collections import defaultdict
+from dataclasses import dataclass, field
+
+
+class JsonFormatter(logging.Formatter):
+    def format(self, record: logging.LogRecord) -> str:
+        out = {
+            "ts": record.created,
+            "level": record.levelname,
+            "logger": record.name,
+            "msg": record.getMessage(),
+        }
+        if record.exc_info:
+            out["exc"] = self.formatException(record.exc_info)
+        extra = getattr(record, "fields", None)
+        if extra:
+            out.update(extra)
+        return json.dumps(out)
+
+
+def get_logger(name: str, json_format: bool = False, level: int = logging.INFO) -> logging.Logger:
+    logger = logging.getLogger(name)
+    if not logger.handlers:
+        handler = logging.StreamHandler()
+        if json_format:
+            handler.setFormatter(JsonFormatter())
+        else:
+            handler.setFormatter(
+                logging.Formatter("%(asctime)s %(levelname)s %(name)s: %(message)s")
+            )
+        logger.addHandler(handler)
+        logger.setLevel(level)
+        logger.propagate = False
+    return logger
+
+
+@dataclass
+class _Histogram:
+    values: list[float] = field(default_factory=list)
+    max_keep: int = 4096
+
+    def observe(self, v: float) -> None:
+        if len(self.values) >= self.max_keep:
+            # Keep a sliding window: drop oldest half.
+            self.values = self.values[self.max_keep // 2 :]
+        self.values.append(v)
+
+    def summary(self) -> dict[str, float]:
+        if not self.values:
+            return {"count": 0}
+        vs = sorted(self.values)
+        n = len(vs)
+
+        def pct(p: float) -> float:
+            return vs[min(n - 1, int(p * n))]
+
+        return {
+            "count": n,
+            "mean": sum(vs) / n,
+            "p50": pct(0.50),
+            "p95": pct(0.95),
+            "p99": pct(0.99),
+            "min": vs[0],
+            "max": vs[-1],
+        }
+
+
+class Metrics:
+    """Thread-safe in-process metrics registry."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._counters: dict[str, float] = defaultdict(float)
+        self._gauges: dict[str, float] = {}
+        self._hists: dict[str, _Histogram] = defaultdict(_Histogram)
+
+    def inc(self, name: str, value: float = 1.0) -> None:
+        with self._lock:
+            self._counters[name] += value
+
+    def set_gauge(self, name: str, value: float) -> None:
+        with self._lock:
+            self._gauges[name] = value
+
+    def observe(self, name: str, value: float) -> None:
+        with self._lock:
+            self._hists[name].observe(value)
+
+    def timer(self, name: str) -> "_Timer":
+        return _Timer(self, name)
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "counters": dict(self._counters),
+                "gauges": dict(self._gauges),
+                "histograms": {k: h.summary() for k, h in self._hists.items()},
+            }
+
+
+class _Timer:
+    def __init__(self, metrics: Metrics, name: str) -> None:
+        self._metrics = metrics
+        self._name = name
+
+    def __enter__(self) -> "_Timer":
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self._metrics.observe(self._name, time.perf_counter() - self._t0)
+
+
+METRICS = Metrics()

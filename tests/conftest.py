@@ -1,0 +1,30 @@
+"""Test harness: force an 8-device CPU fake mesh.
+
+The axon TPU plugin ignores the JAX_PLATFORMS env var, so we must set the
+platform via jax.config *before* any backend initialization.  8 fake CPU
+devices exercise the same Mesh/pjit/ppermute code paths as a TPU slice
+(SURVEY §4: the reference has no distributed tests at all; this is the
+strategy it was missing).
+"""
+
+import os
+import sys
+
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+)
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import pytest
+
+
+@pytest.fixture(scope="session")
+def devices8():
+    devs = jax.devices()
+    assert len(devs) == 8, f"expected 8 fake CPU devices, got {len(devs)}"
+    return devs

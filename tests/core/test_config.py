@@ -1,0 +1,42 @@
+import json
+
+import pytest
+
+from distributed_llms_tpu.core.config import Config, MeshConfig, load_config, save_config
+
+
+def test_defaults():
+    cfg = Config()
+    assert cfg.model.family == "gpt2"
+    assert cfg.mesh.num_devices == 1
+    assert cfg.cluster.coordinator_port == 65432
+
+
+def test_load_json_and_overrides(tmp_path):
+    p = tmp_path / "cfg.json"
+    p.write_text(json.dumps({"model": {"num_layers": 24}, "mesh": {"pipe": 4}}))
+    cfg = load_config(str(p), overrides=["mesh.model=2", "model_id=llama-2-7b"])
+    assert cfg.model.num_layers == 24
+    assert cfg.mesh.pipe == 4
+    assert cfg.mesh.model == 2
+    assert cfg.model_id == "llama-2-7b"
+
+
+def test_yaml_roundtrip(tmp_path):
+    p = tmp_path / "cfg.yaml"
+    save_config(Config(), str(p))
+    cfg = load_config(str(p))
+    assert cfg == Config()
+
+
+def test_unknown_key_rejected(tmp_path):
+    p = tmp_path / "cfg.json"
+    p.write_text(json.dumps({"model": {"nun_layers": 24}}))
+    with pytest.raises(ValueError, match="nun_layers"):
+        load_config(str(p))
+
+
+def test_mesh_shape():
+    m = MeshConfig(data=2, pipe=2, model=2)
+    assert m.num_devices == 8
+    assert m.shape == (2, 2, 2, 1, 1)
