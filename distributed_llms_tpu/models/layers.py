@@ -1,0 +1,157 @@
+"""Core transformer layer primitives (pure functions over param pytrees).
+
+Replaces the reference's compute path end-to-end: its "forward" was a
+placeholder per-parameter ``torch.matmul`` (src/worker/node.py:24-32) and its
+model loading leaned on torch/transformers (src/model/loader.py:5-25).  Here
+the decoder blocks are real, written TPU-first:
+
+- params are plain pytrees of jnp arrays, **stacked over the layer axis** so
+  layers run under ``lax.scan`` (one trace, XLA-friendly) and pipeline stages
+  are contiguous slices of the stacked axis;
+- matmuls are einsums in bf16 hitting the MXU; softmax/norms accumulate f32;
+- no data-dependent Python control flow — everything jits.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from ..core.config import ModelConfig
+
+Params = dict[str, Any]
+
+
+# ---------------------------------------------------------------------------
+# Norms
+# ---------------------------------------------------------------------------
+
+def layer_norm(x: jax.Array, scale: jax.Array, bias: jax.Array, eps: float) -> jax.Array:
+    dtype = x.dtype
+    x = x.astype(jnp.float32)
+    mean = jnp.mean(x, axis=-1, keepdims=True)
+    var = jnp.mean(jnp.square(x - mean), axis=-1, keepdims=True)
+    y = (x - mean) * jax.lax.rsqrt(var + eps)
+    return (y * scale.astype(jnp.float32) + bias.astype(jnp.float32)).astype(dtype)
+
+
+def rms_norm(x: jax.Array, scale: jax.Array, eps: float) -> jax.Array:
+    dtype = x.dtype
+    x = x.astype(jnp.float32)
+    y = x * jax.lax.rsqrt(jnp.mean(jnp.square(x), axis=-1, keepdims=True) + eps)
+    return (y * scale.astype(jnp.float32)).astype(dtype)
+
+
+# ---------------------------------------------------------------------------
+# Rotary position embeddings (Llama family)
+# ---------------------------------------------------------------------------
+
+def rope_frequencies(head_dim: int, theta: float) -> jax.Array:
+    """Inverse frequencies, shape [head_dim // 2], float32."""
+    exponent = jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim
+    return 1.0 / (theta**exponent)
+
+
+def apply_rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
+    """Rotate half-pairs.  x: [B, T, H, D]; positions: [B, T] int32.
+
+    Uses the HF/Llama convention: the head dim is split into two halves
+    (x1 = x[..., :D/2], x2 = x[..., D/2:]) rotated jointly — matches the
+    checkpoint layout our converter targets.
+    """
+    half = x.shape[-1] // 2
+    freqs = rope_frequencies(x.shape[-1], theta)  # [half]
+    angles = positions[..., None].astype(jnp.float32) * freqs  # [B, T, half]
+    cos = jnp.cos(angles)[:, :, None, :]  # [B, T, 1, half]
+    sin = jnp.sin(angles)[:, :, None, :]
+    x1, x2 = x[..., :half], x[..., half:]
+    x1f = x1.astype(jnp.float32)
+    x2f = x2.astype(jnp.float32)
+    out = jnp.concatenate([x1f * cos - x2f * sin, x2f * cos + x1f * sin], axis=-1)
+    return out.astype(x.dtype)
+
+
+# ---------------------------------------------------------------------------
+# Attention
+# ---------------------------------------------------------------------------
+
+def repeat_kv(x: jax.Array, q_per_kv: int) -> jax.Array:
+    """[B, S, KVH, D] -> [B, S, KVH*q_per_kv, D] for grouped-query attention."""
+    if q_per_kv == 1:
+        return x
+    b, s, kvh, d = x.shape
+    x = jnp.broadcast_to(x[:, :, :, None, :], (b, s, kvh, q_per_kv, d))
+    return x.reshape(b, s, kvh * q_per_kv, d)
+
+
+def dot_product_attention(
+    q: jax.Array,  # [B, Tq, H, D]
+    k: jax.Array,  # [B, Tk, H, D]
+    v: jax.Array,  # [B, Tk, H, D]
+    mask: jax.Array | None,  # broadcastable to [B, H, Tq, Tk]; True = attend
+) -> jax.Array:
+    """Softmax(QK^T)V with f32 accumulation.  XLA fuses this into MXU-friendly
+    batched matmuls; the Pallas flash kernel in ops/ is the drop-in for long
+    sequences."""
+    scale = q.shape[-1] ** -0.5
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32)
+    logits = logits * scale
+    if mask is not None:
+        logits = jnp.where(mask, logits, jnp.finfo(jnp.float32).min)
+    probs = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum("bhqk,bkhd->bqhd", probs.astype(v.dtype), v)
+    return out
+
+
+def causal_mask(q_positions: jax.Array, k_positions: jax.Array, k_valid: jax.Array | None = None) -> jax.Array:
+    """Boolean mask [B, 1, Tq, Tk]: query at position p attends keys at
+    positions <= p.  ``k_valid`` ([B, Tk] bool) masks unwritten cache slots."""
+    mask = k_positions[:, None, None, :] <= q_positions[:, None, :, None]
+    if k_valid is not None:
+        mask = jnp.logical_and(mask, k_valid[:, None, None, :])
+    return mask
+
+
+# ---------------------------------------------------------------------------
+# Projections (einsum conventions shared by all families)
+# ---------------------------------------------------------------------------
+
+def qkv_project(x: jax.Array, p: Params, cfg: ModelConfig) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """x: [B, T, D] -> q [B, T, H, hd], k/v [B, T, KVH, hd].
+
+    Weight layout: wq [D, H, hd], wk/wv [D, KVH, hd] — head axis explicit so
+    tensor-parallel sharding annotates the head dim directly.
+    """
+    q = jnp.einsum("btd,dhk->bthk", x, p["wq"])
+    k = jnp.einsum("btd,dhk->bthk", x, p["wk"])
+    v = jnp.einsum("btd,dhk->bthk", x, p["wv"])
+    if "bq" in p:
+        q = q + p["bq"]
+        k = k + p["bk"]
+        v = v + p["bv"]
+    return q, k, v
+
+
+def out_project(x: jax.Array, p: Params) -> jax.Array:
+    """x: [B, T, H, hd] -> [B, T, D].  wo: [H, hd, D]."""
+    out = jnp.einsum("bthk,hkd->btd", x, p["wo"])
+    if "bo" in p:
+        out = out + p["bo"]
+    return out
+
+
+def mlp_gelu(x: jax.Array, p: Params) -> jax.Array:
+    """GPT-2 MLP: gelu(x W_in + b) W_out + b."""
+    h = jnp.einsum("btd,df->btf", x, p["w_in"]) + p["b_in"]
+    h = jax.nn.gelu(h, approximate=True)
+    return jnp.einsum("btf,fd->btd", h, p["w_out"]) + p["b_out"]
+
+
+def mlp_swiglu(x: jax.Array, p: Params) -> jax.Array:
+    """Llama MLP: (silu(x W_gate) * (x W_up)) W_down, no biases."""
+    gate = jnp.einsum("btd,df->btf", x, p["w_gate"])
+    up = jnp.einsum("btd,df->btf", x, p["w_up"])
+    h = jax.nn.silu(gate) * up
+    return jnp.einsum("btf,fd->btd", h, p["w_down"])

@@ -1,0 +1,71 @@
+"""Named model presets covering the BASELINE.json ladder configs
+(GPT-2-125M -> TinyLlama-1.1B -> Llama-2-7B -> Llama-2-13B -> Llama-3-70B)
+plus tiny variants for tests.  Replaces the reference's single hard-coded
+model id (run_master.py:17, "facebook/opt-125m")."""
+
+from __future__ import annotations
+
+from dataclasses import replace
+
+from ..core.config import ModelConfig
+
+PRESETS: dict[str, ModelConfig] = {
+    "gpt2-125m": ModelConfig(
+        family="gpt2", vocab_size=50257, hidden_size=768, intermediate_size=3072,
+        num_layers=12, num_heads=12, num_kv_heads=12, max_seq_len=1024,
+        norm_eps=1e-5, tie_embeddings=True,
+    ),
+    "gpt2-medium": ModelConfig(
+        family="gpt2", vocab_size=50257, hidden_size=1024, intermediate_size=4096,
+        num_layers=24, num_heads=16, num_kv_heads=16, max_seq_len=1024,
+        norm_eps=1e-5, tie_embeddings=True,
+    ),
+    "tinyllama-1.1b": ModelConfig(
+        family="llama", vocab_size=32000, hidden_size=2048, intermediate_size=5632,
+        num_layers=22, num_heads=32, num_kv_heads=4, max_seq_len=2048,
+        rope_theta=10000.0, norm_eps=1e-5, tie_embeddings=False,
+    ),
+    "llama-2-7b": ModelConfig(
+        family="llama", vocab_size=32000, hidden_size=4096, intermediate_size=11008,
+        num_layers=32, num_heads=32, num_kv_heads=32, max_seq_len=4096,
+        rope_theta=10000.0, norm_eps=1e-5, tie_embeddings=False,
+    ),
+    "llama-2-13b": ModelConfig(
+        family="llama", vocab_size=32000, hidden_size=5120, intermediate_size=13824,
+        num_layers=40, num_heads=40, num_kv_heads=40, max_seq_len=4096,
+        rope_theta=10000.0, norm_eps=1e-5, tie_embeddings=False,
+    ),
+    "llama-3-70b": ModelConfig(
+        family="llama", vocab_size=128256, hidden_size=8192, intermediate_size=28672,
+        num_layers=80, num_heads=64, num_kv_heads=8, max_seq_len=8192,
+        rope_theta=500000.0, norm_eps=1e-5, tie_embeddings=False,
+    ),
+    # Tiny configs for unit tests / CPU fake-mesh integration tests.
+    "gpt2-tiny": ModelConfig(
+        family="gpt2", vocab_size=256, hidden_size=64, intermediate_size=256,
+        num_layers=4, num_heads=4, num_kv_heads=4, max_seq_len=128,
+        tie_embeddings=True, dtype="float32",
+    ),
+    "llama-tiny": ModelConfig(
+        family="llama", vocab_size=256, hidden_size=64, intermediate_size=176,
+        num_layers=4, num_heads=4, num_kv_heads=2, max_seq_len=128,
+        tie_embeddings=False, dtype="float32",
+    ),
+}
+
+# HF hub repo ids for the checkpoint converter.
+HF_REPOS: dict[str, str] = {
+    "gpt2-125m": "gpt2",
+    "gpt2-medium": "gpt2-medium",
+    "tinyllama-1.1b": "TinyLlama/TinyLlama-1.1B-Chat-v1.0",
+    "llama-2-7b": "meta-llama/Llama-2-7b-hf",
+    "llama-2-13b": "meta-llama/Llama-2-13b-hf",
+    "llama-3-70b": "meta-llama/Meta-Llama-3-70B",
+}
+
+
+def get_preset(name: str, **overrides) -> ModelConfig:
+    if name not in PRESETS:
+        raise KeyError(f"unknown preset {name!r}; have {sorted(PRESETS)}")
+    cfg = PRESETS[name]
+    return replace(cfg, **overrides) if overrides else cfg

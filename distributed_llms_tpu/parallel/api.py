@@ -1,0 +1,176 @@
+"""ParallelModel: one object that places a model on a mesh and runs it.
+
+This is the TPU-native successor of the reference's assign/distribute pair
+(`assign_shards` round-robin at src/master/node.py:84-104 and
+`distribute_shards` shipping pickled bytes over TCP at :106-115): assignment
+becomes PartitionSpecs (specs.py + stages.py), distribution becomes
+``jax.device_put`` onto the mesh, and execution composes
+
+- data parallelism   : batch sharded over 'data' (GSPMD)
+- tensor parallelism : heads/hidden sharded over 'model' (GSPMD collectives)
+- pipeline           : blocks staged over 'pipe' (shard_map + ppermute)
+
+behind a single ``forward`` with the same signature family as
+``models.model.forward`` so the runtime decode loop plugs in unchanged.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..core.config import MeshConfig, ModelConfig
+from ..models import model as model_lib
+from ..models.model import KVCache
+from . import pipeline as pipeline_lib
+from . import specs as specs_lib
+
+Params = Any
+
+
+def staged_param_specs(cfg: ModelConfig, mesh: Mesh) -> Params:
+    """Specs for a tree whose blocks have been reshaped [L,...] ->
+    [pipe, L/pipe, ...]: prepend 'pipe' to block specs, drop it elsewhere."""
+    base = specs_lib.param_specs(cfg, mesh)
+
+    def retag(p: P) -> P:
+        # base block specs lead with the layer axis ('pipe' or None); staged
+        # trees get an explicit leading stage axis sharded over 'pipe'.
+        rest = tuple(p)[1:] if len(p) else ()
+        return P("pipe", None, *rest)
+
+    out = dict(base)
+    out["blocks"] = jax.tree.map(
+        retag, base["blocks"], is_leaf=lambda x: isinstance(x, P)
+    )
+    return out
+
+
+@dataclass(frozen=True)
+class ParallelModel:
+    """Mesh-placed model.  Build with :func:`make_parallel_model`."""
+
+    cfg: ModelConfig
+    mesh: Mesh
+    num_microbatches: int = 1
+
+    @property
+    def num_stages(self) -> int:
+        return self.mesh.shape.get("pipe", 1)
+
+    @property
+    def pipelined(self) -> bool:
+        return self.num_stages > 1
+
+    # -- placement ---------------------------------------------------------
+
+    def shard_params(self, params: Params) -> Params:
+        """Stage (if pipelined) and place params onto the mesh."""
+        if self.pipelined:
+            params = dict(params)
+            params["blocks"] = pipeline_lib.split_stages(params["blocks"], self.num_stages)
+            specs = staged_param_specs(self.cfg, self.mesh)
+        else:
+            specs = specs_lib.param_specs(self.cfg, self.mesh)
+        return jax.tree.map(
+            lambda x, s: jax.device_put(x, NamedSharding(self.mesh, s)), params, specs
+        )
+
+    def init_cache(self, batch: int, max_len: int) -> KVCache:
+        cfg = self.cfg
+        kvh, hd = cfg.num_kv_heads, cfg.head_dim_
+        tp = self.mesh.shape.get("model", 1)
+        kv_ax = "model" if kvh % max(tp, 1) == 0 else None
+        if self.pipelined:
+            p, lp = self.num_stages, cfg.num_layers // self.num_stages
+            shape = (p, lp, batch, max_len, kvh, hd)
+            spec = P("pipe", None, "data", None, kv_ax, None)
+        else:
+            shape = (cfg.num_layers, batch, max_len, kvh, hd)
+            spec = P(None, "data", None, kv_ax, None)
+        sharding = NamedSharding(self.mesh, spec)
+        # with_sharding_constraint works both eagerly and under jit (the
+        # decode loop allocates its cache inside generate_tokens' trace).
+        z = jax.lax.with_sharding_constraint(
+            jnp.zeros(shape, jnp.dtype(cfg.dtype)), sharding
+        )
+        return KVCache(k=z, v=z)
+
+    # -- adapters for runtime.generate (hashable bound methods; frozen
+    # dataclass => stable hash => jit cache hits across calls) --------------
+
+    def as_forward_fn(self):
+        return self._forward_adapter
+
+    def as_make_cache(self):
+        return self._make_cache_adapter
+
+    def _forward_adapter(
+        self, params, cfg, tokens, positions=None, cache=None,
+        cache_index=None, attn_mask=None,
+    ):
+        del cfg  # self.cfg is authoritative
+        return self.forward(
+            params, tokens, positions=positions, cache=cache,
+            cache_index=cache_index, attn_mask=attn_mask,
+        )
+
+    def _make_cache_adapter(self, cfg, batch, max_len):
+        del cfg
+        return self.init_cache(batch, max_len)
+
+    # -- execution ---------------------------------------------------------
+
+    def forward(
+        self,
+        params: Params,
+        tokens: jax.Array,
+        positions: jax.Array | None = None,
+        cache: KVCache | None = None,
+        cache_index: jax.Array | None = None,
+        attn_mask: jax.Array | None = None,
+        remat: bool = False,
+    ) -> tuple[jax.Array, KVCache | None]:
+        """Same contract as models.model.forward, but mesh-parallel."""
+        cfg = self.cfg
+        if not self.pipelined:
+            return model_lib.forward(
+                params, cfg, tokens, positions=positions, cache=cache,
+                cache_index=cache_index, remat=remat, attn_mask=attn_mask,
+            )
+
+        b, t = tokens.shape
+        if positions is None:
+            base = cache_index if cache_index is not None else 0
+            positions = jnp.broadcast_to(jnp.arange(t, dtype=jnp.int32) + base, (b, t))
+        x = model_lib.embed(params, cfg, tokens, positions)
+        y, new_cache = pipeline_lib.pipeline_blocks(
+            self.mesh, cfg, params["blocks"], x, positions,
+            num_microbatches=self.num_microbatches,
+            cache_k=cache.k if cache is not None else None,
+            cache_v=cache.v if cache is not None else None,
+            cache_index=cache_index, attn_mask=attn_mask, remat=remat,
+        )
+        logits = model_lib.unembed(params, cfg, y)
+        if cache is None:
+            return logits, None
+        nk, nv = new_cache
+        return logits, KVCache(k=nk, v=nv)
+
+
+def make_parallel_model(
+    cfg: ModelConfig, mesh_cfg: MeshConfig, num_microbatches: int = 1,
+    devices: list | None = None,
+) -> ParallelModel:
+    from ..core.mesh import build_mesh
+
+    mesh = build_mesh(mesh_cfg, devices)
+    if mesh_cfg.pipe > 1 and cfg.num_layers % mesh_cfg.pipe:
+        raise ValueError(
+            f"num_layers {cfg.num_layers} not divisible by pipe {mesh_cfg.pipe}"
+        )
+    return ParallelModel(cfg=cfg, mesh=mesh, num_microbatches=num_microbatches)

@@ -1,0 +1,192 @@
+"""True pipeline parallelism over a `pipe` mesh axis.
+
+The reference's "pipeline" never pipelined: every worker received the same
+input and the master collected partials (fan-out/fan-in star,
+src/master/node.py:256-269) — activations never flowed worker->worker
+(SURVEY §2.3).  Here activations hop stage->stage over ICI via
+``lax.ppermute`` inside ``shard_map``:
+
+- stacked block params [L, ...] are reshaped to [P, L/P, ...] and sharded
+  over 'pipe' — each device owns a contiguous layer block (stage);
+- a GPipe microbatch schedule runs as a ``lax.scan`` over
+  ``num_microbatches + P - 1`` ticks; at each tick every stage processes one
+  microbatch and the results rotate one stage forward;
+- the schedule is a pure scan over ppermute/dynamic-slice ops, so
+  ``jax.grad`` differentiates straight through it — the backward pipeline
+  schedule falls out of autodiff, no hand-written 1F1B needed;
+- the 'model' (tensor-parallel) and 'data' axes stay GSPMD-auto inside the
+  body (``axis_names={'pipe'}``), so TP composes with PP without manual
+  collectives.
+
+KV-cache decoding: each stage owns the cache slice for its layers
+([P, L/P, B, S, KVH, HD] sharded over 'pipe'); at tick t stage s updates the
+batch rows of microbatch (t - s), predicated so bubble ticks write no-ops.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..core.config import ModelConfig
+from ..models import model as model_lib
+
+Params = Any
+
+
+def split_stages(blocks: Params, num_stages: int) -> Params:
+    """[L, ...] stacked block params -> [P, L/P, ...]."""
+    def r(a):
+        l = a.shape[0]
+        if l % num_stages:
+            raise ValueError(f"layers {l} not divisible by stages {num_stages}")
+        return a.reshape(num_stages, l // num_stages, *a.shape[1:])
+
+    return jax.tree.map(r, blocks)
+
+
+def merge_stages(blocks: Params) -> Params:
+    """[P, L/P, ...] -> [L, ...]."""
+    return jax.tree.map(lambda a: a.reshape(a.shape[0] * a.shape[1], *a.shape[2:]), blocks)
+
+
+def _split_mb(x: jax.Array, m: int) -> jax.Array:
+    b = x.shape[0]
+    if b % m:
+        raise ValueError(f"batch {b} not divisible by microbatches {m}")
+    return x.reshape(m, b // m, *x.shape[1:])
+
+
+def pipeline_blocks(
+    mesh: Mesh,
+    cfg: ModelConfig,
+    staged_blocks: Params,  # [P, L/P, ...] sharded over 'pipe'
+    x: jax.Array,  # [B, T, D] activations after embed
+    positions: jax.Array,  # [B, T]
+    num_microbatches: int,
+    cache_k: jax.Array | None = None,  # [P, L/P, B, S, KVH, HD]
+    cache_v: jax.Array | None = None,
+    cache_index: jax.Array | None = None,  # scalar int32
+    attn_mask: jax.Array | None = None,  # [B, 1, Tq, S]
+    remat: bool = False,
+) -> tuple[jax.Array, tuple[jax.Array, jax.Array] | None]:
+    """Run the decoder blocks through the pipeline.  Returns ([B, T, D],
+    updated staged caches or None)."""
+    num_stages = mesh.shape["pipe"]
+    m = num_microbatches
+    use_cache = cache_k is not None
+
+    x_mb = _split_mb(x, m)  # [M, mb, T, D]
+    pos_mb = _split_mb(positions, m)
+    use_mask = attn_mask is not None
+    # shard_map wants arrays, not None: dummy when unused (never read).
+    mask_mb = (
+        _split_mb(attn_mask, m) if use_mask else jnp.zeros((m, 1, 1, 1, 1), dtype=bool)
+    )
+    mb_size = x_mb.shape[1]
+
+    def body(staged_blocks, x_mb, pos_mb, cache_k, cache_v, mask_mb):
+        # Per-device views: leading 'pipe' axis has local size 1 -> squeeze.
+        blocks = jax.tree.map(lambda a: a[0], staged_blocks)
+        stage = jax.lax.axis_index("pipe")
+        ck = cache_k[0] if use_cache else None  # [L/P, B, S, KVH, HD]
+        cv = cache_v[0] if use_cache else None
+
+        # Mark per-stage buffers as varying over 'pipe' for vma tracking.
+        out_mb = jax.lax.pcast(jnp.zeros_like(x_mb), ("pipe",), to="varying")
+
+        def tick(carry, t):
+            state, out_mb, ck, cv = carry
+            mb_idx = jnp.clip(t - stage, 0, m - 1)
+            valid = jnp.logical_and(t - stage >= 0, t - stage < m)
+
+            x_in = jnp.where(
+                stage == 0,
+                jax.lax.dynamic_index_in_dim(x_mb, mb_idx, keepdims=False),
+                state,
+            )
+            pos = jax.lax.dynamic_index_in_dim(pos_mb, mb_idx, keepdims=False)
+            amask = (
+                jax.lax.dynamic_index_in_dim(mask_mb, mb_idx, keepdims=False)
+                if use_mask
+                else None
+            )
+
+            if use_cache:
+                row0 = mb_idx * mb_size
+                ck_mb = jax.lax.dynamic_slice_in_dim(ck, row0, mb_size, axis=1)
+                cv_mb = jax.lax.dynamic_slice_in_dim(cv, row0, mb_size, axis=1)
+                y, (nk, nv) = model_lib.run_blocks(
+                    x_in, blocks, cfg, pos, ck_mb, cv_mb, cache_index,
+                    remat=remat, attn_mask=amask,
+                )
+                nk = jnp.where(valid, nk, ck_mb)
+                nv = jnp.where(valid, nv, cv_mb)
+                ck = jax.lax.dynamic_update_slice_in_dim(ck, nk, row0, axis=1)
+                cv = jax.lax.dynamic_update_slice_in_dim(cv, nv, row0, axis=1)
+            else:
+                y, _ = model_lib.run_blocks(
+                    x_in, blocks, cfg, pos, None, None, None,
+                    remat=remat, attn_mask=amask,
+                )
+
+            # Last stage banks its finished microbatch.
+            out_idx = jnp.clip(t - (num_stages - 1), 0, m - 1)
+            bank = jnp.logical_and(stage == num_stages - 1, t >= num_stages - 1)
+            cur = jax.lax.dynamic_index_in_dim(out_mb, out_idx, keepdims=False)
+            out_mb = jax.lax.dynamic_update_index_in_dim(
+                out_mb, jnp.where(bank, y, cur), out_idx, axis=0
+            )
+
+            # Rotate activations one stage forward (circular; stage 0 ignores
+            # what it receives and reads the next fresh microbatch instead).
+            state = jax.lax.ppermute(
+                y, "pipe", [(i, (i + 1) % num_stages) for i in range(num_stages)]
+            )
+            return (state, out_mb, ck, cv), None
+
+        state0 = jax.lax.pcast(jnp.zeros_like(x_mb[0]), ("pipe",), to="varying")
+        carry = (state0, out_mb, ck, cv)
+        (state, out_mb, ck, cv), _ = jax.lax.scan(
+            tick, carry, jnp.arange(m + num_stages - 1)
+        )
+        if use_cache:
+            return out_mb[None], ck[None], cv[None]
+        return (out_mb[None],)
+
+    in_specs = (
+        P("pipe"),  # staged blocks
+        P(),        # x_mb (replicated over pipe; data/model axes stay auto)
+        P(),        # pos_mb
+        P("pipe") if use_cache else P(),
+        P("pipe") if use_cache else P(),
+        P(),        # mask_mb
+    )
+    out_specs = (P("pipe"), P("pipe"), P("pipe")) if use_cache else (P("pipe"),)
+
+    result = jax.shard_map(
+        body,
+        mesh=mesh,
+        in_specs=in_specs,
+        out_specs=out_specs,
+        axis_names={"pipe"},
+        check_vma=True,
+    )(
+        staged_blocks, x_mb, pos_mb,
+        cache_k if use_cache else jnp.zeros((num_stages, 1)),
+        cache_v if use_cache else jnp.zeros((num_stages, 1)),
+        mask_mb,
+    )
+
+    if use_cache:
+        out_all, new_ck, new_cv = result
+    else:
+        (out_all,) = result
+        new_ck = new_cv = None
+
+    # out_all: [P, M, mb, T, D]; only the last stage's bank is meaningful.
+    y = out_all[-1].reshape(x.shape)
+    return y, ((new_ck, new_cv) if use_cache else None)

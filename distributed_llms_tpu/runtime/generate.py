@@ -1,0 +1,143 @@
+"""Autoregressive generation: jitted prefill + lax.scan decode loop.
+
+This is the real replacement for the reference's RUN_INFERENCE path
+(src/master/node.py:227-277 -> src/worker/node.py:218-238), which did one
+placeholder matmul per worker and returned the first worker's raw partial
+(defect D9).  Here: prefill fills the KV cache for the whole (right-padded)
+prompt in one pass, then a ``lax.scan`` emits one token per step with
+EOS-aware freezing — all inside a single jit, static shapes throughout.
+
+Ragged batches: prompts are right-padded to T.  Every decode step writes all
+rows' K/V at the *same* cache slot (T + step) so the update is a single
+``dynamic_update_slice``; per-row token positions (``prompt_lens + step``)
+feed RoPE / learned position embeddings, and an explicit attention mask keeps
+each row attending only its own real prompt slots plus generated slots.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from ..core.config import ModelConfig, RuntimeConfig
+from ..models import model as model_lib
+from . import sampling
+
+
+def _default_forward(params, cfg, tokens, positions=None, cache=None, cache_index=None, attn_mask=None):
+    return model_lib.forward(
+        params, cfg, tokens, positions=positions, cache=cache,
+        cache_index=cache_index, attn_mask=attn_mask,
+    )
+
+
+@partial(
+    jax.jit,
+    static_argnames=(
+        "cfg", "max_new_tokens", "temperature", "top_k", "top_p", "eos_id",
+        "pad_id", "forward_fn", "make_cache",
+    ),
+)
+def generate_tokens(
+    params: Any,
+    cfg: ModelConfig,
+    prompt: jax.Array,  # [B, T] int32, right-padded with pad_id
+    prompt_lens: jax.Array,  # [B] int32 true lengths
+    rng: jax.Array,
+    max_new_tokens: int,
+    temperature: float = 0.0,
+    top_k: int = 0,
+    top_p: float = 1.0,
+    eos_id: int = -1,  # -1 => never stops early
+    pad_id: int = 0,
+    forward_fn: Any = None,  # (params, cfg, tokens, positions=, cache=, cache_index=, attn_mask=) -> (logits, cache)
+    make_cache: Any = None,  # (cfg, batch, max_len) -> KVCache
+) -> jax.Array:
+    """Generate.  Returns new tokens [B, max_new_tokens] int32; positions
+    after a sequence's EOS are filled with pad_id.
+
+    ``forward_fn``/``make_cache`` default to the single-device model; a
+    mesh-parallel model (parallel.api.ParallelModel) plugs in its own.
+
+    The KV cache is sized T + max_new_tokens exactly, so the
+    ``cache_index + T <= max_len`` contract of models.model.forward holds by
+    construction.
+    """
+    if forward_fn is None:
+        forward_fn = _default_forward
+    if make_cache is None:
+        make_cache = model_lib.init_cache
+    b, t = prompt.shape
+    max_len = t + max_new_tokens
+    cache = make_cache(cfg, b, max_len)
+
+    # --- prefill: causal attention over prompt slots (pad queries produce
+    # garbage but nothing reads their logits; pad K/V slots are masked during
+    # decode via the explicit mask below).
+    positions = jnp.broadcast_to(jnp.arange(t, dtype=jnp.int32), (b, t))
+    logits, cache = forward_fn(
+        params, cfg, prompt, positions=positions, cache=cache, cache_index=jnp.int32(0)
+    )
+    last_idx = jnp.maximum(prompt_lens - 1, 0)
+    next_logits = jnp.take_along_axis(logits, last_idx[:, None, None], axis=1)[:, 0]
+
+    slots = jnp.arange(max_len, dtype=jnp.int32)  # [S]
+    prompt_valid = slots[None, :] < prompt_lens[:, None]  # [B, S]
+
+    def step(carry, inputs):
+        cache, cur_logits, done = carry
+        j, rng_step = inputs
+        tok = sampling.sample(rng_step, cur_logits, temperature, top_k, top_p)
+        tok = jnp.where(done, jnp.int32(pad_id), tok)
+        if eos_id >= 0:
+            done = jnp.logical_or(done, tok == eos_id)
+        # Valid keys: real prompt slots + generated slots up to and including
+        # this step's write slot (t + j).
+        gen_valid = jnp.logical_and(slots[None, :] >= t, slots[None, :] <= t + j)
+        mask = jnp.logical_or(prompt_valid, gen_valid)[:, None, None, :]  # [B,1,1,S]
+        positions = (prompt_lens + j)[:, None]  # [B, 1]
+        logits, new_cache = forward_fn(
+            params, cfg, tok[:, None],
+            positions=positions, cache=cache, cache_index=t + j, attn_mask=mask,
+        )
+        return (new_cache, logits[:, 0], done), tok
+
+    rngs = jax.random.split(rng, max_new_tokens)
+    steps = jnp.arange(max_new_tokens, dtype=jnp.int32)
+    done0 = jnp.zeros((b,), dtype=bool)
+    _, toks = jax.lax.scan(step, (cache, next_logits, done0), (steps, rngs))
+    return toks.T  # [B, N]
+
+
+def generate(
+    params: Any,
+    cfg: ModelConfig,
+    rt: RuntimeConfig,
+    prompt: jax.Array,
+    prompt_lens: jax.Array | None = None,
+    rng: jax.Array | None = None,
+    eos_id: int = -1,
+    pad_id: int = 0,
+) -> jax.Array:
+    """Convenience wrapper binding knobs from a RuntimeConfig."""
+    b, t = prompt.shape
+    if prompt_lens is None:
+        prompt_lens = jnp.full((b,), t, dtype=jnp.int32)
+    if rng is None:
+        rng = jax.random.key(rt.seed)
+    limit = min(rt.max_seq_len, cfg.max_seq_len)
+    if t + rt.max_decode_steps > limit:
+        raise ValueError(
+            f"prompt len {t} + max_decode_steps {rt.max_decode_steps} exceeds "
+            f"sequence limit {limit} (min of runtime {rt.max_seq_len} and "
+            f"model {cfg.max_seq_len})"
+        )
+    return generate_tokens(
+        params, cfg, prompt, prompt_lens, rng,
+        max_new_tokens=rt.max_decode_steps,
+        temperature=rt.temperature, top_k=rt.top_k, top_p=rt.top_p,
+        eos_id=eos_id, pad_id=pad_id,
+    )

@@ -1,0 +1,67 @@
+"""Token sampling: greedy, temperature, top-k, top-p.
+
+The reference has no sampling or decode loop at all — its "inference" is a
+single placeholder matmul (src/worker/node.py:24-32; SURVEY §2.5) — while its
+plan promises real inference (plan.md:235-239).  All samplers here are pure,
+jittable, and batched."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.config import RuntimeConfig
+
+
+def greedy(logits: jax.Array) -> jax.Array:
+    """[B, V] -> [B] int32."""
+    return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+
+def _mask_top_k(logits: jax.Array, k: int) -> jax.Array:
+    if k <= 0:
+        return logits
+    vals, _ = jax.lax.top_k(logits, k)
+    cutoff = vals[..., -1:]
+    return jnp.where(logits < cutoff, -jnp.inf, logits)
+
+
+def _mask_top_p(logits: jax.Array, p: float) -> jax.Array:
+    if p >= 1.0:
+        return logits
+    sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
+    probs = jax.nn.softmax(sorted_logits, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    # keep the smallest prefix with cumulative prob >= p (always keep top-1)
+    keep_sorted = cum - probs < p
+    cutoff = jnp.max(jnp.where(keep_sorted, sorted_logits, -jnp.inf), axis=-1, keepdims=True)
+    return jnp.where(logits < cutoff, -jnp.inf, logits)
+
+
+def sample(
+    rng: jax.Array,
+    logits: jax.Array,  # [B, V] float32
+    temperature: float = 0.0,
+    top_k: int = 0,
+    top_p: float = 1.0,
+) -> jax.Array:
+    """Sample next tokens [B].  temperature == 0 -> greedy (rng unused).
+
+    temperature/top_k/top_p are Python floats (static under jit): the sampler
+    specializes at trace time, so the greedy path compiles to a bare argmax.
+    """
+    if temperature == 0.0:
+        return greedy(logits)
+    logits = logits / temperature
+    logits = _mask_top_k(logits, top_k)
+    logits = _mask_top_p(logits, top_p)
+    return jax.random.categorical(rng, logits, axis=-1).astype(jnp.int32)
+
+
+def sampler_from_config(rt: RuntimeConfig):
+    """Bind the static sampling knobs from a RuntimeConfig."""
+
+    def fn(rng: jax.Array, logits: jax.Array) -> jax.Array:
+        return sample(rng, logits, rt.temperature, rt.top_k, rt.top_p)
+
+    return fn

@@ -1,0 +1,99 @@
+"""Training step: next-token cross-entropy + optax optimizer, mesh-parallel.
+
+The reference has no training path at all (no gradients, no optimizer —
+SURVEY "What it is"), but a TPU-native framework's parallel layers must be
+differentiable end-to-end: the pipeline schedule (parallel/pipeline.py) is a
+pure ``lax.scan`` over ``ppermute`` hops, so ``jax.grad`` derives the
+backward pipeline automatically, and GSPMD handles gradient collectives for
+the tensor/data axes.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from ..core.config import ModelConfig
+from ..models import model as model_lib
+from ..parallel.api import ParallelModel
+
+Params = Any
+
+
+def cross_entropy_loss(
+    logits: jax.Array,  # [B, T, V] float32
+    targets: jax.Array,  # [B, T] int32
+    mask: jax.Array | None = None,  # [B, T] float/bool; 0 => ignore
+) -> jax.Array:
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    if mask is None:
+        return -jnp.mean(ll)
+    mask = mask.astype(jnp.float32)
+    return -jnp.sum(ll * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+
+
+def lm_loss(
+    params: Params,
+    cfg: ModelConfig,
+    tokens: jax.Array,  # [B, T+1]: input = [:, :-1], target = [:, 1:]
+    mask: jax.Array | None = None,
+    forward_fn: Any = None,
+    remat: bool = False,
+) -> jax.Array:
+    inputs, targets = tokens[:, :-1], tokens[:, 1:]
+    if forward_fn is None:
+        logits, _ = model_lib.forward(params, cfg, inputs, remat=remat)
+    else:
+        logits, _ = forward_fn(params, cfg, inputs, remat=remat)
+    tmask = mask[:, 1:] if mask is not None else None
+    return cross_entropy_loss(logits, targets, tmask)
+
+
+@dataclass
+class Trainer:
+    """Holds optimizer + compiled step.  Works single-device or over a mesh
+    (pass a ParallelModel)."""
+
+    cfg: ModelConfig
+    optimizer: optax.GradientTransformation
+    parallel: ParallelModel | None = None
+    remat: bool = False
+
+    def init(self, params: Params) -> Any:
+        return self.optimizer.init(params)
+
+    def make_step(self):
+        """Returns jitted (params, opt_state, tokens, mask) ->
+        (params, opt_state, loss)."""
+        cfg = self.cfg
+        pm = self.parallel
+        remat = self.remat
+
+        def fwd(params, cfg, inputs, remat=False):
+            if pm is None:
+                return model_lib.forward(params, cfg, inputs, remat=remat)
+            return pm.forward(params, inputs, remat=remat)
+
+        @partial(jax.jit, donate_argnums=(0, 1))
+        def step(params, opt_state, tokens, mask):
+            loss, grads = jax.value_and_grad(lm_loss)(
+                params, cfg, tokens, mask, forward_fn=fwd, remat=remat
+            )
+            updates, opt_state = self.optimizer.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            return params, opt_state, loss
+
+        return step
+
+
+def default_optimizer(lr: float = 3e-4, weight_decay: float = 0.01) -> optax.GradientTransformation:
+    return optax.chain(
+        optax.clip_by_global_norm(1.0),
+        optax.adamw(lr, weight_decay=weight_decay),
+    )
