@@ -1,0 +1,88 @@
+#!/usr/bin/env python
+"""Benchmark harness (driver contract: prints ONE JSON line).
+
+Measures greedy-decode throughput of GPT-2-125M (BASELINE.md ladder config 1)
+on the available accelerator.  The reference publishes no numbers
+(SURVEY §6: README is a title line, no benchmarks/ dir, placeholder compute),
+so ``vs_baseline`` is reported against the driver's north-star target of
+1000 tok/s aggregate (BASELINE.json).
+
+Usage: python bench.py [--preset gpt2-125m] [--batch 8] [--prompt-len 64]
+       [--new-tokens 64] [--dtype bfloat16]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+
+from distributed_llms_tpu.models import model as model_lib
+from distributed_llms_tpu.models.presets import get_preset
+from distributed_llms_tpu.runtime import generate as gen_lib
+
+NORTH_STAR_TOKS_PER_S = 1000.0  # BASELINE.json: >=1000 tok/s aggregate
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--preset", default="gpt2-125m")
+    ap.add_argument("--batch", type=int, default=8)
+    ap.add_argument("--prompt-len", type=int, default=64)
+    ap.add_argument("--new-tokens", type=int, default=64)
+    ap.add_argument("--dtype", default="bfloat16")
+    ap.add_argument("--iters", type=int, default=3)
+    args = ap.parse_args()
+
+    cfg = get_preset(args.preset, dtype=args.dtype)
+    params = model_lib.init_params(jax.random.key(0), cfg)
+    prompt = jax.random.randint(
+        jax.random.key(1), (args.batch, args.prompt_len), 0, cfg.vocab_size, dtype=jnp.int32
+    )
+    lens = jnp.full((args.batch,), args.prompt_len, dtype=jnp.int32)
+    rng = jax.random.key(2)
+
+    # The axon-tunneled TPU has ~80ms constant dispatch/transfer overhead and
+    # a block_until_ready that does NOT actually block, so we (a) force a host
+    # transfer with np.asarray and (b) use a two-point measurement — time
+    # decode at N and 2N tokens and take the delta — which cancels the
+    # constant overhead and the (shared) prefill cost.
+    import numpy as np
+
+    def timed(n_new: int) -> float:
+        # compile (separate trace per static n_new)
+        np.asarray(
+            gen_lib.generate_tokens(params, cfg, prompt, lens, rng, max_new_tokens=n_new)
+        )
+        times = []
+        for _ in range(args.iters):
+            t0 = time.perf_counter()
+            np.asarray(
+                gen_lib.generate_tokens(params, cfg, prompt, lens, rng, max_new_tokens=n_new)
+            )
+            times.append(time.perf_counter() - t0)
+        return min(times)
+
+    n1, n2 = args.new_tokens, 2 * args.new_tokens
+    t1, t2 = timed(n1), timed(n2)
+    if t2 <= t1:  # overhead-dominated; fall back to the single-shot number
+        tps = args.batch * n2 / t2
+    else:
+        tps = args.batch * (n2 - n1) / (t2 - t1)
+
+    n_chips = jax.device_count()
+    result = {
+        "metric": f"decode tokens/sec ({args.preset}, batch={args.batch}, "
+        f"{jax.devices()[0].platform}x{n_chips})",
+        "value": round(tps, 2),
+        "unit": "tok/s",
+        "vs_baseline": round(tps / NORTH_STAR_TOKS_PER_S, 4),
+    }
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

@@ -85,14 +85,8 @@ class InferenceEngine:
         tok = self.tokenizer
         seqs = [tok.encode(p) for p in prompts]
         prompt_arr, lens = pad_batch(seqs, tok.pad_id)
-        n_new = max_new_tokens or self.rt.max_decode_steps
-        limit = min(self.rt.max_seq_len, self.cfg.max_seq_len)
-        if prompt_arr.shape[1] + n_new > limit:
-            raise ValueError(
-                f"prompt len {prompt_arr.shape[1]} + {n_new} new tokens exceeds "
-                f"sequence limit {limit} (min of runtime {self.rt.max_seq_len} "
-                f"and model {self.cfg.max_seq_len})"
-            )
+        n_new = self.rt.max_decode_steps if max_new_tokens is None else max_new_tokens
+        gen_lib.check_sequence_budget(prompt_arr.shape[1], n_new, self.rt, self.cfg)
         rng = jax.random.key(seed if seed is not None else self.rt.seed)
 
         t0 = time.perf_counter()

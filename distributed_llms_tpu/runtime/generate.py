@@ -112,6 +112,20 @@ def generate_tokens(
     return toks.T  # [B, N]
 
 
+def check_sequence_budget(
+    prompt_len: int, max_new_tokens: int, rt: RuntimeConfig, cfg: ModelConfig
+) -> None:
+    """Shared guard: prompt + decode budget must fit both the runtime limit
+    and the model's position table (GPT-2 wpe indexes OOB -> NaN fill)."""
+    limit = min(rt.max_seq_len, cfg.max_seq_len)
+    if prompt_len + max_new_tokens > limit:
+        raise ValueError(
+            f"prompt len {prompt_len} + {max_new_tokens} new tokens exceeds "
+            f"sequence limit {limit} (min of runtime {rt.max_seq_len} and "
+            f"model {cfg.max_seq_len})"
+        )
+
+
 def generate(
     params: Any,
     cfg: ModelConfig,
@@ -128,13 +142,7 @@ def generate(
         prompt_lens = jnp.full((b,), t, dtype=jnp.int32)
     if rng is None:
         rng = jax.random.key(rt.seed)
-    limit = min(rt.max_seq_len, cfg.max_seq_len)
-    if t + rt.max_decode_steps > limit:
-        raise ValueError(
-            f"prompt len {t} + max_decode_steps {rt.max_decode_steps} exceeds "
-            f"sequence limit {limit} (min of runtime {rt.max_seq_len} and "
-            f"model {cfg.max_seq_len})"
-        )
+    check_sequence_budget(t, rt.max_decode_steps, rt, cfg)
     return generate_tokens(
         params, cfg, prompt, prompt_lens, rng,
         max_new_tokens=rt.max_decode_steps,

@@ -32,9 +32,10 @@ def _mask_top_p(logits: jax.Array, p: float) -> jax.Array:
     sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
     probs = jax.nn.softmax(sorted_logits, axis=-1)
     cum = jnp.cumsum(probs, axis=-1)
-    # keep the smallest prefix with cumulative prob >= p (always keep top-1)
+    # keep the smallest prefix with cumulative prob >= p (always keep top-1);
+    # cutoff = smallest kept logit, so everything below it is masked
     keep_sorted = cum - probs < p
-    cutoff = jnp.max(jnp.where(keep_sorted, sorted_logits, -jnp.inf), axis=-1, keepdims=True)
+    cutoff = jnp.min(jnp.where(keep_sorted, sorted_logits, jnp.inf), axis=-1, keepdims=True)
     return jnp.where(logits < cutoff, -jnp.inf, logits)
 
 

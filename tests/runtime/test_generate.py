@@ -115,3 +115,14 @@ def test_engine_rejects_vocab_mismatch():
     engine must reject the pairing loudly."""
     with pytest.raises(ValueError, match="vocab"):
         InferenceEngine.from_preset("gpt2-tiny", RuntimeConfig())  # vocab 256 < 259
+
+
+def test_top_p_keeps_nucleus_not_just_top1():
+    """Regression: top-p cutoff must be the *min* kept logit — with p=0.9 the
+    nucleus {3,2,1} of [[0,1,2,3]] should all be sampleable."""
+    logits = jnp.log(jnp.array([[0.05, 0.15, 0.3, 0.5]]))
+    seen = set()
+    for i in range(120):
+        t = sampling.sample(jax.random.key(i), logits, temperature=1.0, top_p=0.9)
+        seen.add(int(t[0]))
+    assert seen == {1, 2, 3}, seen
