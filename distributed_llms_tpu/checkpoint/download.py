@@ -1,0 +1,35 @@
+"""Model acquisition: HF Hub snapshot download (network-gated) + local paths.
+
+Parity with the reference's downloader (`snapshot_download(repo_id,
+cache_dir="./models")`, src/model/downloader.py:4-6), with the offline case
+handled explicitly instead of crashing: a local directory path is used as-is,
+and a missing-network download raises a clear error naming the fix.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def fetch_model(model_id_or_path: str, cache_dir: str = "./models") -> str:
+    """Return a local directory containing the model checkpoint.
+
+    - existing local path -> returned unchanged
+    - otherwise -> huggingface_hub.snapshot_download (requires network)
+    """
+    if os.path.isdir(model_id_or_path):
+        return model_id_or_path
+    try:
+        from huggingface_hub import snapshot_download
+    except ImportError as e:  # pragma: no cover
+        raise RuntimeError(
+            "huggingface_hub is not installed and "
+            f"{model_id_or_path!r} is not a local directory"
+        ) from e
+    try:
+        return snapshot_download(repo_id=model_id_or_path, cache_dir=cache_dir)
+    except Exception as e:
+        raise RuntimeError(
+            f"could not download {model_id_or_path!r} (offline?); pass a local "
+            "checkpoint directory instead"
+        ) from e

@@ -1,0 +1,174 @@
+"""Sharded checkpoint store: per-shard .npz files + JSON manifest.
+
+Successor of the reference's shard store (`shard_<i>.pt` + `shard_info.json`
++ copied config.json, src/model/shard_manager.py:63-74) with its defects
+fixed by construction: no pickle anywhere (npz + JSON), explicit param names
+(no fragile layer-index parsing, D6), safetensors-native upstream (D5).
+
+Layout on disk:
+    <dir>/manifest.json   {params: {name: {shard, shape, dtype, quant...}},
+                           num_shards, model_config, quantization}
+    <dir>/shard_<i>.npz   flat arrays for the params packed into shard i
+
+Packing uses the reference's greedy byte-balanced algorithm
+(parallel.stages.pack_greedy).  ``load_shards`` can read a subset of shards
+(a pipeline host loads only its stages' params) and ``reconstruct`` merges
+everything back — the `reconstruct_model` parity point
+(src/model/shard_manager.py:82-93).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from typing import Any
+
+import jax
+import numpy as np
+
+from ..core.config import ModelConfig
+from ..parallel.stages import pack_greedy
+from . import quantize as quant_lib
+from .quantize import QuantizedTensor
+
+SEP = "/"
+MANIFEST = "manifest.json"
+
+
+def _flatten(params: Any) -> dict[str, Any]:
+    flat = {}
+    for path, leaf in jax.tree_util.tree_flatten_with_path(
+        params, is_leaf=lambda x: isinstance(x, QuantizedTensor)
+    )[0]:
+        name = SEP.join(str(getattr(p, "key", p)) for p in path)
+        flat[name] = leaf
+    return flat
+
+
+def _unflatten(flat: dict[str, Any]) -> dict[str, Any]:
+    tree: dict[str, Any] = {}
+    for name, leaf in flat.items():
+        node = tree
+        parts = name.split(SEP)
+        for p in parts[:-1]:
+            node = node.setdefault(p, {})
+        node[parts[-1]] = leaf
+    return tree
+
+
+def save_shards(
+    params: Any,
+    out_dir: str,
+    num_shards: int = 1,
+    model_config: ModelConfig | None = None,
+    quantization: str | None = None,  # None | "int8" | "int4"
+    quant_block: int = 128,
+) -> dict:
+    """Write params (optionally quantizing first) into a sharded store.
+    Returns the manifest dict."""
+    os.makedirs(out_dir, exist_ok=True)
+    if quantization:
+        bits = {"int8": 8, "int4": 4}[quantization]
+        params = quant_lib.quantize_tree(params, bits=bits, block=quant_block)
+
+    flat = _flatten(params)
+    sizes = {}
+    for name, leaf in flat.items():
+        if isinstance(leaf, QuantizedTensor):
+            sizes[name] = leaf.data.size + leaf.scale.size * 4
+        else:
+            sizes[name] = int(np.asarray(leaf).nbytes)
+    assignment = pack_greedy(sizes, num_shards)
+
+    entries: dict[str, dict] = {}
+    shard_arrays: list[dict[str, np.ndarray]] = [dict() for _ in range(num_shards)]
+    for name, leaf in flat.items():
+        shard = assignment[name]
+        if isinstance(leaf, QuantizedTensor):
+            shard_arrays[shard][name + ".q"] = np.asarray(leaf.data)
+            shard_arrays[shard][name + ".scale"] = np.asarray(leaf.scale)
+            entries[name] = {
+                "shard": shard,
+                "shape": list(leaf.orig_shape),
+                "dtype": "quantized",
+                "bits": leaf.bits,
+            }
+        else:
+            arr = np.asarray(leaf)
+            # npz has no bfloat16: store raw bytes viewed as uint16.
+            if arr.dtype == jax.numpy.bfloat16:
+                shard_arrays[shard][name] = arr.view(np.uint16)
+                entries[name] = {"shard": shard, "shape": list(arr.shape), "dtype": "bfloat16"}
+            else:
+                shard_arrays[shard][name] = arr
+                entries[name] = {"shard": shard, "shape": list(arr.shape), "dtype": str(arr.dtype)}
+
+    for i, arrays in enumerate(shard_arrays):
+        np.savez(os.path.join(out_dir, f"shard_{i}.npz"), **arrays)
+
+    manifest = {
+        "format_version": 1,
+        "num_shards": num_shards,
+        "quantization": quantization,
+        "params": entries,
+        "model_config": dataclasses.asdict(model_config) if model_config else None,
+    }
+    with open(os.path.join(out_dir, MANIFEST), "w") as f:
+        json.dump(manifest, f, indent=1)
+    return manifest
+
+
+def load_manifest(store_dir: str) -> dict:
+    with open(os.path.join(store_dir, MANIFEST)) as f:
+        return json.load(f)
+
+
+def load_shards(
+    store_dir: str,
+    shards: list[int] | None = None,
+    dequantize: bool = False,
+    dtype: Any = None,
+) -> dict[str, Any]:
+    """Load params from the store (optionally only some shards).  Returns the
+    nested param tree containing only the params present in those shards."""
+    manifest = load_manifest(store_dir)
+    wanted = set(range(manifest["num_shards"])) if shards is None else set(shards)
+    missing = wanted - set(range(manifest["num_shards"]))
+    if missing:
+        raise ValueError(f"store has {manifest['num_shards']} shards; no {sorted(missing)}")
+
+    raw: dict[str, np.lib.npyio.NpzFile] = {}
+    for i in wanted:
+        path = os.path.join(store_dir, f"shard_{i}.npz")
+        if not os.path.exists(path):
+            raise FileNotFoundError(f"manifest lists shard {i} but {path} is missing")
+        raw[i] = np.load(path)
+
+    import jax.numpy as jnp
+
+    flat: dict[str, Any] = {}
+    for name, meta in manifest["params"].items():
+        if meta["shard"] not in wanted:
+            continue
+        z = raw[meta["shard"]]
+        if meta["dtype"] == "quantized":
+            qt = QuantizedTensor(
+                data=jnp.asarray(z[name + ".q"]),
+                scale=jnp.asarray(z[name + ".scale"]),
+                bits=meta["bits"],
+                orig_shape=tuple(meta["shape"]),
+            )
+            flat[name] = quant_lib.dequantize(qt, dtype or jnp.float32) if dequantize else qt
+        elif meta["dtype"] == "bfloat16":
+            arr = jnp.asarray(z[name].view(jnp.bfloat16))
+            flat[name] = arr.astype(dtype) if dtype else arr
+        else:
+            arr = jnp.asarray(z[name])
+            flat[name] = arr.astype(dtype) if dtype else arr
+    return _unflatten(flat)
+
+
+def reconstruct(store_dir: str, dtype: Any = None) -> dict[str, Any]:
+    """Merge every shard back into a full (dequantized) param tree."""
+    return load_shards(store_dir, shards=None, dequantize=True, dtype=dtype)

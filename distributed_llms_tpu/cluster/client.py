@@ -1,0 +1,51 @@
+"""Thin control-plane client (used by the CLI and tests): ask the
+coordinator for status/metrics or submit generation, over the JSON protocol."""
+
+from __future__ import annotations
+
+import asyncio
+import uuid
+from typing import Any
+
+from . import protocol
+
+
+class CoordinatorClient:
+    def __init__(self, host: str, port: int) -> None:
+        self.host = host
+        self.port = port
+        self._reader: asyncio.StreamReader | None = None
+        self._writer: asyncio.StreamWriter | None = None
+
+    async def __aenter__(self) -> "CoordinatorClient":
+        self._reader, self._writer = await asyncio.open_connection(self.host, self.port)
+        return self
+
+    async def __aexit__(self, *exc) -> None:
+        if self._writer:
+            self._writer.close()
+
+    async def request(self, type_: str, payload: Any = None, timeout: float = 30.0) -> Any:
+        assert self._reader and self._writer, "use 'async with'"
+        msg_id = uuid.uuid4().hex
+        await protocol.send_message(
+            self._writer, protocol.message(type_, payload, msg_id=msg_id)
+        )
+        try:
+            while True:
+                msg = await protocol.receive_message(self._reader, timeout=timeout)
+                if msg.get("msg_id") == msg_id:
+                    if msg["type"] == "ERROR":
+                        raise RuntimeError(str(msg.get("payload")))
+                    return msg.get("payload")
+        except TimeoutError:
+            # a timeout can strand a half-read frame; this stream is dead
+            self._writer.close()
+            self._reader = self._writer = None
+            raise
+
+    async def status(self) -> dict:
+        return await self.request("GET_STATUS")
+
+    async def metrics(self) -> dict:
+        return await self.request("GET_METRICS")

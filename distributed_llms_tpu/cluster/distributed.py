@@ -1,0 +1,38 @@
+"""Multi-host initialization: jax.distributed over DCN.
+
+The reference "scales" by adding TCP workers to a star (SURVEY §2.4); a TPU
+slice scales by joining processes into one global runtime —
+``jax.distributed.initialize`` handshakes every host with the coordinator,
+after which ``jax.devices()`` spans the slice and the same pjit/shard_map
+programs run SPMD across hosts (collectives ride ICI within a slice, DCN
+across slices).  Single-process use never calls this.
+"""
+
+from __future__ import annotations
+
+import jax
+
+from ..core.config import ClusterConfig
+from ..core.observability import get_logger
+
+log = get_logger("distributed")
+
+
+def initialize_distributed(cfg: ClusterConfig) -> None:
+    """Join this process into the multi-host runtime (no-op for 1 process)."""
+    if cfg.num_processes <= 1:
+        return
+    if cfg.distributed_coordinator is None:
+        raise ValueError(
+            "cluster.distributed_coordinator (host:port) is required when "
+            f"num_processes={cfg.num_processes}"
+        )
+    log.info(
+        "jax.distributed.initialize(%s, num_processes=%d, process_id=%d)",
+        cfg.distributed_coordinator, cfg.num_processes, cfg.process_id,
+    )
+    jax.distributed.initialize(
+        coordinator_address=cfg.distributed_coordinator,
+        num_processes=cfg.num_processes,
+        process_id=cfg.process_id,
+    )

@@ -1,0 +1,105 @@
+"""Control-plane wire protocol: length-prefixed JSON over asyncio TCP.
+
+Replaces the reference's MessageProtocol (src/network/protocol.py) one-for-one
+on the *control* plane only — tensors NEVER transit this socket (the data
+plane is compiled XLA collectives over ICI; shard "distribution" is
+device_put, SURVEY §2.4).  Differences by design:
+
+- JSON, never pickle (the reference pickled headers and payloads,
+  protocol.py:58,105 — arbitrary-code-execution on connect);
+- 8-byte big-endian length prefix instead of 10-byte ASCII (protocol.py:8);
+- a single framing (the reference half-migrated TCP->ZMQ and broke both,
+  defects D1-D3);
+- every message carries ``type`` + ``payload``; requests carry ``msg_id`` so
+  replies correlate (the reference matched on task_id with a re-queue race,
+  D9).
+
+Message set (reference's MESSAGE_TYPES at protocol.py:12-20 mapped to the
+mesh runtime):
+  REGISTER, REGISTER_ACK, HEARTBEAT, PLACE_SHARDS (was LOAD_SHARD),
+  UNLOAD_SHARDS, GENERATE (was RUN_INFERENCE), SCHEDULE_COMPUTATION,
+  RESULT, ERROR, GET_STATUS, GET_METRICS, SHUTDOWN
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import struct
+from typing import Any
+
+MAX_FRAME = 64 * 1024 * 1024  # control plane only; nothing big belongs here
+
+MESSAGE_TYPES = frozenset(
+    {
+        "REGISTER",
+        "REGISTER_ACK",
+        "HEARTBEAT",
+        "PLACE_SHARDS",
+        "UNLOAD_SHARDS",
+        "GENERATE",
+        "SCHEDULE_COMPUTATION",
+        "RESULT",
+        "ERROR",
+        "GET_STATUS",
+        "GET_METRICS",
+        "SHUTDOWN",
+    }
+)
+
+
+class ProtocolError(Exception):
+    pass
+
+
+def encode(msg: dict[str, Any]) -> bytes:
+    if msg.get("type") not in MESSAGE_TYPES:
+        raise ProtocolError(f"unknown message type {msg.get('type')!r}")
+    body = json.dumps(msg, separators=(",", ":")).encode("utf-8")
+    if len(body) > MAX_FRAME:
+        raise ProtocolError(f"frame too large ({len(body)} bytes)")
+    return struct.pack(">Q", len(body)) + body
+
+
+def decode_header(header: bytes) -> int:
+    (n,) = struct.unpack(">Q", header)
+    if n > MAX_FRAME:
+        raise ProtocolError(f"frame too large ({n} bytes)")
+    return n
+
+
+async def send_message(writer: asyncio.StreamWriter, msg: dict[str, Any]) -> None:
+    writer.write(encode(msg))
+    await writer.drain()
+
+
+async def receive_message(
+    reader: asyncio.StreamReader, timeout: float | None = None
+) -> dict[str, Any]:
+    """Read one frame.  A TimeoutError may fire mid-frame (header consumed,
+    body pending) which desynchronizes the stream — callers must treat the
+    connection as dead after a timeout and reconnect (CoordinatorClient
+    does)."""
+    async def _recv() -> dict[str, Any]:
+        header = await reader.readexactly(8)
+        n = decode_header(header)
+        body = await reader.readexactly(n)
+        try:
+            msg = json.loads(body.decode("utf-8"))
+        except (UnicodeDecodeError, json.JSONDecodeError) as e:
+            raise ProtocolError(f"invalid frame body: {e}") from e
+        if not isinstance(msg, dict) or msg.get("type") not in MESSAGE_TYPES:
+            raise ProtocolError(f"invalid message: {str(msg)[:200]}")
+        return msg
+
+    if timeout is None:
+        return await _recv()
+    return await asyncio.wait_for(_recv(), timeout)
+
+
+def message(type_: str, payload: Any = None, msg_id: str | None = None, **extra) -> dict:
+    out: dict[str, Any] = {"type": type_, "payload": payload}
+    if msg_id is not None:
+        out["msg_id"] = msg_id
+    out.update(extra)
+    return out

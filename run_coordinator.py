@@ -1,0 +1,8 @@
+#!/usr/bin/env python
+"""Entry point: coordinator + REPL (run_master.py parity).  See
+distributed_llms_tpu/cli/coordinator_main.py."""
+
+from distributed_llms_tpu.cli.coordinator_main import main
+
+if __name__ == "__main__":
+    main()

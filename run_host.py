@@ -1,0 +1,8 @@
+#!/usr/bin/env python
+"""Entry point: TPU host runner (run_worker.py parity).  See
+distributed_llms_tpu/cli/host_main.py."""
+
+from distributed_llms_tpu.cli.host_main import main
+
+if __name__ == "__main__":
+    main()

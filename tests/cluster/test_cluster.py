@@ -1,0 +1,209 @@
+"""Control-plane tests: real asyncio sockets on localhost (the reference
+faked its wire with mocked sockets, SURVEY §4; these run the actual stack),
+plus fault-injection: dead-worker eviction and task retry — capabilities the
+reference planned (plan.md:430-436) but never built."""
+
+import asyncio
+import json
+
+import pytest
+
+from distributed_llms_tpu.cluster import protocol
+from distributed_llms_tpu.cluster.client import CoordinatorClient
+from distributed_llms_tpu.cluster.coordinator import Coordinator
+from distributed_llms_tpu.cluster.worker import WorkerHost
+from distributed_llms_tpu.core.config import ClusterConfig, RuntimeConfig
+
+
+def fast_cfg(**kw):
+    return ClusterConfig(
+        coordinator_host="127.0.0.1", coordinator_port=0,
+        heartbeat_interval_s=0.2, heartbeat_timeout_s=0.6,
+        connect_retry_s=0.1, connect_max_retries=3, task_timeout_s=10.0, **kw
+    )
+
+
+# ---------------------------------------------------------------------------
+# protocol framing
+# ---------------------------------------------------------------------------
+
+def test_encode_decode_roundtrip():
+    msg = protocol.message("REGISTER", {"capabilities": {"platform": "cpu"}})
+    raw = protocol.encode(msg)
+    n = protocol.decode_header(raw[:8])
+    assert n == len(raw) - 8
+    assert json.loads(raw[8:]) == msg
+
+
+def test_encode_rejects_unknown_type():
+    with pytest.raises(protocol.ProtocolError, match="unknown message type"):
+        protocol.encode({"type": "EVIL"})
+
+
+def test_decode_rejects_oversized():
+    import struct
+
+    with pytest.raises(protocol.ProtocolError, match="too large"):
+        protocol.decode_header(struct.pack(">Q", protocol.MAX_FRAME + 1))
+
+
+@pytest.mark.asyncio
+async def test_receive_timeout():
+    coord = Coordinator(fast_cfg())
+    host, port = await coord.start()
+    try:
+        reader, writer = await asyncio.open_connection("127.0.0.1", coord.port)
+        with pytest.raises(asyncio.TimeoutError):
+            await protocol.receive_message(reader, timeout=0.2)
+        writer.close()
+    finally:
+        await coord.stop()
+
+
+# ---------------------------------------------------------------------------
+# registration / heartbeat / eviction
+# ---------------------------------------------------------------------------
+
+class FakeEngine:
+    def generate_text(self, prompts, max_new_tokens=None):
+        import types
+
+        n = max_new_tokens or 4
+        return types.SimpleNamespace(
+            text=[p + "!" for p in prompts],
+            generated_tokens=n * len(prompts),
+            seconds=0.01,
+            tokens_per_second=float(n * len(prompts)) / 0.01,
+        )
+
+
+def fake_factory(store_dir, shards, rt):
+    return FakeEngine()
+
+
+async def start_worker(coord, factory=fake_factory, **kw):
+    w = WorkerHost("127.0.0.1", coord.port, cfg=fast_cfg(), engine_factory=factory, **kw)
+    task = asyncio.create_task(w.run())
+    for _ in range(100):
+        if w.worker_id is not None:
+            break
+        await asyncio.sleep(0.02)
+    assert w.worker_id is not None, "worker failed to register"
+    return w, task
+
+
+@pytest.mark.asyncio
+async def test_register_heartbeat_and_eviction():
+    coord = Coordinator(fast_cfg())
+    await coord.start()
+    try:
+        w, wt = await start_worker(coord)
+        assert w.worker_id in coord.workers
+        # heartbeats keep it alive past the timeout window
+        await asyncio.sleep(0.9)
+        assert w.worker_id in coord.workers
+
+        # kill the worker silently -> deadline eviction (reference never
+        # evicted: D10)
+        wt.cancel()
+        await asyncio.sleep(0.05)
+        await asyncio.sleep(1.0)
+        assert w.worker_id not in coord.workers
+    finally:
+        await coord.stop()
+
+
+@pytest.mark.asyncio
+async def test_plan_place_generate_roundtrip(tmp_path):
+    coord = Coordinator(fast_cfg())
+    await coord.start()
+    try:
+        w, wt = await start_worker(coord)
+        coord.plan_shards(2, store_dir=str(tmp_path))
+        assert set(coord.shard_assignment) == {0, 1}
+        placed = await coord.place_shards()
+        assert placed[w.worker_id]["loaded"] == [0, 1]
+        out = await coord.generate(["hello"], max_new_tokens=3)
+        assert out["text"] == ["hello!"]
+        wt.cancel()
+    finally:
+        await coord.stop()
+
+
+@pytest.mark.asyncio
+async def test_task_retry_on_worker_death(tmp_path):
+    """Task dispatched to a worker that dies mid-flight is retried on the
+    survivor (planned in the reference, never built)."""
+
+    class SlowEngine(FakeEngine):
+        def generate_text(self, prompts, max_new_tokens=None):
+            import time
+
+            time.sleep(0.5)
+            return super().generate_text(prompts, max_new_tokens)
+
+    calls = []
+
+    def factory(store_dir, shards, rt):
+        calls.append(shards)
+        return SlowEngine()
+
+    coord = Coordinator(fast_cfg())
+    await coord.start()
+    try:
+        w1, t1 = await start_worker(coord, factory=factory, rt=RuntimeConfig())
+        w2, t2 = await start_worker(coord, factory=factory)
+        coord.plan_shards(2, store_dir=str(tmp_path))
+        await coord.place_shards()
+        assert len(calls) == 2  # both workers built (slow) engines
+
+        gen = asyncio.create_task(coord.generate(["x"], max_new_tokens=2))
+        await asyncio.sleep(0.15)  # task is in-flight on some worker
+        inflight = [t for t in coord.tasks.values()]
+        assert inflight, "task finished before fault injection"
+        victim = inflight[0].assigned_to
+        vw, vt = (w1, t1) if victim == w1.worker_id else (w2, t2)
+        vt.cancel()  # dies silently mid-task
+        out = await asyncio.wait_for(gen, timeout=15)
+        assert out["text"] == ["x!"]
+        for t in (t1, t2):
+            t.cancel()
+    finally:
+        await coord.stop()
+
+
+@pytest.mark.asyncio
+async def test_generate_without_placement_errors_then_retries_exhaust(tmp_path):
+    coord = Coordinator(fast_cfg())
+    await coord.start()
+    try:
+        w, wt = await start_worker(coord)
+        # no PLACE_SHARDS: worker raises, coordinator retries, then fails
+        with pytest.raises(RuntimeError, match="failed after"):
+            await coord.generate(["x"])
+        wt.cancel()
+    finally:
+        await coord.stop()
+
+
+@pytest.mark.asyncio
+async def test_status_and_metrics_client(tmp_path):
+    coord = Coordinator(fast_cfg())
+    await coord.start()
+    try:
+        w, wt = await start_worker(coord)
+        async with CoordinatorClient("127.0.0.1", coord.port) as c:
+            status = await c.status()
+            assert w.worker_id in status["workers"]
+            metrics = await c.metrics()
+            assert "counters" in metrics
+        wt.cancel()
+    finally:
+        await coord.stop()
+
+
+@pytest.mark.asyncio
+async def test_worker_connect_retry_fails_cleanly():
+    w = WorkerHost("127.0.0.1", 1, cfg=fast_cfg())  # port 1: nothing there
+    with pytest.raises(ConnectionError, match="could not reach"):
+        await w.run()

@@ -20,7 +20,24 @@ import jax
 
 jax.config.update("jax_platforms", "cpu")
 
+import asyncio
+import inspect
+
 import pytest
+
+
+def pytest_pyfunc_call(pyfuncitem):
+    """Minimal async-test support (pytest-asyncio is not in the image):
+    coroutine tests run under asyncio.run."""
+    fn = pyfuncitem.obj
+    if inspect.iscoroutinefunction(fn):
+        kwargs = {
+            name: pyfuncitem.funcargs[name]
+            for name in pyfuncitem._fixtureinfo.argnames
+        }
+        asyncio.run(fn(**kwargs))
+        return True
+    return None
 
 
 @pytest.fixture(scope="session")
