@@ -198,6 +198,9 @@ class Coordinator:
         log.warning("evicting %s (%s)", worker_id, reason)
         METRICS.set_gauge("coordinator.workers", len(self.workers))
         METRICS.inc("coordinator.evictions")
+        # Close the connection so the worker *sees* the eviction (EOF) and can
+        # exit or reconnect — otherwise it heartbeats into the void forever.
+        info.writer.close()
         # free its shards and requeue its in-flight tasks
         self.shard_assignment = {
             s: w for s, w in self.shard_assignment.items() if w != worker_id
@@ -242,21 +245,29 @@ class Coordinator:
         per_worker: dict[str, list[int]] = {}
         for shard, wid in self.shard_assignment.items():
             per_worker.setdefault(wid, []).append(shard)
-        results = {}
-        for wid, shards in per_worker.items():
-            reply = await self.submit(
-                "PLACE_SHARDS",
-                {"store_dir": self.store_dir, "shards": sorted(shards)},
-                worker_id=wid,
-                timeout=timeout,
-            )
-            info = self.workers.get(wid)  # may have been evicted mid-loop
+
+        async def place_one(wid: str, shards: list[int]) -> Any:
+            # Placements are independent — run them concurrently so N hosts
+            # load/compile in ~1× wall-clock, not N×.
+            try:
+                reply = await self.submit(
+                    "PLACE_SHARDS",
+                    {"store_dir": self.store_dir, "shards": sorted(shards)},
+                    worker_id=wid,
+                    timeout=timeout,
+                )
+            except (RuntimeError, asyncio.TimeoutError) as e:
+                return {"error": str(e)}
+            info = self.workers.get(wid)  # may have been evicted meanwhile
             if info is None:
-                results[wid] = {"error": f"worker {wid} evicted during placement"}
-                continue
+                return {"error": f"worker {wid} evicted during placement"}
             info.shards = sorted(shards)
-            results[wid] = reply
-        return results
+            return reply
+
+        replies = await asyncio.gather(
+            *(place_one(w, s) for w, s in per_worker.items())
+        )
+        return dict(zip(per_worker, replies))
 
     # -- task submission ---------------------------------------------------
 
@@ -297,6 +308,16 @@ class Coordinator:
             if task.future.done():
                 continue
             wid = task.payload.get("worker_id")
+            if wid and wid not in self.workers:
+                # Pinned worker is gone: fail fast — requeueing could never
+                # succeed (the pin survives eviction) and would spin forever.
+                if not task.future.done():
+                    task.future.set_exception(
+                        RuntimeError(f"task {task.task_id} pinned to "
+                                     f"evicted worker {wid}")
+                    )
+                METRICS.inc("coordinator.tasks_failed")
+                continue
             info = self.workers.get(wid) if wid else self._pick_worker()
             if info is None:
                 # no worker (yet): brief backoff then requeue

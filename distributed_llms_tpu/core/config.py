@@ -23,6 +23,9 @@ except Exception:  # pragma: no cover
     _HAVE_YAML = False
 
 
+_ATTN_IMPLS = {"dot", "ring"}
+
+
 @dataclass(frozen=True)
 class ModelConfig:
     """Architecture hyperparameters for a decoder-only transformer.
@@ -45,6 +48,15 @@ class ModelConfig:
     norm_eps: float = 1e-5
     tie_embeddings: bool = True
     dtype: str = "bfloat16"
+    # Attention implementation: "dot" (XLA-fused) or "ring" (sequence-parallel
+    # ppermute ring over the 'seq' mesh axis; prefill/training only).
+    attn_impl: str = "dot"
+
+    def __post_init__(self):
+        if self.attn_impl not in _ATTN_IMPLS:
+            raise ValueError(
+                f"unknown attn_impl {self.attn_impl!r}; choose from {sorted(_ATTN_IMPLS)}"
+            )
     # MoE (expert parallelism); num_experts == 0 -> dense MLP.
     num_experts: int = 0
     num_experts_per_token: int = 2

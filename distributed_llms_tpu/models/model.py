@@ -67,6 +67,17 @@ def _attention(
         q = layers.apply_rope(q, positions, cfg.rope_theta)
         k = layers.apply_rope(k, positions, cfg.rope_theta)
 
+    if cfg.attn_impl == "ring" and layer_cache is None:
+        # Sequence-parallel path: we are inside a shard_map over the 'seq'
+        # mesh axis (ParallelModel handles the wrapping); positions carry
+        # *global* indices so causality holds across rotating blocks.
+        if attn_mask is not None:
+            raise NotImplementedError("ring attention supports causal masking only")
+        from ..ops import ring
+
+        out = ring.ring_attention(q, k, v, positions, positions, axis_name="seq")
+        return layers.out_project(out, p), None
+
     if layer_cache is not None:
         ck, cv = layer_cache  # [B, S, KVH, HD]
         ck = jax.lax.dynamic_update_slice(ck, k.astype(ck.dtype), (0, cache_index, 0, 0))

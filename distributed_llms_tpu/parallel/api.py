@@ -66,6 +66,10 @@ class ParallelModel:
     def pipelined(self) -> bool:
         return self.num_stages > 1
 
+    @property
+    def seq_parallel(self) -> bool:
+        return self.mesh.shape.get("seq", 1) > 1
+
     # -- placement ---------------------------------------------------------
 
     def shard_params(self, params: Params) -> Params:
@@ -125,6 +129,30 @@ class ParallelModel:
 
     # -- execution ---------------------------------------------------------
 
+    def _seq_forward(self, params, tokens, positions, remat):
+        """Full forward under shard_map over {'seq'}: sequence axis sharded,
+        global positions passed through so RoPE/causality stay correct;
+        attention runs the ppermute ring (ops/ring.py); 'data'/'model' axes
+        remain GSPMD-auto inside the body."""
+        cfg = _ring_cfg(self.cfg)
+        b, t = tokens.shape
+        if positions is None:
+            positions = jnp.broadcast_to(jnp.arange(t, dtype=jnp.int32), (b, t))
+
+        def body(params, tokens, positions):
+            logits, _ = model_lib.forward(
+                params, cfg, tokens, positions=positions, remat=remat
+            )
+            return logits
+
+        return jax.shard_map(
+            body,
+            mesh=self.mesh,
+            in_specs=(P(), P(None, "seq"), P(None, "seq")),
+            out_specs=P(None, "seq", None),
+            axis_names={"seq"},
+        )(params, tokens, positions)
+
     def forward(
         self,
         params: Params,
@@ -137,6 +165,17 @@ class ParallelModel:
     ) -> tuple[jax.Array, KVCache | None]:
         """Same contract as models.model.forward, but mesh-parallel."""
         cfg = self.cfg
+        if (
+            self.seq_parallel
+            and cache is None
+            and not self.pipelined
+            and attn_mask is None
+        ):
+            # Long-context path (SURVEY §5.7): sequence sharded over 'seq',
+            # ring attention rotates KV blocks over ICI.  Decode-with-cache
+            # and custom-mask calls fall through to the dense path (the ring
+            # handles causal masking only; ring targets prefill/training).
+            return self._seq_forward(params, tokens, positions, remat), None
         if not self.pipelined:
             return model_lib.forward(
                 params, cfg, tokens, positions=positions, cache=cache,
@@ -162,6 +201,12 @@ class ParallelModel:
         return logits, KVCache(k=nk, v=nv)
 
 
+def _ring_cfg(cfg: ModelConfig) -> ModelConfig:
+    import dataclasses
+
+    return dataclasses.replace(cfg, attn_impl="ring")
+
+
 def make_parallel_model(
     cfg: ModelConfig, mesh_cfg: MeshConfig, num_microbatches: int = 1,
     devices: list | None = None,
@@ -172,5 +217,13 @@ def make_parallel_model(
     if mesh_cfg.pipe > 1 and cfg.num_layers % mesh_cfg.pipe:
         raise ValueError(
             f"num_layers {cfg.num_layers} not divisible by pipe {mesh_cfg.pipe}"
+        )
+    if mesh_cfg.pipe > 1 and mesh_cfg.seq > 1:
+        # The ring path replaces the pipeline schedule; a seq axis alongside
+        # pipe would silently hold inert replicas instead of sharding sequence.
+        raise ValueError(
+            f"seq={mesh_cfg.seq} cannot combine with pipe={mesh_cfg.pipe}: "
+            "ring attention and the pipeline schedule are alternative "
+            "shardings of the layer loop — use one, with 'data'/'model' axes"
         )
     return ParallelModel(cfg=cfg, mesh=mesh, num_microbatches=num_microbatches)

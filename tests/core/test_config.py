@@ -40,3 +40,10 @@ def test_mesh_shape():
     m = MeshConfig(data=2, pipe=2, model=2)
     assert m.num_devices == 8
     assert m.shape == (2, 2, 2, 1, 1)
+
+
+def test_bad_attn_impl_rejected():
+    import pytest
+    from distributed_llms_tpu.core.config import ModelConfig
+    with pytest.raises(ValueError, match="attn_impl"):
+        ModelConfig(attn_impl="flsh")
