@@ -309,14 +309,12 @@ class Coordinator:
                 continue
             wid = task.payload.get("worker_id")
             if wid and wid not in self.workers:
-                # Pinned worker is gone: fail fast — requeueing could never
-                # succeed (the pin survives eviction) and would spin forever.
-                if not task.future.done():
-                    task.future.set_exception(
-                        RuntimeError(f"task {task.task_id} pinned to "
-                                     f"evicted worker {wid}")
-                    )
-                METRICS.inc("coordinator.tasks_failed")
+                # Pinned worker is absent — it may reconnect and re-register
+                # under the same id (a heartbeat blip), so back off and
+                # requeue; the submitter's wait_for timeout bounds the wait
+                # (a cancelled future is dropped at the top of this loop).
+                await asyncio.sleep(0.2)
+                await self.task_queue.put(task)
                 continue
             info = self.workers.get(wid) if wid else self._pick_worker()
             if info is None:

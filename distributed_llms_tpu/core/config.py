@@ -23,7 +23,7 @@ except Exception:  # pragma: no cover
     _HAVE_YAML = False
 
 
-_ATTN_IMPLS = {"dot", "ring"}
+_ATTN_IMPLS = {"dot", "ring", "flash"}
 
 
 @dataclass(frozen=True)
@@ -48,7 +48,9 @@ class ModelConfig:
     norm_eps: float = 1e-5
     tie_embeddings: bool = True
     dtype: str = "bfloat16"
-    # Attention implementation: "dot" (XLA-fused) or "ring" (sequence-parallel
+    # Attention implementation: "dot" (XLA-fused), "flash" (Pallas fused
+    # blockwise kernel, ops/flash.py; inference paths — prefill uses it,
+    # single-token decode falls back to dot), or "ring" (sequence-parallel
     # ppermute ring over the 'seq' mesh axis; prefill/training only).
     attn_impl: str = "dot"
 

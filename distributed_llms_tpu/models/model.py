@@ -61,11 +61,30 @@ def _attention(
     cache_index: jax.Array | None,
     use_rope: bool,
     attn_mask: jax.Array | None = None,  # broadcastable to [B, H, Tq, S]
+    std_layout: bool = False,  # positions are the standard arange (forward
+    #                            generated them itself) — unlocks the flash
+    #                            kernel's static-causal fast path
 ) -> tuple[jax.Array, tuple[jax.Array, jax.Array] | None]:
     q, k, v = layers.qkv_project(x, p, cfg)
     if use_rope:
         q = layers.apply_rope(q, positions, cfg.rope_theta)
         k = layers.apply_rope(k, positions, cfg.rope_theta)
+
+    if (
+        cfg.attn_impl == "flash"
+        and attn_mask is None
+        and layer_cache is None
+    ):
+        # Self-attention over the input block (training / no-cache eval).
+        from ..ops import flash
+
+        out = flash.flash_attention(
+            q, k, v,
+            q_positions=None if std_layout else positions,
+            k_positions=None if std_layout else positions,
+            causal=True,
+        )
+        return layers.out_project(out, p), None
 
     if cfg.attn_impl == "ring" and layer_cache is None:
         # Sequence-parallel path: we are inside a shard_map over the 'seq'
@@ -86,6 +105,19 @@ def _attention(
             s = ck.shape[1]
             k_positions = jnp.broadcast_to(jnp.arange(s, dtype=jnp.int32), (x.shape[0], s))
             k_valid = k_positions < (cache_index + x.shape[1])
+            if cfg.attn_impl == "flash" and x.shape[1] > 1:
+                # Prefill into a (longer, padded) cache: the flash kernel
+                # masks the unwritten tail instead of computing a dense
+                # [Tq, max_len] score matrix.  Single-token decode stays on
+                # the dense path (the kernel targets block-sized Tq).
+                from ..ops import flash
+
+                out = flash.flash_attention(
+                    q, ck.astype(q.dtype), cv.astype(q.dtype),
+                    q_positions=positions, k_positions=k_positions,
+                    k_valid=k_valid, causal=True,
+                )
+                return layers.out_project(out, p), (ck, cv)
             attn_mask = layers.causal_mask(positions, k_positions, k_valid)
         k_full = layers.repeat_kv(ck.astype(q.dtype), cfg.q_per_kv)
         v_full = layers.repeat_kv(cv.astype(q.dtype), cfg.q_per_kv)
@@ -100,18 +132,18 @@ def _attention(
     return layers.out_project(out, p), new_cache
 
 
-def gpt2_block(x, p, cfg, positions, layer_cache, cache_index, attn_mask=None):
+def gpt2_block(x, p, cfg, positions, layer_cache, cache_index, attn_mask=None, std_layout=False):
     h = layers.layer_norm(x, p["ln1"]["scale"], p["ln1"]["bias"], cfg.norm_eps)
-    attn_out, new_cache = _attention(h, p["attn"], cfg, positions, layer_cache, cache_index, use_rope=False, attn_mask=attn_mask)
+    attn_out, new_cache = _attention(h, p["attn"], cfg, positions, layer_cache, cache_index, use_rope=False, attn_mask=attn_mask, std_layout=std_layout)
     x = x + attn_out
     h = layers.layer_norm(x, p["ln2"]["scale"], p["ln2"]["bias"], cfg.norm_eps)
     x = x + layers.mlp_gelu(h, p["mlp"])
     return x, new_cache
 
 
-def llama_block(x, p, cfg, positions, layer_cache, cache_index, attn_mask=None):
+def llama_block(x, p, cfg, positions, layer_cache, cache_index, attn_mask=None, std_layout=False):
     h = layers.rms_norm(x, p["ln1"]["scale"], cfg.norm_eps)
-    attn_out, new_cache = _attention(h, p["attn"], cfg, positions, layer_cache, cache_index, use_rope=True, attn_mask=attn_mask)
+    attn_out, new_cache = _attention(h, p["attn"], cfg, positions, layer_cache, cache_index, use_rope=True, attn_mask=attn_mask, std_layout=std_layout)
     x = x + attn_out
     h = layers.rms_norm(x, p["ln2"]["scale"], cfg.norm_eps)
     x = x + layers.mlp_swiglu(h, p["mlp"])
@@ -131,6 +163,7 @@ def run_blocks(
     cache_index: jax.Array | None,
     remat: bool = False,
     attn_mask: jax.Array | None = None,
+    std_layout: bool = False,
 ) -> tuple[jax.Array, tuple[jax.Array, jax.Array] | None]:
     """Scan the stacked blocks over x.  Used both for the whole model and for
     a single pipeline stage (blocks then hold only the stage's layer slice)."""
@@ -138,7 +171,7 @@ def run_blocks(
 
     if cache_k is None:
         def body(carry, layer_params):
-            y, _ = block_fn(carry, layer_params, cfg, positions, None, None, attn_mask)
+            y, _ = block_fn(carry, layer_params, cfg, positions, None, None, attn_mask, std_layout)
             return y, None
 
         if remat:
@@ -148,7 +181,7 @@ def run_blocks(
 
     def body(carry, xs):
         layer_params, ck, cv = xs
-        y, new_cache = block_fn(carry, layer_params, cfg, positions, (ck, cv), cache_index, attn_mask)
+        y, new_cache = block_fn(carry, layer_params, cfg, positions, (ck, cv), cache_index, attn_mask, std_layout)
         return y, new_cache
 
     if remat:
@@ -199,15 +232,19 @@ def forward(
     overwrite the last cache slot.  The decode loop in runtime/ enforces this
     statically (max_decode_steps + prompt_len <= max_seq_len)."""
     b, t = tokens.shape
+    # Standard layout: forward generated the positions itself with no cache
+    # offset — query rows align with key slots, which lets the flash kernel
+    # take its static-causal fast path (no per-tile position masks).
+    std_layout = positions is None and (cache_index is None or cache is None)
     if positions is None:
         base = cache_index if cache_index is not None else 0
         positions = jnp.broadcast_to(jnp.arange(t, dtype=jnp.int32) + base, (b, t))
     x = embed(params, cfg, tokens, positions)
     if cache is None:
-        x, _ = run_blocks(x, params["blocks"], cfg, positions, None, None, None, remat, attn_mask)
+        x, _ = run_blocks(x, params["blocks"], cfg, positions, None, None, None, remat, attn_mask, std_layout)
         return unembed(params, cfg, x), None
     x, (new_k, new_v) = run_blocks(
-        x, params["blocks"], cfg, positions, cache.k, cache.v, cache_index, remat, attn_mask
+        x, params["blocks"], cfg, positions, cache.k, cache.v, cache_index, remat, attn_mask, std_layout
     )
     return unembed(params, cfg, x), KVCache(k=new_k, v=new_v)
 

@@ -1,0 +1,400 @@
+"""Flash attention: fused blockwise attention as a Pallas TPU kernel.
+
+Net-new relative to the reference, whose only compute was a placeholder
+per-parameter ``torch.matmul`` (src/worker/node.py:24-32).  This is the
+"native tier" of the new stack (SURVEY §2 intro): the hot O(T²) op written
+directly against the TPU memory hierarchy instead of relying on XLA fusion.
+
+Design (standard flash-attention recurrence, TPU-tiled):
+
+- grid ``(B, H, num_q_blocks, num_k_blocks)``; the K-block axis is innermost,
+  so VMEM scratch accumulators (running max / numerator / denominator)
+  persist across K blocks of one Q block while ``pallas_call`` double-buffers
+  the K/V block DMAs;
+- each step computes a ``[block_q, block_k]`` score tile on the MXU in f32
+  and folds it into the online softmax;
+- grouped-query attention is native: the K/V ``BlockSpec`` index maps divide
+  the query-head grid index by ``q_per_kv``, so K/V blocks are fetched once
+  per KV head — queries in the same group reuse them;
+- **static-causal fast path** (the training / prefill hot path, detected when
+  positions and validity are the standard contiguous layout): above-diagonal
+  tiles are skipped *and their K/V index maps are clamped to the diagonal*,
+  so the dead tiles issue no new DMA; fully-visible tiles skip masking
+  entirely; only diagonal tiles pay for the iota mask;
+- **dynamic path** (ragged prompts, padded KV caches): per-tile masks are
+  built from global position / validity vectors, and fully-masked tiles skip
+  their MXU work via ``pl.when``.
+
+Differentiation: the kernel carries a ``custom_vjp`` whose backward pass
+recomputes attention densely (flash-checkpoint style — nothing but q/k/v is
+saved from the forward).  Gradients therefore cost O(T²) memory in the
+backward only; a fused backward kernel can replace it without touching
+callers.  Interpret mode runs automatically off-TPU so the CPU fake-mesh
+tests exercise the same path.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_NEG_INF = float(jnp.finfo(jnp.float32).min)
+
+
+def _round_up(x: int, m: int) -> int:
+    return (x + m - 1) // m * m
+
+
+# ---------------------------------------------------------------------------
+# Shared online-softmax accumulate
+# ---------------------------------------------------------------------------
+
+def _accumulate(s, v, acc_ref, m_ref, l_ref):
+    """Fold one masked f32 score tile ``s`` [bq, bk] and its V block into the
+    running (acc, m, l) scratch state."""
+    m_prev = m_ref[:, 0]  # [bq]
+    l_prev = l_ref[:, 0]
+    m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1))
+    # Rows with every key masked so far sit at finite finfo.min; using that as
+    # the softmax shift would make masked entries exp(0)=1.  Shift by 0
+    # instead so they underflow to exp(_NEG_INF)=0.
+    safe = jnp.where(m_new <= _NEG_INF * 0.5, 0.0, m_new)
+    p = jnp.exp(s - safe[:, None])  # [bq, bk] f32
+    alpha = jnp.exp(m_prev - safe)  # 0 while unseeded
+    l_new = l_prev * alpha + jnp.sum(p, axis=-1)
+    acc_ref[...] = acc_ref[...] * alpha[:, None] + jax.lax.dot_general(
+        p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    m_ref[:, 0] = m_new
+    l_ref[:, 0] = l_new
+
+
+def _scores(q, k, scale):
+    return (
+        jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        * scale
+    )
+
+
+def _finish(o_ref, acc_ref, l_ref):
+    l = jnp.maximum(l_ref[:, 0], 1e-37)
+    o_ref[0] = (acc_ref[...] / l[:, None]).astype(o_ref.dtype)
+
+
+# ---------------------------------------------------------------------------
+# Static-causal kernel (training / prefill hot path)
+# ---------------------------------------------------------------------------
+
+def _kernel_static(
+    q_ref,  # [1, bq, D]
+    k_ref,  # [1, bk, D]
+    v_ref,  # [1, bk, D]
+    o_ref,  # [1, bq, D]
+    acc_ref,  # [bq, D] f32
+    m_ref,  # [bq, 128] f32
+    l_ref,  # [bq, 128] f32
+    *,
+    scale: float,
+    num_k_blocks: int,
+    block_q: int,
+    block_k: int,
+):
+    qi = pl.program_id(2)
+    ki = pl.program_id(3)
+
+    @pl.when(ki == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+    q_start = qi * block_q
+    k_start = ki * block_k
+    # Tile classes: fully visible (strictly below the diagonal band), diagonal
+    # (crosses row==col), dead (above the diagonal; index maps clamp its K/V
+    # fetch so it costs nothing).
+    visible = k_start + block_k - 1 <= q_start
+    diagonal = jnp.logical_and(
+        k_start + block_k - 1 > q_start, k_start <= q_start + block_q - 1
+    )
+
+    @pl.when(visible)
+    def _full():
+        s = _scores(q_ref[0], k_ref[0], scale)
+        _accumulate(s, v_ref[0], acc_ref, m_ref, l_ref)
+
+    @pl.when(diagonal)
+    def _diag():
+        s = _scores(q_ref[0], k_ref[0], scale)
+        rows = q_start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+        cols = k_start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        s = jnp.where(cols <= rows, s, _NEG_INF)
+        _accumulate(s, v_ref[0], acc_ref, m_ref, l_ref)
+
+    @pl.when(ki == num_k_blocks - 1)
+    def _done():
+        _finish(o_ref, acc_ref, l_ref)
+
+
+# ---------------------------------------------------------------------------
+# Dynamic kernel (ragged prompts / padded caches / explicit validity)
+# ---------------------------------------------------------------------------
+
+def _kernel_dynamic(
+    qpos_ref,  # [1, 1, bq] int32 — global positions of this Q block's rows
+    kpos_ref,  # [1, 1, bk] int32 — global positions of this K block's slots
+    kval_ref,  # [1, 1, bk] int32 — 1 where the K slot is a real/valid key
+    q_ref,  # [1, bq, D]
+    k_ref,  # [1, bk, D]
+    v_ref,  # [1, bk, D]
+    o_ref,  # [1, bq, D]
+    acc_ref,
+    m_ref,
+    l_ref,
+    *,
+    causal: bool,
+    scale: float,
+    num_k_blocks: int,
+):
+    ki = pl.program_id(3)
+
+    @pl.when(ki == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+    qp = qpos_ref[0, 0, :]  # [bq]
+    kp = kpos_ref[0, 0, :]  # [bk]
+    kv = kval_ref[0, 0, :]  # [bk]
+    mask = (kv != 0)[None, :]  # [1, bk]
+    if causal:
+        mask = jnp.logical_and(mask, kp[None, :] <= qp[:, None])  # [bq, bk]
+    mask = jnp.broadcast_to(mask, (qp.shape[0], kp.shape[0]))
+
+    @pl.when(jnp.any(mask))
+    def _block():
+        s = jnp.where(mask, _scores(q_ref[0], k_ref[0], scale), _NEG_INF)
+        _accumulate(s, v_ref[0], acc_ref, m_ref, l_ref)
+
+    @pl.when(ki == num_k_blocks - 1)
+    def _done():
+        _finish(o_ref, acc_ref, l_ref)
+
+
+# ---------------------------------------------------------------------------
+# Host-side wrapper
+# ---------------------------------------------------------------------------
+
+def _pad_to(x: jax.Array, axis: int, mult: int, value) -> jax.Array:
+    size = x.shape[axis]
+    pad = (-size) % mult
+    if pad == 0:
+        return x
+    widths = [(0, 0)] * x.ndim
+    widths[axis] = (0, pad)
+    return jnp.pad(x, widths, constant_values=value)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(6, 7, 8, 9))
+def _flash(q, k, v, q_positions, k_positions, k_valid, causal, block_q, block_k, interpret):
+    b, tq, h, d = q.shape
+    s = k.shape[1]
+    kvh = k.shape[2]
+    assert h % kvh == 0, (h, kvh)
+    g = h // kvh
+    scale = d**-0.5
+
+    # Q tile: sublane dim of the score tile (min 8 rows); K tile: lane dim
+    # (pad short sequences up to one 128-lane tile).
+    bq = min(block_q, _round_up(tq, 8))
+    bk = min(block_k, _round_up(s, 128))
+
+    # The hot path: standard contiguous positions, every key slot valid, and
+    # query rows aligned with key slots (training forward / full prefill).
+    static_causal = (
+        causal and q_positions is None and k_positions is None
+        and k_valid is None and tq == s
+    )
+
+    # [B, H, T, D] layout: contiguous [T, D] tiles per head.
+    qt = _pad_to(q.transpose(0, 2, 1, 3), 2, bq, 0)
+    kt = _pad_to(k.transpose(0, 2, 1, 3), 2, bk, 0)
+    vt = _pad_to(v.transpose(0, 2, 1, 3), 2, bk, 0)
+    tq_p, s_p = qt.shape[2], kt.shape[2]
+    nq, nk = tq_p // bq, s_p // bk
+    grid = (b, h, nq, nk)
+    scratch = [
+        pltpu.VMEM((bq, d), jnp.float32),
+        pltpu.VMEM((bq, 128), jnp.float32),
+        pltpu.VMEM((bq, 128), jnp.float32),
+    ]
+    q_spec = pl.BlockSpec((1, bq, d), lambda bi, hi, qi, ki: (bi * h + hi, qi, 0))
+    o_spec = pl.BlockSpec((1, bq, d), lambda bi, hi, qi, ki: (bi * h + hi, qi, 0))
+    out_shape = jax.ShapeDtypeStruct((b * h, tq_p, d), q.dtype)
+    args = (
+        qt.reshape(b * h, tq_p, d),
+        kt.reshape(b * kvh, s_p, d),
+        vt.reshape(b * kvh, s_p, d),
+    )
+
+    if static_causal:
+        # Clamp dead (above-diagonal) tiles' K/V fetches to the diagonal tile:
+        # repeated index => the pipeline issues no new DMA for skipped tiles.
+        def kv_index(bi, hi, qi, ki):
+            last_needed = jax.lax.div(qi * bq + bq - 1, bk)
+            return (bi * kvh + hi // g, jnp.minimum(ki, last_needed), 0)
+
+        out = pl.pallas_call(
+            functools.partial(
+                _kernel_static, scale=scale, num_k_blocks=nk,
+                block_q=bq, block_k=bk,
+            ),
+            grid=grid,
+            in_specs=[
+                q_spec,
+                pl.BlockSpec((1, bk, d), kv_index),
+                pl.BlockSpec((1, bk, d), kv_index),
+            ],
+            out_specs=o_spec,
+            out_shape=out_shape,
+            scratch_shapes=scratch,
+            interpret=interpret,
+        )(*args)
+    else:
+        if q_positions is None:
+            q_positions = jnp.broadcast_to(jnp.arange(tq, dtype=jnp.int32), (b, tq))
+        if k_positions is None:
+            k_positions = jnp.broadcast_to(jnp.arange(s, dtype=jnp.int32), (b, s))
+        kval = (
+            jnp.ones((b, s), jnp.int32)
+            if k_valid is None
+            else k_valid.astype(jnp.int32)
+        )
+        # Padded q rows get position -1 (causal-masks every key -> zero
+        # output); padded k slots get valid=0.  Vectors go in as [B*n, 1, blk]
+        # (block dims equal array dims => satisfies the (8,128) tiling rule
+        # without replicating across sublanes).
+        qpos = _pad_to(q_positions.astype(jnp.int32), 1, bq, -1)
+        kpos = _pad_to(k_positions.astype(jnp.int32), 1, bk, 2**30)
+        kval = _pad_to(kval, 1, bk, 0)
+        out = pl.pallas_call(
+            functools.partial(
+                _kernel_dynamic, causal=causal, scale=scale, num_k_blocks=nk
+            ),
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec((1, 1, bq), lambda bi, hi, qi, ki: (bi * nq + qi, 0, 0)),
+                pl.BlockSpec((1, 1, bk), lambda bi, hi, qi, ki: (bi * nk + ki, 0, 0)),
+                pl.BlockSpec((1, 1, bk), lambda bi, hi, qi, ki: (bi * nk + ki, 0, 0)),
+                q_spec,
+                pl.BlockSpec((1, bk, d), lambda bi, hi, qi, ki: (bi * kvh + hi // g, ki, 0)),
+                pl.BlockSpec((1, bk, d), lambda bi, hi, qi, ki: (bi * kvh + hi // g, ki, 0)),
+            ],
+            out_specs=o_spec,
+            out_shape=out_shape,
+            scratch_shapes=scratch,
+            interpret=interpret,
+        )(
+            qpos.reshape(b * nq, 1, bq),
+            kpos.reshape(b * nk, 1, bk),
+            kval.reshape(b * nk, 1, bk),
+            *args,
+        )
+    out = out.reshape(b, h, tq_p, d)[:, :, :tq]
+    return out.transpose(0, 2, 1, 3)
+
+
+# ---------------------------------------------------------------------------
+# Autodiff: dense-recompute backward (flash-checkpoint style)
+# ---------------------------------------------------------------------------
+
+def _dense_reference(q, k, v, q_positions, k_positions, k_valid, causal):
+    """Same math and masking semantics as the kernel, in plain XLA ops — the
+    VJP target for the backward pass."""
+    b, tq, h, d = q.shape
+    s, kvh = k.shape[1], k.shape[2]
+    g = h // kvh
+    if g > 1:
+        k = jnp.broadcast_to(k[:, :, :, None, :], (b, s, kvh, g, d)).reshape(b, s, h, d)
+        v = jnp.broadcast_to(v[:, :, :, None, :], (b, s, kvh, g, d)).reshape(b, s, h, d)
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32)
+    logits = logits * (d**-0.5)
+    qp = (
+        jnp.broadcast_to(jnp.arange(tq, dtype=jnp.int32), (b, tq))
+        if q_positions is None
+        else q_positions
+    )
+    kp = (
+        jnp.broadcast_to(jnp.arange(s, dtype=jnp.int32), (b, s))
+        if k_positions is None
+        else k_positions
+    )
+    mask = jnp.ones((b, 1, 1, s), bool) if not causal else (
+        kp[:, None, None, :] <= qp[:, None, :, None]
+    )
+    if k_valid is not None:
+        mask = jnp.logical_and(mask, k_valid[:, None, None, :])
+    logits = jnp.where(mask, logits, _NEG_INF)
+    probs = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum("bhqk,bkhd->bqhd", probs.astype(v.dtype), v)
+    return out.astype(q.dtype)
+
+
+def _flash_fwd(q, k, v, q_positions, k_positions, k_valid, causal, block_q, block_k, interpret):
+    out = _flash(
+        q, k, v, q_positions, k_positions, k_valid, causal, block_q, block_k, interpret
+    )
+    return out, (q, k, v, q_positions, k_positions, k_valid)
+
+
+def _flash_bwd(causal, block_q, block_k, interpret, res, g):
+    q, k, v, q_positions, k_positions, k_valid = res
+    _, vjp = jax.vjp(
+        lambda q_, k_, v_: _dense_reference(
+            q_, k_, v_, q_positions, k_positions, k_valid, causal
+        ),
+        q, k, v,
+    )
+    dq, dk, dv = vjp(g)
+    zero = lambda x: None if x is None else np.zeros(x.shape, jax.dtypes.float0)
+    return dq, dk, dv, zero(q_positions), zero(k_positions), zero(k_valid)
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("causal", "block_q", "block_k", "interpret"),
+)
+def flash_attention(
+    q: jax.Array,  # [B, Tq, H, D]
+    k: jax.Array,  # [B, S, KVH, D]  (KVH divides H — GQA-aware)
+    v: jax.Array,  # [B, S, KVH, D]
+    q_positions: jax.Array | None = None,  # [B, Tq] int32 global positions
+    k_positions: jax.Array | None = None,  # [B, S] int32 global positions
+    k_valid: jax.Array | None = None,  # [B, S] bool — False masks the slot
+    causal: bool = True,
+    block_q: int = 1024,
+    block_k: int = 1024,
+    interpret: bool | None = None,
+) -> jax.Array:
+    """Fused attention.  Matches ``layers.dot_product_attention`` with mask
+    ``(k_pos <= q_pos if causal) & k_valid`` but never materializes the
+    [Tq, S] score matrix in the forward.  Differentiable (dense-recompute
+    backward).  Returns [B, Tq, H, D] in q.dtype."""
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    return _flash(
+        q, k, v, q_positions, k_positions, k_valid, causal, block_q, block_k, interpret
+    )

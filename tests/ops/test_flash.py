@@ -1,0 +1,156 @@
+"""Flash attention kernel vs the dense reference (CPU interpret mode).
+
+Mirrors the reference's unit-test strategy (SURVEY §4: per-layer tests with
+real tensors) for the net-new Pallas kernel: every dispatch mode is checked
+against ``layers.dot_product_attention`` with the equivalent mask.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_llms_tpu.core.config import ModelConfig
+from distributed_llms_tpu.models import layers, model as model_lib
+from distributed_llms_tpu.ops.flash import flash_attention
+
+
+def _qkv(b=2, t=37, h=4, kvh=2, d=16, s=None, seed=0):
+    s = s or t
+    ks = jax.random.split(jax.random.key(seed), 3)
+    q = jax.random.normal(ks[0], (b, t, h, d), jnp.float32)
+    k = jax.random.normal(ks[1], (b, s, kvh, d), jnp.float32)
+    v = jax.random.normal(ks[2], (b, s, kvh, d), jnp.float32)
+    return q, k, v
+
+
+def _dense(q, k, v, mask):
+    g = q.shape[2] // k.shape[2]
+    return layers.dot_product_attention(
+        q, layers.repeat_kv(k, g), layers.repeat_kv(v, g), mask
+    )
+
+
+def test_static_causal_matches_dense():
+    q, k, v = _qkv()
+    b, t = q.shape[:2]
+    pos = jnp.broadcast_to(jnp.arange(t, dtype=jnp.int32), (b, t))
+    ref = _dense(q, k, v, layers.causal_mask(pos, pos))
+    out = flash_attention(q, k, v, block_q=16, block_k=128)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+
+def test_dynamic_positions_match_dense():
+    q, k, v = _qkv(seed=1)
+    b, t = q.shape[:2]
+    pos = jnp.broadcast_to(jnp.arange(t, dtype=jnp.int32), (b, t))
+    ref = _dense(q, k, v, layers.causal_mask(pos, pos))
+    # Passing positions explicitly forces the dynamic kernel.
+    out = flash_attention(
+        q, k, v, q_positions=pos, k_positions=pos, block_q=16, block_k=128
+    )
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+
+def test_cached_prefill_k_valid():
+    # Prefill into a longer padded cache: only the first T slots are valid.
+    t, s = 23, 64
+    q, k, v = _qkv(t=t, s=s, seed=2)
+    b = q.shape[0]
+    pos = jnp.broadcast_to(jnp.arange(t, dtype=jnp.int32), (b, t))
+    kpos = jnp.broadcast_to(jnp.arange(s, dtype=jnp.int32), (b, s))
+    k_valid = kpos < t
+    ref = _dense(q, k, v, layers.causal_mask(pos, kpos, k_valid))
+    out = flash_attention(
+        q, k, v, q_positions=pos, k_positions=kpos, k_valid=k_valid,
+        block_q=16, block_k=128,
+    )
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+
+def test_non_causal():
+    q, k, v = _qkv(seed=3)
+    ref = _dense(q, k, v, None)
+    out = flash_attention(q, k, v, causal=False, block_q=16, block_k=128)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+
+def test_mha_no_gqa():
+    q, k, v = _qkv(h=4, kvh=4, seed=4)
+    b, t = q.shape[:2]
+    pos = jnp.broadcast_to(jnp.arange(t, dtype=jnp.int32), (b, t))
+    ref = _dense(q, k, v, layers.causal_mask(pos, pos))
+    out = flash_attention(q, k, v, block_q=16, block_k=128)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+
+@pytest.mark.parametrize("family", ["gpt2", "llama"])
+def test_model_forward_flash_matches_dot(family):
+    cfg_dot = ModelConfig(
+        family=family, vocab_size=128, hidden_size=32, intermediate_size=64,
+        num_layers=2, num_heads=4, num_kv_heads=2 if family == "llama" else 4,
+        max_seq_len=64, dtype="float32", attn_impl="dot",
+    )
+    cfg_flash = ModelConfig(**{**cfg_dot.__dict__, "attn_impl": "flash"})
+    params = model_lib.init_params(jax.random.key(0), cfg_dot)
+    tokens = jax.random.randint(jax.random.key(1), (2, 17), 0, 128, dtype=jnp.int32)
+    ref, _ = model_lib.forward(params, cfg_dot, tokens)
+    out, _ = model_lib.forward(params, cfg_flash, tokens)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-4)
+
+
+def test_grad_through_flash_matches_dot():
+    import dataclasses
+
+    cfg = ModelConfig(
+        family="llama", vocab_size=64, hidden_size=32, intermediate_size=64,
+        num_layers=2, num_heads=4, num_kv_heads=2, max_seq_len=32,
+        dtype="float32", attn_impl="flash",
+    )
+    cfg_dot = dataclasses.replace(cfg, attn_impl="dot")
+    params = model_lib.init_params(jax.random.key(0), cfg)
+    toks = jax.random.randint(jax.random.key(1), (2, 9), 0, 64, dtype=jnp.int32)
+
+    def loss(p, c):
+        lg, _ = model_lib.forward(p, c, toks)
+        return jnp.mean(lg**2)
+
+    g1 = jax.grad(lambda p: loss(p, cfg))(params)
+    g2 = jax.grad(lambda p: loss(p, cfg_dot))(params)
+    for a, b in zip(jax.tree.leaves(g1), jax.tree.leaves(g2)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
+
+
+def test_offset_positions_match_dot():
+    import dataclasses
+
+    cfg = ModelConfig(
+        family="llama", vocab_size=64, hidden_size=32, intermediate_size=64,
+        num_layers=2, num_heads=4, num_kv_heads=2, max_seq_len=64,
+        dtype="float32", attn_impl="flash",
+    )
+    cfg_dot = dataclasses.replace(cfg, attn_impl="dot")
+    params = model_lib.init_params(jax.random.key(0), cfg)
+    toks = jax.random.randint(jax.random.key(1), (2, 9), 0, 64, dtype=jnp.int32)
+    pos = jnp.broadcast_to(jnp.arange(9, dtype=jnp.int32) + 5, (2, 9))
+    l1, _ = model_lib.forward(params, cfg, toks, positions=pos)
+    l2, _ = model_lib.forward(params, cfg_dot, toks, positions=pos)
+    np.testing.assert_allclose(np.asarray(l1), np.asarray(l2), atol=1e-4)
+
+
+def test_generate_flash_matches_dot():
+    from distributed_llms_tpu.runtime import generate as gen_lib
+
+    cfg_dot = ModelConfig(
+        family="llama", vocab_size=128, hidden_size=32, intermediate_size=64,
+        num_layers=2, num_heads=4, num_kv_heads=2, max_seq_len=64,
+        dtype="float32", attn_impl="dot",
+    )
+    cfg_flash = ModelConfig(**{**cfg_dot.__dict__, "attn_impl": "flash"})
+    params = model_lib.init_params(jax.random.key(0), cfg_dot)
+    prompt = jax.random.randint(jax.random.key(1), (2, 9), 0, 128, dtype=jnp.int32)
+    lens = jnp.array([5, 9], dtype=jnp.int32)
+    rng = jax.random.key(2)
+    ref = gen_lib.generate_tokens(params, cfg_dot, prompt, lens, rng, max_new_tokens=6)
+    out = gen_lib.generate_tokens(params, cfg_flash, prompt, lens, rng, max_new_tokens=6)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
