@@ -306,15 +306,22 @@ class Coordinator:
         while True:
             task = await self.task_queue.get()
             if task.future.done():
+                if task.future.cancelled():
+                    # Submitter gave up (wait_for timeout) while the task was
+                    # still queued — surface it as a failure, not silence.
+                    METRICS.inc("coordinator.tasks_failed")
                 continue
             wid = task.payload.get("worker_id")
             if wid and wid not in self.workers:
                 # Pinned worker is absent — it may reconnect and re-register
-                # under the same id (a heartbeat blip), so back off and
-                # requeue; the submitter's wait_for timeout bounds the wait
-                # (a cancelled future is dropped at the top of this loop).
-                await asyncio.sleep(0.2)
-                await self.task_queue.put(task)
+                # under the same id (a heartbeat blip).  Requeue after a
+                # delay *without* blocking this loop (other queued tasks keep
+                # dispatching); the submitter's wait_for timeout bounds the
+                # wait (a cancelled future is dropped at the top of this
+                # loop).  Pin-waits are not dispatches, so they don't consume
+                # task.attempts.
+                loop = asyncio.get_running_loop()
+                loop.call_later(0.2, self.task_queue.put_nowait, task)
                 continue
             info = self.workers.get(wid) if wid else self._pick_worker()
             if info is None:

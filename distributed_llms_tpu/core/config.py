@@ -23,7 +23,7 @@ except Exception:  # pragma: no cover
     _HAVE_YAML = False
 
 
-_ATTN_IMPLS = {"dot", "ring", "flash"}
+_ATTN_IMPLS = {"dot", "ring", "flash", "ulysses"}
 
 
 @dataclass(frozen=True)
@@ -49,9 +49,12 @@ class ModelConfig:
     tie_embeddings: bool = True
     dtype: str = "bfloat16"
     # Attention implementation: "dot" (XLA-fused), "flash" (Pallas fused
-    # blockwise kernel, ops/flash.py; inference paths — prefill uses it,
-    # single-token decode falls back to dot), or "ring" (sequence-parallel
-    # ppermute ring over the 'seq' mesh axis; prefill/training only).
+    # blockwise kernel, ops/flash.py: prefill and training forwards use it —
+    # note the backward recomputes attention densely at O(T^2) memory —
+    # while single-token decode falls back to dot), "ring" (sequence-parallel
+    # ppermute ring over the 'seq' mesh axis; prefill/training only), or
+    # "ulysses" (sequence-parallel all-to-all head scatter over 'seq';
+    # needs num_heads and num_kv_heads divisible by the seq axis).
     attn_impl: str = "dot"
 
     def __post_init__(self):

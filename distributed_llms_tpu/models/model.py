@@ -86,15 +86,22 @@ def _attention(
         )
         return layers.out_project(out, p), None
 
-    if cfg.attn_impl == "ring" and layer_cache is None:
-        # Sequence-parallel path: we are inside a shard_map over the 'seq'
+    if cfg.attn_impl in ("ring", "ulysses") and layer_cache is None:
+        # Sequence-parallel paths: we are inside a shard_map over the 'seq'
         # mesh axis (ParallelModel handles the wrapping); positions carry
-        # *global* indices so causality holds across rotating blocks.
+        # *global* indices so causality holds across blocks.
         if attn_mask is not None:
-            raise NotImplementedError("ring attention supports causal masking only")
-        from ..ops import ring
+            raise NotImplementedError(
+                f"{cfg.attn_impl} attention supports causal masking only"
+            )
+        if cfg.attn_impl == "ring":
+            from ..ops import ring
 
-        out = ring.ring_attention(q, k, v, positions, positions, axis_name="seq")
+            out = ring.ring_attention(q, k, v, positions, positions, axis_name="seq")
+        else:
+            from ..ops import ulysses
+
+            out = ulysses.ulysses_attention(q, k, v, positions, axis_name="seq")
         return layers.out_project(out, p), None
 
     if layer_cache is not None:

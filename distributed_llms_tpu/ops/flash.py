@@ -207,6 +207,18 @@ def _pad_to(x: jax.Array, axis: int, mult: int, value) -> jax.Array:
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(6, 7, 8, 9))
 def _flash(q, k, v, q_positions, k_positions, k_valid, causal, block_q, block_k, interpret):
+    # Inside shard_map (e.g. the Ulysses body) the inputs carry varying
+    # manual axes (vma); the output must declare the same set.
+    vma = frozenset().union(
+        *(getattr(jax.typeof(x), "vma", frozenset()) for x in (q, k, v))
+    )
+    if interpret and vma:
+        # The Pallas HLO *interpreter* (off-TPU test path) loses vma on its
+        # internal dynamic_slices; run the numerically-identical dense
+        # reference there.  Real TPU lowering takes the kernel.
+        return _dense_reference(
+            q, k, v, q_positions, k_positions, k_valid, causal
+        )
     b, tq, h, d = q.shape
     s = k.shape[1]
     kvh = k.shape[2]
@@ -240,7 +252,7 @@ def _flash(q, k, v, q_positions, k_positions, k_valid, causal, block_q, block_k,
     ]
     q_spec = pl.BlockSpec((1, bq, d), lambda bi, hi, qi, ki: (bi * h + hi, qi, 0))
     o_spec = pl.BlockSpec((1, bq, d), lambda bi, hi, qi, ki: (bi * h + hi, qi, 0))
-    out_shape = jax.ShapeDtypeStruct((b * h, tq_p, d), q.dtype)
+    out_shape = jax.ShapeDtypeStruct((b * h, tq_p, d), q.dtype, vma=vma)
     args = (
         qt.reshape(b * h, tq_p, d),
         kt.reshape(b * kvh, s_p, d),
@@ -271,12 +283,23 @@ def _flash(q, k, v, q_positions, k_positions, k_valid, causal, block_q, block_k,
             interpret=interpret,
         )(*args)
     else:
+        # Freshly created defaults are not device-varying over any manual
+        # mesh axis; align them with q/k/v so vma tracking stays consistent
+        # inside shard_map bodies (same trick as ops/ring.py).
+        align = (
+            (lambda x: jax.lax.pcast(x, tuple(vma), to="varying")) if vma
+            else (lambda x: x)
+        )
         if q_positions is None:
-            q_positions = jnp.broadcast_to(jnp.arange(tq, dtype=jnp.int32), (b, tq))
+            q_positions = align(
+                jnp.broadcast_to(jnp.arange(tq, dtype=jnp.int32), (b, tq))
+            )
         if k_positions is None:
-            k_positions = jnp.broadcast_to(jnp.arange(s, dtype=jnp.int32), (b, s))
+            k_positions = align(
+                jnp.broadcast_to(jnp.arange(s, dtype=jnp.int32), (b, s))
+            )
         kval = (
-            jnp.ones((b, s), jnp.int32)
+            align(jnp.ones((b, s), jnp.int32))
             if k_valid is None
             else k_valid.astype(jnp.int32)
         )

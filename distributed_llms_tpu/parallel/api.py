@@ -132,9 +132,10 @@ class ParallelModel:
     def _seq_forward(self, params, tokens, positions, remat):
         """Full forward under shard_map over {'seq'}: sequence axis sharded,
         global positions passed through so RoPE/causality stay correct;
-        attention runs the ppermute ring (ops/ring.py); 'data'/'model' axes
-        remain GSPMD-auto inside the body."""
-        cfg = _ring_cfg(self.cfg)
+        attention runs the ppermute ring (ops/ring.py) or, when the user set
+        attn_impl='ulysses', the all-to-all head scatter (ops/ulysses.py);
+        'data'/'model' axes remain GSPMD-auto inside the body."""
+        cfg = _seq_cfg(self.cfg)
         b, t = tokens.shape
         if positions is None:
             positions = jnp.broadcast_to(jnp.arange(t, dtype=jnp.int32), (b, t))
@@ -176,6 +177,7 @@ class ParallelModel:
             # and custom-mask calls fall through to the dense path (the ring
             # handles causal masking only; ring targets prefill/training).
             return self._seq_forward(params, tokens, positions, remat), None
+        cfg = _local_cfg(cfg)
         if not self.pipelined:
             return model_lib.forward(
                 params, cfg, tokens, positions=positions, cache=cache,
@@ -201,10 +203,25 @@ class ParallelModel:
         return logits, KVCache(k=nk, v=nv)
 
 
-def _ring_cfg(cfg: ModelConfig) -> ModelConfig:
+def _seq_cfg(cfg: ModelConfig) -> ModelConfig:
+    """Pick the sequence-parallel attention impl for the shard_map body:
+    the user's 'ulysses' is kept, anything else becomes the ring."""
     import dataclasses
 
+    if cfg.attn_impl == "ulysses":
+        return cfg
     return dataclasses.replace(cfg, attn_impl="ring")
+
+
+def _local_cfg(cfg: ModelConfig) -> ModelConfig:
+    """Strip sequence-parallel impls for paths that run *outside* shard_map
+    (decode-with-cache, pipeline stages): 'ring'/'ulysses' need a bound seq
+    axis and would raise; they degrade to the dense dot path."""
+    import dataclasses
+
+    if cfg.attn_impl in ("ring", "ulysses"):
+        return dataclasses.replace(cfg, attn_impl="dot")
+    return cfg
 
 
 def make_parallel_model(
