@@ -54,8 +54,8 @@ def init_store(model_id: str, num_shards: int, cfg: Config) -> str:
 
 
 async def repl(coord: Coordinator, cfg: Config) -> None:
-    print("commands: init <model> [shards] | assign [shards] | distribute | "
-          "inference | status | metrics | exit")
+    print("commands: init <model> [shards] | assign [shards] [policy] | "
+          "distribute | rebalance | inference | status | metrics | exit")
     store_dir: str | None = None
     while True:
         try:
@@ -72,10 +72,17 @@ async def repl(coord: Coordinator, cfg: Config) -> None:
                 store_dir = init_store(model_id, shards, cfg)
             elif cmd == "assign":
                 shards = int(rest[0]) if rest else cfg.checkpoint.num_shards
-                plan = coord.plan_shards(shards, store_dir=store_dir or cfg.checkpoint.shard_dir)
+                policy = rest[1] if len(rest) > 1 else "capacity"
+                plan = coord.plan_shards(
+                    shards, store_dir=store_dir or cfg.checkpoint.shard_dir,
+                    policy=policy,
+                )
                 print(json.dumps({str(k): v for k, v in plan.items()}, indent=1))
             elif cmd == "distribute":
                 print(json.dumps(await coord.place_shards(), indent=1))
+            elif cmd == "rebalance":
+                plan = await coord.rebalance()
+                print(json.dumps({str(k): v for k, v in plan.items()}, indent=1))
             elif cmd == "inference":
                 text = await _ainput("prompt: ")
                 out = await coord.generate([text])
@@ -106,17 +113,48 @@ async def amain(args: argparse.Namespace) -> None:
     coord = Coordinator(ccfg)
     await coord.start()
     local_tasks = []
+    procs = []
     if args.local:
         rt = cfg.runtime
         for _ in range(args.local):
             w = WorkerHost("127.0.0.1", coord.port, cfg=ccfg, rt=rt)
             local_tasks.append(asyncio.create_task(w.run()))
         log.info("spawned %d local in-process workers", args.local)
+    if args.local_proc:
+        # True process isolation (the reference's planned multiprocessing
+        # local-simulation mode, plan.md:225-233): each worker is a separate
+        # interpreter running the host entry point.
+        import subprocess
+
+        for i in range(args.local_proc):
+            procs.append(subprocess.Popen(
+                [sys.executable, "-m", "distributed_llms_tpu.cli.host_main",
+                 "--host", "127.0.0.1", "--port", str(coord.port),
+                 *(["--platform", args.platform] if args.platform else []),
+                 *(["--config", args.config] if args.config else []),
+                 *(x for ov in args.override for x in ("--override", ov))],
+            ))
+        log.info("spawned %d local worker processes", args.local_proc)
+    expected = args.local + args.local_proc
+    if expected:
+        # Don't hand the REPL to the user (or a piped script) until the local
+        # workers are actually registered — otherwise the first `assign`
+        # races the registrations.
+        for _ in range(600):
+            if len(coord.workers) >= expected:
+                break
+            await asyncio.sleep(0.1)
+        else:
+            log.warning(
+                "only %d/%d local workers registered", len(coord.workers), expected
+            )
     try:
         await repl(coord, cfg)
     finally:
         for t in local_tasks:
             t.cancel()
+        for p in procs:
+            p.terminate()
         await coord.stop()
 
 
@@ -129,6 +167,8 @@ def main(argv: list[str] | None = None) -> None:
     ap.add_argument("--port", type=int, default=None)
     ap.add_argument("--local", type=int, default=0, metavar="N",
                     help="spawn N in-process workers (local simulation)")
+    ap.add_argument("--local-proc", type=int, default=0, metavar="N",
+                    help="spawn N worker *processes* (isolated local simulation)")
     ap.add_argument("--platform", default=None, choices=["cpu", "tpu"],
                     help="force a JAX platform (e.g. cpu for a CPU-only host)")
     args = ap.parse_args(argv)

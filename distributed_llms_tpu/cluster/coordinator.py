@@ -105,8 +105,9 @@ class Coordinator:
         worker_id: str | None = None
         try:
             while True:
-                msg = await protocol.receive_message(reader)
-                worker_id = await self._handle_message(msg, writer, worker_id)
+                frame = await protocol.receive_message(reader)
+                for msg in protocol.unbatch(frame):
+                    worker_id = await self._handle_message(msg, writer, worker_id)
         except (asyncio.IncompleteReadError, ConnectionError):
             pass
         except protocol.ProtocolError as e:
@@ -201,10 +202,14 @@ class Coordinator:
         # Close the connection so the worker *sees* the eviction (EOF) and can
         # exit or reconnect — otherwise it heartbeats into the void forever.
         info.writer.close()
-        # free its shards and requeue its in-flight tasks
-        self.shard_assignment = {
-            s: w for s, w in self.shard_assignment.items() if w != worker_id
-        }
+        # Reassign its shards to survivors and requeue its in-flight tasks.
+        orphaned = sorted(
+            s for s, w in self.shard_assignment.items() if w == worker_id
+        )
+        for s in orphaned:
+            del self.shard_assignment[s]
+        if orphaned and self.workers:
+            self._bg.append(asyncio.create_task(self._reassign_orphans(orphaned)))
         for task in list(self.tasks.values()):
             if task.assigned_to == worker_id and not task.future.done():
                 await self._retry(task, reason=f"worker {worker_id} evicted")
@@ -224,17 +229,139 @@ class Coordinator:
 
     # -- model lifecycle ---------------------------------------------------
 
-    def plan_shards(self, num_shards: int, store_dir: str | None = None) -> dict[int, str]:
-        """Assign store shards to registered workers round-robin (the
-        reference's policy, :93-102), capability-aware hook included."""
+    def _capacity(self, info: WorkerInfo) -> float:
+        """Assignment weight from the worker's advertised capabilities.
+        The reference recorded capabilities (:193-197) but never used them
+        (SURVEY §2.2 'capacity-aware ... assignment' was plan-only)."""
+        caps = info.capabilities or {}
+        w = caps.get("capacity") or caps.get("memory_gb") or caps.get("num_devices") or 1
+        return max(float(w), 1e-9)
+
+    def _balanced_assign(
+        self, shards: list[int], load: dict[str, float] | None = None
+    ) -> dict[int, str]:
+        """Greedy capacity-weighted balancing: each shard goes to the worker
+        with the lowest (projected load / capacity) ratio."""
+        workers = sorted(self.workers)
+        load = dict(load or {w: 0.0 for w in workers})
+        weight = {w: self._capacity(self.workers[w]) for w in workers}
+        out: dict[int, str] = {}
+        for s in shards:
+            w = min(workers, key=lambda w_: ((load.get(w_, 0.0) + 1) / weight[w_], w_))
+            out[s] = w
+            load[w] = load.get(w, 0.0) + 1
+        return out
+
+    def plan_shards(
+        self,
+        num_shards: int,
+        store_dir: str | None = None,
+        policy: str = "capacity",
+    ) -> dict[int, str]:
+        """Assign store shards to registered workers.
+
+        policy='round_robin' reproduces the reference's only strategy
+        (src/master/node.py:93-102); 'capacity' (default) weights the
+        per-worker shard count by advertised capacity — with equal
+        capabilities it degenerates to the same balanced split."""
         if not self.workers:
             raise RuntimeError("no workers registered")
         self.num_shards = num_shards
         self.store_dir = store_dir
         workers = sorted(self.workers)
-        self.shard_assignment = {
-            s: workers[s % len(workers)] for s in range(num_shards)
-        }
+        if policy == "round_robin":
+            self.shard_assignment = {
+                s: workers[s % len(workers)] for s in range(num_shards)
+            }
+        elif policy == "capacity":
+            self.shard_assignment = self._balanced_assign(list(range(num_shards)))
+        else:
+            raise ValueError(f"unknown policy {policy!r}; round_robin|capacity")
+        return dict(self.shard_assignment)
+
+    async def _place_on(
+        self, wid: str, shards: list[int], timeout: float | None = None
+    ) -> Any:
+        """Tell one worker its (new) shard set — PLACE_SHARDS, or
+        UNLOAD_SHARDS when it lost everything — and sync bookkeeping."""
+        try:
+            if shards:
+                reply = await self.submit(
+                    "PLACE_SHARDS",
+                    {"store_dir": self.store_dir, "shards": sorted(shards)},
+                    worker_id=wid,
+                    timeout=timeout,
+                )
+            else:
+                reply = await self.submit("UNLOAD_SHARDS", {}, worker_id=wid, timeout=timeout)
+        except (RuntimeError, asyncio.TimeoutError) as e:
+            log.warning("placement on %s failed: %s", wid, e)
+            return {"error": str(e)}
+        info = self.workers.get(wid)  # may have been evicted meanwhile
+        if info is None:
+            return {"error": f"worker {wid} evicted during placement"}
+        info.shards = sorted(shards)
+        return reply
+
+    async def _reassign_orphans(self, orphaned: list[int]) -> None:
+        """Dynamic reassignment (plan.md:423-428, never built in the
+        reference): move an evicted worker's shards onto survivors —
+        capacity-weighted against their current load — and re-place them
+        from the store."""
+        try:
+            if not self.workers:
+                # Last worker died before this task ran.  num_shards is
+                # intact, so a later plan_shards/rebalance rebuilds the map.
+                log.warning(
+                    "no survivors to take orphaned shards %s; replan needed",
+                    orphaned,
+                )
+                return
+            load: dict[str, float] = {w: 0.0 for w in self.workers}
+            for s, w in self.shard_assignment.items():
+                if w in load:
+                    load[w] += 1
+            moved = self._balanced_assign(orphaned, load)
+            self.shard_assignment.update(moved)
+            METRICS.inc("coordinator.shards_reassigned", len(moved))
+            log.info("reassigned orphaned shards %s", moved)
+            if self.store_dir is None:
+                return
+            targets = sorted(set(moved.values()))
+            await asyncio.gather(
+                *(
+                    self._place_on(
+                        wid,
+                        [s for s, w in self.shard_assignment.items() if w == wid],
+                    )
+                    for wid in targets
+                )
+            )
+        except Exception:  # background task: never die silently
+            log.exception("orphan reassignment failed")
+
+    async def rebalance(self, policy: str = "capacity") -> dict[int, str]:
+        """Recompute the whole assignment over the *current* pool (e.g. after
+        workers joined) and re-place every worker whose shard set changed —
+        including workers that lost all shards (they get UNLOAD_SHARDS)."""
+        if not self.num_shards:
+            raise RuntimeError("plan_shards first")
+        old_sets: dict[str, list[int]] = {}
+        for s, w in self.shard_assignment.items():
+            old_sets.setdefault(w, []).append(s)
+        self.plan_shards(self.num_shards, self.store_dir, policy)
+        if self.store_dir is not None:
+            new_sets: dict[str, list[int]] = {}
+            for s, w in self.shard_assignment.items():
+                new_sets.setdefault(w, []).append(s)
+            changed = [
+                w for w in set(old_sets) | set(new_sets)
+                if w in self.workers
+                and sorted(old_sets.get(w, [])) != sorted(new_sets.get(w, []))
+            ]
+            await asyncio.gather(
+                *(self._place_on(wid, sorted(new_sets.get(wid, []))) for wid in changed)
+            )
         return dict(self.shard_assignment)
 
     async def place_shards(self, timeout: float | None = None) -> dict[str, Any]:
@@ -245,27 +372,10 @@ class Coordinator:
         per_worker: dict[str, list[int]] = {}
         for shard, wid in self.shard_assignment.items():
             per_worker.setdefault(wid, []).append(shard)
-
-        async def place_one(wid: str, shards: list[int]) -> Any:
-            # Placements are independent — run them concurrently so N hosts
-            # load/compile in ~1× wall-clock, not N×.
-            try:
-                reply = await self.submit(
-                    "PLACE_SHARDS",
-                    {"store_dir": self.store_dir, "shards": sorted(shards)},
-                    worker_id=wid,
-                    timeout=timeout,
-                )
-            except (RuntimeError, asyncio.TimeoutError) as e:
-                return {"error": str(e)}
-            info = self.workers.get(wid)  # may have been evicted meanwhile
-            if info is None:
-                return {"error": f"worker {wid} evicted during placement"}
-            info.shards = sorted(shards)
-            return reply
-
+        # Placements are independent — run them concurrently so N hosts
+        # load/compile in ~1× wall-clock, not N×.
         replies = await asyncio.gather(
-            *(place_one(w, s) for w, s in per_worker.items())
+            *(self._place_on(w, s, timeout) for w, s in per_worker.items())
         )
         return dict(zip(per_worker, replies))
 

@@ -12,7 +12,11 @@ device_put, SURVEY §2.4).  Differences by design:
   defects D1-D3);
 - every message carries ``type`` + ``payload``; requests carry ``msg_id`` so
   replies correlate (the reference matched on task_id with a re-queue race,
-  D9).
+  D9);
+- large frames are transparently zlib-compressed (flag bit in the length
+  prefix) and multiple messages can ride one frame via BATCH — the
+  compression/batching the reference planned (plan.md:285-288, 482-486) but
+  never built.
 
 Message set (reference's MESSAGE_TYPES at protocol.py:12-20 mapped to the
 mesh runtime):
@@ -26,9 +30,13 @@ from __future__ import annotations
 import asyncio
 import json
 import struct
+import zlib
 from typing import Any
 
 MAX_FRAME = 64 * 1024 * 1024  # control plane only; nothing big belongs here
+COMPRESS_MIN = 2048  # frames at least this large get zlib'd
+_FLAG_ZLIB = 0x01  # stored in the top byte of the 8-byte length prefix
+_LEN_MASK = (1 << 56) - 1
 
 MESSAGE_TYPES = frozenset(
     {
@@ -44,6 +52,7 @@ MESSAGE_TYPES = frozenset(
         "GET_STATUS",
         "GET_METRICS",
         "SHUTDOWN",
+        "BATCH",
     }
 )
 
@@ -52,20 +61,36 @@ class ProtocolError(Exception):
     pass
 
 
-def encode(msg: dict[str, Any]) -> bytes:
+def encode(msg: dict[str, Any], compress: bool | None = None) -> bytes:
+    """Frame one message.  ``compress=None`` auto-compresses bodies >=
+    COMPRESS_MIN when it actually shrinks them."""
     if msg.get("type") not in MESSAGE_TYPES:
         raise ProtocolError(f"unknown message type {msg.get('type')!r}")
     body = json.dumps(msg, separators=(",", ":")).encode("utf-8")
     if len(body) > MAX_FRAME:
+        # Check the *logical* size pre-compression: the receiver enforces the
+        # same bound post-decompression, so an over-limit-but-compressible
+        # frame must fail at send time, not as a silent connection drop.
         raise ProtocolError(f"frame too large ({len(body)} bytes)")
-    return struct.pack(">Q", len(body)) + body
+    flags = 0
+    if compress is None:
+        compress = len(body) >= COMPRESS_MIN
+    if compress:
+        packed = zlib.compress(body, 6)
+        if len(packed) < len(body):
+            body, flags = packed, _FLAG_ZLIB
+    if len(body) > MAX_FRAME:
+        raise ProtocolError(f"frame too large ({len(body)} bytes)")
+    return struct.pack(">Q", (flags << 56) | len(body)) + body
 
 
-def decode_header(header: bytes) -> int:
-    (n,) = struct.unpack(">Q", header)
+def decode_header(header: bytes) -> tuple[int, int]:
+    """-> (body length, flags)."""
+    (v,) = struct.unpack(">Q", header)
+    flags, n = v >> 56, v & _LEN_MASK
     if n > MAX_FRAME:
         raise ProtocolError(f"frame too large ({n} bytes)")
-    return n
+    return n, flags
 
 
 async def send_message(writer: asyncio.StreamWriter, msg: dict[str, Any]) -> None:
@@ -82,8 +107,18 @@ async def receive_message(
     does)."""
     async def _recv() -> dict[str, Any]:
         header = await reader.readexactly(8)
-        n = decode_header(header)
+        n, flags = decode_header(header)
         body = await reader.readexactly(n)
+        if flags & _FLAG_ZLIB:
+            # Bounded inflate: cap the output BEFORE allocating it, so a
+            # decompression bomb can't balloon past MAX_FRAME.
+            try:
+                d = zlib.decompressobj()
+                body = d.decompress(body, MAX_FRAME + 1)
+            except zlib.error as e:
+                raise ProtocolError(f"bad compressed frame: {e}") from e
+            if len(body) > MAX_FRAME or d.unconsumed_tail:
+                raise ProtocolError("decompressed frame too large")
         try:
             msg = json.loads(body.decode("utf-8"))
         except (UnicodeDecodeError, json.JSONDecodeError) as e:
@@ -103,3 +138,34 @@ def message(type_: str, payload: Any = None, msg_id: str | None = None, **extra)
         out["msg_id"] = msg_id
     out.update(extra)
     return out
+
+
+# -- batching ---------------------------------------------------------------
+
+def batch(msgs: list[dict]) -> dict:
+    """Wrap several messages into one frame (one syscall, one compression
+    context).  Receivers expand with :func:`unbatch`."""
+    return message("BATCH", {"messages": list(msgs)})
+
+
+def unbatch(msg: dict) -> list[dict]:
+    """Expand a BATCH message; any other message passes through as [msg]."""
+    if msg.get("type") != "BATCH":
+        return [msg]
+    inner = (msg.get("payload") or {}).get("messages")
+    if not isinstance(inner, list):
+        raise ProtocolError("BATCH payload must carry a 'messages' list")
+    for m in inner:
+        if not isinstance(m, dict) or m.get("type") not in MESSAGE_TYPES or m.get("type") == "BATCH":
+            raise ProtocolError(f"invalid batched message: {str(m)[:200]}")
+    return inner
+
+
+async def send_messages(writer: asyncio.StreamWriter, msgs: list[dict]) -> None:
+    """Send several messages in one frame (BATCH) — message batching the
+    reference planned at plan.md:285-288."""
+    if len(msgs) == 1:
+        await send_message(writer, msgs[0])
+        return
+    writer.write(encode(batch(msgs)))
+    await writer.drain()

@@ -153,21 +153,22 @@ class WorkerHost:
 
     async def _serve(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter) -> None:
         while not self._stop.is_set():
-            msg = await protocol.receive_message(reader)
-            msg_id = msg.get("msg_id")
-            try:
-                result = await self._handle(msg)
-                if msg_id is not None:
-                    await protocol.send_message(
-                        writer, protocol.message("RESULT", result, msg_id=msg_id)
-                    )
-            except Exception as e:  # report, don't die (coordinator retries)
-                log.exception("command %s failed", msg["type"])
-                if msg_id is not None:
-                    await protocol.send_message(
-                        writer,
-                        protocol.message("ERROR", {"error": str(e)}, msg_id=msg_id),
-                    )
+            frame = await protocol.receive_message(reader)
+            for msg in protocol.unbatch(frame):
+                msg_id = msg.get("msg_id")
+                try:
+                    result = await self._handle(msg)
+                    if msg_id is not None:
+                        await protocol.send_message(
+                            writer, protocol.message("RESULT", result, msg_id=msg_id)
+                        )
+                except Exception as e:  # report, don't die (coordinator retries)
+                    log.exception("command %s failed", msg["type"])
+                    if msg_id is not None:
+                        await protocol.send_message(
+                            writer,
+                            protocol.message("ERROR", {"error": str(e)}, msg_id=msg_id),
+                        )
 
     async def _handle(self, msg: dict) -> Any:
         mtype = msg["type"]

@@ -123,6 +123,7 @@ class RuntimeConfig:
     kv_host_spill: bool = False  # spill KV blocks to host DRAM
     remat: bool = False  # jax.checkpoint on decoder blocks
     seed: int = 0
+    profile_dir: str | None = None  # capture jax.profiler traces of generate
 
 
 @dataclass(frozen=True)

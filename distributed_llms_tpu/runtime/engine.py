@@ -17,8 +17,11 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+import contextlib
+
 from ..core.config import Config, ModelConfig, RuntimeConfig
 from ..core.observability import METRICS, get_logger
+from ..core import profiling
 from ..models import model as model_lib
 from ..models.presets import get_preset
 from . import generate as gen_lib
@@ -70,6 +73,7 @@ class InferenceEngine:
         # (identity-hashed) make_cache and caches the compilation.
         kv_dtype = jnp.dtype(rt.kv_cache_dtype)
         self._make_cache = lambda cfg_, b, s: model_lib.init_cache(cfg_, b, s, dtype=kv_dtype)
+        self._timer = profiling.StepTimer("engine.generate")
 
     @classmethod
     def from_preset(
@@ -89,16 +93,23 @@ class InferenceEngine:
         gen_lib.check_sequence_budget(prompt_arr.shape[1], n_new, self.rt, self.cfg)
         rng = jax.random.key(seed if seed is not None else self.rt.seed)
 
-        t0 = time.perf_counter()
-        out = gen_lib.generate_tokens(
-            self.params, self.cfg,
-            jnp.asarray(prompt_arr), jnp.asarray(lens), rng,
-            max_new_tokens=n_new,
-            temperature=self.rt.temperature, top_k=self.rt.top_k, top_p=self.rt.top_p,
-            eos_id=tok.eos_id, pad_id=tok.pad_id, make_cache=self._make_cache,
+        profile_ctx = (
+            profiling.trace(self.rt.profile_dir)
+            if self.rt.profile_dir
+            else contextlib.nullcontext()
         )
-        out = np.asarray(jax.block_until_ready(out))
+        t0 = time.perf_counter()
+        with profile_ctx, self._timer.step(tokens=len(prompts) * n_new):
+            out = gen_lib.generate_tokens(
+                self.params, self.cfg,
+                jnp.asarray(prompt_arr), jnp.asarray(lens), rng,
+                max_new_tokens=n_new,
+                temperature=self.rt.temperature, top_k=self.rt.top_k, top_p=self.rt.top_p,
+                eos_id=tok.eos_id, pad_id=tok.pad_id, make_cache=self._make_cache,
+            )
+            out = np.asarray(jax.block_until_ready(out))
         dt = time.perf_counter() - t0
+        profiling.record_memory_stats()
 
         texts = [tok.decode(row) for row in out]
         gen_count = int(out.shape[0] * out.shape[1])

@@ -30,9 +30,56 @@ def fast_cfg(**kw):
 def test_encode_decode_roundtrip():
     msg = protocol.message("REGISTER", {"capabilities": {"platform": "cpu"}})
     raw = protocol.encode(msg)
-    n = protocol.decode_header(raw[:8])
+    n, flags = protocol.decode_header(raw[:8])
     assert n == len(raw) - 8
+    assert flags == 0  # small frame: uncompressed
     assert json.loads(raw[8:]) == msg
+
+
+def test_encode_compresses_large_frames():
+    big = protocol.message("RESULT", {"text": ["x" * 100_000]})
+    raw = protocol.encode(big)
+    n, flags = protocol.decode_header(raw[:8])
+    assert flags == 1
+    assert n < 10_000  # zlib shrank 100kB of 'x'
+    import zlib
+
+    assert json.loads(zlib.decompress(raw[8:])) == big
+
+
+@pytest.mark.asyncio
+async def test_compressed_and_batched_over_the_wire():
+    """Large (compressed) frames and BATCH frames round-trip through the real
+    coordinator socket."""
+    coord = Coordinator(fast_cfg())
+    await coord.start()
+    try:
+        reader, writer = await asyncio.open_connection("127.0.0.1", coord.port)
+        big_caps = {"note": "y" * 50_000}
+        await protocol.send_messages(
+            writer,
+            [
+                protocol.message("REGISTER", {"worker_id": "b", "capabilities": big_caps}),
+                protocol.message("HEARTBEAT", {}),
+            ],
+        )
+        ack = await protocol.receive_message(reader, timeout=5)
+        assert ack["type"] == "REGISTER_ACK"
+        for _ in range(50):
+            if "b" in coord.workers:
+                break
+            await asyncio.sleep(0.02)
+        assert coord.workers["b"].capabilities == big_caps
+        writer.close()
+    finally:
+        await coord.stop()
+
+
+def test_unbatch_rejects_nested_and_invalid():
+    with pytest.raises(protocol.ProtocolError, match="messages"):
+        protocol.unbatch({"type": "BATCH", "payload": {}})
+    with pytest.raises(protocol.ProtocolError, match="invalid batched"):
+        protocol.unbatch(protocol.batch([protocol.batch([])]))
 
 
 def test_encode_rejects_unknown_type():
@@ -186,6 +233,104 @@ async def test_generate_without_placement_errors_then_retries_exhaust(tmp_path):
         await coord.stop()
 
 
+async def register_fake(coord, wid, caps):
+    """Raw-protocol registration with custom capabilities."""
+    reader, writer = await asyncio.open_connection("127.0.0.1", coord.port)
+    await protocol.send_message(
+        writer, protocol.message("REGISTER", {"worker_id": wid, "capabilities": caps})
+    )
+    ack = await protocol.receive_message(reader, timeout=5)
+    assert ack["type"] == "REGISTER_ACK"
+    return reader, writer
+
+
+@pytest.mark.asyncio
+async def test_capacity_aware_plan():
+    """Workers advertising more capacity receive proportionally more shards
+    (the reference recorded capabilities but never used them, SURVEY §2.2)."""
+    coord = Coordinator(fast_cfg())
+    await coord.start()
+    try:
+        r1, w1 = await register_fake(coord, "big", {"num_devices": 3})
+        r2, w2 = await register_fake(coord, "small", {"num_devices": 1})
+        plan = coord.plan_shards(4)
+        counts = {"big": 0, "small": 0}
+        for wid in plan.values():
+            counts[wid] += 1
+        assert counts == {"big": 3, "small": 1}
+        # round_robin parity policy still splits 2/2
+        plan_rr = coord.plan_shards(4, policy="round_robin")
+        assert sorted(plan_rr.values()) == ["big", "big", "small", "small"]
+        w1.close(), w2.close()
+    finally:
+        await coord.stop()
+
+
+@pytest.mark.asyncio
+async def test_eviction_reassigns_shards(tmp_path):
+    """Dynamic reassignment on pool change (plan.md:423-428, never built):
+    a dead worker's shards move to the survivor and get re-placed."""
+    calls: list[tuple[str, list[int]]] = []
+
+    def factory(store_dir, shards, rt):
+        calls.append(("w", shards))
+        return FakeEngine()
+
+    coord = Coordinator(fast_cfg())
+    await coord.start()
+    try:
+        w1, t1 = await start_worker(coord, factory=factory)
+        w2, t2 = await start_worker(coord, factory=factory)
+        coord.plan_shards(4, store_dir=str(tmp_path))
+        await coord.place_shards()
+        assert len(calls) == 2
+
+        t1.cancel()  # dies silently -> deadline eviction
+        for _ in range(100):
+            await asyncio.sleep(0.05)
+            if (
+                w1.worker_id not in coord.workers
+                and set(coord.shard_assignment.values()) == {w2.worker_id}
+                and len(calls) >= 3
+            ):
+                break
+        assert set(coord.shard_assignment.values()) == {w2.worker_id}
+        assert sorted(coord.shard_assignment) == [0, 1, 2, 3]
+        assert sorted(calls[-1][1]) == [0, 1, 2, 3]  # survivor re-placed all
+        t2.cancel()
+    finally:
+        await coord.stop()
+
+
+@pytest.mark.asyncio
+async def test_rebalance_after_join(tmp_path):
+    """A worker joining after placement takes over shards via rebalance()."""
+    calls: list[list[int]] = []
+
+    def factory(store_dir, shards, rt):
+        calls.append(shards)
+        return FakeEngine()
+
+    coord = Coordinator(fast_cfg())
+    await coord.start()
+    try:
+        w1, t1 = await start_worker(coord, factory=factory)
+        coord.plan_shards(4, store_dir=str(tmp_path))
+        await coord.place_shards()
+        assert set(coord.shard_assignment.values()) == {w1.worker_id}
+
+        w2, t2 = await start_worker(coord, factory=factory)
+        plan = await coord.rebalance()
+        assert set(plan.values()) == {w1.worker_id, w2.worker_id}
+        per = {}
+        for s, wid in plan.items():
+            per.setdefault(wid, []).append(s)
+        assert sorted(len(v) for v in per.values()) == [2, 2]
+        t1.cancel(), t2.cancel()
+    finally:
+        await coord.stop()
+
+
 @pytest.mark.asyncio
 async def test_status_and_metrics_client(tmp_path):
     coord = Coordinator(fast_cfg())
@@ -199,6 +344,36 @@ async def test_status_and_metrics_client(tmp_path):
             assert "counters" in metrics
         wt.cancel()
     finally:
+        await coord.stop()
+
+
+@pytest.mark.asyncio
+async def test_worker_process_registers():
+    """Process-isolated local simulation (the reference's planned
+    multiprocessing mode, plan.md:225-233): a separate interpreter running
+    host_main registers with the coordinator."""
+    import subprocess
+    import sys
+
+    coord = Coordinator(fast_cfg())
+    await coord.start()
+    import pathlib
+
+    repo_root = str(pathlib.Path(__file__).resolve().parents[2])
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "distributed_llms_tpu.cli.host_main",
+         "--host", "127.0.0.1", "--port", str(coord.port), "--platform", "cpu"],
+        cwd=repo_root,
+    )
+    try:
+        for _ in range(300):  # jax import in the child takes a few seconds
+            if coord.workers:
+                break
+            await asyncio.sleep(0.1)
+        assert coord.workers, "worker process never registered"
+    finally:
+        proc.terminate()
+        proc.wait(timeout=10)
         await coord.stop()
 
 
