@@ -148,8 +148,27 @@ def convert_gpt2(sd: StateDict, cfg: ModelConfig) -> dict[str, Any]:
     return params
 
 
+def _stack_experts(
+    sd: StateDict, template: str, num_layers: int, num_experts: int,
+    fn: Callable[[Array], Array],
+) -> np.ndarray:
+    """Stack [L, E, ...] from per-layer per-expert keys."""
+    per_layer = []
+    for i in range(num_layers):
+        per_expert = []
+        for j in range(num_experts):
+            key = template.format(i=i, j=j)
+            if key not in sd:
+                raise KeyError(f"missing checkpoint key {key!r}")
+            per_expert.append(fn(np.asarray(sd[key])))
+        per_layer.append(np.stack(per_expert))
+    return np.stack(per_layer)
+
+
 def convert_llama(sd: StateDict, cfg: ModelConfig) -> dict[str, Any]:
-    """Llama/TinyLlama/Llama-3 use nn.Linear: stored [out, in] -> transpose."""
+    """Llama/TinyLlama/Llama-3 use nn.Linear: stored [out, in] -> transpose.
+    With cfg.num_experts > 0 the MLP mapping follows Mixtral's
+    ``block_sparse_moe`` layout (gate router + per-expert w1/w2/w3)."""
     sd = _strip_prefix(sd, ("model.",))
     D, H, KVH, HD = cfg.hidden_size, cfg.num_heads, cfg.num_kv_heads, cfg.head_dim_
     L = cfg.num_layers
@@ -165,11 +184,32 @@ def convert_llama(sd: StateDict, cfg: ModelConfig) -> dict[str, Any]:
                 "wv": _stack(sd, "layers.{i}.self_attn.v_proj.weight", L, lambda w: w.T.reshape(D, KVH, HD)),
                 "wo": _stack(sd, "layers.{i}.self_attn.o_proj.weight", L, lambda w: w.T.reshape(H, HD, D)),
             },
-            "mlp": {
-                "w_gate": _stack(sd, "layers.{i}.mlp.gate_proj.weight", L, lambda w: w.T),
-                "w_up": _stack(sd, "layers.{i}.mlp.up_proj.weight", L, lambda w: w.T),
-                "w_down": _stack(sd, "layers.{i}.mlp.down_proj.weight", L, lambda w: w.T),
-            },
+            "mlp": (
+                {
+                    # Mixtral: w1 = gate, w3 = up, w2 = down (all [out, in]).
+                    "router": _stack(
+                        sd, "layers.{i}.block_sparse_moe.gate.weight", L, lambda w: w.T
+                    ),
+                    "w_gate": _stack_experts(
+                        sd, "layers.{i}.block_sparse_moe.experts.{j}.w1.weight",
+                        L, cfg.num_experts, lambda w: w.T,
+                    ),
+                    "w_up": _stack_experts(
+                        sd, "layers.{i}.block_sparse_moe.experts.{j}.w3.weight",
+                        L, cfg.num_experts, lambda w: w.T,
+                    ),
+                    "w_down": _stack_experts(
+                        sd, "layers.{i}.block_sparse_moe.experts.{j}.w2.weight",
+                        L, cfg.num_experts, lambda w: w.T,
+                    ),
+                }
+                if cfg.num_experts > 0
+                else {
+                    "w_gate": _stack(sd, "layers.{i}.mlp.gate_proj.weight", L, lambda w: w.T),
+                    "w_up": _stack(sd, "layers.{i}.mlp.up_proj.weight", L, lambda w: w.T),
+                    "w_down": _stack(sd, "layers.{i}.mlp.down_proj.weight", L, lambda w: w.T),
+                }
+            ),
         },
     }
     if not cfg.tie_embeddings:
@@ -217,7 +257,7 @@ def config_from_hf(hf_config: Mapping[str, Any]) -> ModelConfig:
             norm_eps=hf_config.get("layer_norm_epsilon", 1e-5),
             tie_embeddings=True,
         )
-    if model_type == "llama" or "llama" in arch:
+    if model_type in ("llama", "mixtral") or "llama" in arch or "mixtral" in arch:
         return ModelConfig(
             family="llama",
             vocab_size=hf_config["vocab_size"],
@@ -230,5 +270,8 @@ def config_from_hf(hf_config: Mapping[str, Any]) -> ModelConfig:
             rope_theta=hf_config.get("rope_theta", 10000.0),
             norm_eps=hf_config.get("rms_norm_eps", 1e-5),
             tie_embeddings=hf_config.get("tie_word_embeddings", False),
+            # Mixtral MoE knobs (0 experts -> dense llama).
+            num_experts=hf_config.get("num_local_experts", 0) or 0,
+            num_experts_per_token=hf_config.get("num_experts_per_tok", 2) or 2,
         )
     raise ValueError(f"unsupported HF model_type {model_type!r}")

@@ -65,6 +65,11 @@ class ModelConfig:
     # MoE (expert parallelism); num_experts == 0 -> dense MLP.
     num_experts: int = 0
     num_experts_per_token: int = 2
+    # Per-expert buffer = capacity_factor * k * tokens / num_experts; tokens
+    # routed past a full expert are dropped (standard GShard semantics).
+    moe_capacity_factor: float = 1.25
+    # Weight of the Switch-style load-balance aux loss added by lm_loss.
+    moe_aux_loss_weight: float = 0.02
 
     @property
     def head_dim_(self) -> int:

@@ -155,3 +155,72 @@ def mlp_swiglu(x: jax.Array, p: Params) -> jax.Array:
     up = jnp.einsum("btd,df->btf", x, p["w_up"])
     h = jax.nn.silu(gate) * up
     return jnp.einsum("btf,fd->btd", h, p["w_down"])
+
+
+def moe_swiglu(
+    x: jax.Array, p: Params, cfg: ModelConfig
+) -> tuple[jax.Array, jax.Array]:
+    """Mixture-of-experts SwiGLU MLP (Mixtral-style routing, GShard-style
+    capacity semantics, scatter-based dispatch).  Net-new vs the reference
+    (SURVEY §2.3: MoE absent).  Returns (output, aux_load_balance_loss).
+
+    - router: top-k experts per token, gates = softmax over the k logits
+      (Mixtral convention);
+    - dispatch: every (token, choice) claim computes its slot index
+      ``expert * cap + position_in_expert`` and the token rows are
+      scatter-added into a per-expert buffer [E, C, D] — O(tokens·D) memory,
+      not the O(tokens²) of dense one-hot dispatch tensors.  C =
+      ceil(capacity_factor · k · tokens / E); earlier-ranked choices win
+      capacity first, overflow claims are dropped (contribute zero) — all
+      shapes static, XLA-friendly;
+    - expert compute: per-expert SwiGLU over stacked weights [E, D, F]; with
+      the expert axis sharded over the 'expert' mesh axis, GSPMD turns the
+      scatter/gather into the all-to-alls of expert parallelism;
+    - aux loss: Switch-Transformer load-balancing term
+      ``E · Σ_e importance_e · load_e`` (mean router prob × dispatched
+      fraction) — scale by ``cfg.moe_aux_loss_weight`` and add to the task
+      loss, or the router collapses and capacity silently drops most tokens.
+
+    p: router [D, E], w_gate/w_up [E, D, F], w_down [E, F, D].
+    """
+    b, t, d = x.shape
+    e, k = cfg.num_experts, cfg.num_experts_per_token
+    s = b * t
+    cap = max(1, int(-(-cfg.moe_capacity_factor * k * s // e)))  # ceil
+    xf = x.reshape(s, d)
+
+    logits = jnp.einsum(
+        "sd,de->se", xf, p["router"], preferred_element_type=jnp.float32
+    )
+    topv, topi = jax.lax.top_k(logits, k)  # [s, k]
+    gates = jax.nn.softmax(topv, axis=-1)  # [s, k] f32
+
+    # Choice-major claim order: every token's 1st choice claims capacity
+    # before any 2nd choice does.  eid: [k*s] expert id per claim.
+    eid = topi.T.reshape(k * s)
+    oh = jax.nn.one_hot(eid, e, dtype=jnp.float32)  # [k*s, e]
+    pos = jnp.sum((jnp.cumsum(oh, axis=0) - 1.0) * oh, axis=-1)  # [k*s]
+    keep = pos < cap
+    slot = jnp.where(keep, eid * cap + pos.astype(jnp.int32), e * cap)
+
+    token_idx = jnp.tile(jnp.arange(s), k)  # claim -> source token
+    buf = jnp.zeros((e * cap + 1, d), xf.dtype)  # +1: overflow dump row
+    buf = buf.at[slot].add(xf[token_idx] * keep[:, None].astype(xf.dtype))
+    xe = buf[:-1].reshape(e, cap, d)
+
+    g = jnp.einsum("ecd,edf->ecf", xe, p["w_gate"])
+    u = jnp.einsum("ecd,edf->ecf", xe, p["w_up"])
+    ye = jnp.einsum("ecf,efd->ecd", jax.nn.silu(g) * u, p["w_down"])
+
+    yflat = jnp.concatenate([ye.reshape(e * cap, d), jnp.zeros((1, d), ye.dtype)])
+    gathered = yflat[slot]  # [k*s, d]; dropped claims hit the zero row
+    w = (gates.T.reshape(k * s) * keep).astype(gathered.dtype)
+    y = jnp.sum((gathered * w[:, None]).reshape(k, s, d), axis=0)
+
+    # Switch-style load-balance aux: importance (mean prob) x load (dispatch
+    # fraction) per expert, scaled by E so the balanced value is ~1.
+    probs = jax.nn.softmax(logits, axis=-1)  # [s, e] f32
+    importance = jnp.mean(probs, axis=0)
+    load = jnp.sum(oh * keep[:, None].astype(oh.dtype), axis=0) / (s * k)
+    aux = e * jnp.sum(importance * load)
+    return y.reshape(b, t, d).astype(x.dtype), aux

@@ -140,21 +140,26 @@ def _attention(
 
 
 def gpt2_block(x, p, cfg, positions, layer_cache, cache_index, attn_mask=None, std_layout=False):
+    """-> (x, new_cache, aux): aux is the MoE load-balance term (0 here)."""
     h = layers.layer_norm(x, p["ln1"]["scale"], p["ln1"]["bias"], cfg.norm_eps)
     attn_out, new_cache = _attention(h, p["attn"], cfg, positions, layer_cache, cache_index, use_rope=False, attn_mask=attn_mask, std_layout=std_layout)
     x = x + attn_out
     h = layers.layer_norm(x, p["ln2"]["scale"], p["ln2"]["bias"], cfg.norm_eps)
     x = x + layers.mlp_gelu(h, p["mlp"])
-    return x, new_cache
+    return x, new_cache, jnp.float32(0.0)
 
 
 def llama_block(x, p, cfg, positions, layer_cache, cache_index, attn_mask=None, std_layout=False):
+    """-> (x, new_cache, aux): aux is the MoE load-balance term."""
     h = layers.rms_norm(x, p["ln1"]["scale"], cfg.norm_eps)
     attn_out, new_cache = _attention(h, p["attn"], cfg, positions, layer_cache, cache_index, use_rope=True, attn_mask=attn_mask, std_layout=std_layout)
     x = x + attn_out
     h = layers.rms_norm(x, p["ln2"]["scale"], cfg.norm_eps)
+    if "router" in p["mlp"]:  # MoE block (cfg.num_experts > 0)
+        mlp_out, aux = layers.moe_swiglu(h, p["mlp"], cfg)
+        return x + mlp_out, new_cache, aux
     x = x + layers.mlp_swiglu(h, p["mlp"])
-    return x, new_cache
+    return x, new_cache, jnp.float32(0.0)
 
 
 BLOCK_FNS = {"gpt2": gpt2_block, "llama": llama_block}
@@ -171,30 +176,31 @@ def run_blocks(
     remat: bool = False,
     attn_mask: jax.Array | None = None,
     std_layout: bool = False,
-) -> tuple[jax.Array, tuple[jax.Array, jax.Array] | None]:
+) -> tuple[jax.Array, tuple[jax.Array, jax.Array] | None, jax.Array]:
     """Scan the stacked blocks over x.  Used both for the whole model and for
-    a single pipeline stage (blocks then hold only the stage's layer slice)."""
+    a single pipeline stage (blocks then hold only the stage's layer slice).
+    Returns (x, caches, aux) — aux sums the MoE load-balance terms."""
     block_fn = BLOCK_FNS[cfg.family]
 
     if cache_k is None:
         def body(carry, layer_params):
-            y, _ = block_fn(carry, layer_params, cfg, positions, None, None, attn_mask, std_layout)
-            return y, None
+            y, _, aux = block_fn(carry, layer_params, cfg, positions, None, None, attn_mask, std_layout)
+            return y, aux
 
         if remat:
             body = jax.checkpoint(body)
-        x, _ = jax.lax.scan(body, x, blocks)
-        return x, None
+        x, auxs = jax.lax.scan(body, x, blocks)
+        return x, None, jnp.sum(auxs)
 
     def body(carry, xs):
         layer_params, ck, cv = xs
-        y, new_cache = block_fn(carry, layer_params, cfg, positions, (ck, cv), cache_index, attn_mask, std_layout)
-        return y, new_cache
+        y, new_cache, aux = block_fn(carry, layer_params, cfg, positions, (ck, cv), cache_index, attn_mask, std_layout)
+        return y, (new_cache, aux)
 
     if remat:
         body = jax.checkpoint(body)
-    x, (new_k, new_v) = jax.lax.scan(body, x, (blocks, cache_k, cache_v))
-    return x, (new_k, new_v)
+    x, ((new_k, new_v), auxs) = jax.lax.scan(body, x, (blocks, cache_k, cache_v))
+    return x, (new_k, new_v), jnp.sum(auxs)
 
 
 # ---------------------------------------------------------------------------
@@ -231,8 +237,11 @@ def forward(
     cache_index: jax.Array | None = None,  # scalar int32: write offset into cache
     remat: bool = False,
     attn_mask: jax.Array | None = None,  # broadcastable to [B, H, Tq, S]; True = attend
-) -> tuple[jax.Array, KVCache | None]:
-    """Full forward.  Returns (logits [B, T, V] float32, updated cache).
+    return_aux: bool = False,  # also return the MoE load-balance aux loss
+) -> tuple[jax.Array, KVCache | None] | tuple[jax.Array, KVCache | None, jax.Array]:
+    """Full forward.  Returns (logits [B, T, V] float32, updated cache), plus
+    the summed MoE aux loss when ``return_aux`` (scale by
+    cfg.moe_aux_loss_weight and add to the task loss when training MoE).
 
     Contract: ``cache_index + T`` must not exceed ``cache.max_len`` — XLA's
     ``dynamic_update_slice`` clamps out-of-range starts, which would silently
@@ -248,12 +257,14 @@ def forward(
         positions = jnp.broadcast_to(jnp.arange(t, dtype=jnp.int32) + base, (b, t))
     x = embed(params, cfg, tokens, positions)
     if cache is None:
-        x, _ = run_blocks(x, params["blocks"], cfg, positions, None, None, None, remat, attn_mask, std_layout)
-        return unembed(params, cfg, x), None
-    x, (new_k, new_v) = run_blocks(
-        x, params["blocks"], cfg, positions, cache.k, cache.v, cache_index, remat, attn_mask, std_layout
-    )
-    return unembed(params, cfg, x), KVCache(k=new_k, v=new_v)
+        x, _, aux = run_blocks(x, params["blocks"], cfg, positions, None, None, None, remat, attn_mask, std_layout)
+        out = (unembed(params, cfg, x), None)
+    else:
+        x, (new_k, new_v), aux = run_blocks(
+            x, params["blocks"], cfg, positions, cache.k, cache.v, cache_index, remat, attn_mask, std_layout
+        )
+        out = (unembed(params, cfg, x), KVCache(k=new_k, v=new_v))
+    return (*out, aux) if return_aux else out
 
 
 # ---------------------------------------------------------------------------
@@ -297,6 +308,20 @@ def init_params(rng: jax.Array, cfg: ModelConfig, dtype: Any = None) -> Params:
             },
         }
     elif cfg.family == "llama":
+        if cfg.num_experts > 0:
+            E = cfg.num_experts
+            mlp = {
+                "router": dense(next(keys), (L, D, E), D),
+                "w_gate": dense(next(keys), (L, E, D, F), D),
+                "w_up": dense(next(keys), (L, E, D, F), D),
+                "w_down": dense(next(keys), (L, E, F, D), F),
+            }
+        else:
+            mlp = {
+                "w_gate": dense(next(keys), (L, D, F), D),
+                "w_up": dense(next(keys), (L, D, F), D),
+                "w_down": dense(next(keys), (L, F, D), F),
+            }
         params["blocks"] = {
             "ln1": {"scale": jnp.ones((L, D), dtype)},
             "ln2": {"scale": jnp.ones((L, D), dtype)},
@@ -306,14 +331,12 @@ def init_params(rng: jax.Array, cfg: ModelConfig, dtype: Any = None) -> Params:
                 "wv": dense(next(keys), (L, D, KVH, HD), D),
                 "wo": dense(next(keys), (L, H, HD, D), H * HD),
             },
-            "mlp": {
-                "w_gate": dense(next(keys), (L, D, F), D),
-                "w_up": dense(next(keys), (L, D, F), D),
-                "w_down": dense(next(keys), (L, F, D), F),
-            },
+            "mlp": mlp,
         }
     else:
         raise ValueError(f"unknown family {cfg.family!r}")
+    if cfg.num_experts > 0 and cfg.family != "llama":
+        raise ValueError("MoE (num_experts > 0) is supported for the llama family")
     if not cfg.tie_embeddings:
         params["lm_head"] = {"w": dense(next(keys), (D, cfg.vocab_size), D)}
     return params

@@ -40,7 +40,19 @@ PRESETS: dict[str, ModelConfig] = {
         num_layers=80, num_heads=64, num_kv_heads=8, max_seq_len=8192,
         rope_theta=500000.0, norm_eps=1e-5, tie_embeddings=False,
     ),
+    "mixtral-8x7b": ModelConfig(
+        family="llama", vocab_size=32000, hidden_size=4096, intermediate_size=14336,
+        num_layers=32, num_heads=32, num_kv_heads=8, max_seq_len=32768,
+        rope_theta=1e6, norm_eps=1e-5, tie_embeddings=False,
+        num_experts=8, num_experts_per_token=2,
+    ),
     # Tiny configs for unit tests / CPU fake-mesh integration tests.
+    "moe-tiny": ModelConfig(
+        family="llama", vocab_size=256, hidden_size=64, intermediate_size=128,
+        num_layers=2, num_heads=4, num_kv_heads=2, max_seq_len=128,
+        tie_embeddings=False, dtype="float32",
+        num_experts=4, num_experts_per_token=2,
+    ),
     "gpt2-tiny": ModelConfig(
         family="gpt2", vocab_size=256, hidden_size=64, intermediate_size=256,
         num_layers=4, num_heads=4, num_kv_heads=4, max_seq_len=128,

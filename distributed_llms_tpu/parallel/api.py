@@ -163,8 +163,12 @@ class ParallelModel:
         cache_index: jax.Array | None = None,
         attn_mask: jax.Array | None = None,
         remat: bool = False,
-    ) -> tuple[jax.Array, KVCache | None]:
-        """Same contract as models.model.forward, but mesh-parallel."""
+        return_aux: bool = False,
+    ) -> tuple[jax.Array, KVCache | None] | tuple[jax.Array, KVCache | None, jax.Array]:
+        """Same contract as models.model.forward, but mesh-parallel.
+        ``return_aux`` (MoE load-balance loss) flows through on the
+        GSPMD paths; the pipeline/seq shard_map schedules return aux=0 —
+        train MoE with data/model/expert axes."""
         cfg = self.cfg
         if (
             self.seq_parallel
@@ -176,12 +180,14 @@ class ParallelModel:
             # ring attention rotates KV blocks over ICI.  Decode-with-cache
             # and custom-mask calls fall through to the dense path (the ring
             # handles causal masking only; ring targets prefill/training).
-            return self._seq_forward(params, tokens, positions, remat), None
+            logits = self._seq_forward(params, tokens, positions, remat)
+            return (logits, None, jnp.float32(0.0)) if return_aux else (logits, None)
         cfg = _local_cfg(cfg)
         if not self.pipelined:
             return model_lib.forward(
                 params, cfg, tokens, positions=positions, cache=cache,
                 cache_index=cache_index, remat=remat, attn_mask=attn_mask,
+                return_aux=return_aux,
             )
 
         b, t = tokens.shape
@@ -197,10 +203,8 @@ class ParallelModel:
             cache_index=cache_index, attn_mask=attn_mask, remat=remat,
         )
         logits = model_lib.unembed(params, cfg, y)
-        if cache is None:
-            return logits, None
-        nk, nv = new_cache
-        return logits, KVCache(k=nk, v=nv)
+        new = None if cache is None else KVCache(k=new_cache[0], v=new_cache[1])
+        return (logits, new, jnp.float32(0.0)) if return_aux else (logits, new)
 
 
 def _seq_cfg(cfg: ModelConfig) -> ModelConfig:

@@ -119,7 +119,7 @@ def pipeline_blocks(
                 row0 = mb_idx * mb_size
                 ck_mb = jax.lax.dynamic_slice_in_dim(ck, row0, mb_size, axis=1)
                 cv_mb = jax.lax.dynamic_slice_in_dim(cv, row0, mb_size, axis=1)
-                y, (nk, nv) = model_lib.run_blocks(
+                y, (nk, nv), _ = model_lib.run_blocks(
                     x_in, blocks, cfg, pos, ck_mb, cv_mb, cache_index,
                     remat=remat, attn_mask=amask,
                 )
@@ -128,7 +128,9 @@ def pipeline_blocks(
                 ck = jax.lax.dynamic_update_slice_in_dim(ck, nk, row0, axis=1)
                 cv = jax.lax.dynamic_update_slice_in_dim(cv, nv, row0, axis=1)
             else:
-                y, _ = model_lib.run_blocks(
+                # MoE aux loss is not threaded through the pipeline schedule
+                # (train MoE with data/tensor/expert axes, not 'pipe').
+                y, _, _ = model_lib.run_blocks(
                     x_in, blocks, cfg, pos, None, None, None,
                     remat=remat, attn_mask=amask,
                 )

@@ -65,6 +65,17 @@ def param_specs(cfg: ModelConfig, mesh: Mesh) -> Params:
             "w_out": P(pipe, f_ax, None), "b_out": P(pipe, None),
         }
         norm = {"scale": P(pipe, None), "bias": P(pipe, None)}
+    elif cfg.num_experts > 0:
+        # MoE: expert-stacked weights shard over 'expert' (expert
+        # parallelism); the hidden axis can still shard over 'model'.
+        ep_size = _axis_size(mesh, "expert")
+        ep = "expert" if ep_size > 1 and cfg.num_experts % ep_size == 0 else None
+        mlp = {
+            "router": P(pipe, None, None),
+            "w_gate": P(pipe, ep, None, f_ax), "w_up": P(pipe, ep, None, f_ax),
+            "w_down": P(pipe, ep, f_ax, None),
+        }
+        norm = {"scale": P(pipe, None)}
     else:
         mlp = {
             "w_gate": P(pipe, None, f_ax), "w_up": P(pipe, None, f_ax),

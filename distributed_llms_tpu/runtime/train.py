@@ -46,13 +46,18 @@ def lm_loss(
     forward_fn: Any = None,
     remat: bool = False,
 ) -> jax.Array:
+    """Next-token CE; MoE models additionally get the Switch-style
+    load-balance aux term (cfg.moe_aux_loss_weight) so the router cannot
+    collapse onto a few experts and capacity-drop the rest."""
     inputs, targets = tokens[:, :-1], tokens[:, 1:]
-    if forward_fn is None:
-        logits, _ = model_lib.forward(params, cfg, inputs, remat=remat)
+    fwd = forward_fn if forward_fn is not None else model_lib.forward
+    if cfg.num_experts > 0:
+        logits, _, aux = fwd(params, cfg, inputs, remat=remat, return_aux=True)
     else:
-        logits, _ = forward_fn(params, cfg, inputs, remat=remat)
+        logits, _ = fwd(params, cfg, inputs, remat=remat)
+        aux = 0.0
     tmask = mask[:, 1:] if mask is not None else None
-    return cross_entropy_loss(logits, targets, tmask)
+    return cross_entropy_loss(logits, targets, tmask) + cfg.moe_aux_loss_weight * aux
 
 
 @dataclass
@@ -75,10 +80,10 @@ class Trainer:
         pm = self.parallel
         remat = self.remat
 
-        def fwd(params, cfg, inputs, remat=False):
+        def fwd(params, cfg, inputs, remat=False, return_aux=False):
             if pm is None:
-                return model_lib.forward(params, cfg, inputs, remat=remat)
-            return pm.forward(params, inputs, remat=remat)
+                return model_lib.forward(params, cfg, inputs, remat=remat, return_aux=return_aux)
+            return pm.forward(params, inputs, remat=remat, return_aux=return_aux)
 
         @partial(jax.jit, donate_argnums=(0, 1))
         def step(params, opt_state, tokens, mask):
