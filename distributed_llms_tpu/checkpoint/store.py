@@ -7,8 +7,14 @@ fixed by construction: no pickle anywhere (npz + JSON), explicit param names
 
 Layout on disk:
     <dir>/manifest.json   {params: {name: {shard, shape, dtype, quant...}},
-                           num_shards, model_config, quantization}
-    <dir>/shard_<i>.npz   flat arrays for the params packed into shard i
+                           arrays: {name: {shard[, offset, nbytes, crc32,
+                           dtype, shape]}}, storage, num_shards,
+                           model_config, quantization}
+    <dir>/shard_<i>.bin   storage="raw" (default): tensors concatenated at
+                          64-byte-aligned offsets; read by the native C++
+                          parallel-pread tier (native/dlt_io.cpp) with
+                          per-tensor CRC32 verification, Python fallback
+    <dir>/shard_<i>.npz   storage="npz": numpy archives (v1 compatibility)
 
 Packing uses the reference's greedy byte-balanced algorithm
 (parallel.stages.pack_greedy).  ``load_shards`` can read a subset of shards
@@ -29,11 +35,13 @@ import numpy as np
 
 from ..core.config import ModelConfig
 from ..parallel.stages import pack_greedy
+from .. import native
 from . import quantize as quant_lib
 from .quantize import QuantizedTensor
 
 SEP = "/"
 MANIFEST = "manifest.json"
+ALIGN = 64  # raw storage: tensor offsets aligned for mmap/DMA friendliness
 
 
 def _flatten(params: Any) -> dict[str, Any]:
@@ -64,9 +72,12 @@ def save_shards(
     model_config: ModelConfig | None = None,
     quantization: str | None = None,  # None | "int8" | "int4"
     quant_block: int = 128,
+    storage: str = "raw",  # "raw" (native-IO blobs + CRC) | "npz" (v1)
 ) -> dict:
     """Write params (optionally quantizing first) into a sharded store.
     Returns the manifest dict."""
+    if storage not in ("raw", "npz"):
+        raise ValueError(f"unknown storage {storage!r}; raw|npz")
     os.makedirs(out_dir, exist_ok=True)
     if quantization:
         bits = {"int8": 8, "int4": 4}[quantization]
@@ -82,6 +93,7 @@ def save_shards(
     assignment = pack_greedy(sizes, num_shards)
 
     entries: dict[str, dict] = {}
+    arrays_meta: dict[str, dict] = {}
     shard_arrays: list[dict[str, np.ndarray]] = [dict() for _ in range(num_shards)]
     for name, leaf in flat.items():
         shard = assignment[name]
@@ -96,7 +108,8 @@ def save_shards(
             }
         else:
             arr = np.asarray(leaf)
-            # npz has no bfloat16: store raw bytes viewed as uint16.
+            # Neither npz nor numpy dtypes know bfloat16: store raw bytes
+            # viewed as uint16.
             if arr.dtype == jax.numpy.bfloat16:
                 shard_arrays[shard][name] = arr.view(np.uint16)
                 entries[name] = {"shard": shard, "shape": list(arr.shape), "dtype": "bfloat16"}
@@ -105,13 +118,38 @@ def save_shards(
                 entries[name] = {"shard": shard, "shape": list(arr.shape), "dtype": str(arr.dtype)}
 
     for i, arrays in enumerate(shard_arrays):
-        np.savez(os.path.join(out_dir, f"shard_{i}.npz"), **arrays)
+        if storage == "npz":
+            np.savez(os.path.join(out_dir, f"shard_{i}.npz"), **arrays)
+            for aname in arrays:
+                arrays_meta[aname] = {"shard": i}
+            continue
+        # raw: concatenated tensors at 64-byte-aligned offsets + CRC32.
+        path = os.path.join(out_dir, f"shard_{i}.bin")
+        with open(path, "wb") as f:
+            for aname, arr in arrays.items():
+                arr = np.ascontiguousarray(arr)
+                pad = (-f.tell()) % ALIGN
+                f.write(b"\0" * pad)
+                offset = f.tell()
+                # Zero-copy: stream the array buffer and checksum it in
+                # place (no tensor-sized bytes duplicate on the save path).
+                arr.tofile(f)
+                arrays_meta[aname] = {
+                    "shard": i,
+                    "offset": offset,
+                    "nbytes": int(arr.nbytes),
+                    "crc32": native.crc32(arr),
+                    "dtype": str(arr.dtype),
+                    "shape": list(arr.shape),
+                }
 
     manifest = {
-        "format_version": 1,
+        "format_version": 2,
+        "storage": storage,
         "num_shards": num_shards,
         "quantization": quantization,
         "params": entries,
+        "arrays": arrays_meta,
         "model_config": dataclasses.asdict(model_config) if model_config else None,
     }
     with open(os.path.join(out_dir, MANIFEST), "w") as f:
@@ -124,11 +162,56 @@ def load_manifest(store_dir: str) -> dict:
         return json.load(f)
 
 
+def _load_arrays_npz(
+    store_dir: str, manifest: dict, wanted: set[int]
+) -> dict[str, np.ndarray]:
+    out: dict[str, np.ndarray] = {}
+    for i in wanted:
+        path = os.path.join(store_dir, f"shard_{i}.npz")
+        if not os.path.exists(path):
+            raise FileNotFoundError(f"manifest lists shard {i} but {path} is missing")
+        z = np.load(path)
+        for aname in z.files:
+            out[aname] = z[aname]
+    return out
+
+
+def _load_arrays_raw(
+    store_dir: str, manifest: dict, wanted: set[int], io_threads: int
+) -> dict[str, np.ndarray]:
+    """Raw storage: parallel native pread of every wanted tensor segment,
+    CRC32-verified against the manifest."""
+    names: list[str] = []
+    tasks: list[tuple[str, int, int]] = []
+    for aname, meta in manifest["arrays"].items():
+        if meta["shard"] not in wanted:
+            continue
+        path = os.path.join(store_dir, f"shard_{meta['shard']}.bin")
+        if not os.path.exists(path):
+            raise FileNotFoundError(
+                f"manifest lists shard {meta['shard']} but {path} is missing"
+            )
+        names.append(aname)
+        tasks.append((path, meta["offset"], meta["nbytes"]))
+    bufs, crcs = native.read_segments(tasks, threads=io_threads, with_crc=True)
+    out: dict[str, np.ndarray] = {}
+    for aname, buf, crc in zip(names, bufs, crcs):
+        meta = manifest["arrays"][aname]
+        if crc != meta["crc32"]:
+            raise IOError(
+                f"checksum mismatch for {aname!r} in shard {meta['shard']} "
+                f"(expected {meta['crc32']:#010x}, got {crc:#010x}) — store corrupt?"
+            )
+        out[aname] = buf.view(np.dtype(meta["dtype"])).reshape(meta["shape"])
+    return out
+
+
 def load_shards(
     store_dir: str,
     shards: list[int] | None = None,
     dequantize: bool = False,
     dtype: Any = None,
+    io_threads: int = 8,
 ) -> dict[str, Any]:
     """Load params from the store (optionally only some shards).  Returns the
     nested param tree containing only the params present in those shards."""
@@ -138,12 +221,10 @@ def load_shards(
     if missing:
         raise ValueError(f"store has {manifest['num_shards']} shards; no {sorted(missing)}")
 
-    raw: dict[str, np.lib.npyio.NpzFile] = {}
-    for i in wanted:
-        path = os.path.join(store_dir, f"shard_{i}.npz")
-        if not os.path.exists(path):
-            raise FileNotFoundError(f"manifest lists shard {i} but {path} is missing")
-        raw[i] = np.load(path)
+    if manifest.get("storage", "npz") == "raw":
+        arrays = _load_arrays_raw(store_dir, manifest, wanted, io_threads)
+    else:
+        arrays = _load_arrays_npz(store_dir, manifest, wanted)
 
     import jax.numpy as jnp
 
@@ -151,20 +232,19 @@ def load_shards(
     for name, meta in manifest["params"].items():
         if meta["shard"] not in wanted:
             continue
-        z = raw[meta["shard"]]
         if meta["dtype"] == "quantized":
             qt = QuantizedTensor(
-                data=jnp.asarray(z[name + ".q"]),
-                scale=jnp.asarray(z[name + ".scale"]),
+                data=jnp.asarray(arrays[name + ".q"]),
+                scale=jnp.asarray(arrays[name + ".scale"]),
                 bits=meta["bits"],
                 orig_shape=tuple(meta["shape"]),
             )
             flat[name] = quant_lib.dequantize(qt, dtype or jnp.float32) if dequantize else qt
         elif meta["dtype"] == "bfloat16":
-            arr = jnp.asarray(z[name].view(jnp.bfloat16))
+            arr = jnp.asarray(arrays[name].view(jnp.bfloat16))
             flat[name] = arr.astype(dtype) if dtype else arr
         else:
-            arr = jnp.asarray(z[name])
+            arr = jnp.asarray(arrays[name])
             flat[name] = arr.astype(dtype) if dtype else arr
     return _unflatten(flat)
 

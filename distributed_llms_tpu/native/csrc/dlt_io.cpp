@@ -1,0 +1,121 @@
+// Native IO tier for the shard store (checkpoint/store.py).
+//
+// The reference has no native components at all (SURVEY: 100% Python), but a
+// real framework's checkpoint path is IO-bound at cold start: loading a 7B
+// bf16 model is ~14 GB of disk reads.  This library does the store reads the
+// way a C++ runtime would:
+//   - per-tensor pread() segments fanned out over a thread pool (no GIL, no
+//     Python object churn, page-cache friendly);
+//   - CRC32 (zlib polynomial, slice-by-8) computed in the same pass for
+//     integrity checking — corruption surfaces as a checksum mismatch at
+//     load time, not NaNs at step 40k.
+//
+// Exposed as a plain C ABI consumed via ctypes (pybind11 is not in the
+// image; ctypes keeps the build a single `g++ -shared`).
+
+#include <atomic>
+#include <cstdint>
+#include <cstring>
+#include <fcntl.h>
+#include <thread>
+#include <unistd.h>
+#include <vector>
+
+namespace {
+
+uint32_t crc_table[8][256];
+
+void init_crc_tables() {
+  for (uint32_t i = 0; i < 256; i++) {
+    uint32_t c = i;
+    for (int k = 0; k < 8; k++) c = (c & 1) ? 0xEDB88320u ^ (c >> 1) : c >> 1;
+    crc_table[0][i] = c;
+  }
+  for (uint32_t i = 0; i < 256; i++)
+    for (int s = 1; s < 8; s++)
+      crc_table[s][i] =
+          crc_table[0][crc_table[s - 1][i] & 0xFF] ^ (crc_table[s - 1][i] >> 8);
+}
+
+struct CrcInit {
+  CrcInit() { init_crc_tables(); }
+} crc_init;
+
+uint32_t crc32_update(uint32_t crc, const uint8_t* p, uint64_t len) {
+  crc = ~crc;
+  // slice-by-8
+  while (len >= 8) {
+    uint32_t lo;
+    uint32_t hi;
+    memcpy(&lo, p, 4);
+    memcpy(&hi, p + 4, 4);
+    lo ^= crc;
+    crc = crc_table[7][lo & 0xFF] ^ crc_table[6][(lo >> 8) & 0xFF] ^
+          crc_table[5][(lo >> 16) & 0xFF] ^ crc_table[4][lo >> 24] ^
+          crc_table[3][hi & 0xFF] ^ crc_table[2][(hi >> 8) & 0xFF] ^
+          crc_table[1][(hi >> 16) & 0xFF] ^ crc_table[0][hi >> 24];
+    p += 8;
+    len -= 8;
+  }
+  while (len--) crc = crc_table[0][(crc ^ *p++) & 0xFF] ^ (crc >> 8);
+  return ~crc;
+}
+
+}  // namespace
+
+extern "C" {
+
+uint32_t dlt_crc32(const uint8_t* data, uint64_t len, uint32_t seed) {
+  return crc32_update(seed, data, len);
+}
+
+// Read `count` segments — paths[i] at byte offsets[i], nbytes[i] bytes —
+// into caller-owned bufs[i], optionally writing CRC32s to crcs_out.
+// Returns 0 on success, or (1 + i) for the first segment that failed.
+int64_t dlt_read_segments(const char** paths, const uint64_t* offsets,
+                          const uint64_t* nbytes, uint8_t** bufs,
+                          uint32_t* crcs_out, int64_t count, int threads) {
+  if (count <= 0) return 0;
+  if (threads < 1) threads = 1;
+  if (threads > count) threads = static_cast<int>(count);
+
+  std::atomic<int64_t> next(0);
+  std::atomic<int64_t> failed(0);  // 0 = ok, else 1-based segment index
+
+  auto worker = [&]() {
+    for (;;) {
+      int64_t i = next.fetch_add(1);
+      if (i >= count || failed.load(std::memory_order_relaxed)) break;
+      int fd = open(paths[i], O_RDONLY);
+      if (fd < 0) {
+        failed.store(i + 1);
+        break;
+      }
+      uint64_t done = 0;
+      bool ok = true;
+      while (done < nbytes[i]) {
+        ssize_t r = pread(fd, bufs[i] + done, nbytes[i] - done,
+                          static_cast<off_t>(offsets[i] + done));
+        if (r <= 0) {
+          ok = false;
+          break;
+        }
+        done += static_cast<uint64_t>(r);
+      }
+      close(fd);
+      if (!ok) {
+        failed.store(i + 1);
+        break;
+      }
+      if (crcs_out) crcs_out[i] = crc32_update(0, bufs[i], nbytes[i]);
+    }
+  };
+
+  std::vector<std::thread> pool;
+  pool.reserve(threads);
+  for (int t = 0; t < threads; t++) pool.emplace_back(worker);
+  for (auto& th : pool) th.join();
+  return failed.load();
+}
+
+}  // extern "C"

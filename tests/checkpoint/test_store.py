@@ -82,9 +82,46 @@ def test_store_missing_shard_file_errors(tmp_path):
     cfg = presets.get_preset("llama-tiny")
     params = model.init_params(jax.random.key(0), cfg)
     store.save_shards(params, str(tmp_path), num_shards=2)
-    (tmp_path / "shard_1.npz").unlink()
+    (tmp_path / "shard_1.bin").unlink()
     with pytest.raises(FileNotFoundError, match="shard 1"):
         store.reconstruct(str(tmp_path))
+
+
+def test_store_npz_storage_roundtrip(tmp_path):
+    """v1 (npz) storage stays readable."""
+    cfg = presets.get_preset("llama-tiny")
+    params = model.init_params(jax.random.key(0), cfg)
+    store.save_shards(params, str(tmp_path), num_shards=2, storage="npz")
+    assert (tmp_path / "shard_0.npz").exists()
+    out = store.reconstruct(str(tmp_path))
+    a = jax.tree.leaves(params)
+    b = jax.tree.leaves(out)
+    assert all((x == y).all() for x, y in zip(a, b))
+
+
+def test_store_raw_detects_corruption(tmp_path):
+    """Native raw storage carries per-tensor CRC32: flipping bytes on disk
+    fails the load instead of silently feeding garbage weights."""
+    cfg = presets.get_preset("llama-tiny")
+    params = model.init_params(jax.random.key(0), cfg)
+    store.save_shards(params, str(tmp_path), num_shards=1)
+    path = tmp_path / "shard_0.bin"
+    blob = bytearray(path.read_bytes())
+    blob[len(blob) // 2] ^= 0xFF
+    path.write_bytes(bytes(blob))
+    with pytest.raises(IOError, match="checksum mismatch"):
+        store.reconstruct(str(tmp_path))
+
+
+def test_native_io_available_and_matches_python():
+    """The C++ tier builds in this image; its reads match the fallback."""
+    import zlib
+
+    from distributed_llms_tpu import native
+
+    assert native.available(), "native build failed (g++ is in the image)"
+    data = b"x" * 100_001
+    assert native.crc32(data) == zlib.crc32(data) & 0xFFFFFFFF
 
 
 def test_store_generation_after_roundtrip(tmp_path):
