@@ -1,0 +1,94 @@
+"""Training-state checkpoint / resume (Orbax-backed).
+
+SURVEY §5.4: the reference's only persistence is the model shard store —
+"no training/serving state, no resume protocol".  The model store
+(checkpoint/store.py) covers weights; this module covers the *training*
+state: params + optimizer state + step, saved as a sharded array tree and
+restored mesh-aware (each host reads only what its devices need — resume is
+``device_put`` onto the live mesh, not a socket transfer).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any
+
+import jax
+
+try:
+    import orbax.checkpoint as ocp
+
+    _HAVE_ORBAX = True
+except Exception:  # pragma: no cover - orbax is baked into the image
+    _HAVE_ORBAX = False
+
+
+def _checkpointer() -> "ocp.Checkpointer":
+    if not _HAVE_ORBAX:
+        raise RuntimeError("orbax-checkpoint is not available")
+    return ocp.Checkpointer(ocp.PyTreeCheckpointHandler())
+
+
+def save_train_state(
+    ckpt_dir: str,
+    step: int,
+    params: Any,
+    opt_state: Any,
+    keep: int = 3,
+) -> str:
+    """Write ``step``'s training state under ``ckpt_dir/step_<n>``; prunes to
+    the newest ``keep`` checkpoints.  Returns the written path."""
+    path = os.path.join(os.path.abspath(ckpt_dir), f"step_{step:08d}")
+    _checkpointer().save(
+        path, {"step": step, "params": params, "opt_state": opt_state}, force=True
+    )
+    for old in list_checkpoints(ckpt_dir)[:-keep]:
+        _rmtree(os.path.join(ckpt_dir, old))
+    return path
+
+
+def list_checkpoints(ckpt_dir: str) -> list[str]:
+    """step_<n> directory names, oldest first."""
+    if not os.path.isdir(ckpt_dir):
+        return []
+    return sorted(
+        d for d in os.listdir(ckpt_dir)
+        if d.startswith("step_") and os.path.isdir(os.path.join(ckpt_dir, d))
+    )
+
+
+def latest_step(ckpt_dir: str) -> int | None:
+    names = list_checkpoints(ckpt_dir)
+    return int(names[-1][len("step_"):]) if names else None
+
+
+def restore_train_state(
+    ckpt_dir: str,
+    step: int | None = None,
+    template: Any = None,
+) -> tuple[int, Any, Any]:
+    """Restore (step, params, opt_state).  ``step=None`` takes the latest.
+
+    ``template`` is a pytree of like-structured arrays (e.g. freshly-built
+    sharded params + opt_state as ``{"step": 0, "params": ..., "opt_state":
+    ...}``): restored arrays adopt its shardings, so resume lands directly on
+    the live mesh."""
+    if step is None:
+        step = latest_step(ckpt_dir)
+        if step is None:
+            raise FileNotFoundError(f"no checkpoints under {ckpt_dir}")
+    path = os.path.join(os.path.abspath(ckpt_dir), f"step_{step:08d}")
+    if not os.path.isdir(path):
+        raise FileNotFoundError(f"no checkpoint at {path}")
+    if template is not None:
+        restore_args = ocp.checkpoint_utils.construct_restore_args(template)
+        out = _checkpointer().restore(path, restore_args=restore_args)
+    else:
+        out = _checkpointer().restore(path)
+    return int(out["step"]), out["params"], out["opt_state"]
+
+
+def _rmtree(path: str) -> None:
+    import shutil
+
+    shutil.rmtree(path, ignore_errors=True)
