@@ -15,16 +15,51 @@ from __future__ import annotations
 
 import argparse
 import json
+import subprocess
+import sys
 import time
 
 import jax
 import jax.numpy as jnp
 
-from distributed_llms_tpu.models import model as model_lib
-from distributed_llms_tpu.models.presets import get_preset
-from distributed_llms_tpu.runtime import generate as gen_lib
-
 NORTH_STAR_TOKS_PER_S = 1000.0  # BASELINE.json: >=1000 tok/s aggregate
+
+
+def _probe_accelerator(timeout_s: float) -> str | None:
+    """Check in a subprocess (hard-killed on timeout) whether the default JAX
+    backend initializes.  The axon TPU plugin, when its tunnel is down, blocks
+    ``jax.devices()`` for ~25 minutes before raising UNAVAILABLE — round 1's
+    BENCH artifact died exactly this way.  Returns the platform name or None."""
+    code = "import jax; print(jax.devices()[0].platform)"
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c", code],
+            capture_output=True, text=True, timeout=timeout_s,
+        )
+        if r.returncode == 0 and r.stdout.strip():
+            return r.stdout.strip().splitlines()[-1]
+    except subprocess.TimeoutExpired:
+        pass
+    return None
+
+
+def _init_backend(probe_timeout: float, attempts: int) -> str | None:
+    """Retry accelerator init with backoff; fall back to CPU on persistent
+    failure.  Returns a degraded-marker string, or None if healthy."""
+    for i in range(attempts):
+        platform = _probe_accelerator(probe_timeout)
+        if platform is not None and platform != "cpu":
+            return None  # healthy — main process will init the same backend
+        if platform == "cpu":
+            # No accelerator configured at all: still a CPU measurement.
+            return "no accelerator present; measured on cpu"
+        if i + 1 < attempts:
+            time.sleep(5.0 * (i + 1))
+    # Persistent failure: pin the CPU backend before any jax backend use in
+    # this process (the axon plugin ignores the JAX_PLATFORMS env var, so this
+    # must go through jax.config).
+    jax.config.update("jax_platforms", "cpu")
+    return "accelerator-unavailable; measured on cpu fallback"
 
 
 def main() -> None:
@@ -35,7 +70,19 @@ def main() -> None:
     ap.add_argument("--new-tokens", type=int, default=64)
     ap.add_argument("--dtype", default="bfloat16")
     ap.add_argument("--iters", type=int, default=3)
+    ap.add_argument("--probe-timeout", type=float, default=120.0)
+    ap.add_argument("--probe-attempts", type=int, default=2)
     args = ap.parse_args()
+
+    degraded = _init_backend(args.probe_timeout, args.probe_attempts)
+    if degraded is not None:
+        # CPU can't hold bf16 numerics through XLA's collective passes and is
+        # slower in bf16 anyway; measure the fallback in f32.
+        args.dtype = "float32"
+
+    from distributed_llms_tpu.models import model as model_lib
+    from distributed_llms_tpu.models.presets import get_preset
+    from distributed_llms_tpu.runtime import generate as gen_lib
 
     cfg = get_preset(args.preset, dtype=args.dtype)
     params = model_lib.init_params(jax.random.key(0), cfg)
@@ -81,8 +128,20 @@ def main() -> None:
         "unit": "tok/s",
         "vs_baseline": round(tps / NORTH_STAR_TOKS_PER_S, 4),
     }
+    if degraded is not None:
+        result["degraded"] = degraded
     print(json.dumps(result))
 
 
 if __name__ == "__main__":
-    main()
+    try:
+        main()
+    except Exception as exc:  # driver contract: ALWAYS emit one JSON line
+        print(json.dumps({
+            "metric": "decode tokens/sec",
+            "value": 0.0,
+            "unit": "tok/s",
+            "vs_baseline": 0.0,
+            "degraded": f"bench crashed: {type(exc).__name__}: {exc}",
+        }))
+        raise SystemExit(0)

@@ -11,6 +11,7 @@ restored mesh-aware (each host reads only what its devices need — resume is
 from __future__ import annotations
 
 import os
+import pickle
 from typing import Any
 
 import jax
@@ -29,6 +30,9 @@ def _checkpointer() -> "ocp.Checkpointer":
     return ocp.Checkpointer(ocp.PyTreeCheckpointHandler())
 
 
+_TREEDEF_FILE = "opt_treedef.pkl"
+
+
 def save_train_state(
     ckpt_dir: str,
     step: int,
@@ -37,23 +41,49 @@ def save_train_state(
     keep: int = 3,
 ) -> str:
     """Write ``step``'s training state under ``ckpt_dir/step_<n>``; prunes to
-    the newest ``keep`` checkpoints.  Returns the written path."""
-    path = os.path.join(os.path.abspath(ckpt_dir), f"step_{step:08d}")
+    the newest ``keep`` checkpoints.  Returns the written path.
+
+    The optimizer state is stored as an ordered leaf list plus a pickled
+    treedef sidecar: Orbax's PyTree handler round-trips optax NamedTuple
+    states (ScaleByAdamState etc.) as plain dicts, which optax then rejects;
+    a flat list keeps leaf order exactly and the treedef rebuilds the real
+    structure on restore without needing the optimizer at restore time.
+
+    The sidecar doubles as the checkpoint's commit marker: it is written
+    last (atomically, via tmp-file rename), and ``list_checkpoints`` ignores
+    directories that lack it, so a crash between Orbax finalize and sidecar
+    write can never leave a 'latest' checkpoint that restore would brick on.
+    Uncommitted directories are ignored, never deleted — they may be another
+    writer's in-flight save or a user's foreign data."""
+    ckpt_dir = os.path.abspath(ckpt_dir)
+    path = os.path.join(ckpt_dir, f"step_{step:08d}")
+    leaves, treedef = jax.tree.flatten(opt_state)
     _checkpointer().save(
-        path, {"step": step, "params": params, "opt_state": opt_state}, force=True
+        path, {"step": step, "params": params, "opt_state_leaves": leaves}, force=True
     )
+    marker = os.path.join(path, _TREEDEF_FILE)
+    tmp = marker + ".tmp"
+    with open(tmp, "wb") as f:
+        pickle.dump(treedef, f)
+        f.flush()
+        os.fsync(f.fileno())
+    os.rename(tmp, marker)
     for old in list_checkpoints(ckpt_dir)[:-keep]:
         _rmtree(os.path.join(ckpt_dir, old))
     return path
 
 
+def _is_committed(step_dir: str) -> bool:
+    return os.path.exists(os.path.join(step_dir, _TREEDEF_FILE))
+
+
 def list_checkpoints(ckpt_dir: str) -> list[str]:
-    """step_<n> directory names, oldest first."""
+    """Committed step_<n> directory names, oldest first."""
     if not os.path.isdir(ckpt_dir):
         return []
     return sorted(
         d for d in os.listdir(ckpt_dir)
-        if d.startswith("step_") and os.path.isdir(os.path.join(ckpt_dir, d))
+        if d.startswith("step_") and _is_committed(os.path.join(ckpt_dir, d))
     )
 
 
@@ -80,12 +110,20 @@ def restore_train_state(
     path = os.path.join(os.path.abspath(ckpt_dir), f"step_{step:08d}")
     if not os.path.isdir(path):
         raise FileNotFoundError(f"no checkpoint at {path}")
+    with open(os.path.join(path, _TREEDEF_FILE), "rb") as f:
+        opt_treedef = pickle.load(f)
     if template is not None:
-        restore_args = ocp.checkpoint_utils.construct_restore_args(template)
+        stored_shape = {
+            "step": 0,
+            "params": template["params"],
+            "opt_state_leaves": jax.tree.leaves(template["opt_state"]),
+        }
+        restore_args = ocp.checkpoint_utils.construct_restore_args(stored_shape)
         out = _checkpointer().restore(path, restore_args=restore_args)
     else:
         out = _checkpointer().restore(path)
-    return int(out["step"]), out["params"], out["opt_state"]
+    opt_state = jax.tree.unflatten(opt_treedef, out["opt_state_leaves"])
+    return int(out["step"]), out["params"], opt_state
 
 
 def _rmtree(path: str) -> None:
