@@ -117,7 +117,7 @@ async def amain(args: argparse.Namespace) -> None:
     if args.local:
         rt = cfg.runtime
         for _ in range(args.local):
-            w = WorkerHost("127.0.0.1", coord.port, cfg=ccfg, rt=rt)
+            w = WorkerHost("127.0.0.1", coord.port, cfg=ccfg, rt=rt, mesh_cfg=cfg.mesh)
             local_tasks.append(asyncio.create_task(w.run()))
         log.info("spawned %d local in-process workers", args.local)
     if args.local_proc:

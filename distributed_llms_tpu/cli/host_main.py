@@ -20,7 +20,7 @@ async def amain(args: argparse.Namespace) -> None:
     port = args.port if args.port is not None else cfg.cluster.coordinator_port
     if host == "0.0.0.0":  # bind-any is not a connect address
         host = "localhost"
-    worker = WorkerHost(host, port, cfg=cfg.cluster, rt=cfg.runtime)
+    worker = WorkerHost(host, port, cfg=cfg.cluster, rt=cfg.runtime, mesh_cfg=cfg.mesh)
     await worker.run()
 
 

@@ -23,7 +23,7 @@ from typing import Any
 
 import jax
 
-from ..core.config import ClusterConfig, RuntimeConfig
+from ..core.config import ClusterConfig, MeshConfig, RuntimeConfig
 from ..core.observability import get_logger
 from . import protocol
 
@@ -49,10 +49,12 @@ class WorkerHost:
         coordinator_port: int,
         cfg: ClusterConfig | None = None,
         rt: RuntimeConfig | None = None,
-        engine_factory: Any = None,  # (store_dir, shards) -> engine-like
+        engine_factory: Any = None,  # (store_dir, shards, rt) -> engine-like
+        mesh_cfg: MeshConfig | None = None,
     ) -> None:
         self.cfg = cfg or ClusterConfig()
         self.rt = rt or RuntimeConfig()
+        self.mesh_cfg = mesh_cfg
         self.host = coordinator_host
         self.port = coordinator_port
         self.engine_factory = engine_factory or self._default_engine_factory
@@ -64,30 +66,26 @@ class WorkerHost:
 
     # -- default engine: shard store -> InferenceEngine --------------------
 
-    @staticmethod
-    def _default_engine_factory(store_dir: str, shards: list[int], rt: RuntimeConfig):
-        """Single-host engine: needs the FULL model to serve generate, so it
-        reconstructs every store shard regardless of the assigned subset —
-        the assignment expresses coordinator bookkeeping (which host answers
-        for which shards).  Partial-weight residency is the mesh path
-        (parallel.api.ParallelModel stages over a 'pipe' axis), not a
-        store-subset load."""
-        from ..checkpoint import store as store_lib
-        from ..core.config import ModelConfig
+    def _default_engine_factory(self, store_dir: str, shards: list[int], rt: RuntimeConfig):
+        """Engine over this host's local devices.  With a >1-device
+        ``mesh_cfg`` (Config.mesh) the model serves mesh-parallel: weights
+        are staged over 'pipe' / sharded over 'model' and placed by
+        ``device_put`` — the reference's "split one model across workers"
+        contract (src/master/node.py:84-115) realized as device placement.
+        Otherwise the full model is reconstructed single-device; the shard
+        assignment then expresses coordinator bookkeeping (which host
+        answers for which shards), not residency."""
         from ..runtime.engine import InferenceEngine
 
-        manifest = store_lib.load_manifest(store_dir)
-        if manifest.get("model_config") is None:
-            raise ValueError(f"store {store_dir} has no embedded model_config")
-        if set(shards) != set(range(manifest["num_shards"])):
+        mesh_parallel = self.mesh_cfg is not None and self.mesh_cfg.num_devices > 1
+        if not mesh_parallel:
             log.info(
-                "assigned shards %s of %d; single-host engine loads the full "
-                "model anyway (mesh mode handles partial residency)",
-                shards, manifest["num_shards"],
+                "assigned shards %s; single-device engine loads the full "
+                "model regardless (mesh mode shards residency)", shards,
             )
-        cfg = ModelConfig(**manifest["model_config"])
-        params = store_lib.reconstruct(store_dir, dtype=cfg.dtype)
-        return InferenceEngine(cfg, rt, params)
+        return InferenceEngine.from_store(
+            store_dir, rt=rt, mesh_cfg=self.mesh_cfg if mesh_parallel else None
+        )
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -181,7 +179,13 @@ class WorkerHost:
                 self.engine_factory, store_dir, shards, self.rt
             )
             self.loaded_shards = shards
-            return {"loaded": shards, "resident": "full-model"}
+            # Report what the built engine actually is, not what the config
+            # asked for — a custom engine_factory may ignore mesh_cfg.
+            pm = getattr(self.engine, "parallel", None)
+            resident = (
+                f"mesh({dict(pm.mesh.shape)})" if pm is not None else "full-model"
+            )
+            return {"loaded": shards, "resident": resident}
         if mtype == "UNLOAD_SHARDS":
             self.engine = None
             unloaded, self.loaded_shards = self.loaded_shards, []

@@ -57,6 +57,7 @@ class ParallelModel:
     cfg: ModelConfig
     mesh: Mesh
     num_microbatches: int = 1
+    kv_dtype: str | None = None  # KV-cache dtype override (default cfg.dtype)
 
     @property
     def num_stages(self) -> int:
@@ -100,7 +101,7 @@ class ParallelModel:
         # with_sharding_constraint works both eagerly and under jit (the
         # decode loop allocates its cache inside generate_tokens' trace).
         z = jax.lax.with_sharding_constraint(
-            jnp.zeros(shape, jnp.dtype(cfg.dtype)), sharding
+            jnp.zeros(shape, jnp.dtype(self.kv_dtype or cfg.dtype)), sharding
         )
         return KVCache(k=z, v=z)
 
@@ -230,7 +231,7 @@ def _local_cfg(cfg: ModelConfig) -> ModelConfig:
 
 def make_parallel_model(
     cfg: ModelConfig, mesh_cfg: MeshConfig, num_microbatches: int = 1,
-    devices: list | None = None,
+    devices: list | None = None, kv_dtype: str | None = None,
 ) -> ParallelModel:
     from ..core.mesh import build_mesh
 
@@ -247,4 +248,6 @@ def make_parallel_model(
             "ring attention and the pipeline schedule are alternative "
             "shardings of the layer loop — use one, with 'data'/'model' axes"
         )
-    return ParallelModel(cfg=cfg, mesh=mesh, num_microbatches=num_microbatches)
+    return ParallelModel(
+        cfg=cfg, mesh=mesh, num_microbatches=num_microbatches, kv_dtype=kv_dtype
+    )

@@ -44,10 +44,14 @@ class GenerationResult:
 
 
 class InferenceEngine:
-    """Single-slice inference engine.
+    """Inference engine, single-device or mesh-parallel.
 
     `params` may come from the checkpoint converter (real weights) or
     ``init_params`` (random, for benchmarks) — the engine is agnostic.
+    With ``parallel`` (a parallel.api.ParallelModel) the params are placed
+    onto the mesh (``device_put`` per NamedSharding — the reference's
+    "distribute" without tensor bytes on a socket) and generation runs the
+    pipelined / tensor-parallel forward.
     """
 
     def __init__(
@@ -56,10 +60,11 @@ class InferenceEngine:
         rt: RuntimeConfig,
         params: Any,
         tokenizer=None,
+        parallel: Any = None,  # parallel.api.ParallelModel
     ) -> None:
         self.cfg = cfg
         self.rt = rt
-        self.params = params
+        self.parallel = parallel
         self.tokenizer = tokenizer or get_tokenizer(None)
         # Out-of-vocab ids silently become NaN embeddings (jnp.take fills
         # OOB gathers) — reject the mismatch loudly instead.
@@ -69,10 +74,19 @@ class InferenceEngine:
                 f"tokenizer vocab ({tok_vocab}, incl. specials) exceeds model "
                 f"vocab ({cfg.vocab_size}); token ids would be out of range"
             )
-        # KV-cache dtype knob: bound once so the jitted decode sees a stable
-        # (identity-hashed) make_cache and caches the compilation.
-        kv_dtype = jnp.dtype(rt.kv_cache_dtype)
-        self._make_cache = lambda cfg_, b, s: model_lib.init_cache(cfg_, b, s, dtype=kv_dtype)
+        if parallel is not None:
+            self.params = parallel.shard_params(params)
+            self._forward_fn = parallel.as_forward_fn()
+            self._make_cache = parallel.as_make_cache()
+        else:
+            self.params = params
+            self._forward_fn = None  # generate_tokens' single-device default
+            # KV-cache dtype knob: bound once so the jitted decode sees a
+            # stable (identity-hashed) make_cache and caches the compilation.
+            kv_dtype = jnp.dtype(rt.kv_cache_dtype)
+            self._make_cache = lambda cfg_, b, s: model_lib.init_cache(
+                cfg_, b, s, dtype=kv_dtype
+            )
         self._timer = profiling.StepTimer("engine.generate")
 
     @classmethod
@@ -83,11 +97,62 @@ class InferenceEngine:
         params = model_lib.init_params(jax.random.key(rng_seed), cfg)
         return cls(cfg, rt or RuntimeConfig(), params)
 
+    @classmethod
+    def from_store(
+        cls,
+        store_dir: str,
+        rt: RuntimeConfig | None = None,
+        mesh_cfg: Any = None,  # core.config.MeshConfig
+        tokenizer=None,
+    ) -> "InferenceEngine":
+        """Build from a shard store, optionally mesh-parallel.
+
+        This is the product path the reference promised (split one model
+        across workers, src/master/node.py:84-115) done TPU-native: the mesh
+        comes from ``Config.mesh``, microbatches from
+        ``RuntimeConfig.microbatches``, placement is ``device_put``.
+        """
+        from ..checkpoint import store as store_lib
+        from ..core.config import ModelConfig
+
+        rt = rt or RuntimeConfig()
+        manifest = store_lib.load_manifest(store_dir)
+        if manifest.get("model_config") is None:
+            raise ValueError(f"store {store_dir} has no embedded model_config")
+        cfg = ModelConfig(**manifest["model_config"])
+        params = store_lib.reconstruct(store_dir, dtype=cfg.dtype)
+        parallel = None
+        if mesh_cfg is not None and mesh_cfg.num_devices > 1:
+            from ..parallel.api import make_parallel_model
+
+            parallel = make_parallel_model(
+                cfg, mesh_cfg,
+                num_microbatches=max(rt.microbatches, 1),
+                kv_dtype=rt.kv_cache_dtype,
+            )
+        return cls(cfg, rt, params, tokenizer=tokenizer, parallel=parallel)
+
+    def _batch_multiple(self) -> int:
+        """Batch rows must divide evenly over the data axis, times the
+        microbatch count when the pipeline schedule splits the batch."""
+        if self.parallel is None:
+            return 1
+        data = self.parallel.mesh.shape.get("data", 1)
+        mb = self.parallel.num_microbatches if self.parallel.pipelined else 1
+        return max(mb, 1) * data
+
     def generate_text(
         self, prompts: list[str], max_new_tokens: int | None = None, seed: int | None = None
     ) -> GenerationResult:
         tok = self.tokenizer
         seqs = [tok.encode(p) for p in prompts]
+        # Pad the batch up to the mesh's divisibility requirement with dummy
+        # rows (dropped after decode) so a single REPL prompt still serves
+        # through a microbatched pipeline.
+        n_real = len(seqs)
+        mult = self._batch_multiple()
+        while len(seqs) % mult:
+            seqs.append(seqs[0])
         prompt_arr, lens = pad_batch(seqs, tok.pad_id)
         n_new = self.rt.max_decode_steps if max_new_tokens is None else max_new_tokens
         gen_lib.check_sequence_budget(prompt_arr.shape[1], n_new, self.rt, self.cfg)
@@ -99,15 +164,16 @@ class InferenceEngine:
             else contextlib.nullcontext()
         )
         t0 = time.perf_counter()
-        with profile_ctx, self._timer.step(tokens=len(prompts) * n_new):
+        with profile_ctx, self._timer.step(tokens=n_real * n_new):
             out = gen_lib.generate_tokens(
                 self.params, self.cfg,
                 jnp.asarray(prompt_arr), jnp.asarray(lens), rng,
                 max_new_tokens=n_new,
                 temperature=self.rt.temperature, top_k=self.rt.top_k, top_p=self.rt.top_p,
-                eos_id=tok.eos_id, pad_id=tok.pad_id, make_cache=self._make_cache,
+                eos_id=tok.eos_id, pad_id=tok.pad_id,
+                forward_fn=self._forward_fn, make_cache=self._make_cache,
             )
-            out = np.asarray(jax.block_until_ready(out))
+            out = np.asarray(jax.block_until_ready(out))[:n_real]
         dt = time.perf_counter() - t0
         profiling.record_memory_stats()
 
@@ -117,5 +183,6 @@ class InferenceEngine:
         METRICS.observe("engine.generate_seconds", dt)
         return GenerationResult(
             text=texts, tokens=out,
-            prompt_tokens=int(lens.sum()), generated_tokens=gen_count, seconds=dt,
+            prompt_tokens=int(lens[:n_real].sum()), generated_tokens=gen_count,
+            seconds=dt,
         )

@@ -110,6 +110,12 @@ def restore_train_state(
     path = os.path.join(os.path.abspath(ckpt_dir), f"step_{step:08d}")
     if not os.path.isdir(path):
         raise FileNotFoundError(f"no checkpoint at {path}")
+    if not _is_committed(path):
+        raise RuntimeError(
+            f"checkpoint at {path} has no {_TREEDEF_FILE} sidecar: either the "
+            "save crashed before committing, or it predates the leaf-list "
+            "optimizer-state format and cannot be restored by this version"
+        )
     with open(os.path.join(path, _TREEDEF_FILE), "rb") as f:
         opt_treedef = pickle.load(f)
     if template is not None:

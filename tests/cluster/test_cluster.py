@@ -382,3 +382,53 @@ async def test_worker_connect_retry_fails_cleanly():
     w = WorkerHost("127.0.0.1", 1, cfg=fast_cfg())  # port 1: nothing there
     with pytest.raises(ConnectionError, match="could not reach"):
         await w.run()
+
+
+@pytest.mark.asyncio
+async def test_mesh_parallel_serving_end_to_end(tmp_path):
+    """The reference's core promise — split one model across devices and
+    serve it (src/master/node.py:84-138) — through the PRODUCT path:
+    coordinator -> worker -> ParallelModel(dp=2, pp=2, tp=2) -> decoded
+    text, exact-matching the single-device engine."""
+    import jax
+
+    from distributed_llms_tpu.checkpoint import store as store_lib
+    from distributed_llms_tpu.core.config import MeshConfig
+    from distributed_llms_tpu.models import model as model_lib, presets
+    from distributed_llms_tpu.runtime.engine import InferenceEngine
+
+    # vocab 512 >= the byte tokenizer's 259 ids (256 bytes + specials)
+    cfg = presets.get_preset("llama-tiny", vocab_size=512)
+    params = model_lib.init_params(jax.random.key(0), cfg)
+    store_lib.save_shards(params, str(tmp_path), num_shards=2, model_config=cfg)
+
+    rt = RuntimeConfig(microbatches=2, max_decode_steps=8)
+    mesh_cfg = MeshConfig(data=2, pipe=2, model=2)
+    import dataclasses
+
+    # pipelined generate compile on CPU needs a roomy task deadline
+    ccfg = dataclasses.replace(fast_cfg(), task_timeout_s=180.0)
+    coord = Coordinator(ccfg)
+    await coord.start()
+    try:
+        w = WorkerHost("127.0.0.1", coord.port, cfg=ccfg, rt=rt, mesh_cfg=mesh_cfg)
+        wt = asyncio.create_task(w.run())
+        for _ in range(100):
+            if w.worker_id is not None:
+                break
+            await asyncio.sleep(0.02)
+        assert w.worker_id is not None
+
+        coord.plan_shards(2, store_dir=str(tmp_path))
+        placed = await coord.place_shards()
+        assert "mesh" in placed[w.worker_id]["resident"]
+        assert w.engine.parallel is not None and w.engine.parallel.pipelined
+
+        out = await coord.generate(["hello world"], max_new_tokens=8)
+
+        ref = InferenceEngine.from_store(str(tmp_path), rt=rt)
+        expect = ref.generate_text(["hello world"], max_new_tokens=8)
+        assert out["text"] == expect.text
+        wt.cancel()
+    finally:
+        await coord.stop()
