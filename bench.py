@@ -1,14 +1,20 @@
 #!/usr/bin/env python
 """Benchmark harness (driver contract: prints ONE JSON line).
 
-Measures greedy-decode throughput of GPT-2-125M (BASELINE.md ladder config 1)
-on the available accelerator.  The reference publishes no numbers
-(SURVEY §6: README is a title line, no benchmarks/ dir, placeholder compute),
-so ``vs_baseline`` is reported against the driver's north-star target of
-1000 tok/s aggregate (BASELINE.json).
+Default mode measures greedy-decode throughput of GPT-2-125M (BASELINE.md
+ladder config 1) on the available accelerator.  The reference publishes no
+numbers (SURVEY §6: README is a title line, no benchmarks/ dir, placeholder
+compute), so ``vs_baseline`` is reported against the driver's north-star
+target of 1000 tok/s aggregate (BASELINE.json).
+
+``--ladder`` additionally measures the BASELINE.md ladder configs that fit
+the local device (tokens/sec/chip + 2N-approx MFU per config, plus the
+pipeline-hop ppermute latency microbenchmark when >1 device is visible) and
+writes the rows to ``--out`` (default BENCH_LADDER.json).  The final stdout
+line stays the single config-1 JSON object either way.
 
 Usage: python bench.py [--preset gpt2-125m] [--batch 8] [--prompt-len 64]
-       [--new-tokens 64] [--dtype bfloat16]
+       [--new-tokens 64] [--dtype bfloat16] [--ladder] [--out FILE]
 """
 
 from __future__ import annotations
@@ -23,6 +29,26 @@ import jax
 import jax.numpy as jnp
 
 NORTH_STAR_TOKS_PER_S = 1000.0  # BASELINE.json: >=1000 tok/s aggregate
+
+# Peak dense bf16 FLOP/s per chip by device_kind substring (public specs);
+# MFU is reported only when the device is recognized.
+PEAK_FLOPS = {
+    "v5 lite": 197e12,  # TPU v5e
+    "v5e": 197e12,
+    "v4": 275e12,
+    "v5p": 459e12,
+    "v6 lite": 918e12,  # Trillium
+    "v6e": 918e12,
+}
+
+# BASELINE.md ladder (config 5, multi-host 70B, needs hardware this harness
+# will never see single-chip; it is covered by the dryrun/multi-host tests).
+LADDER = [
+    {"config": 1, "preset": "gpt2-125m", "batch": 8, "prompt": 64, "new": 64},
+    {"config": 2, "preset": "tinyllama-1.1b", "batch": 8, "prompt": 64, "new": 32},
+    {"config": 3, "preset": "llama-2-7b", "batch": 4, "prompt": 64, "new": 16},
+    {"config": 4, "preset": "llama-2-13b", "batch": 2, "prompt": 64, "new": 16},
+]
 
 
 def _probe_accelerator(timeout_s: float) -> str | None:
@@ -62,6 +88,203 @@ def _init_backend(probe_timeout: float, attempts: int) -> str | None:
     return "accelerator-unavailable; measured on cpu fallback"
 
 
+def _param_count(cfg) -> int:
+    """Parameter count from the architecture dims (matches init_params)."""
+    d, v, l = cfg.hidden_size, cfg.vocab_size, cfg.num_layers
+    h, kvh, hd = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim_
+    ff = cfg.intermediate_size
+    attn = d * h * hd + 2 * d * kvh * hd + h * hd * d
+    mlp = 3 * d * ff if cfg.family == "llama" else 2 * d * ff
+    if cfg.num_experts:
+        mlp = cfg.num_experts * 3 * d * ff + d * cfg.num_experts
+    norms = 2 * d * l + d
+    embed = v * d + (0 if cfg.tie_embeddings else v * d)
+    pos = cfg.max_seq_len * d if cfg.family == "gpt2" else 0
+    return l * (attn + mlp) + norms + embed + pos
+
+
+def _mem_budget_bytes() -> int | None:
+    """Usable memory on the target device (HBM) or host (CPU fallback)."""
+    dev = jax.devices()[0]
+    stats = getattr(dev, "memory_stats", lambda: None)()
+    if stats and "bytes_limit" in stats:
+        return int(stats["bytes_limit"])
+    if dev.platform == "cpu":
+        try:
+            import os
+
+            return os.sysconf("SC_PAGE_SIZE") * os.sysconf("SC_PHYS_PAGES")
+        except (ValueError, OSError):
+            return None
+    return None
+
+
+def _fits(cfg, batch: int, seq: int, dtype: str) -> tuple[bool, str]:
+    budget = _mem_budget_bytes()
+    if budget is None:
+        return True, "unknown memory budget; attempting"
+    bytes_per = jnp.dtype(dtype).itemsize
+    weights = _param_count(cfg) * bytes_per
+    kv = 2 * cfg.num_layers * batch * seq * cfg.num_kv_heads * cfg.head_dim_ * bytes_per
+    need = int((weights + kv) * 1.25)  # activations + fragmentation headroom
+    if need > budget * 0.92:
+        return False, (
+            f"needs ~{need / 1e9:.1f} GB ({_param_count(cfg) / 1e9:.2f}B params "
+            f"@ {dtype}), budget {budget / 1e9:.1f} GB"
+        )
+    return True, f"~{need / 1e9:.1f} GB of {budget / 1e9:.1f} GB"
+
+
+def _measure_decode(preset: str, batch: int, prompt_len: int, new_tokens: int,
+                    dtype: str, iters: int) -> dict:
+    """Two-point greedy-decode throughput at true model shapes (random
+    weights — no network in this environment; decode FLOPs are identical)."""
+    from distributed_llms_tpu.models import model as model_lib
+    from distributed_llms_tpu.models.presets import get_preset
+    from distributed_llms_tpu.runtime import generate as gen_lib
+
+    import numpy as np
+
+    cfg = get_preset(preset, dtype=dtype)
+    params = model_lib.init_params(jax.random.key(0), cfg)
+    prompt = jax.random.randint(
+        jax.random.key(1), (batch, prompt_len), 0, cfg.vocab_size, dtype=jnp.int32
+    )
+    lens = jnp.full((batch,), prompt_len, dtype=jnp.int32)
+    rng = jax.random.key(2)
+
+    # The axon-tunneled TPU has ~80ms constant dispatch/transfer overhead and
+    # a block_until_ready that does NOT actually block, so (a) force a host
+    # transfer with np.asarray and (b) use a two-point measurement — time
+    # decode at N and 2N tokens and take the delta — which cancels the
+    # constant overhead and the (shared) prefill cost.
+    def timed(n_new: int) -> float:
+        np.asarray(
+            gen_lib.generate_tokens(params, cfg, prompt, lens, rng, max_new_tokens=n_new)
+        )
+        times = []
+        for _ in range(iters):
+            t0 = time.perf_counter()
+            np.asarray(
+                gen_lib.generate_tokens(params, cfg, prompt, lens, rng, max_new_tokens=n_new)
+            )
+            times.append(time.perf_counter() - t0)
+        return min(times)
+
+    n1, n2 = new_tokens, 2 * new_tokens
+    t1, t2 = timed(n1), timed(n2)
+    if t2 <= t1:  # overhead-dominated; fall back to the single-shot number
+        tps = batch * n2 / t2
+    else:
+        tps = batch * (n2 - n1) / (t2 - t1)
+
+    n_chips = jax.device_count()
+    out = {
+        "preset": preset,
+        "batch": batch,
+        "platform": jax.devices()[0].platform,
+        "n_chips": n_chips,
+        "tok_per_s": round(tps, 2),
+        "tok_per_s_per_chip": round(tps / n_chips, 2),
+        "params_b": round(_param_count(get_preset(preset)) / 1e9, 3),
+    }
+    mfu = _mfu(tps / n_chips, _param_count(get_preset(preset)))
+    if mfu is not None:
+        out["mfu_2N"] = mfu
+    return out
+
+
+def _mfu(tps_per_chip: float, n_params: int) -> float | None:
+    """Model FLOPs utilization with the standard 2N FLOPs/token estimate."""
+    kind = getattr(jax.devices()[0], "device_kind", "").lower()
+    for key, peak in PEAK_FLOPS.items():
+        if key in kind:
+            return round(tps_per_chip * 2.0 * n_params / peak, 5)
+    return None
+
+
+def _measure_hop_latency(d_model: int = 4096, batch: int = 8, iters: int = 50) -> dict | None:
+    """p50/p95 latency of one pipeline-stage activation hop: a ppermute
+    rotation of a [batch, d_model] bf16 activation over all visible devices
+    (SURVEY §6's 'p50 inter-stage hop latency' metric).  None on 1 device."""
+    import numpy as np
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    devs = jax.devices()
+    n = len(devs)
+    if n < 2:
+        return None
+    mesh = Mesh(np.array(devs), ("pipe",))
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def body(x):
+        return jax.lax.ppermute(x, "pipe", perm)
+
+    f = jax.jit(
+        jax.shard_map(body, mesh=mesh, in_specs=P("pipe"), out_specs=P("pipe"))
+    )
+    dtype = jnp.float32 if devs[0].platform == "cpu" else jnp.bfloat16
+    x = jax.device_put(
+        jnp.zeros((n, batch, d_model), dtype),
+        jax.sharding.NamedSharding(mesh, P("pipe")),
+    )
+    jax.block_until_ready(f(x))  # compile
+    times = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        jax.block_until_ready(f(x))
+        times.append(time.perf_counter() - t0)
+    ts = sorted(times)
+    return {
+        "hop_bytes": batch * d_model * jnp.dtype(dtype).itemsize,
+        "n_devices": n,
+        "p50_us": round(ts[len(ts) // 2] * 1e6, 1),
+        "p95_us": round(ts[int(len(ts) * 0.95)] * 1e6, 1),
+        "note": "jit dispatch included; one full ring rotation per sample",
+    }
+
+
+def run_ladder(args, degraded: str | None) -> list[dict]:
+    from distributed_llms_tpu.models.presets import get_preset
+
+    dtype = "float32" if degraded is not None else args.dtype
+    on_cpu = jax.devices()[0].platform == "cpu"
+    rows = []
+    for entry in LADDER:
+        cfg = get_preset(entry["preset"])
+        if on_cpu and _param_count(cfg) > 0.5e9:
+            rows.append({
+                "config": entry["config"], "preset": entry["preset"],
+                "skipped": "cpu fallback: >0.5B-param decode is minutes/token",
+            })
+            print(f"# config {entry['config']} ({entry['preset']}): SKIP — cpu fallback",
+                  file=sys.stderr)
+            continue
+        ok, why = _fits(cfg, entry["batch"], entry["prompt"] + 2 * entry["new"], dtype)
+        if not ok:
+            rows.append({"config": entry["config"], "preset": entry["preset"],
+                         "skipped": why})
+            print(f"# config {entry['config']} ({entry['preset']}): SKIP — {why}",
+                  file=sys.stderr)
+            continue
+        print(f"# config {entry['config']} ({entry['preset']}): measuring ({why})",
+              file=sys.stderr)
+        row = {"config": entry["config"]}
+        row.update(_measure_decode(
+            entry["preset"], entry["batch"], entry["prompt"], entry["new"],
+            dtype, args.iters,
+        ))
+        if degraded is not None:
+            row["degraded"] = degraded
+        rows.append(row)
+        print(f"#   -> {row}", file=sys.stderr)
+    hop = _measure_hop_latency()
+    if hop is not None:
+        rows.append({"config": "hop-latency", **hop})
+        print(f"# hop latency: {hop}", file=sys.stderr)
+    return rows
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--preset", default="gpt2-125m")
@@ -72,6 +295,10 @@ def main() -> None:
     ap.add_argument("--iters", type=int, default=3)
     ap.add_argument("--probe-timeout", type=float, default=120.0)
     ap.add_argument("--probe-attempts", type=int, default=2)
+    ap.add_argument("--ladder", action="store_true",
+                    help="measure all BASELINE ladder configs that fit")
+    ap.add_argument("--out", default="BENCH_LADDER.json",
+                    help="ladder results file (with --ladder)")
     args = ap.parse_args()
 
     degraded = _init_backend(args.probe_timeout, args.probe_attempts)
@@ -80,56 +307,35 @@ def main() -> None:
         # slower in bf16 anyway; measure the fallback in f32.
         args.dtype = "float32"
 
-    from distributed_llms_tpu.models import model as model_lib
-    from distributed_llms_tpu.models.presets import get_preset
-    from distributed_llms_tpu.runtime import generate as gen_lib
-
-    cfg = get_preset(args.preset, dtype=args.dtype)
-    params = model_lib.init_params(jax.random.key(0), cfg)
-    prompt = jax.random.randint(
-        jax.random.key(1), (args.batch, args.prompt_len), 0, cfg.vocab_size, dtype=jnp.int32
-    )
-    lens = jnp.full((args.batch,), args.prompt_len, dtype=jnp.int32)
-    rng = jax.random.key(2)
-
-    # The axon-tunneled TPU has ~80ms constant dispatch/transfer overhead and
-    # a block_until_ready that does NOT actually block, so we (a) force a host
-    # transfer with np.asarray and (b) use a two-point measurement — time
-    # decode at N and 2N tokens and take the delta — which cancels the
-    # constant overhead and the (shared) prefill cost.
-    import numpy as np
-
-    def timed(n_new: int) -> float:
-        # compile (separate trace per static n_new)
-        np.asarray(
-            gen_lib.generate_tokens(params, cfg, prompt, lens, rng, max_new_tokens=n_new)
-        )
-        times = []
-        for _ in range(args.iters):
-            t0 = time.perf_counter()
-            np.asarray(
-                gen_lib.generate_tokens(params, cfg, prompt, lens, rng, max_new_tokens=n_new)
-            )
-            times.append(time.perf_counter() - t0)
-        return min(times)
-
-    n1, n2 = args.new_tokens, 2 * args.new_tokens
-    t1, t2 = timed(n1), timed(n2)
-    if t2 <= t1:  # overhead-dominated; fall back to the single-shot number
-        tps = args.batch * n2 / t2
+    if args.ladder:
+        rows = run_ladder(args, degraded)
+        with open(args.out, "w") as f:
+            json.dump({"rows": rows}, f, indent=1)
+        print(f"# ladder results -> {args.out}", file=sys.stderr)
+        head = next((r for r in rows if "tok_per_s" in r), None)
     else:
-        tps = args.batch * (n2 - n1) / (t2 - t1)
+        head = _measure_decode(
+            args.preset, args.batch, args.prompt_len, args.new_tokens,
+            args.dtype, args.iters,
+        )
 
-    n_chips = jax.device_count()
-    result = {
-        "metric": f"decode tokens/sec ({args.preset}, batch={args.batch}, "
-        f"{jax.devices()[0].platform}x{n_chips})",
-        "value": round(tps, 2),
-        "unit": "tok/s",
-        "vs_baseline": round(tps / NORTH_STAR_TOKS_PER_S, 4),
-    }
-    if degraded is not None:
-        result["degraded"] = degraded
+    if head is None:  # every ladder config skipped
+        result = {
+            "metric": "decode tokens/sec", "value": 0.0, "unit": "tok/s",
+            "vs_baseline": 0.0, "degraded": "all ladder configs skipped",
+        }
+    else:
+        result = {
+            "metric": f"decode tokens/sec ({head['preset']}, batch={head['batch']}, "
+            f"{head['platform']}x{head['n_chips']})",
+            "value": head["tok_per_s"],
+            "unit": "tok/s",
+            "vs_baseline": round(head["tok_per_s"] / NORTH_STAR_TOKS_PER_S, 4),
+        }
+        if "mfu_2N" in head:
+            result["mfu_2N"] = head["mfu_2N"]
+        if degraded is not None:
+            result["degraded"] = degraded
     print(json.dumps(result))
 
 

@@ -125,7 +125,11 @@ class RuntimeConfig:
     top_k: int = 0
     top_p: float = 1.0
     kv_cache_dtype: str = "bfloat16"
-    kv_host_spill: bool = False  # spill KV blocks to host DRAM
+    # Session KV residency: with kv_host_spill, at most max_resident_sessions
+    # session caches stay in HBM; least-recently-used ones spill to host DRAM
+    # and are restored (async device_put) on their next turn.
+    kv_host_spill: bool = False
+    max_resident_sessions: int = 4
     remat: bool = False  # jax.checkpoint on decoder blocks
     seed: int = 0
     profile_dir: str | None = None  # capture jax.profiler traces of generate

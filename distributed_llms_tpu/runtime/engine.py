@@ -88,6 +88,13 @@ class InferenceEngine:
                 cfg_, b, s, dtype=kv_dtype
             )
         self._timer = profiling.StepTimer("engine.generate")
+        # Session store: caches persist across turns; with kv_host_spill only
+        # the most recent max_resident_sessions stay in device memory.
+        from .session import SessionManager
+
+        self.sessions = SessionManager(
+            max_resident=rt.max_resident_sessions if rt.kv_host_spill else (1 << 30)
+        )
 
     @classmethod
     def from_preset(
@@ -145,15 +152,7 @@ class InferenceEngine:
         self, prompts: list[str], max_new_tokens: int | None = None, seed: int | None = None
     ) -> GenerationResult:
         tok = self.tokenizer
-        seqs = [tok.encode(p) for p in prompts]
-        # Pad the batch up to the mesh's divisibility requirement with dummy
-        # rows (dropped after decode) so a single REPL prompt still serves
-        # through a microbatched pipeline.
-        n_real = len(seqs)
-        mult = self._batch_multiple()
-        while len(seqs) % mult:
-            seqs.append(seqs[0])
-        prompt_arr, lens = pad_batch(seqs, tok.pad_id)
+        prompt_arr, lens, n_real = self._encode_rows(prompts, batch=None)
         n_new = self.rt.max_decode_steps if max_new_tokens is None else max_new_tokens
         gen_lib.check_sequence_budget(prompt_arr.shape[1], n_new, self.rt, self.cfg)
         rng = jax.random.key(seed if seed is not None else self.rt.seed)
@@ -186,3 +185,110 @@ class InferenceEngine:
             prompt_tokens=int(lens[:n_real].sum()), generated_tokens=gen_count,
             seconds=dt,
         )
+
+    # -- sessions: KV persists across turns; host spill under kv_host_spill --
+
+    def _session_max_len(self) -> int:
+        return min(self.rt.max_seq_len, self.cfg.max_seq_len)
+
+    def _encode_rows(self, prompts: list[str], batch: int | None) -> tuple:
+        """Encode + pad rows.  ``batch=None``: new session — pad the row count
+        up to the mesh multiple.  Otherwise: continuation — row count must
+        match the session's real rows; mesh-padding rows repeat row 0."""
+        tok = self.tokenizer
+        seqs = [tok.encode(p) for p in prompts]
+        n_real = len(seqs)
+        if batch is None:
+            mult = self._batch_multiple()
+            while len(seqs) % mult:
+                seqs.append(seqs[0])
+        else:
+            while len(seqs) < batch:
+                seqs.append(seqs[0])
+        arr, lens = pad_batch(seqs, tok.pad_id)
+        return jnp.asarray(arr), jnp.asarray(lens), n_real
+
+    def _session_turn(self, sess, chunk, lens, n_new: int, seed: int | None) -> GenerationResult:
+        from . import session as session_lib
+
+        t = int(chunk.shape[1])
+        if sess.base + t + n_new > sess.max_len:
+            raise ValueError(
+                f"session {sess.sid}: {sess.base} used + {t} chunk + {n_new} "
+                f"new tokens exceeds session max_len {sess.max_len}"
+            )
+        tok = self.tokenizer
+        rng = jax.random.key(seed if seed is not None else self.rt.seed)
+        t0 = time.perf_counter()
+        with self._timer.step(tokens=sess.n_real * n_new):
+            toks, cache, valid, real = session_lib.session_step(
+                self.params, self.cfg, chunk, lens,
+                sess.real_lens, sess.valid_mask, sess.cache,
+                jnp.int32(sess.base), rng,
+                max_new_tokens=n_new,
+                temperature=self.rt.temperature, top_k=self.rt.top_k,
+                top_p=self.rt.top_p, eos_id=tok.eos_id, pad_id=tok.pad_id,
+                forward_fn=self._forward_fn,
+            )
+            out = np.asarray(jax.block_until_ready(toks))[: sess.n_real]
+        dt = time.perf_counter() - t0
+        sess.cache, sess.valid_mask, sess.real_lens = cache, valid, real
+        sess.base += t + n_new
+        texts = [tok.decode(row) for row in out]
+        gen_count = int(out.shape[0] * out.shape[1])
+        METRICS.inc("engine.generated_tokens", gen_count)
+        METRICS.observe("engine.generate_seconds", dt)
+        return GenerationResult(
+            text=texts, tokens=out,
+            prompt_tokens=int(np.asarray(lens)[: sess.n_real].sum()),
+            generated_tokens=gen_count, seconds=dt,
+        )
+
+    def start_session(
+        self, prompts: list[str], max_new_tokens: int | None = None,
+        seed: int | None = None,
+    ) -> tuple[str, GenerationResult]:
+        """Open a session: prefill + decode, keeping the KV cache for
+        continuation turns.  Returns (session_id, result)."""
+        n_new = self.rt.max_decode_steps if max_new_tokens is None else max_new_tokens
+        max_len = self._session_max_len()
+        chunk, lens, n_real = self._encode_rows(prompts, batch=None)
+        b, t = int(chunk.shape[0]), int(chunk.shape[1])
+        if t + n_new > max_len:  # validate BEFORE allocating/registering
+            raise ValueError(
+                f"prompt ({t} padded tokens) + {n_new} new tokens exceeds "
+                f"session max_len {max_len}"
+            )
+        self.sessions.make_room()  # evict an LRU cache before allocating ours
+        cache = self._make_cache(self.cfg, b, max_len)
+        valid = jnp.zeros((b, max_len), dtype=bool)
+        real = jnp.zeros((b,), jnp.int32)
+        sess = self.sessions.new_session(cache, valid, real, base=0, max_len=max_len)
+        sess.n_real = n_real
+        try:
+            res = self._session_turn(sess, chunk, lens, n_new, seed)
+        except Exception:
+            self.sessions.drop(sess.sid)  # no orphaned HBM cache on failure
+            raise
+        return sess.sid, res
+
+    def continue_session(
+        self, sid: str, prompts: list[str], max_new_tokens: int | None = None,
+        seed: int | None = None,
+    ) -> GenerationResult:
+        """Append a turn to an existing session (restoring its cache from
+        host DRAM first if it was spilled)."""
+        sess = self.sessions.get(sid)
+        if len(prompts) != sess.n_real:
+            raise ValueError(
+                f"session {sid} has {sess.n_real} rows; got {len(prompts)} prompts"
+            )
+        self.sessions.ensure_resident(sess)
+        self.sessions.touch(sess)
+        n_new = self.rt.max_decode_steps if max_new_tokens is None else max_new_tokens
+        batch = int(sess.valid_mask.shape[0])
+        chunk, lens, _ = self._encode_rows(prompts, batch=batch)
+        return self._session_turn(sess, chunk, lens, n_new, seed)
+
+    def end_session(self, sid: str) -> None:
+        self.sessions.drop(sid)

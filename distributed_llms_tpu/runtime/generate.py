@@ -62,6 +62,11 @@ def generate_tokens(
     ``forward_fn``/``make_cache`` default to the single-device model; a
     mesh-parallel model (parallel.api.ParallelModel) plugs in its own.
 
+    runtime.session.session_step is the multi-turn generalization of this
+    loop; the pair is deliberately unmerged (this prefill's attn_mask=None
+    unlocks the flash kernel) and pinned equivalent by
+    tests/runtime/test_session.py — decode-loop changes must land in both.
+
     The KV cache is sized T + max_new_tokens exactly, so the
     ``cache_index + T <= max_len`` contract of models.model.forward holds by
     construction.
