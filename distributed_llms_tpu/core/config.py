@@ -119,7 +119,6 @@ class RuntimeConfig:
 
     max_seq_len: int = 1024
     max_decode_steps: int = 64
-    batch_size: int = 1
     microbatches: int = 1  # pipeline microbatches per step
     temperature: float = 0.0  # 0 => greedy
     top_k: int = 0

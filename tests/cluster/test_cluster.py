@@ -406,8 +406,13 @@ async def test_mesh_parallel_serving_end_to_end(tmp_path):
     mesh_cfg = MeshConfig(data=2, pipe=2, model=2)
     import dataclasses
 
-    # pipelined generate compile on CPU needs a roomy task deadline
-    ccfg = dataclasses.replace(fast_cfg(), task_timeout_s=180.0)
+    # Pipelined generate compiles on CPU need a roomy task deadline, and the
+    # compile holds the GIL in bursts that can starve the worker's heartbeat
+    # task — so eviction must be lenient too (fast eviction is covered by the
+    # dedicated eviction tests above).
+    ccfg = dataclasses.replace(
+        fast_cfg(), task_timeout_s=180.0, heartbeat_timeout_s=180.0
+    )
     coord = Coordinator(ccfg)
     await coord.start()
     try:
