@@ -109,6 +109,8 @@ async def amain(args: argparse.Namespace) -> None:
         cfg.cluster,
         coordinator_host=args.host or cfg.cluster.coordinator_host,
         coordinator_port=args.port if args.port is not None else cfg.cluster.coordinator_port,
+        metrics_port=args.metrics_port if args.metrics_port is not None
+        else cfg.cluster.metrics_port,
     )
     coord = Coordinator(ccfg)
     await coord.start()
@@ -149,7 +151,13 @@ async def amain(args: argparse.Namespace) -> None:
                 "only %d/%d local workers registered", len(coord.workers), expected
             )
     try:
-        await repl(coord, cfg)
+        if args.serve:
+            # Headless daemon mode: containers/K8s have no interactive
+            # stdin, and a REPL there would hit EOF and exit immediately.
+            log.info("serving headless (no REPL); SIGTERM/Ctrl-C stops")
+            await asyncio.Event().wait()
+        else:
+            await repl(coord, cfg)
     finally:
         for t in local_tasks:
             t.cancel()
@@ -165,6 +173,12 @@ def main(argv: list[str] | None = None) -> None:
                     help="dotted config override, e.g. mesh.pipe=2")
     ap.add_argument("--host", default=None)
     ap.add_argument("--port", type=int, default=None)
+    ap.add_argument("--metrics-port", type=int, default=None,
+                    help="serve Prometheus /metrics (+/healthz, /status) here")
+    ap.add_argument("--serve", action="store_true",
+                    help="headless daemon mode (no REPL) — for containers/K8s "
+                         "where stdin is closed; default is the interactive "
+                         "REPL, which also accepts piped command scripts")
     ap.add_argument("--local", type=int, default=0, metavar="N",
                     help="spawn N in-process workers (local simulation)")
     ap.add_argument("--local-proc", type=int, default=0, metavar="N",

@@ -68,6 +68,7 @@ class Coordinator:
         self.num_shards = 0
         self.store_dir: str | None = None
         self._server: asyncio.base_events.Server | None = None
+        self._metrics_server = None
         self._bg: list[asyncio.Task] = []
         self._counter = itertools.count()
 
@@ -80,10 +81,23 @@ class Coordinator:
         addr = self._server.sockets[0].getsockname()
         self._bg.append(asyncio.create_task(self._liveness_loop()))
         self._bg.append(asyncio.create_task(self._dispatch_loop()))
+        if self.cfg.metrics_port is not None:
+            from .metrics_http import MetricsServer
+
+            self._metrics_server = MetricsServer(
+                self.cfg.coordinator_host, self.cfg.metrics_port, status_fn=self.status
+            )
+            await self._metrics_server.start()
         log.info("coordinator listening on %s:%s", addr[0], addr[1])
         return addr[0], addr[1]
 
+    @property
+    def metrics_port(self) -> int | None:
+        return self._metrics_server.bound_port if self._metrics_server else None
+
     async def stop(self) -> None:
+        if self._metrics_server is not None:
+            await self._metrics_server.stop()
         for t in self._bg:
             t.cancel()
         for w in list(self.workers.values()):

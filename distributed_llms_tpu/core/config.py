@@ -146,6 +146,9 @@ class ClusterConfig:
     connect_retry_s: float = 5.0
     connect_max_retries: int = 5
     task_timeout_s: float = 60.0
+    # Prometheus /metrics + /healthz + /status HTTP port on the coordinator
+    # (implementation.md:34-37 parity). None disables; 0 binds ephemeral.
+    metrics_port: int | None = None
     # jax.distributed settings for multi-host slices
     distributed_coordinator: str | None = None
     num_processes: int = 1

@@ -54,12 +54,18 @@ def get_logger(name: str, json_format: bool = False, level: int = logging.INFO) 
 class _Histogram:
     values: list[float] = field(default_factory=list)
     max_keep: int = 4096
+    # Cumulative across the full lifetime (Prometheus summary semantics);
+    # the percentile window above slides, these never reset.
+    total_count: int = 0
+    total_sum: float = 0.0
 
     def observe(self, v: float) -> None:
         if len(self.values) >= self.max_keep:
             # Keep a sliding window: drop oldest half.
             self.values = self.values[self.max_keep // 2 :]
         self.values.append(v)
+        self.total_count += 1
+        self.total_sum += v
 
     def summary(self) -> dict[str, float]:
         if not self.values:
@@ -112,6 +118,38 @@ class Metrics:
                 "gauges": dict(self._gauges),
                 "histograms": {k: h.summary() for k, h in self._hists.items()},
             }
+
+    def prometheus_text(self) -> str:
+        """Render the registry in Prometheus exposition format (text/plain
+        version 0.0.4).  Histograms export as summaries: quantile series plus
+        cumulative _count/_sum.  The reference planned a Prometheus endpoint
+        (implementation.md:34-37, :146-157) but never built one."""
+
+        def name_of(raw: str) -> str:
+            # Prometheus names: [a-zA-Z_:][a-zA-Z0-9_:]*
+            out = "".join(c if c.isalnum() or c == "_" else "_" for c in raw)
+            return out if out[:1].isalpha() or out[:1] == "_" else "_" + out
+
+        lines: list[str] = []
+        with self._lock:
+            for raw, v in sorted(self._counters.items()):
+                n = name_of(raw)
+                lines.append(f"# TYPE {n} counter")
+                lines.append(f"{n} {v}")
+            for raw, v in sorted(self._gauges.items()):
+                n = name_of(raw)
+                lines.append(f"# TYPE {n} gauge")
+                lines.append(f"{n} {v}")
+            for raw, h in sorted(self._hists.items()):
+                n = name_of(raw)
+                s = h.summary()
+                lines.append(f"# TYPE {n} summary")
+                for q in ("p50", "p95", "p99"):
+                    if q in s:
+                        lines.append(f'{n}{{quantile="0.{q[1:]}"}} {s[q]}')
+                lines.append(f"{n}_count {h.total_count}")
+                lines.append(f"{n}_sum {h.total_sum}")
+        return "\n".join(lines) + "\n"
 
 
 class _Timer:
