@@ -154,8 +154,17 @@ async def amain(args: argparse.Namespace) -> None:
         if args.serve:
             # Headless daemon mode: containers/K8s have no interactive
             # stdin, and a REPL there would hit EOF and exit immediately.
+            # As PID 1, Python's default SIGTERM action would kill the
+            # interpreter before the finally-cleanup runs; catch it.
+            import signal
+
+            stop = asyncio.Event()
+            loop = asyncio.get_running_loop()
+            for sig in (signal.SIGTERM, signal.SIGINT):
+                loop.add_signal_handler(sig, stop.set)
             log.info("serving headless (no REPL); SIGTERM/Ctrl-C stops")
-            await asyncio.Event().wait()
+            await stop.wait()
+            log.info("stop signal received; shutting down")
         else:
             await repl(coord, cfg)
     finally:

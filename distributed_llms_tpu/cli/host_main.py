@@ -5,6 +5,7 @@ from __future__ import annotations
 
 import argparse
 import asyncio
+import os
 import sys
 
 from ..cluster.distributed import initialize_distributed
@@ -21,6 +22,11 @@ async def amain(args: argparse.Namespace) -> None:
     if host == "0.0.0.0":  # bind-any is not a connect address
         host = "localhost"
     worker = WorkerHost(host, port, cfg=cfg.cluster, rt=cfg.runtime, mesh_cfg=cfg.mesh)
+    if args.worker_id:
+        # Stable identity across restarts (e.g. the StatefulSet pod name):
+        # the coordinator re-registers the same id, so shard assignment and
+        # pinned tasks survive a host bounce.
+        worker.worker_id = args.worker_id
     await worker.run()
 
 
@@ -34,6 +40,9 @@ def main(argv: list[str] | None = None) -> None:
                     help="coordinator port (default: from config)")
     ap.add_argument("--platform", default=None, choices=["cpu", "tpu"],
                     help="force a JAX platform (e.g. cpu for a CPU-only host)")
+    ap.add_argument("--worker-id", default=os.environ.get("DLT_WORKER_ID"),
+                    help="stable worker identity to register under (default: "
+                         "$DLT_WORKER_ID; unset -> coordinator assigns one)")
     args = ap.parse_args(argv)
     if args.platform:
         import jax

@@ -87,7 +87,14 @@ class Coordinator:
             self._metrics_server = MetricsServer(
                 self.cfg.coordinator_host, self.cfg.metrics_port, status_fn=self.status
             )
-            await self._metrics_server.start()
+            try:
+                await self._metrics_server.start()
+            except OSError:
+                # A half-started coordinator must not leak its control socket
+                # and background tasks when the metrics port can't bind.
+                self._metrics_server = None
+                await self.stop()
+                raise
         log.info("coordinator listening on %s:%s", addr[0], addr[1])
         return addr[0], addr[1]
 
@@ -127,7 +134,11 @@ class Coordinator:
         except protocol.ProtocolError as e:
             log.warning("protocol error from %s: %s", worker_id, e)
         finally:
-            if worker_id and worker_id in self.workers:
+            info = self.workers.get(worker_id) if worker_id else None
+            # Only evict if this connection still owns the registration — a
+            # worker that re-registered under a stable id (new connection)
+            # must not be evicted when its stale connection finally closes.
+            if info is not None and info.writer is writer:
                 await self._evict(worker_id, reason="connection closed")
             writer.close()
 
@@ -138,6 +149,11 @@ class Coordinator:
         payload = msg.get("payload") or {}
         if mtype == "REGISTER":
             worker_id = payload.get("worker_id") or f"worker-{next(self._counter)}"
+            prior = self.workers.get(worker_id)
+            if prior is not None and prior.writer is not writer:
+                # Same stable id on a fresh connection (host restart):
+                # replace the registration and drop the stale socket.
+                prior.writer.close()
             self.workers[worker_id] = WorkerInfo(
                 worker_id=worker_id,
                 capabilities=payload.get("capabilities", {}),

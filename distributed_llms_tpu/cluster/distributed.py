@@ -27,6 +27,17 @@ def initialize_distributed(cfg: ClusterConfig) -> None:
             "cluster.distributed_coordinator (host:port) is required when "
             f"num_processes={cfg.num_processes}"
         )
+    import os
+
+    plat = jax.config.jax_platforms or os.environ.get("JAX_PLATFORMS", "")
+    if not plat or plat.startswith("cpu"):
+        # Cross-process collectives on the CPU backend need an explicit
+        # implementation (TPU/GPU bring their own fabric); without this any
+        # multi-host psum/ppermute fails at compile time.  Empty platform
+        # counts too: an accelerator-less host resolves to CPU implicitly,
+        # and the setting only affects the CPU backend so it is harmless
+        # when an accelerator is present.
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
     log.info(
         "jax.distributed.initialize(%s, num_processes=%d, process_id=%d)",
         cfg.distributed_coordinator, cfg.num_processes, cfg.process_id,

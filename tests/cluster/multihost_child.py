@@ -1,0 +1,80 @@
+"""Child process for the multi-host smoke test (run via subprocess, not
+collected by pytest): joins a 2-process jax.distributed runtime on CPU,
+runs a cross-process psum over the global mesh, and registers with the
+control-plane coordinator as a worker host.
+
+Usage: python multihost_child.py <process_id> <jax_port> <coord_port>
+"""
+
+import asyncio
+import os
+import sys
+
+# 2 local devices per process -> a 4-device global mesh across 2 "hosts",
+# the smallest shape that exercises both intra- and inter-process axes.
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "..", ".."))
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+
+from distributed_llms_tpu.cluster.distributed import initialize_distributed
+from distributed_llms_tpu.cluster.worker import WorkerHost
+from distributed_llms_tpu.core.config import ClusterConfig
+
+
+def main() -> None:
+    process_id, jax_port, coord_port = (int(a) for a in sys.argv[1:4])
+    cfg = ClusterConfig(
+        distributed_coordinator=f"127.0.0.1:{jax_port}",
+        num_processes=2,
+        process_id=process_id,
+        heartbeat_interval_s=0.2,
+    )
+    initialize_distributed(cfg)
+    assert jax.process_count() == 2, jax.process_count()
+    assert jax.device_count() == 4, jax.device_count()
+    assert jax.local_device_count() == 2
+
+    # Data plane: a psum spanning both processes — the collective the
+    # reference's star topology cannot express (every tensor transited the
+    # master; SURVEY §2.4).
+    mesh = jax.sharding.Mesh(np.array(jax.devices()).reshape(2, 2), ("host", "local"))
+    f = jax.jit(
+        jax.shard_map(
+            lambda a: jax.lax.psum(a, ("host", "local")),
+            mesh=mesh,
+            in_specs=jax.sharding.PartitionSpec(("host", "local")),
+            out_specs=jax.sharding.PartitionSpec(),
+        )
+    )
+    arr = jax.make_array_from_process_local_data(
+        jax.NamedSharding(mesh, jax.sharding.PartitionSpec(("host", "local"))),
+        np.full((2,), float(process_id + 1), np.float32),
+    )
+    total = float(np.asarray(f(arr))[0])
+    assert total == 6.0, total  # proc0 contributes 1+1, proc1 contributes 2+2
+
+    # Control plane: register with the product coordinator like any host.
+    async def register_and_report() -> None:
+        w = WorkerHost("127.0.0.1", coord_port, cfg=cfg)
+        task = asyncio.create_task(w.run())
+        for _ in range(200):
+            if w.worker_id is not None:
+                break
+            await asyncio.sleep(0.05)
+        assert w.worker_id is not None, "never registered"
+        await asyncio.sleep(0.5)  # a few heartbeats
+        task.cancel()
+
+    asyncio.run(register_and_report())
+    print(f"CHILD_OK process={process_id} psum={total}", flush=True)
+    jax.distributed.shutdown()
+
+
+if __name__ == "__main__":
+    main()

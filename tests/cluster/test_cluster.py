@@ -161,6 +161,41 @@ async def test_register_heartbeat_and_eviction():
 
 
 @pytest.mark.asyncio
+async def test_stable_id_reregistration_survives_stale_close():
+    """A host restarting under a stable id (e.g. its StatefulSet pod name)
+    replaces its registration; the stale connection's close must not evict
+    the fresh one."""
+    import dataclasses
+
+    coord = Coordinator(dataclasses.replace(fast_cfg(), heartbeat_timeout_s=60.0))
+    await coord.start()
+    try:
+        async def register(wid):
+            reader, writer = await asyncio.open_connection("127.0.0.1", coord.port)
+            await protocol.send_message(
+                writer, protocol.message("REGISTER", {"worker_id": wid, "capabilities": {}})
+            )
+            ack = await protocol.receive_message(reader, timeout=5)
+            assert ack["payload"]["worker_id"] == wid
+            return reader, writer
+
+        r1, w1 = await register("pod-0")
+        old_info = coord.workers["pod-0"]
+        r2, w2 = await register("pod-0")  # restart: same id, new connection
+        assert coord.workers["pod-0"].writer is not old_info.writer
+        # Stale socket closes (either side) -> registration must survive.
+        w1.close()
+        await asyncio.sleep(0.3)
+        assert "pod-0" in coord.workers
+        assert coord.workers["pod-0"].writer is not old_info.writer
+        w2.close()
+        await asyncio.sleep(0.3)
+        assert "pod-0" not in coord.workers  # real close still evicts
+    finally:
+        await coord.stop()
+
+
+@pytest.mark.asyncio
 async def test_plan_place_generate_roundtrip(tmp_path):
     coord = Coordinator(fast_cfg())
     await coord.start()

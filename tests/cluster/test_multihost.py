@@ -1,0 +1,69 @@
+"""Multi-host smoke test: two real OS processes join one jax.distributed
+runtime (CPU + gloo collectives), run a cross-process psum, and register
+with the product coordinator — the only feasible single-machine validation
+of BASELINE config 5's multi-host path (SURVEY §2.4: the reference's
+"multi-node" story was TCP workers in a star; here it is one global SPMD
+runtime plus a thin control plane)."""
+
+import asyncio
+import os
+import socket
+import subprocess
+import sys
+
+import pytest
+
+from distributed_llms_tpu.cluster.coordinator import Coordinator
+from distributed_llms_tpu.core.config import ClusterConfig
+
+CHILD = os.path.join(os.path.dirname(__file__), "multihost_child.py")
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+@pytest.mark.asyncio
+async def test_two_process_distributed_init_and_registration():
+    jax_port = _free_port()
+    coord = Coordinator(ClusterConfig(
+        coordinator_host="127.0.0.1", coordinator_port=0,
+        heartbeat_interval_s=0.2, heartbeat_timeout_s=60.0,
+    ))
+    await coord.start()
+    procs: list[subprocess.Popen] = []
+    try:
+        env = {k: v for k, v in os.environ.items() if k != "XLA_FLAGS"}
+        for pid in range(2):
+            procs.append(subprocess.Popen(
+                [sys.executable, CHILD, str(pid), str(jax_port), str(coord.port)],
+                stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+                text=True, env=env,
+            ))
+
+        async def drain(p: subprocess.Popen) -> str:
+            return await asyncio.to_thread(lambda: p.communicate(timeout=240)[0])
+
+        async def watch_registrations() -> int:
+            seen = 0
+            while any(p.poll() is None for p in procs):
+                seen = max(seen, len(coord.workers))
+                await asyncio.sleep(0.05)
+            return seen
+
+        watcher = asyncio.create_task(watch_registrations())
+        outs = await asyncio.gather(*(drain(p) for p in procs))
+        for p, out in zip(procs, outs):
+            assert p.returncode == 0, f"child rc={p.returncode}:\n{out[-2000:]}"
+            assert "CHILD_OK" in out, out[-2000:]
+        # Both real processes were registered with the control plane at once.
+        assert await watcher == 2
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        await coord.stop()
