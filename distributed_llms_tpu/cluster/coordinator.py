@@ -169,6 +169,25 @@ class Coordinator:
                     {"worker_id": worker_id, "heartbeat_interval_s": self.cfg.heartbeat_interval_s},
                 ),
             )
+            # A registration whose id already has assigned shards is a rejoin
+            # (stale-connection replacement, or a post-eviction comeback when
+            # no survivor could take the orphans): the fresh process has
+            # nothing loaded, so re-place its assignment — without this,
+            # shard_assignment routes generates at an empty worker.
+            shards = sorted(
+                s for s, w in self.shard_assignment.items() if w == worker_id
+            )
+            if shards and self.store_dir is not None:
+                self._bg.append(
+                    asyncio.create_task(self._place_on(worker_id, shards))
+                )
+            if prior is not None and prior.writer is not writer:
+                # Tasks in flight on the dead connection will never answer.
+                for task in list(self.tasks.values()):
+                    if task.assigned_to == worker_id and not task.future.done():
+                        await self._retry(
+                            task, reason=f"worker {worker_id} re-registered"
+                        )
         elif mtype == "HEARTBEAT":
             if worker_id in self.workers:
                 self.workers[worker_id].last_heartbeat = time.monotonic()
@@ -236,10 +255,19 @@ class Coordinator:
         orphaned = sorted(
             s for s, w in self.shard_assignment.items() if w == worker_id
         )
-        for s in orphaned:
-            del self.shard_assignment[s]
         if orphaned and self.workers:
+            for s in orphaned:
+                del self.shard_assignment[s]
             self._bg.append(asyncio.create_task(self._reassign_orphans(orphaned)))
+        elif orphaned:
+            # No survivor can take the orphans: keep the assignment pointing
+            # at the dead id.  Pinned dispatch already tolerates an absent
+            # worker (requeue-with-delay), and a stable-id rejoin re-places
+            # exactly this set (REGISTER handler); rebalance() also fixes it.
+            log.warning(
+                "no survivors for %s's shards %s; keeping assignment pending "
+                "rejoin or rebalance", worker_id, orphaned,
+            )
         for task in list(self.tasks.values()):
             if task.assigned_to == worker_id and not task.future.done():
                 await self._retry(task, reason=f"worker {worker_id} evicted")

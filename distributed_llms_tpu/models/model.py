@@ -32,17 +32,29 @@ from .layers import Params
 @jax.tree_util.register_dataclass
 @dataclass
 class KVCache:
-    """Preallocated per-layer KV cache, [L, B, S, KVH, HD]."""
+    """Preallocated per-layer KV cache, [L, B, S, KVH, HD].
 
-    k: jax.Array
-    v: jax.Array
+    Under sequence parallelism ``k``/``v`` are two-region tuples
+    ``(prefill, decode)`` instead (see models.model._seq_cached_attention);
+    every consumer treats the fields as opaque pytrees."""
+
+    k: Any
+    v: Any
 
     @property
     def max_len(self) -> int:
+        if isinstance(self.k, tuple):  # seq-parallel two-region layout
+            return self.k[0].shape[2] + self.k[1].shape[2]
         return self.k.shape[2]
 
 
-def init_cache(cfg: ModelConfig, batch: int, max_len: int, dtype: Any = None) -> KVCache:
+def init_cache(
+    cfg: ModelConfig, batch: int, max_len: int, dtype: Any = None,
+    prompt_len: int | None = None,
+) -> KVCache:
+    """``prompt_len`` is part of the shared make_cache protocol (the
+    seq-parallel cache splits regions there); the dense layout ignores it."""
+    del prompt_len
     dtype = dtype or jnp.dtype(cfg.dtype)
     shape = (cfg.num_layers, batch, max_len, cfg.num_kv_heads, cfg.head_dim_)
     return KVCache(k=jnp.zeros(shape, dtype), v=jnp.zeros(shape, dtype))
@@ -85,6 +97,14 @@ def _attention(
             causal=True,
         )
         return layers.out_project(out, p), None
+
+    if cfg.attn_impl in ("ring", "ulysses") and layer_cache is not None:
+        # Sequence-parallel cached generation (SURVEY §5.7): the KV cache is
+        # split into a seq-sharded prefill region and a small replicated
+        # decode region (parallel.api builds it; see ParallelModel.init_cache).
+        return _seq_cached_attention(
+            q, k, v, p, cfg, positions, layer_cache, cache_index, attn_mask
+        )
 
     if cfg.attn_impl in ("ring", "ulysses") and layer_cache is None:
         # Sequence-parallel paths: we are inside a shard_map over the 'seq'
@@ -137,6 +157,76 @@ def _attention(
         out = layers.dot_product_attention(q, k_full, v_full, mask)
         new_cache = None
     return layers.out_project(out, p), new_cache
+
+
+def _seq_cached_attention(
+    q: jax.Array,  # [B, Tq, H, HD] (post-RoPE)
+    k: jax.Array,  # [B, Tq, KVH, HD]
+    v: jax.Array,
+    p: Params,
+    cfg: ModelConfig,
+    positions: jax.Array,
+    layer_cache: tuple,  # ((ck_pref, ck_dec), (cv_pref, cv_dec))
+    cache_index: jax.Array,
+    attn_mask,
+) -> tuple[jax.Array, tuple]:
+    """Cached attention under sequence parallelism — runs inside a shard_map
+    over the 'seq' axis (parallel.api wraps it).
+
+    Two-region cache layout: the prefill region holds the long prompt's KV
+    sharded over 'seq' (each device keeps its own block — written locally,
+    never moved); the decode region holds generated tokens' KV replicated
+    (bounded by max_new_tokens, a sliver next to a long-context prompt).
+
+    Prefill (Tq > 1): this device's block fills its prefill slice wholesale
+    and attention is the ring / Ulysses pass.  Decode (Tq == 1): the token's
+    KV appends to the decode region on every device, and attention merges
+    flash-style partial stats across the seq axis (one psum) — the KV stays
+    put instead of rotating to meet a single query (ops/ring.py,
+    seq_cached_decode_attention)."""
+    from ..ops import ring
+
+    (ck_pref, ck_dec), (cv_pref, cv_dec) = layer_cache
+    tq = q.shape[1]
+    if tq > 1:
+        # -- prefill: whole (sharded) prompt in one pass at cache_index 0.
+        if attn_mask is not None:
+            raise NotImplementedError(
+                "sequence-parallel prefill supports causal masking only"
+            )
+        if tq != ck_pref.shape[1]:
+            raise ValueError(
+                f"seq-parallel prefill expects the full prompt at once: got "
+                f"{tq} local tokens for a {ck_pref.shape[1]}-slot local "
+                "prefill region (chunked prefill is unsupported here)"
+            )
+        ck_pref = k.astype(ck_pref.dtype)
+        cv_pref = v.astype(cv_pref.dtype)
+        if cfg.attn_impl == "ring":
+            out = ring.ring_attention(q, k, v, positions, positions, axis_name="seq")
+        else:
+            from ..ops import ulysses
+
+            out = ulysses.ulysses_attention(q, k, v, positions, axis_name="seq")
+        return layers.out_project(out, p), ((ck_pref, ck_dec), (cv_pref, cv_dec))
+
+    # -- decode: append this token's KV to the replicated decode region.
+    if not isinstance(attn_mask, tuple):
+        raise ValueError(
+            "seq-parallel cached decode needs attn_mask=(prefill_mask, "
+            "decode_mask) — ParallelModel.forward splits the global mask"
+        )
+    t_pref_global = ck_pref.shape[1] * jax.lax.axis_size("seq")
+    di = cache_index - t_pref_global
+    ck_dec = jax.lax.dynamic_update_slice(ck_dec, k.astype(ck_dec.dtype), (0, di, 0, 0))
+    cv_dec = jax.lax.dynamic_update_slice(cv_dec, v.astype(cv_dec.dtype), (0, di, 0, 0))
+    m_pref, m_dec = attn_mask
+    out = ring.seq_cached_decode_attention(
+        q, ck_pref.astype(q.dtype), cv_pref.astype(q.dtype),
+        ck_dec.astype(q.dtype), cv_dec.astype(q.dtype),
+        m_pref, m_dec, axis_name="seq",
+    )
+    return layers.out_project(out, p), ((ck_pref, ck_dec), (cv_pref, cv_dec))
 
 
 def gpt2_block(x, p, cfg, positions, layer_cache, cache_index, attn_mask=None, std_layout=False):

@@ -149,6 +149,65 @@ def ring_attention(
     return out.astype(q.dtype)
 
 
+def seq_cached_decode_attention(
+    q: jax.Array,  # [B, 1, H, D] — replicated over the seq axis
+    ck_local: jax.Array,  # [B, S_loc, KVH, D] — this device's prefill KV block
+    cv_local: jax.Array,
+    dk: jax.Array,  # [B, N, KVH, D] — decode-region KV, replicated
+    dv: jax.Array,
+    mask_local: jax.Array,  # [B, S_loc] bool — this device's slice of the key mask
+    mask_dec: jax.Array,  # [B, N] bool
+    axis_name: str = "seq",
+) -> jax.Array:
+    """Single-token decode over a sequence-sharded KV cache (long-context
+    generation, SURVEY §5.7 — the part ring prefill alone leaves open).
+
+    Decode inverts ring attention's economics: the query is one token, so
+    rotating KV blocks would move O(S) bytes to meet O(1) queries.  Instead
+    the KV stays put: every device computes flash-style partial softmax stats
+    (max / numerator / denominator) over its resident block, and one psum
+    over ``axis_name`` merges them — the only collective in the step.  The
+    decode region (tokens generated after prefill) is replicated on every
+    device — it is bounded by max_new_tokens, a sliver next to a long
+    prompt — so its stats merge locally with no ownership bookkeeping.
+
+    Returns [B, 1, H, D], identical on every device of the seq axis.
+    """
+    q_per_kv = q.shape[2] // ck_local.shape[2]
+
+    def stats(k_blk, v_blk, valid):
+        logits = _block_scores(
+            q, k_blk, q, k_blk, valid, causal=False, q_per_kv=q_per_kv
+        )  # positions unused with causal=False
+        mx = jnp.max(logits, axis=-1)  # [B, H, 1]
+        safe = jnp.where(mx <= _NEG_INF * 0.5, 0.0, mx)
+        probs = jnp.exp(logits - safe[..., None])
+        num = _block_pv(probs, v_blk, q_per_kv).astype(jnp.float32)  # [B,1,H,D]
+        den = jnp.sum(probs, axis=-1)  # [B, H, 1]
+        return num, den, mx
+
+    # Local prefill block -> psum-merged global prefill stats.
+    num_l, den_l, mx_l = stats(ck_local, cv_local, mask_local)
+    mx_p = jax.lax.pmax(mx_l, axis_name)
+    safe_p = jnp.where(mx_p <= _NEG_INF * 0.5, 0.0, mx_p)
+    scale_l = jnp.exp(mx_l - safe_p)  # 0 for fully-masked local blocks
+    num_p = jax.lax.psum(num_l * scale_l[..., None].transpose(0, 2, 1, 3), axis_name)
+    den_p = jax.lax.psum(den_l * scale_l, axis_name)
+
+    # Decode region (replicated, computed identically everywhere).
+    num_d, den_d, mx_d = stats(dk, dv, mask_dec)
+
+    # Final merge of the two partial softmaxes.
+    mx = jnp.maximum(mx_p, mx_d)
+    safe = jnp.where(mx <= _NEG_INF * 0.5, 0.0, mx)
+    a_p = jnp.exp(mx_p - safe)[..., None].transpose(0, 2, 1, 3)
+    a_d = jnp.exp(mx_d - safe)[..., None].transpose(0, 2, 1, 3)
+    num = num_p * a_p + num_d * a_d
+    den = (den_p * jnp.exp(mx_p - safe) + den_d * jnp.exp(mx_d - safe))
+    den = den.transpose(0, 2, 1)[..., None]  # [B, 1, H, 1]
+    return (num / jnp.maximum(den, 1e-37)).astype(q.dtype)
+
+
 def ring_self_attention(
     mesh: Mesh,
     q: jax.Array,  # [B, T, H, D] global

@@ -85,11 +85,41 @@ class ParallelModel:
             lambda x, s: jax.device_put(x, NamedSharding(self.mesh, s)), params, specs
         )
 
-    def init_cache(self, batch: int, max_len: int) -> KVCache:
+    def init_cache(
+        self, batch: int, max_len: int, prompt_len: int | None = None
+    ) -> KVCache:
         cfg = self.cfg
         kvh, hd = cfg.num_kv_heads, cfg.head_dim_
         tp = self.mesh.shape.get("model", 1)
         kv_ax = "model" if kvh % max(tp, 1) == 0 else None
+        if self.seq_parallel:
+            # Two-region layout for long-context generation: the prompt's KV
+            # sharded over 'seq' (each device writes + keeps its own block),
+            # the decode region replicated (bounded by max_new_tokens).
+            seq_ax = self.mesh.shape["seq"]
+            if prompt_len is None:
+                raise ValueError(
+                    "sequence-parallel KV cache needs prompt_len (the region "
+                    "split point); the session path does not support "
+                    "seq-parallel decode"
+                )
+            if prompt_len % seq_ax:
+                raise ValueError(
+                    f"padded prompt length {prompt_len} not divisible by "
+                    f"seq axis {seq_ax}"
+                )
+            dt = jnp.dtype(self.kv_dtype or cfg.dtype)
+            l = cfg.num_layers
+
+            def region(length, spec):
+                return jax.lax.with_sharding_constraint(
+                    jnp.zeros((l, batch, length, kvh, hd), dt),
+                    NamedSharding(self.mesh, spec),
+                )
+
+            pref = region(prompt_len, P(None, "data", "seq", kv_ax, None))
+            dec = region(max_len - prompt_len, P(None, "data", None, kv_ax, None))
+            return KVCache(k=(pref, dec), v=(pref, dec))
         if self.pipelined:
             p, lp = self.num_stages, cfg.num_layers // self.num_stages
             shape = (p, lp, batch, max_len, kvh, hd)
@@ -114,6 +144,24 @@ class ParallelModel:
     def as_make_cache(self):
         return self._make_cache_adapter
 
+    def as_decode_fn(self):
+        """Fused wavefront decode loop (pipeline.pipeline_decode) for
+        runtime.generate: only meaningful when pipelined."""
+        return self._decode_adapter if self.pipelined else None
+
+    def _decode_adapter(
+        self, params, tok0, prompt_lens, prompt_pad_len, cache, rng,
+        max_new_tokens, temperature, top_k, top_p, eos_id, pad_id,
+    ):
+        toks, _, _ = pipeline_lib.pipeline_decode(
+            self.mesh, _local_cfg(self.cfg), params, tok0, prompt_lens,
+            prompt_pad_len, cache.k, cache.v, max_new_tokens,
+            self.num_microbatches, rng,
+            temperature=temperature, top_k=top_k, top_p=top_p,
+            eos_id=eos_id, pad_id=pad_id,
+        )
+        return toks
+
     def _forward_adapter(
         self, params, cfg, tokens, positions=None, cache=None,
         cache_index=None, attn_mask=None,
@@ -124,9 +172,9 @@ class ParallelModel:
             cache_index=cache_index, attn_mask=attn_mask,
         )
 
-    def _make_cache_adapter(self, cfg, batch, max_len):
+    def _make_cache_adapter(self, cfg, batch, max_len, prompt_len=None):
         del cfg
-        return self.init_cache(batch, max_len)
+        return self.init_cache(batch, max_len, prompt_len=prompt_len)
 
     # -- execution ---------------------------------------------------------
 
@@ -155,6 +203,74 @@ class ParallelModel:
             axis_names={"seq"},
         )(params, tokens, positions)
 
+    def _seq_prefill_cached(self, params, tokens, positions, cache, cache_index, remat):
+        """Cached prefill under 'seq': tokens sharded over the sequence,
+        each device writes its prefill-region KV block locally."""
+        cfg = _seq_cfg(self.cfg)
+        b, t = tokens.shape
+        seq_ax = self.mesh.shape["seq"]
+        if t % seq_ax:
+            raise ValueError(
+                f"prompt length {t} not divisible by seq axis {seq_ax} "
+                "(the engine pads prompts to the mesh multiple)"
+            )
+        if positions is None:
+            positions = jnp.broadcast_to(jnp.arange(t, dtype=jnp.int32), (b, t))
+        (pk, dk), (pv, dv) = cache.k, cache.v
+
+        def body(params, tokens, positions, pk, pv, dk, dv):
+            logits, new_cache = model_lib.forward(
+                params, cfg, tokens, positions=positions,
+                cache=KVCache(k=(pk, dk), v=(pv, dv)),
+                cache_index=jnp.int32(0), remat=remat,
+            )
+            (npk, ndk), (npv, ndv) = new_cache.k, new_cache.v
+            return logits, npk, npv, ndk, ndv
+
+        seq_kv = P(None, None, "seq", None, None)
+        logits, npk, npv, ndk, ndv = jax.shard_map(
+            body,
+            mesh=self.mesh,
+            in_specs=(P(), P(None, "seq"), P(None, "seq"), seq_kv, seq_kv, P(), P()),
+            out_specs=(P(None, "seq", None), seq_kv, seq_kv, P(), P()),
+            axis_names={"seq"},
+        )(params, tokens, positions, pk, pv, dk, dv)
+        return logits, KVCache(k=(npk, ndk), v=(npv, ndv))
+
+    def _seq_decode_cached(self, params, tokens, positions, cache, cache_index, attn_mask, remat):
+        """Single-token decode over the seq-sharded cache: partial softmax
+        stats merge across 'seq' with one psum; the query is replicated."""
+        cfg = _seq_cfg(self.cfg)
+        (pk, dk), (pv, dv) = cache.k, cache.v
+        t_pref = pk.shape[2]
+        if attn_mask is None:
+            raise ValueError(
+                "seq-parallel cached decode needs the decode loop's explicit "
+                "attention mask (runtime.generate supplies it)"
+            )
+        m = attn_mask[:, 0, 0, :]  # [B, S_total]
+        m_pref, m_dec = m[:, :t_pref], m[:, t_pref:]
+
+        def body(params, tokens, positions, pk, pv, dk, dv, m_pref, m_dec, ci):
+            logits, new_cache = model_lib.forward(
+                params, cfg, tokens, positions=positions,
+                cache=KVCache(k=(pk, dk), v=(pv, dv)), cache_index=ci,
+                attn_mask=(m_pref, m_dec), remat=remat,
+            )
+            (npk, ndk), (npv, ndv) = new_cache.k, new_cache.v
+            return logits, npk, npv, ndk, ndv
+
+        seq_kv = P(None, None, "seq", None, None)
+        logits, npk, npv, ndk, ndv = jax.shard_map(
+            body,
+            mesh=self.mesh,
+            in_specs=(P(), P(), P(), seq_kv, seq_kv, P(), P(),
+                      P(None, "seq"), P(), P()),
+            out_specs=(P(), seq_kv, seq_kv, P(), P()),
+            axis_names={"seq"},
+        )(params, tokens, positions, pk, pv, dk, dv, m_pref, m_dec, cache_index)
+        return logits, KVCache(k=(npk, ndk), v=(npv, ndv))
+
     def forward(
         self,
         params: Params,
@@ -171,16 +287,36 @@ class ParallelModel:
         GSPMD paths; the pipeline/seq shard_map schedules return aux=0 —
         train MoE with data/model/expert axes."""
         cfg = self.cfg
+        if self.seq_parallel and cache is not None:
+            # Long-context *generation* (SURVEY §5.7): prompt KV sharded over
+            # 'seq' (two-region cache from init_cache); single-token decode
+            # merges partial softmax stats with one psum instead of rotating
+            # KV to meet one query.
+            if tokens.shape[1] > 1:
+                if attn_mask is not None:
+                    # Loud, not silently-causal: the sharded prefill cannot
+                    # honor an arbitrary mask (ring/Ulysses are causal-only).
+                    raise NotImplementedError(
+                        "sequence-parallel cached prefill supports causal "
+                        "masking only; got an explicit attn_mask"
+                    )
+                out = self._seq_prefill_cached(
+                    params, tokens, positions, cache, cache_index, remat
+                )
+            else:
+                out = self._seq_decode_cached(
+                    params, tokens, positions, cache, cache_index, attn_mask, remat
+                )
+            return (*out, jnp.float32(0.0)) if return_aux else out
         if (
             self.seq_parallel
             and cache is None
             and not self.pipelined
             and attn_mask is None
         ):
-            # Long-context path (SURVEY §5.7): sequence sharded over 'seq',
-            # ring attention rotates KV blocks over ICI.  Decode-with-cache
-            # and custom-mask calls fall through to the dense path (the ring
-            # handles causal masking only; ring targets prefill/training).
+            # Long-context no-cache path: sequence sharded over 'seq', ring
+            # attention rotates KV blocks over ICI (prefill/training; custom
+            # masks fall through to the dense path — causal only).
             logits = self._seq_forward(params, tokens, positions, remat)
             return (logits, None, jnp.float32(0.0)) if return_aux else (logits, None)
         cfg = _local_cfg(cfg)

@@ -33,6 +33,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from ..core.config import ModelConfig
 from ..models import model as model_lib
+from ..runtime import sampling
 
 Params = Any
 
@@ -192,3 +193,178 @@ def pipeline_blocks(
     # out_all: [P, M, mb, T, D]; only the last stage's bank is meaningful.
     y = out_all[-1].reshape(x.shape)
     return y, ((new_ck, new_cv) if use_cache else None)
+
+
+def pipeline_decode(
+    mesh: Mesh,
+    cfg: ModelConfig,
+    params: Params,  # staged tree: params["blocks"] is [P, L/P, ...] over 'pipe'
+    tok0: jax.Array,  # [B] int32: first token, sampled from the prefill logits
+    prompt_lens: jax.Array,  # [B] int32 true prompt lengths
+    prompt_pad_len: int,  # T: padded prompt length = cache write base
+    cache_k: jax.Array,  # [P, L/P, B, S, KVH, HD] (prefilled)
+    cache_v: jax.Array,
+    num_new_tokens: int,
+    num_microbatches: int,
+    rng: jax.Array,
+    temperature: float = 0.0,
+    top_k: int = 0,
+    top_p: float = 1.0,
+    eos_id: int = -1,
+    pad_id: int = 0,
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Fused wavefront decode: the whole autoregressive loop as ONE scan, the
+    pipeline never drains between tokens (SURVEY §7 hard part 1).
+
+    Running the per-token GPipe schedule once per decode step costs
+    ``M + P - 1`` ticks per token and drains the pipeline every step.  Here
+    stage 0 starts microbatch ``m``'s token ``j`` at tick ``j*Q + m`` with
+    ``Q = max(M, P)``; the last stage's block output rotates (the existing
+    circular ppermute) back to stage 0, which applies the final norm +
+    unembed, samples token ``j+1``, embeds it, and parks it in a per-
+    microbatch buffer until its start tick.  Steady-state cost: ``Q`` ticks
+    per token round — with M >= P microbatches in flight every stage is busy
+    every tick (zero steady-state bubbles); the per-token schedule can never
+    do better than ``M + P - 1``.
+
+    Exactness: identical math to the per-token path under greedy decoding
+    (same masks, cache slots, and per-stage block partitioning); under
+    sampling the RNG stream differs (keys are ``fold_in(fold_in(rng, j), m)``
+    rather than a pre-split array), which is a draw from the same
+    distribution.
+
+    Cost note: every stage traces the stage-0 duties (unembed + sample +
+    embed) and discards them via ``where`` — SPMD branchless gating.  The
+    wasted unembed read per tick is the price of keeping the scan free of
+    cross-stage control flow.
+
+    Returns (tokens [B, N] int32 — EOS-frozen rows pad-filled, matching
+    runtime.generate semantics — plus the updated staged KV cache halves).
+    """
+    num_stages = mesh.shape["pipe"]
+    p_, m_, n_ = num_stages, num_microbatches, num_new_tokens
+    q = max(m_, p_)
+    b = tok0.shape[0]
+    if b % m_:
+        raise ValueError(f"batch {b} not divisible by microbatches {m_}")
+    mb = b // m_
+    t_base = prompt_pad_len
+    s_len = cache_k.shape[3]
+    ticks = (n_ - 1) * q + m_ + p_ - 1
+    head = {k: v for k, v in params.items() if k != "blocks"}
+    head_specs = jax.tree.map(lambda _: P(), head)
+    key_data = jax.random.key_data(rng)
+
+    def body(staged_blocks, head, tok0_mb, plens_mb, key_data, cache_k, cache_v):
+        blocks = jax.tree.map(lambda a: a[0], staged_blocks)
+        ck, cv = cache_k[0], cache_v[0]  # [L/P, B, S, KVH, HD]
+        stage = jax.lax.axis_index("pipe")
+        base_key = jax.random.wrap_key_data(key_data)
+        slots = jnp.arange(s_len, dtype=jnp.int32)
+        dtype = jnp.dtype(cfg.dtype)
+
+        def emb(tok, pos):  # [mb] int32, [mb] int32 -> [mb, 1, D]
+            return model_lib.embed(head, cfg, tok[:, None], pos[:, None])
+
+        # Stage-0 state (vma-varying; other stages carry discarded copies).
+        var = lambda a: jax.lax.pcast(a, ("pipe",), to="varying")
+        buf0 = jnp.stack([emb(tok0_mb[m], plens_mb[m]) for m in range(m_)])
+        buf = var(buf0.astype(dtype))  # [M, mb, 1, D] next-token embeds
+        done0 = (tok0_mb == eos_id) if eos_id >= 0 else jnp.zeros((m_, mb), bool)
+        done = var(done0)
+        out = var(jnp.zeros((n_, m_, mb), jnp.int32).at[0].set(tok0_mb))
+        state = var(jnp.zeros((mb, 1, buf0.shape[-1]), dtype))
+
+        def tick(carry, t):
+            state, buf, done, out, ck, cv = carry
+
+            # -- stage-0 arrival: `state` is what stage P-1 rotated out at
+            # the end of tick t-1, i.e. the block output for (m', j') with
+            # u' = t - P.  Turn it into token j'+1.
+            up = t - p_
+            mp = jnp.clip(up % q, 0, m_ - 1)
+            jp = up // q
+            arr_valid = jnp.logical_and(
+                jnp.logical_and(up >= 0, (up % q) < m_), jp + 1 < n_
+            )
+            logits = model_lib.unembed(head, cfg, state)[:, 0]  # [mb, V] f32
+            key = jax.random.fold_in(jax.random.fold_in(base_key, jp + 1), mp)
+            tok = sampling.sample(key, logits, temperature, top_k, top_p)
+            dmb = jax.lax.dynamic_index_in_dim(done, mp, keepdims=False)
+            tok = jnp.where(dmb, jnp.int32(pad_id), tok)
+            dnew = jnp.logical_or(dmb, tok == eos_id) if eos_id >= 0 else dmb
+            apply = jnp.logical_and(arr_valid, stage == 0)
+            done = jax.lax.dynamic_update_index_in_dim(
+                done, jnp.where(apply, dnew, dmb), mp, axis=0
+            )
+            jpc = jnp.clip(jp + 1, 0, n_ - 1)
+            cur_out = jax.lax.dynamic_index_in_dim(out, jpc, keepdims=False)
+            cur_row = jax.lax.dynamic_index_in_dim(cur_out, mp, keepdims=False)
+            new_row = jnp.where(apply, tok, cur_row)
+            out = jax.lax.dynamic_update_index_in_dim(
+                out,
+                jax.lax.dynamic_update_index_in_dim(cur_out, new_row, mp, axis=0),
+                jpc, axis=0,
+            )
+            plens_arr = jax.lax.dynamic_index_in_dim(plens_mb, mp, keepdims=False)
+            x_next = emb(tok, plens_arr + jp + 1).astype(dtype)
+            cur_buf = jax.lax.dynamic_index_in_dim(buf, mp, keepdims=False)
+            buf = jax.lax.dynamic_update_index_in_dim(
+                buf, jnp.where(apply, x_next, cur_buf), mp, axis=0
+            )
+
+            # -- this tick's stage compute: (m, j) with u = t - stage.
+            u = t - stage
+            m_idx = jnp.clip(u % q, 0, m_ - 1)
+            j = jnp.clip(u // q, 0, n_ - 1)
+            valid = jnp.logical_and(
+                jnp.logical_and(u >= 0, (u % q) < m_), u // q < n_
+            )
+            x_in = jnp.where(
+                stage == 0,
+                jax.lax.dynamic_index_in_dim(buf, m_idx, keepdims=False),
+                state,
+            )
+            plens_m = jax.lax.dynamic_index_in_dim(plens_mb, m_idx, keepdims=False)
+            pos = (plens_m + j)[:, None]  # [mb, 1]
+            prompt_valid = slots[None, :] < plens_m[:, None]
+            gen_valid = jnp.logical_and(
+                slots[None, :] >= t_base, slots[None, :] <= t_base + j
+            )
+            mask = jnp.logical_or(prompt_valid, gen_valid)[:, None, None, :]
+            row0 = m_idx * mb
+            ck_mb = jax.lax.dynamic_slice_in_dim(ck, row0, mb, axis=1)
+            cv_mb = jax.lax.dynamic_slice_in_dim(cv, row0, mb, axis=1)
+            y, (nk, nv), _ = model_lib.run_blocks(
+                x_in, blocks, cfg, pos, ck_mb, cv_mb, t_base + j, attn_mask=mask
+            )
+            nk = jnp.where(valid, nk, ck_mb)
+            nv = jnp.where(valid, nv, cv_mb)
+            ck = jax.lax.dynamic_update_slice_in_dim(ck, nk, row0, axis=1)
+            cv = jax.lax.dynamic_update_slice_in_dim(cv, nv, row0, axis=1)
+
+            state = jax.lax.ppermute(
+                y, "pipe", [(i, (i + 1) % p_) for i in range(p_)]
+            )
+            return (state, buf, done, out, ck, cv), None
+
+        carry = (state, buf, done, out, ck, cv)
+        (state, buf, done, out, ck, cv), _ = jax.lax.scan(
+            tick, carry, jnp.arange(ticks)
+        )
+        return out[None], ck[None], cv[None]
+
+    tok0_mb = tok0.reshape(m_, mb)
+    plens_mb = prompt_lens.reshape(m_, mb)
+    out_all, new_ck, new_cv = jax.shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(P("pipe"), head_specs, P(), P(), P(), P("pipe"), P("pipe")),
+        out_specs=(P("pipe"), P("pipe"), P("pipe")),
+        axis_names={"pipe"},
+        check_vma=True,
+    )(params["blocks"], head, tok0_mb, plens_mb, key_data, cache_k, cache_v)
+
+    # out_all: [P, N, M, mb]; stage 0 holds the real bank.
+    toks = out_all[0].reshape(num_new_tokens, b).T  # [B, N]
+    return toks, new_ck, new_cv

@@ -78,13 +78,15 @@ class InferenceEngine:
             self.params = parallel.shard_params(params)
             self._forward_fn = parallel.as_forward_fn()
             self._make_cache = parallel.as_make_cache()
+            self._decode_fn = parallel.as_decode_fn()  # fused pipelined decode
         else:
             self.params = params
             self._forward_fn = None  # generate_tokens' single-device default
+            self._decode_fn = None
             # KV-cache dtype knob: bound once so the jitted decode sees a
             # stable (identity-hashed) make_cache and caches the compilation.
             kv_dtype = jnp.dtype(rt.kv_cache_dtype)
-            self._make_cache = lambda cfg_, b, s: model_lib.init_cache(
+            self._make_cache = lambda cfg_, b, s, prompt_len=None: model_lib.init_cache(
                 cfg_, b, s, dtype=kv_dtype
             )
         self._timer = profiling.StepTimer("engine.generate")
@@ -171,6 +173,7 @@ class InferenceEngine:
                 temperature=self.rt.temperature, top_k=self.rt.top_k, top_p=self.rt.top_p,
                 eos_id=tok.eos_id, pad_id=tok.pad_id,
                 forward_fn=self._forward_fn, make_cache=self._make_cache,
+                decode_fn=self._decode_fn,
             )
             out = np.asarray(jax.block_until_ready(out))[:n_real]
         dt = time.perf_counter() - t0
@@ -206,6 +209,15 @@ class InferenceEngine:
             while len(seqs) < batch:
                 seqs.append(seqs[0])
         arr, lens = pad_batch(seqs, tok.pad_id)
+        if self.parallel is not None and self.parallel.seq_parallel:
+            # The seq-sharded prefill splits the prompt over the 'seq' axis;
+            # right-pad T up to the mesh multiple (pad slots are masked out
+            # of decode attention via prompt_lens, like any padding).
+            seq_ax = self.parallel.mesh.shape["seq"]
+            t = arr.shape[1]
+            if t % seq_ax:
+                pad = seq_ax - t % seq_ax
+                arr = np.pad(arr, ((0, 0), (0, pad)), constant_values=tok.pad_id)
         return jnp.asarray(arr), jnp.asarray(lens), n_real
 
     def _session_turn(self, sess, chunk, lens, n_new: int, seed: int | None) -> GenerationResult:

@@ -38,7 +38,7 @@ def _default_forward(params, cfg, tokens, positions=None, cache=None, cache_inde
     jax.jit,
     static_argnames=(
         "cfg", "max_new_tokens", "temperature", "top_k", "top_p", "eos_id",
-        "pad_id", "forward_fn", "make_cache",
+        "pad_id", "forward_fn", "make_cache", "decode_fn",
     ),
 )
 def generate_tokens(
@@ -55,6 +55,7 @@ def generate_tokens(
     pad_id: int = 0,
     forward_fn: Any = None,  # (params, cfg, tokens, positions=, cache=, cache_index=, attn_mask=) -> (logits, cache)
     make_cache: Any = None,  # (cfg, batch, max_len) -> KVCache
+    decode_fn: Any = None,  # fused decode loop (ParallelModel.as_decode_fn())
 ) -> jax.Array:
     """Generate.  Returns new tokens [B, max_new_tokens] int32; positions
     after a sequence's EOS are filled with pad_id.
@@ -77,7 +78,7 @@ def generate_tokens(
         make_cache = model_lib.init_cache
     b, t = prompt.shape
     max_len = t + max_new_tokens
-    cache = make_cache(cfg, b, max_len)
+    cache = make_cache(cfg, b, max_len, prompt_len=t)
 
     # --- prefill: causal attention over prompt slots (pad queries produce
     # garbage but nothing reads their logits; pad K/V slots are masked during
@@ -88,6 +89,17 @@ def generate_tokens(
     )
     last_idx = jnp.maximum(prompt_lens - 1, 0)
     next_logits = jnp.take_along_axis(logits, last_idx[:, None, None], axis=1)[:, 0]
+
+    if decode_fn is not None:
+        # Fused wavefront decode (pipelined models): the whole loop runs as
+        # one schedule that never drains the pipeline between tokens —
+        # max(M, P) ticks per token round instead of M + P - 1.
+        rng0, rng_loop = jax.random.split(rng)
+        tok0 = sampling.sample(rng0, next_logits, temperature, top_k, top_p)
+        return decode_fn(
+            params, tok0, prompt_lens, t, cache, rng_loop, max_new_tokens,
+            temperature, top_k, top_p, eos_id, pad_id,
+        )
 
     slots = jnp.arange(max_len, dtype=jnp.int32)  # [S]
     prompt_valid = slots[None, :] < prompt_lens[:, None]  # [B, S]

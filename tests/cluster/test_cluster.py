@@ -196,6 +196,48 @@ async def test_stable_id_reregistration_survives_stale_close():
 
 
 @pytest.mark.asyncio
+async def test_stable_id_rejoin_replaces_shards(tmp_path):
+    """A stable-id rejoin is a fresh process with nothing loaded: the
+    coordinator must re-send PLACE_SHARDS for its assignment instead of
+    routing generates at an empty worker."""
+    import dataclasses
+
+    coord = Coordinator(dataclasses.replace(fast_cfg(), heartbeat_timeout_s=60.0))
+    await coord.start()
+    try:
+        async def register(wid):
+            reader, writer = await asyncio.open_connection("127.0.0.1", coord.port)
+            await protocol.send_message(
+                writer, protocol.message("REGISTER", {"worker_id": wid, "capabilities": {}})
+            )
+            ack = await protocol.receive_message(reader, timeout=5)
+            assert ack["type"] == "REGISTER_ACK"
+            return reader, writer
+
+        r1, w1 = await register("pod-0")
+        coord.plan_shards(2, store_dir=str(tmp_path))
+        # Drain the initial PLACE_SHARDS (ack it so place_shards resolves).
+        place_task = asyncio.create_task(coord.place_shards())
+        msg = await protocol.receive_message(r1, timeout=5)
+        assert msg["type"] == "PLACE_SHARDS"
+        await protocol.send_message(
+            w1, protocol.message("RESULT", {"loaded": [0, 1], "resident": "x"},
+                                 msg_id=msg["msg_id"])
+        )
+        await place_task
+
+        # Restart: same id, new connection -> expect a fresh PLACE_SHARDS.
+        w1.close()
+        r2, w2 = await register("pod-0")
+        msg2 = await protocol.receive_message(r2, timeout=5)
+        assert msg2["type"] == "PLACE_SHARDS"
+        assert sorted(msg2["payload"]["shards"]) == [0, 1]
+        w2.close()
+    finally:
+        await coord.stop()
+
+
+@pytest.mark.asyncio
 async def test_plan_place_generate_roundtrip(tmp_path):
     coord = Coordinator(fast_cfg())
     await coord.start()

@@ -108,6 +108,54 @@ def test_dp_pp_tp_generation_matches_single_device(gpt2, devices8):
     assert np.asarray(ref).tolist() == np.asarray(out).tolist()
 
 
+@pytest.mark.parametrize("microbatches", [1, 2, 4])
+def test_fused_wavefront_decode_matches_single_device(gpt2, devices8, microbatches):
+    """The fused decode schedule (pipeline never drains between tokens,
+    max(M,P) ticks per token round vs M+P-1) is numerically identical to the
+    single-device loop, for M below/at/above P."""
+    cfg, params = gpt2
+    rows = [[7, 1, 9], [4, 4, 4, 4, 4, 4], [100, 3, 5, 2], [9, 8, 7, 6, 5]]
+    arr, lens = pad_batch(rows, pad_id=0)
+    ref = gen_lib.generate_tokens(
+        params, cfg, jnp.asarray(arr), jnp.asarray(lens), jax.random.key(0),
+        max_new_tokens=5,
+    )
+    pm = make_parallel_model(
+        cfg, MeshConfig(data=2, pipe=2, model=2), num_microbatches=microbatches
+    )
+    sharded = pm.shard_params(params)
+    out = gen_lib.generate_tokens(
+        sharded, cfg, jnp.asarray(arr), jnp.asarray(lens), jax.random.key(0),
+        max_new_tokens=5, forward_fn=pm.as_forward_fn(),
+        make_cache=pm.as_make_cache(), decode_fn=pm.as_decode_fn(),
+    )
+    assert np.asarray(ref).tolist() == np.asarray(out).tolist()
+
+
+def test_fused_decode_eos_freezing_matches(gpt2, devices8):
+    """EOS-aware freezing (rows stop and pad-fill) through the wavefront."""
+    cfg, params = gpt2
+    rows = [[7, 1, 9], [4, 4, 4, 4], [100, 3, 5, 2], [9, 8, 7, 6, 5]]
+    arr, lens = pad_batch(rows, pad_id=0)
+    ref = gen_lib.generate_tokens(
+        params, cfg, jnp.asarray(arr), jnp.asarray(lens), jax.random.key(0),
+        max_new_tokens=6,
+    )
+    eos = int(np.asarray(ref)[0, 1])  # a token greedy decoding actually emits
+    kw = dict(max_new_tokens=6, eos_id=eos, pad_id=0)
+    ref_e = gen_lib.generate_tokens(
+        params, cfg, jnp.asarray(arr), jnp.asarray(lens), jax.random.key(0), **kw
+    )
+    assert (np.asarray(ref_e) == eos).any()
+    pm = make_parallel_model(cfg, MeshConfig(data=2, pipe=2, model=2), num_microbatches=2)
+    out_e = gen_lib.generate_tokens(
+        pm.shard_params(params), cfg, jnp.asarray(arr), jnp.asarray(lens),
+        jax.random.key(0), forward_fn=pm.as_forward_fn(),
+        make_cache=pm.as_make_cache(), decode_fn=pm.as_decode_fn(), **kw
+    )
+    assert np.asarray(ref_e).tolist() == np.asarray(out_e).tolist()
+
+
 def test_train_step_decreases_loss(devices8):
     from distributed_llms_tpu.runtime import train
 
@@ -157,6 +205,55 @@ def test_seq_parallel_train_step(devices8):
         params, opt_state, loss = step(params, opt_state, tokens, None)
         losses.append(float(loss))
     assert losses[-1] < losses[0], losses
+
+
+def test_seq_parallel_cached_generation_matches(gpt2, devices8):
+    """Long-context decode (SURVEY §5.7): prompt KV sharded over 'seq' (two-
+    region cache), decode merges partial softmax stats with one psum — tokens
+    must match the single-device loop exactly."""
+    cfg, params = gpt2
+    B, T, N = 2, 16, 6
+    prompt = jax.random.randint(jax.random.key(5), (B, T), 0, cfg.vocab_size, dtype=jnp.int32)
+    lens = jnp.array([16, 11], jnp.int32)
+    ref = gen_lib.generate_tokens(
+        params, cfg, prompt, lens, jax.random.key(0), max_new_tokens=N
+    )
+    pm = make_parallel_model(cfg, MeshConfig(data=2, seq=4))
+    out = gen_lib.generate_tokens(
+        pm.shard_params(params), cfg, prompt, lens, jax.random.key(0),
+        max_new_tokens=N, forward_fn=pm.as_forward_fn(),
+        make_cache=pm.as_make_cache(),
+    )
+    assert np.asarray(ref).tolist() == np.asarray(out).tolist()
+
+
+def test_seq_parallel_ulysses_cached_generation_matches(gpt2, devices8):
+    """Same decode path behind a Ulysses prefill, composed with TP."""
+    import dataclasses
+
+    cfg, params = gpt2
+    cfg_u = dataclasses.replace(cfg, attn_impl="ulysses")
+    B, T, N = 2, 16, 5
+    prompt = jax.random.randint(jax.random.key(6), (B, T), 0, cfg.vocab_size, dtype=jnp.int32)
+    lens = jnp.array([16, 9], jnp.int32)
+    ref = gen_lib.generate_tokens(
+        params, cfg, prompt, lens, jax.random.key(0), max_new_tokens=N
+    )
+    pm = make_parallel_model(cfg_u, MeshConfig(data=2, seq=2, model=2))
+    out = gen_lib.generate_tokens(
+        pm.shard_params(params), cfg_u, prompt, lens, jax.random.key(0),
+        max_new_tokens=N, forward_fn=pm.as_forward_fn(),
+        make_cache=pm.as_make_cache(),
+    )
+    assert np.asarray(ref).tolist() == np.asarray(out).tolist()
+
+
+def test_seq_parallel_cache_requires_prompt_len(gpt2, devices8):
+    """The session path (no prompt_len) fails loudly, not silently densely."""
+    cfg, _ = gpt2
+    pm = make_parallel_model(cfg, MeshConfig(data=2, seq=4))
+    with pytest.raises(ValueError, match="prompt_len"):
+        pm.init_cache(batch=2, max_len=32)
 
 
 def test_seq_plus_pipe_rejected(devices8):
