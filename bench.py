@@ -99,8 +99,18 @@ def _param_count(cfg) -> int:
         mlp = cfg.num_experts * 3 * d * ff + d * cfg.num_experts
     norms = 2 * d * l + d
     embed = v * d + (0 if cfg.tie_embeddings else v * d)
-    pos = cfg.max_seq_len * d if cfg.family == "gpt2" else 0
+    pos = (cfg.max_seq_len + (2 if cfg.family == "opt" else 0)) * d \
+        if cfg.family in ("gpt2", "opt") else 0
     return l * (attn + mlp) + norms + embed + pos
+
+
+# HBM per chip by device_kind substring — fallback when the plugin exposes
+# no memory_stats (the axon TPU plugin doesn't; round 2's first ladder run
+# attempted 7B bf16 on a 16 GB chip and died RESOURCE_EXHAUSTED).
+HBM_BYTES = {
+    "v5 lite": 16e9, "v5e": 16e9, "v4": 32e9, "v5p": 95e9,
+    "v6 lite": 32e9, "v6e": 32e9,
+}
 
 
 def _mem_budget_bytes() -> int | None:
@@ -116,6 +126,10 @@ def _mem_budget_bytes() -> int | None:
             return os.sysconf("SC_PAGE_SIZE") * os.sysconf("SC_PHYS_PAGES")
         except (ValueError, OSError):
             return None
+    kind = getattr(dev, "device_kind", "").lower()
+    for key, hbm in HBM_BYTES.items():
+        if key in kind:
+            return int(hbm)
     return None
 
 
@@ -244,6 +258,11 @@ def _measure_hop_latency(d_model: int = 4096, batch: int = 8, iters: int = 50) -
     }
 
 
+def _write_rows(path: str, rows: list[dict]) -> None:
+    with open(path, "w") as f:
+        json.dump({"rows": rows}, f, indent=1)
+
+
 def run_ladder(args, degraded: str | None) -> list[dict]:
     from distributed_llms_tpu.models.presets import get_preset
 
@@ -270,14 +289,22 @@ def run_ladder(args, degraded: str | None) -> list[dict]:
         print(f"# config {entry['config']} ({entry['preset']}): measuring ({why})",
               file=sys.stderr)
         row = {"config": entry["config"]}
-        row.update(_measure_decode(
-            entry["preset"], entry["batch"], entry["prompt"], entry["new"],
-            dtype, args.iters,
-        ))
-        if degraded is not None:
-            row["degraded"] = degraded
+        try:
+            row.update(_measure_decode(
+                entry["preset"], entry["batch"], entry["prompt"], entry["new"],
+                dtype, args.iters,
+            ))
+            if degraded is not None:
+                row["degraded"] = degraded
+        except Exception as exc:  # one config's OOM must not kill the ladder
+            row.update({
+                "preset": entry["preset"],
+                "skipped": f"{type(exc).__name__}: "
+                           f"{(str(exc).splitlines() or ['?'])[0][:200]}",
+            })
         rows.append(row)
         print(f"#   -> {row}", file=sys.stderr)
+        _write_rows(args.out, rows)  # incremental: a later crash keeps these
     hop = _measure_hop_latency()
     if hop is not None:
         rows.append({"config": "hop-latency", **hop})
@@ -309,8 +336,7 @@ def main() -> None:
 
     if args.ladder:
         rows = run_ladder(args, degraded)
-        with open(args.out, "w") as f:
-            json.dump({"rows": rows}, f, indent=1)
+        _write_rows(args.out, rows)
         print(f"# ladder results -> {args.out}", file=sys.stderr)
         head = next((r for r in rows if "tok_per_s" in r), None)
     else:

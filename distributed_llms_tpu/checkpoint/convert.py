@@ -221,8 +221,76 @@ def convert_llama(sd: StateDict, cfg: ModelConfig) -> dict[str, Any]:
     return params
 
 
+def convert_opt(sd: StateDict, cfg: ModelConfig) -> dict[str, Any]:
+    """OPT (the reference's own default model, run_master.py:17): gpt2-layout
+    blocks with separate nn.Linear q/k/v/out projections ([out, in] ->
+    transpose), ReLU MLP, and a learned position table carrying HF's offset
+    of 2 (OPTLearnedPositionalEmbedding) — kept in the table, applied in
+    models.model.embed.  Covers the pre-LN, unprojected-embedding variants
+    (125m and 1.3b+); 350m's word_embed_proj_dim/post-LN are rejected in
+    config_from_hf."""
+    sd = _strip_prefix(sd, ("model.decoder.", "decoder.", "model."))
+    D, H, HD = cfg.hidden_size, cfg.num_heads, cfg.head_dim_
+    L = cfg.num_layers
+
+    def w_of(w):  # [D, D] stored [out, in] -> [D(in), H, HD]
+        return w.T.reshape(D, H, HD)
+
+    def b_of(b):  # [D] -> [H, HD]
+        return b.reshape(H, HD)
+
+    return {
+        "embed": {
+            "wte": np.asarray(sd["embed_tokens.weight"]),
+            "wpe": np.asarray(sd["embed_positions.weight"]),  # rows 0-1 = offset
+        },
+        "final_norm": {
+            "scale": np.asarray(sd["final_layer_norm.weight"]),
+            "bias": np.asarray(sd["final_layer_norm.bias"]),
+        },
+        "blocks": {
+            "ln1": {
+                "scale": _stack(sd, "layers.{i}.self_attn_layer_norm.weight", L, lambda x: x),
+                "bias": _stack(sd, "layers.{i}.self_attn_layer_norm.bias", L, lambda x: x),
+            },
+            "ln2": {
+                "scale": _stack(sd, "layers.{i}.final_layer_norm.weight", L, lambda x: x),
+                "bias": _stack(sd, "layers.{i}.final_layer_norm.bias", L, lambda x: x),
+            },
+            "attn": {
+                "wq": _stack(sd, "layers.{i}.self_attn.q_proj.weight", L, w_of),
+                "wk": _stack(sd, "layers.{i}.self_attn.k_proj.weight", L, w_of),
+                "wv": _stack(sd, "layers.{i}.self_attn.v_proj.weight", L, w_of),
+                "bq": _stack(sd, "layers.{i}.self_attn.q_proj.bias", L, b_of),
+                "bk": _stack(sd, "layers.{i}.self_attn.k_proj.bias", L, b_of),
+                "bv": _stack(sd, "layers.{i}.self_attn.v_proj.bias", L, b_of),
+                "wo": _stack(sd, "layers.{i}.self_attn.out_proj.weight", L,
+                             lambda w: w.T.reshape(H, HD, D)),
+                "bo": _stack(sd, "layers.{i}.self_attn.out_proj.bias", L, lambda x: x),
+            },
+            "mlp": {
+                "w_in": _stack(sd, "layers.{i}.fc1.weight", L, lambda w: w.T),
+                "b_in": _stack(sd, "layers.{i}.fc1.bias", L, lambda x: x),
+                "w_out": _stack(sd, "layers.{i}.fc2.weight", L, lambda w: w.T),
+                "b_out": _stack(sd, "layers.{i}.fc2.bias", L, lambda x: x),
+            },
+        },
+    } | (
+        {}
+        if cfg.tie_embeddings
+        else {
+            "lm_head": {
+                "w": np.asarray(
+                    sd.get("lm_head.weight", sd["embed_tokens.weight"])
+                ).T
+            }
+        }
+    )
+
+
 CONVERTERS: dict[str, Callable[[StateDict, ModelConfig], dict[str, Any]]] = {
     "gpt2": convert_gpt2,
+    "opt": convert_opt,
     "llama": convert_llama,
 }
 
@@ -238,6 +306,13 @@ def convert_state_dict(sd: StateDict, cfg: ModelConfig, dtype: Any = None) -> di
     import jax
 
     return jax.tree.map(lambda x: jnp.asarray(x, dtype=target), tree)
+
+
+def _opt_activation(name: str) -> str:
+    table = {"relu": "relu", "gelu": "gelu_exact", "gelu_new": "gelu"}
+    if name not in table:
+        raise ValueError(f"unsupported OPT activation_function {name!r}")
+    return table[name]
 
 
 def config_from_hf(hf_config: Mapping[str, Any]) -> ModelConfig:
@@ -256,6 +331,33 @@ def config_from_hf(hf_config: Mapping[str, Any]) -> ModelConfig:
             max_seq_len=hf_config["n_positions"],
             norm_eps=hf_config.get("layer_norm_epsilon", 1e-5),
             tie_embeddings=True,
+        )
+    if model_type == "opt" or "optfor" in arch:
+        hidden = hf_config["hidden_size"]
+        if not hf_config.get("do_layer_norm_before", True):
+            raise ValueError(
+                "OPT variant with do_layer_norm_before=False (350m-style "
+                "post-LN) is not supported"
+            )
+        if hf_config.get("word_embed_proj_dim", hidden) != hidden:
+            raise ValueError(
+                "OPT variant with word_embed_proj_dim != hidden_size "
+                "(350m-style embedding projection) is not supported"
+            )
+        return ModelConfig(
+            family="opt",
+            vocab_size=hf_config["vocab_size"],
+            hidden_size=hidden,
+            intermediate_size=hf_config["ffn_dim"],
+            num_layers=hf_config["num_hidden_layers"],
+            num_heads=hf_config["num_attention_heads"],
+            num_kv_heads=hf_config["num_attention_heads"],
+            max_seq_len=hf_config["max_position_embeddings"],
+            norm_eps=1e-5,  # torch LayerNorm default; OPTConfig has no knob
+            tie_embeddings=hf_config.get("tie_word_embeddings", True),
+            # HF "gelu" is the exact erf form; "gelu_new" the tanh approx.
+            # Anything else is rejected rather than silently approximated.
+            activation=_opt_activation(hf_config.get("activation_function", "relu")),
         )
     if model_type in ("llama", "mixtral") or "llama" in arch or "mixtral" in arch:
         return ModelConfig(

@@ -30,12 +30,15 @@ _ATTN_IMPLS = {"dot", "ring", "flash", "ulysses"}
 class ModelConfig:
     """Architecture hyperparameters for a decoder-only transformer.
 
-    One dataclass covers every supported family (GPT-2, TinyLlama, Llama-2,
-    Llama-3); ``family`` selects the block flavour (LayerNorm+learned-pos vs
-    RMSNorm+RoPE+GQA).
+    One dataclass covers every supported family (GPT-2, OPT, TinyLlama,
+    Llama-2, Llama-3, Mixtral); ``family`` selects the block flavour
+    (LayerNorm+learned-pos vs RMSNorm+RoPE+GQA).  "opt" is the gpt2 layout
+    with separate q/k/v projections folded in conversion, a ReLU MLP, and
+    HF OPT's position-table offset of 2 — the reference's own default model
+    (run_master.py:17, facebook/opt-125m).
     """
 
-    family: str = "gpt2"  # "gpt2" | "llama"
+    family: str = "gpt2"  # "gpt2" | "opt" | "llama"
     vocab_size: int = 50257
     hidden_size: int = 768
     intermediate_size: int = 3072
@@ -48,6 +51,9 @@ class ModelConfig:
     norm_eps: float = 1e-5
     tie_embeddings: bool = True
     dtype: str = "bfloat16"
+    # MLP activation for the gpt2-layout families ("gelu" for GPT-2, "relu"
+    # for OPT); the llama family is SwiGLU regardless.
+    activation: str = "gelu"
     # Attention implementation: "dot" (XLA-fused), "flash" (Pallas fused
     # blockwise kernel, ops/flash.py: prefill and training forwards use it —
     # note the backward recomputes attention densely at O(T^2) memory —

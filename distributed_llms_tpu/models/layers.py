@@ -142,10 +142,19 @@ def out_project(x: jax.Array, p: Params) -> jax.Array:
     return out
 
 
-def mlp_gelu(x: jax.Array, p: Params) -> jax.Array:
-    """GPT-2 MLP: gelu(x W_in + b) W_out + b."""
+def mlp_gelu(x: jax.Array, p: Params, activation: str = "gelu") -> jax.Array:
+    """GPT-2-layout MLP: act(x W_in + b) W_out + b.  ``activation``:
+    "relu" (OPT), "gelu_exact" (erf gelu — HF's "gelu"), anything else the
+    tanh approximation (HF's "gelu_new", GPT-2's convention)."""
     h = jnp.einsum("btd,df->btf", x, p["w_in"]) + p["b_in"]
-    h = jax.nn.gelu(h, approximate=True)
+    if activation == "relu":
+        h = jax.nn.relu(h)
+    elif activation == "gelu_exact":
+        h = jax.nn.gelu(h, approximate=False)
+    elif activation in ("gelu", "gelu_new"):
+        h = jax.nn.gelu(h, approximate=True)
+    else:  # loud, not silently-gelu: wrong activation = wrong logits
+        raise ValueError(f"unsupported MLP activation {activation!r}")
     return jnp.einsum("btf,fd->btd", h, p["w_out"]) + p["b_out"]
 
 

@@ -230,12 +230,14 @@ def _seq_cached_attention(
 
 
 def gpt2_block(x, p, cfg, positions, layer_cache, cache_index, attn_mask=None, std_layout=False):
-    """-> (x, new_cache, aux): aux is the MoE load-balance term (0 here)."""
+    """-> (x, new_cache, aux): aux is the MoE load-balance term (0 here).
+    Shared by the gpt2 and opt families (pre-LN + learned positions);
+    cfg.activation picks the MLP nonlinearity (gelu vs relu)."""
     h = layers.layer_norm(x, p["ln1"]["scale"], p["ln1"]["bias"], cfg.norm_eps)
     attn_out, new_cache = _attention(h, p["attn"], cfg, positions, layer_cache, cache_index, use_rope=False, attn_mask=attn_mask, std_layout=std_layout)
     x = x + attn_out
     h = layers.layer_norm(x, p["ln2"]["scale"], p["ln2"]["bias"], cfg.norm_eps)
-    x = x + layers.mlp_gelu(h, p["mlp"])
+    x = x + layers.mlp_gelu(h, p["mlp"], cfg.activation)
     return x, new_cache, jnp.float32(0.0)
 
 
@@ -252,7 +254,7 @@ def llama_block(x, p, cfg, positions, layer_cache, cache_index, attn_mask=None, 
     return x, new_cache, jnp.float32(0.0)
 
 
-BLOCK_FNS = {"gpt2": gpt2_block, "llama": llama_block}
+BLOCK_FNS = {"gpt2": gpt2_block, "opt": gpt2_block, "llama": llama_block}
 
 
 def run_blocks(
@@ -299,13 +301,16 @@ def run_blocks(
 
 def embed(params: Params, cfg: ModelConfig, tokens: jax.Array, positions: jax.Array) -> jax.Array:
     x = jnp.take(params["embed"]["wte"], tokens, axis=0)
-    if cfg.family == "gpt2":
-        x = x + jnp.take(params["embed"]["wpe"], positions, axis=0)
+    if cfg.family in ("gpt2", "opt"):
+        # OPT's learned position table carries HF's historical offset of 2
+        # (OPTLearnedPositionalEmbedding); the converted table keeps it.
+        off = 2 if cfg.family == "opt" else 0
+        x = x + jnp.take(params["embed"]["wpe"], positions + off, axis=0)
     return x.astype(jnp.dtype(cfg.dtype))
 
 
 def unembed(params: Params, cfg: ModelConfig, x: jax.Array) -> jax.Array:
-    if cfg.family == "gpt2":
+    if cfg.family in ("gpt2", "opt"):
         x = layers.layer_norm(x, params["final_norm"]["scale"], params["final_norm"]["bias"], cfg.norm_eps)
     else:
         x = layers.rms_norm(x, params["final_norm"]["scale"], cfg.norm_eps)
@@ -374,8 +379,9 @@ def init_params(rng: jax.Array, cfg: ModelConfig, dtype: Any = None) -> Params:
         "embed": {"wte": dense(next(keys), (cfg.vocab_size, D), D)},
         "final_norm": {"scale": jnp.ones((D,), dtype)},
     }
-    if cfg.family == "gpt2":
-        params["embed"]["wpe"] = dense(next(keys), (cfg.max_seq_len, D), D)
+    if cfg.family in ("gpt2", "opt"):
+        pos_rows = cfg.max_seq_len + (2 if cfg.family == "opt" else 0)
+        params["embed"]["wpe"] = dense(next(keys), (pos_rows, D), D)
         params["final_norm"]["bias"] = jnp.zeros((D,), dtype)
         params["blocks"] = {
             "ln1": {"scale": jnp.ones((L, D), dtype), "bias": jnp.zeros((L, D), dtype)},

@@ -20,6 +20,12 @@ PRESETS: dict[str, ModelConfig] = {
         num_layers=24, num_heads=16, num_kv_heads=16, max_seq_len=1024,
         norm_eps=1e-5, tie_embeddings=True,
     ),
+    # The reference's default model (run_master.py:17).
+    "opt-125m": ModelConfig(
+        family="opt", vocab_size=50272, hidden_size=768, intermediate_size=3072,
+        num_layers=12, num_heads=12, num_kv_heads=12, max_seq_len=2048,
+        norm_eps=1e-5, tie_embeddings=True, activation="relu",
+    ),
     "tinyllama-1.1b": ModelConfig(
         family="llama", vocab_size=32000, hidden_size=2048, intermediate_size=5632,
         num_layers=22, num_heads=32, num_kv_heads=4, max_seq_len=2048,
@@ -58,6 +64,11 @@ PRESETS: dict[str, ModelConfig] = {
         num_layers=4, num_heads=4, num_kv_heads=4, max_seq_len=128,
         tie_embeddings=True, dtype="float32",
     ),
+    "opt-tiny": ModelConfig(
+        family="opt", vocab_size=256, hidden_size=64, intermediate_size=256,
+        num_layers=4, num_heads=4, num_kv_heads=4, max_seq_len=128,
+        tie_embeddings=True, dtype="float32", activation="relu",
+    ),
     "llama-tiny": ModelConfig(
         family="llama", vocab_size=256, hidden_size=64, intermediate_size=176,
         num_layers=4, num_heads=4, num_kv_heads=2, max_seq_len=128,
@@ -69,6 +80,7 @@ PRESETS: dict[str, ModelConfig] = {
 HF_REPOS: dict[str, str] = {
     "gpt2-125m": "gpt2",
     "gpt2-medium": "gpt2-medium",
+    "opt-125m": "facebook/opt-125m",
     "tinyllama-1.1b": "TinyLlama/TinyLlama-1.1B-Chat-v1.0",
     "llama-2-7b": "meta-llama/Llama-2-7b-hf",
     "llama-2-13b": "meta-llama/Llama-2-13b-hf",

@@ -53,7 +53,7 @@ def param_specs(cfg: ModelConfig, mesh: Mesh) -> Params:
         "wv": P(pipe, None, kv_ax, None),
         "wo": P(pipe, q_ax, None, None),
     }
-    if cfg.family == "gpt2":
+    if cfg.family in ("gpt2", "opt"):
         specs["embed"]["wpe"] = P(None, None)
         specs["final_norm"]["bias"] = P(None)
         attn.update(

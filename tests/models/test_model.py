@@ -11,7 +11,7 @@ from distributed_llms_tpu.models import model, presets
 from distributed_llms_tpu.checkpoint import convert
 
 
-@pytest.mark.parametrize("name", ["gpt2-tiny", "llama-tiny"])
+@pytest.mark.parametrize("name", ["gpt2-tiny", "llama-tiny", "opt-tiny"])
 def test_forward_shapes(name):
     cfg = presets.get_preset(name)
     params = model.init_params(jax.random.key(0), cfg)
@@ -22,7 +22,7 @@ def test_forward_shapes(name):
     assert cache is None
 
 
-@pytest.mark.parametrize("name", ["gpt2-tiny", "llama-tiny"])
+@pytest.mark.parametrize("name", ["gpt2-tiny", "llama-tiny", "opt-tiny"])
 def test_kv_cache_matches_full_forward(name):
     cfg = presets.get_preset(name)
     params = model.init_params(jax.random.key(0), cfg)
@@ -90,7 +90,29 @@ def _hf_llama_pair():
     return hf_model, cfg, params
 
 
-@pytest.mark.parametrize("maker", [_hf_gpt2_pair, _hf_llama_pair], ids=["gpt2", "llama"])
+def _hf_opt_pair():
+    import torch
+    from transformers import OPTConfig, OPTForCausalLM
+
+    hf_cfg = OPTConfig(
+        vocab_size=97, hidden_size=32, ffn_dim=88, num_hidden_layers=3,
+        num_attention_heads=4, max_position_embeddings=64,
+        activation_function="relu", do_layer_norm_before=True,
+        word_embed_proj_dim=32, dropout=0.0, attention_dropout=0.0,
+    )
+    torch.manual_seed(0)
+    hf_model = OPTForCausalLM(hf_cfg).eval()
+    cfg = convert.config_from_hf(hf_cfg.to_dict())
+    cfg = cfg.__class__(**{**cfg.__dict__, "dtype": "float32"})
+    sd = convert.torch_state_dict_to_numpy(hf_model.state_dict())
+    params = convert.convert_state_dict(sd, cfg)
+    return hf_model, cfg, params
+
+
+@pytest.mark.parametrize(
+    "maker", [_hf_gpt2_pair, _hf_llama_pair, _hf_opt_pair],
+    ids=["gpt2", "llama", "opt"],
+)
 def test_golden_parity_vs_transformers(maker):
     import torch
 
