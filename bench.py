@@ -47,7 +47,13 @@ LADDER = [
     {"config": 1, "preset": "gpt2-125m", "batch": 8, "prompt": 64, "new": 64},
     {"config": 2, "preset": "tinyllama-1.1b", "batch": 8, "prompt": 64, "new": 32},
     {"config": 3, "preset": "llama-2-7b", "batch": 4, "prompt": 64, "new": 16},
+    # int8 weight-only variant: block weights resident quantized (dequant
+    # fused per layer), letting 7B fit — and be measured on — one chip.
+    {"config": "3-int8", "preset": "llama-2-7b", "batch": 4, "prompt": 64,
+     "new": 16, "quant": "int8"},
     {"config": 4, "preset": "llama-2-13b", "batch": 2, "prompt": 64, "new": 16},
+    {"config": "4-int8", "preset": "llama-2-13b", "batch": 2, "prompt": 64,
+     "new": 16, "quant": "int8"},
 ]
 
 
@@ -133,26 +139,31 @@ def _mem_budget_bytes() -> int | None:
     return None
 
 
-def _fits(cfg, batch: int, seq: int, dtype: str) -> tuple[bool, str]:
+def _fits(cfg, batch: int, seq: int, dtype: str, quant: str | None = None) -> tuple[bool, str]:
     budget = _mem_budget_bytes()
     if budget is None:
         return True, "unknown memory budget; attempting"
     bytes_per = jnp.dtype(dtype).itemsize
-    weights = _param_count(cfg) * bytes_per
+    # int8/int4 weight-only: ~1 byte (0.5) per block weight + scales, with
+    # embeddings still at full dtype — folded into an average factor.
+    w_bytes = {None: bytes_per, "int8": 1.1, "int4": 0.6}[quant]
+    weights = _param_count(cfg) * w_bytes
     kv = 2 * cfg.num_layers * batch * seq * cfg.num_kv_heads * cfg.head_dim_ * bytes_per
     need = int((weights + kv) * 1.25)  # activations + fragmentation headroom
     if need > budget * 0.92:
         return False, (
             f"needs ~{need / 1e9:.1f} GB ({_param_count(cfg) / 1e9:.2f}B params "
-            f"@ {dtype}), budget {budget / 1e9:.1f} GB"
+            f"@ {quant or dtype}), budget {budget / 1e9:.1f} GB"
         )
     return True, f"~{need / 1e9:.1f} GB of {budget / 1e9:.1f} GB"
 
 
 def _measure_decode(preset: str, batch: int, prompt_len: int, new_tokens: int,
-                    dtype: str, iters: int) -> dict:
+                    dtype: str, iters: int, quant: str | None = None) -> dict:
     """Two-point greedy-decode throughput at true model shapes (random
-    weights — no network in this environment; decode FLOPs are identical)."""
+    weights — no network in this environment; decode FLOPs are identical).
+    ``quant``: int8/int4 weight-only serving (block weights resident
+    quantized; dequant fused per layer)."""
     from distributed_llms_tpu.models import model as model_lib
     from distributed_llms_tpu.models.presets import get_preset
     from distributed_llms_tpu.runtime import generate as gen_lib
@@ -160,7 +171,20 @@ def _measure_decode(preset: str, batch: int, prompt_len: int, new_tokens: int,
     import numpy as np
 
     cfg = get_preset(preset, dtype=dtype)
-    params = model_lib.init_params(jax.random.key(0), cfg)
+    if quant:
+        # Build + quantize on host: full-dtype 7B/13B weights would OOM the
+        # device before quantization could shrink them.  Only the int8/int4
+        # blocks (plus full-dtype embeddings) ever reach HBM.
+        from distributed_llms_tpu.checkpoint import quantize as quant_lib
+
+        bits = {"int8": 8, "int4": 4}[quant]
+        dev = jax.devices()[0]
+        with jax.default_device(jax.devices("cpu")[0]):
+            params = model_lib.init_params(jax.random.key(0), cfg)
+            params["blocks"] = quant_lib.quantize_tree(params["blocks"], bits=bits)
+        params = jax.device_put(params, dev)
+    else:
+        params = model_lib.init_params(jax.random.key(0), cfg)
     prompt = jax.random.randint(
         jax.random.key(1), (batch, prompt_len), 0, cfg.vocab_size, dtype=jnp.int32
     )
@@ -195,6 +219,7 @@ def _measure_decode(preset: str, batch: int, prompt_len: int, new_tokens: int,
     n_chips = jax.device_count()
     out = {
         "preset": preset,
+        **({"quant": quant} if quant else {}),
         "batch": batch,
         "platform": jax.devices()[0].platform,
         "n_chips": n_chips,
@@ -279,7 +304,9 @@ def run_ladder(args, degraded: str | None) -> list[dict]:
             print(f"# config {entry['config']} ({entry['preset']}): SKIP — cpu fallback",
                   file=sys.stderr)
             continue
-        ok, why = _fits(cfg, entry["batch"], entry["prompt"] + 2 * entry["new"], dtype)
+        quant = entry.get("quant")
+        ok, why = _fits(cfg, entry["batch"], entry["prompt"] + 2 * entry["new"],
+                        dtype, quant)
         if not ok:
             rows.append({"config": entry["config"], "preset": entry["preset"],
                          "skipped": why})
@@ -292,7 +319,7 @@ def run_ladder(args, degraded: str | None) -> list[dict]:
         try:
             row.update(_measure_decode(
                 entry["preset"], entry["batch"], entry["prompt"], entry["new"],
-                dtype, args.iters,
+                dtype, args.iters, quant=quant,
             ))
             if degraded is not None:
                 row["degraded"] = degraded

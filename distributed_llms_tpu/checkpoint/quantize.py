@@ -80,18 +80,22 @@ def quantize(x: jax.Array, bits: int = 8, block: int = 128) -> QuantizedTensor:
 
 
 def dequantize(qt: QuantizedTensor, dtype: Any = jnp.float32) -> jax.Array:
+    """Shapes derive from data/scale, NOT orig_shape: a per-layer slice of a
+    stacked [L, ...] QuantizedTensor (what lax.scan hands the decoder-block
+    body when serving quantized weights) carries stale orig_shape metadata
+    but self-consistent data/scale."""
     q = qt.data
     if qt.bits == 4:
         lo = (q << 4).astype(jnp.int8) >> 4  # sign-extend low nibble
         hi = q >> 4  # arithmetic shift sign-extends high nibble
         q = jnp.stack([lo, hi], axis=-1).reshape(*q.shape[:-1], q.shape[-1] * 2)
     qf = q.astype(jnp.float32)
-    n = qt.orig_shape[-1]
+    n = q.shape[-1]
     n_blocks = qt.scale.shape[-1]
     block = n // n_blocks
-    qb = qf.reshape(*qt.orig_shape[:-1], n_blocks, block)
+    qb = qf.reshape(*q.shape[:-1], n_blocks, block)
     out = qb * qt.scale[..., None]
-    return out.reshape(qt.orig_shape).astype(dtype)
+    return out.reshape(q.shape).astype(dtype)
 
 
 def _should_quantize(path: str, x: Any) -> bool:

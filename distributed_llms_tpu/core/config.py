@@ -135,6 +135,10 @@ class RuntimeConfig:
     # and are restored (async device_put) on their next turn.
     kv_host_spill: bool = False
     max_resident_sessions: int = 4
+    # Weight-only quantized serving: keep an int8/int4 store's decoder-block
+    # weights quantized in device memory; the blockwise dequant fuses into
+    # each layer's matmuls (halves/quarters weight HBM + read bandwidth).
+    serve_quantized: bool = False
     remat: bool = False  # jax.checkpoint on decoder blocks
     seed: int = 0
     profile_dir: str | None = None  # capture jax.profiler traces of generate

@@ -271,12 +271,23 @@ def run_blocks(
 ) -> tuple[jax.Array, tuple[jax.Array, jax.Array] | None, jax.Array]:
     """Scan the stacked blocks over x.  Used both for the whole model and for
     a single pipeline stage (blocks then hold only the stage's layer slice).
-    Returns (x, caches, aux) — aux sums the MoE load-balance terms."""
+    Returns (x, caches, aux) — aux sums the MoE load-balance terms.
+
+    Blocks may carry ``QuantizedTensor`` leaves (weight-only quantized
+    serving): weights live in HBM at int8/int4 and each layer's slice is
+    dequantized *inside* the scan body, so XLA fuses the blockwise
+    ``q * scale`` into the consuming matmuls — one layer of transient
+    full-dtype weights at a time, never the whole model."""
     block_fn = BLOCK_FNS[cfg.family]
+
+    def deq(layer_params):
+        from ..checkpoint import quantize as quant_lib
+
+        return quant_lib.dequantize_tree(layer_params, jnp.dtype(cfg.dtype))
 
     if cache_k is None:
         def body(carry, layer_params):
-            y, _, aux = block_fn(carry, layer_params, cfg, positions, None, None, attn_mask, std_layout)
+            y, _, aux = block_fn(carry, deq(layer_params), cfg, positions, None, None, attn_mask, std_layout)
             return y, aux
 
         if remat:
@@ -286,7 +297,7 @@ def run_blocks(
 
     def body(carry, xs):
         layer_params, ck, cv = xs
-        y, new_cache, aux = block_fn(carry, layer_params, cfg, positions, (ck, cv), cache_index, attn_mask, std_layout)
+        y, new_cache, aux = block_fn(carry, deq(layer_params), cfg, positions, (ck, cv), cache_index, attn_mask, std_layout)
         return y, (new_cache, aux)
 
     if remat:

@@ -75,6 +75,26 @@ class ParallelModel:
 
     def shard_params(self, params: Params) -> Params:
         """Stage (if pipelined) and place params onto the mesh."""
+        from ..checkpoint.quantize import QuantizedTensor, dequantize_tree
+
+        if any(
+            isinstance(leaf, QuantizedTensor)
+            for leaf in jax.tree.leaves(
+                params, is_leaf=lambda x: isinstance(x, QuantizedTensor)
+            )
+        ):
+            # Quantized-resident serving is single-device for now: blockwise
+            # scale tensors don't divide evenly over TP shards (e.g. 86
+            # scale blocks over tp=4), so mesh placement rehydrates.  Mesh +
+            # quantized-HBM needs shard-aligned quant blocks (future work).
+            # Rehydrate via host: dequantizing on the (single) loading device
+            # would materialize the full-dtype tree NEXT TO the int8 copy —
+            # an OOM spike for exactly the models quantization exists to fit.
+            cpu = jax.devices("cpu")[0]
+            with jax.default_device(cpu):
+                params = dequantize_tree(
+                    jax.device_put(params, cpu), jnp.dtype(self.cfg.dtype)
+                )
         if self.pipelined:
             params = dict(params)
             params["blocks"] = pipeline_lib.split_stages(params["blocks"], self.num_stages)

@@ -129,7 +129,25 @@ class InferenceEngine:
         if manifest.get("model_config") is None:
             raise ValueError(f"store {store_dir} has no embedded model_config")
         cfg = ModelConfig(**manifest["model_config"])
-        params = store_lib.reconstruct(store_dir, dtype=cfg.dtype)
+        if rt.serve_quantized:
+            # Weight-only quantized serving: decoder-block weights stay
+            # int8/int4 in HBM and dequantize per layer inside the block scan
+            # (models.model.run_blocks).  Embedding/unembedding tables are
+            # rehydrated — gathers can't consume QuantizedTensor leaves.
+            if not manifest.get("quantization"):
+                raise ValueError(
+                    f"serve_quantized=True but store {store_dir} is not "
+                    "quantized; save it with quantization='int8'|'int4'"
+                )
+            from ..checkpoint import quantize as quant_lib
+
+            params = store_lib.load_shards(store_dir, dequantize=False)
+            params = {
+                k: (v if k == "blocks" else quant_lib.dequantize_tree(v, cfg.dtype))
+                for k, v in params.items()
+            }
+        else:
+            params = store_lib.reconstruct(store_dir, dtype=cfg.dtype)
         parallel = None
         if mesh_cfg is not None and mesh_cfg.num_devices > 1:
             from ..parallel.api import make_parallel_model
