@@ -9,8 +9,15 @@ shard boundary — SURVEY §7 hard part 6), implemented as pure jnp ops.
 
 Policy: only matmul weights (ndim >= 2) quantize; norms/biases stay in the
 model dtype.  A quantized tree stores ``QuantizedTensor`` leaves that
-``dequantize_tree`` restores (host side or on-device — XLA fuses the
-dequant multiply into the consumer matmul).
+``dequantize_tree`` restores — or, on TPU, that the fused dequant-matmul
+kernel (ops/quant_matmul.py) consumes directly without ever writing the
+full-precision weights back to HBM.
+
+int4 pack layout: two values per byte along ``pack_axis`` — the weight's
+*reduction* axis (adjacent rows k, k+1 share a byte; low nibble = even row).
+Row-packing (rather than packing along the last axis) is what lets the TPU
+kernel unpack with a sublane interleave, which Mosaic supports for any
+width; scales always run along the LAST axis regardless.
 """
 
 from __future__ import annotations
@@ -27,32 +34,42 @@ import numpy as np
 class QuantizedTensor:
     """Blockwise-quantized array.
 
-    data: int8; for int4, two values packed per byte along the LAST axis
-    (low nibble = even index, high nibble = odd index).
-    scale: float32, shape = data.shape with the last axis divided by blocks.
+    data: int8; for int4, two values packed per byte along ``pack_axis``
+    (low nibble = even index, high nibble = odd index along that axis).
+    scale: float32, shape = unpacked shape with the last axis divided into
+    blocks.
+    pack_axis: negative axis index the int4 pairs run along — negative so a
+    leading stacked-layer axis can be sliced off (lax.scan) without
+    invalidating it.  Unused for int8.
     """
 
     data: jax.Array
     scale: jax.Array
     bits: int
     orig_shape: tuple[int, ...]
+    pack_axis: int = -2
+
+    @property
+    def unpacked_shape(self) -> tuple[int, ...]:
+        """Shape of the dequantized array — derived from data (NOT
+        orig_shape, which goes stale on stacked-layer slices)."""
+        shape = list(self.data.shape)
+        if self.bits == 4:
+            shape[self.pack_axis] *= 2
+        return tuple(shape)
 
 
-# data/scale are pytree children; bits/orig_shape are static metadata.
+# data/scale are pytree children; the rest is static metadata.
 jax.tree_util.register_dataclass(
-    QuantizedTensor, data_fields=["data", "scale"], meta_fields=["bits", "orig_shape"]
+    QuantizedTensor,
+    data_fields=["data", "scale"],
+    meta_fields=["bits", "orig_shape", "pack_axis"],
 )
 
 
-def _block_reshape(x: jnp.ndarray, block: int) -> tuple[jnp.ndarray, int]:
-    """[..., N] -> [..., N//block, block]; requires divisibility."""
-    n = x.shape[-1]
-    if n % block:
-        raise ValueError(f"last axis {n} not divisible by quant block {block}")
-    return x.reshape(*x.shape[:-1], n // block, block), n // block
-
-
-def quantize(x: jax.Array, bits: int = 8, block: int = 128) -> QuantizedTensor:
+def quantize(
+    x: jax.Array, bits: int = 8, block: int = 128, pack_axis: int = -2
+) -> QuantizedTensor:
     if bits not in (8, 4):
         raise ValueError(f"bits must be 8 or 4, got {bits}")
     orig_shape = tuple(x.shape)
@@ -62,7 +79,8 @@ def quantize(x: jax.Array, bits: int = 8, block: int = 128) -> QuantizedTensor:
         import math
 
         block = math.gcd(x.shape[-1], block)
-    xb, _ = _block_reshape(jnp.asarray(x, jnp.float32), block)
+    n = x.shape[-1]
+    xb = jnp.asarray(x, jnp.float32).reshape(*x.shape[:-1], n // block, block)
     qmax = 127.0 if bits == 8 else 7.0
     absmax = jnp.max(jnp.abs(xb), axis=-1, keepdims=True)
     scale = jnp.where(absmax > 0, absmax / qmax, 1.0)
@@ -70,13 +88,24 @@ def quantize(x: jax.Array, bits: int = 8, block: int = 128) -> QuantizedTensor:
     q = q.reshape(orig_shape)
     scale = scale[..., 0]  # [..., n_blocks]
     if bits == 4:
-        # pack pairs along the last axis: [..., N] -> [..., N//2]
-        if orig_shape[-1] % 2:
-            raise ValueError("int4 packing requires even last axis")
-        lo = q[..., 0::2] & 0x0F
-        hi = (q[..., 1::2] & 0x0F) << 4
+        if not -x.ndim <= pack_axis < 0:
+            raise ValueError(f"pack_axis must be negative, got {pack_axis}")
+        a = x.ndim + pack_axis
+        if x.shape[a] % 2:
+            raise ValueError(
+                f"int4 packing requires even size along pack_axis {pack_axis} "
+                f"(shape {orig_shape})"
+            )
+        idx_lo = [slice(None)] * x.ndim
+        idx_hi = [slice(None)] * x.ndim
+        idx_lo[a] = slice(0, None, 2)
+        idx_hi[a] = slice(1, None, 2)
+        lo = q[tuple(idx_lo)] & 0x0F
+        hi = (q[tuple(idx_hi)] & 0x0F) << 4
         q = (lo | hi).astype(jnp.int8)
-    return QuantizedTensor(data=q, scale=scale, bits=bits, orig_shape=orig_shape)
+    return QuantizedTensor(
+        data=q, scale=scale, bits=bits, orig_shape=orig_shape, pack_axis=pack_axis
+    )
 
 
 def dequantize(qt: QuantizedTensor, dtype: Any = jnp.float32) -> jax.Array:
@@ -86,9 +115,12 @@ def dequantize(qt: QuantizedTensor, dtype: Any = jnp.float32) -> jax.Array:
     but self-consistent data/scale."""
     q = qt.data
     if qt.bits == 4:
+        a = q.ndim + qt.pack_axis
         lo = (q << 4).astype(jnp.int8) >> 4  # sign-extend low nibble
         hi = q >> 4  # arithmetic shift sign-extends high nibble
-        q = jnp.stack([lo, hi], axis=-1).reshape(*q.shape[:-1], q.shape[-1] * 2)
+        shape = list(q.shape)
+        shape[a] *= 2
+        q = jnp.stack([lo, hi], axis=a + 1).reshape(shape)
     qf = q.astype(jnp.float32)
     n = q.shape[-1]
     n_blocks = qt.scale.shape[-1]
@@ -98,10 +130,18 @@ def dequantize(qt: QuantizedTensor, dtype: Any = jnp.float32) -> jax.Array:
     return out.reshape(q.shape).astype(dtype)
 
 
+# Weights whose trailing TWO axes are output axes ([D, H, hd]): their
+# reduction axis sits at -3, everything else contracts at -2.
+_PACK_AXIS_BY_NAME = {"wq": -3, "wk": -3, "wv": -3}
+
+
 def _should_quantize(path: str, x: Any) -> bool:
     if not hasattr(x, "ndim") or x.ndim < 2:
         return False
+    leaf = path.split("/")[-1]
     if "norm" in path or "ln" in path.split("/")[-2:][0]:
+        return False
+    if leaf.startswith("b"):  # bias vectors/planes (bq/bk/bv/bo/b_in/b_out)
         return False
     return True
 
@@ -112,7 +152,8 @@ def quantize_tree(params: Any, bits: int = 8, block: int = 128) -> Any:
     def visit(path, x):
         key = "/".join(str(getattr(p, "key", p)) for p in path)
         if _should_quantize(key, x):
-            return quantize(x, bits=bits, block=block)
+            pack_axis = _PACK_AXIS_BY_NAME.get(key.split("/")[-1], -2)
+            return quantize(x, bits=bits, block=block, pack_axis=pack_axis)
         return x
 
     return jax.tree_util.tree_map_with_path(visit, params)

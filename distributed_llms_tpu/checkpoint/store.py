@@ -105,6 +105,7 @@ def save_shards(
                 "shape": list(leaf.orig_shape),
                 "dtype": "quantized",
                 "bits": leaf.bits,
+                "pack_axis": leaf.pack_axis,
             }
         else:
             arr = np.asarray(leaf)
@@ -238,6 +239,7 @@ def load_shards(
                 scale=jnp.asarray(arrays[name + ".scale"]),
                 bits=meta["bits"],
                 orig_shape=tuple(meta["shape"]),
+                pack_axis=meta.get("pack_axis", -2),
             )
             flat[name] = quant_lib.dequantize(qt, dtype or jnp.float32) if dequantize else qt
         elif meta["dtype"] == "bfloat16":

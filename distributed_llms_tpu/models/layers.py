@@ -118,27 +118,52 @@ def causal_mask(q_positions: jax.Array, k_positions: jax.Array, k_valid: jax.Arr
 # Projections (einsum conventions shared by all families)
 # ---------------------------------------------------------------------------
 
+def _is_quantized(w: Any) -> bool:
+    # Duck-typed (bits/scale/data) to keep layers import-light; the leaf type
+    # is checkpoint.quantize.QuantizedTensor.
+    return hasattr(w, "bits") and hasattr(w, "scale") and hasattr(w, "data")
+
+
+def _contract(x: jax.Array, w: Any, eq: str, k_lead: int) -> jax.Array:
+    """einsum for plain weights; fused dequant-matmul (ops/quant_matmul) for
+    QuantizedTensor weights under weight-only quantized serving."""
+    if _is_quantized(w):
+        from ..ops.quant_matmul import quant_contract
+
+        return quant_contract(x, w, k_lead, eq)
+    return jnp.einsum(eq, x, w)
+
+
+def _plain(b: Any) -> jax.Array:
+    """Rehydrate a (rare, legacy-store) quantized bias/vector leaf."""
+    if _is_quantized(b):
+        from ..checkpoint.quantize import dequantize
+
+        return dequantize(b)
+    return b
+
+
 def qkv_project(x: jax.Array, p: Params, cfg: ModelConfig) -> tuple[jax.Array, jax.Array, jax.Array]:
     """x: [B, T, D] -> q [B, T, H, hd], k/v [B, T, KVH, hd].
 
     Weight layout: wq [D, H, hd], wk/wv [D, KVH, hd] — head axis explicit so
     tensor-parallel sharding annotates the head dim directly.
     """
-    q = jnp.einsum("btd,dhk->bthk", x, p["wq"])
-    k = jnp.einsum("btd,dhk->bthk", x, p["wk"])
-    v = jnp.einsum("btd,dhk->bthk", x, p["wv"])
+    q = _contract(x, p["wq"], "btd,dhk->bthk", 1)
+    k = _contract(x, p["wk"], "btd,dhk->bthk", 1)
+    v = _contract(x, p["wv"], "btd,dhk->bthk", 1)
     if "bq" in p:
-        q = q + p["bq"]
-        k = k + p["bk"]
-        v = v + p["bv"]
+        q = q + _plain(p["bq"])
+        k = k + _plain(p["bk"])
+        v = v + _plain(p["bv"])
     return q, k, v
 
 
 def out_project(x: jax.Array, p: Params) -> jax.Array:
     """x: [B, T, H, hd] -> [B, T, D].  wo: [H, hd, D]."""
-    out = jnp.einsum("bthk,hkd->btd", x, p["wo"])
+    out = _contract(x, p["wo"], "bthk,hkd->btd", 2)
     if "bo" in p:
-        out = out + p["bo"]
+        out = out + _plain(p["bo"])
     return out
 
 
@@ -146,7 +171,7 @@ def mlp_gelu(x: jax.Array, p: Params, activation: str = "gelu") -> jax.Array:
     """GPT-2-layout MLP: act(x W_in + b) W_out + b.  ``activation``:
     "relu" (OPT), "gelu_exact" (erf gelu — HF's "gelu"), anything else the
     tanh approximation (HF's "gelu_new", GPT-2's convention)."""
-    h = jnp.einsum("btd,df->btf", x, p["w_in"]) + p["b_in"]
+    h = _contract(x, p["w_in"], "btd,df->btf", 1) + _plain(p["b_in"])
     if activation == "relu":
         h = jax.nn.relu(h)
     elif activation == "gelu_exact":
@@ -155,15 +180,15 @@ def mlp_gelu(x: jax.Array, p: Params, activation: str = "gelu") -> jax.Array:
         h = jax.nn.gelu(h, approximate=True)
     else:  # loud, not silently-gelu: wrong activation = wrong logits
         raise ValueError(f"unsupported MLP activation {activation!r}")
-    return jnp.einsum("btf,fd->btd", h, p["w_out"]) + p["b_out"]
+    return _contract(h, p["w_out"], "btf,fd->btd", 1) + _plain(p["b_out"])
 
 
 def mlp_swiglu(x: jax.Array, p: Params) -> jax.Array:
     """Llama MLP: (silu(x W_gate) * (x W_up)) W_down, no biases."""
-    gate = jnp.einsum("btd,df->btf", x, p["w_gate"])
-    up = jnp.einsum("btd,df->btf", x, p["w_up"])
+    gate = _contract(x, p["w_gate"], "btd,df->btf", 1)
+    up = _contract(x, p["w_up"], "btd,df->btf", 1)
     h = jax.nn.silu(gate) * up
-    return jnp.einsum("btf,fd->btd", h, p["w_down"])
+    return _contract(h, p["w_down"], "btf,fd->btd", 1)
 
 
 def moe_swiglu(
@@ -191,7 +216,15 @@ def moe_swiglu(
       loss, or the router collapses and capacity silently drops most tokens.
 
     p: router [D, E], w_gate/w_up [E, D, F], w_down [E, F, D].
+
+    Quantized-resident expert weights rehydrate here (per layer, inside the
+    scan): the fused kernel targets 2D contractions, not the batched
+    per-expert einsums below.
     """
+    if any(_is_quantized(w) for w in p.values()):
+        from ..checkpoint.quantize import dequantize_tree
+
+        p = dequantize_tree(p, x.dtype)
     b, t, d = x.shape
     e, k = cfg.num_experts, cfg.num_experts_per_token
     s = b * t

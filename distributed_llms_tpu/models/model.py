@@ -274,20 +274,15 @@ def run_blocks(
     Returns (x, caches, aux) — aux sums the MoE load-balance terms.
 
     Blocks may carry ``QuantizedTensor`` leaves (weight-only quantized
-    serving): weights live in HBM at int8/int4 and each layer's slice is
-    dequantized *inside* the scan body, so XLA fuses the blockwise
-    ``q * scale`` into the consuming matmuls — one layer of transient
-    full-dtype weights at a time, never the whole model."""
+    serving): weights live in HBM at int8/int4 and flow through the scan to
+    each matmul site, where layers._contract runs the fused dequant-matmul
+    Pallas kernel (ops/quant_matmul.py) on TPU — the weights are read at
+    their quantized width and never materialized full-dtype in HBM."""
     block_fn = BLOCK_FNS[cfg.family]
-
-    def deq(layer_params):
-        from ..checkpoint import quantize as quant_lib
-
-        return quant_lib.dequantize_tree(layer_params, jnp.dtype(cfg.dtype))
 
     if cache_k is None:
         def body(carry, layer_params):
-            y, _, aux = block_fn(carry, deq(layer_params), cfg, positions, None, None, attn_mask, std_layout)
+            y, _, aux = block_fn(carry, layer_params, cfg, positions, None, None, attn_mask, std_layout)
             return y, aux
 
         if remat:
@@ -297,7 +292,7 @@ def run_blocks(
 
     def body(carry, xs):
         layer_params, ck, cv = xs
-        y, new_cache, aux = block_fn(carry, deq(layer_params), cfg, positions, (ck, cv), cache_index, attn_mask, std_layout)
+        y, new_cache, aux = block_fn(carry, layer_params, cfg, positions, (ck, cv), cache_index, attn_mask, std_layout)
         return y, (new_cache, aux)
 
     if remat:

@@ -29,9 +29,8 @@ def test_int4_pack_unpack_exact():
     vals = rng.integers(-7, 8, size=(8, 32)).astype(np.float32)
     qt = q.quantize(jnp.asarray(vals * 0.5), bits=4, block=32)
     back = np.asarray(q.dequantize(qt))
-    scale = np.asarray(qt.scale)
     assert np.allclose(back / 0.5, vals, atol=1e-5)
-    assert qt.data.shape == (8, 16)  # packed
+    assert qt.data.shape == (4, 32)  # packed pairs along the reduction axis
 
 
 def test_quantize_tree_policy():
