@@ -3,9 +3,11 @@
 Covers the reference's designed-but-unlanded quantization module
 (snippets.md:675-833, plan.md:438-456): its scheme was per-tensor absmax
 int8 (scale = absmax/127) with a 4-bit packed variant.  Here the same absmax
-scheme is *blockwise* along the reduction axis (finer-grained scales lose
-less precision, and blocks align with TP shards so scales never straddle a
-shard boundary — SURVEY §7 hard part 6), implemented as pure jnp ops.
+scheme is *blockwise* along the LAST axis of each weight — for most weights
+that is the reduction axis, but for wq/wk/wv ([D, H, hd]) it is the output
+head dim (finer-grained scales lose less precision, and blocks align with TP
+shards so scales never straddle a shard boundary — SURVEY §7 hard part 6),
+implemented as pure jnp ops.
 
 Policy: only matmul weights (ndim >= 2) quantize; norms/biases stay in the
 model dtype.  A quantized tree stores ``QuantizedTensor`` leaves that
@@ -135,13 +137,18 @@ def dequantize(qt: QuantizedTensor, dtype: Any = jnp.float32) -> jax.Array:
 _PACK_AXIS_BY_NAME = {"wq": -3, "wk": -3, "wv": -3}
 
 
+# Bias leaves by exact name — matched explicitly (not by "b" prefix) so a
+# future weight whose name starts with "b" is not silently left unquantized.
+_BIAS_NAMES = frozenset({"bq", "bk", "bv", "bo", "b_in", "b_out", "b_gate", "b_up", "b_down"})
+
+
 def _should_quantize(path: str, x: Any) -> bool:
     if not hasattr(x, "ndim") or x.ndim < 2:
         return False
     leaf = path.split("/")[-1]
     if "norm" in path or "ln" in path.split("/")[-2:][0]:
         return False
-    if leaf.startswith("b"):  # bias vectors/planes (bq/bk/bv/bo/b_in/b_out)
+    if leaf in _BIAS_NAMES:
         return False
     return True
 

@@ -239,7 +239,11 @@ def load_shards(
                 scale=jnp.asarray(arrays[name + ".scale"]),
                 bits=meta["bits"],
                 orig_shape=tuple(meta["shape"]),
-                pack_axis=meta.get("pack_axis", -2),
+                # Legacy stores (written before pack_axis landed) packed int4
+                # pairs along the LAST axis; missing key must decode as -1,
+                # not the modern default of -2, or unpack runs along the
+                # wrong axis and dequantize fails/corrupts.
+                pack_axis=meta.get("pack_axis", -1),
             )
             flat[name] = quant_lib.dequantize(qt, dtype or jnp.float32) if dequantize else qt
         elif meta["dtype"] == "bfloat16":

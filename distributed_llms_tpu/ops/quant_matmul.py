@@ -143,13 +143,14 @@ def flatten_qt(qt, k_lead: int):
     return q2, s2, n, block
 
 
-def _use_kernel() -> bool:
+def _kernel_mode() -> str:
+    """Resolve DLT_QUANT_MATMUL: "kernel" (compiled Pallas), "interpret"
+    (Pallas interpret mode — the CI leg that runs the kernel's exact program
+    on CPU), "fallback" (dequantize+einsum), or "auto" (kernel iff TPU)."""
     mode = os.environ.get("DLT_QUANT_MATMUL", "auto")
-    if mode == "kernel":
-        return True
-    if mode == "fallback":
-        return False
-    return jax.default_backend() == "tpu"
+    if mode in ("kernel", "interpret", "fallback"):
+        return mode
+    return "kernel" if jax.default_backend() == "tpu" else "fallback"
 
 
 def quant_contract(
@@ -171,7 +172,11 @@ def quant_contract(
         k *= d
     x2 = x.reshape(-1, k)
 
-    if _use_kernel() or interpret:
+    mode = _kernel_mode()
+    if interpret:
+        mode = "interpret"
+    if mode != "fallback":
+        interpret = mode == "interpret"
         q2, s2, n, block = flatten_qt(qt, k_lead)
         bk = _pick(k, _BK_CANDIDATES)
         bn = _pick(n, _BN_CANDIDATES)

@@ -131,9 +131,11 @@ class InferenceEngine:
         cfg = ModelConfig(**manifest["model_config"])
         if rt.serve_quantized:
             # Weight-only quantized serving: decoder-block weights stay
-            # int8/int4 in HBM and dequantize per layer inside the block scan
-            # (models.model.run_blocks).  Embedding/unembedding tables are
-            # rehydrated — gathers can't consume QuantizedTensor leaves.
+            # int8/int4 in HBM; QuantizedTensor leaves flow through the block
+            # scan into layers._contract, which feeds the fused dequant-matmul
+            # Pallas kernel on TPU (ops/quant_matmul.py) or dequantize+einsum
+            # elsewhere.  Embedding/unembedding tables are rehydrated —
+            # gathers can't consume QuantizedTensor leaves.
             if not manifest.get("quantization"):
                 raise ValueError(
                     f"serve_quantized=True but store {store_dir} is not "
