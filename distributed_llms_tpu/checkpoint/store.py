@@ -43,6 +43,23 @@ SEP = "/"
 MANIFEST = "manifest.json"
 ALIGN = 64  # raw storage: tensor offsets aligned for mmap/DMA friendliness
 
+# HF tokenizer files copied into the store so serving decodes with the
+# model's real vocab (the reference tokenized with the HF tokenizer on the
+# master, src/master/node.py:235-245; without this the cluster path fell
+# back to byte-level ids — gibberish against a real checkpoint).
+TOKENIZER_DIR = "tokenizer"
+_TOKENIZER_FILES = (
+    "tokenizer.json",
+    "tokenizer_config.json",
+    "vocab.json",
+    "merges.txt",
+    "special_tokens_map.json",
+    "tokenizer.model",
+    "added_tokens.json",
+    "vocab.txt",
+    "spiece.model",
+)
+
 
 def _flatten(params: Any) -> dict[str, Any]:
     flat = {}
@@ -73,12 +90,36 @@ def save_shards(
     quantization: str | None = None,  # None | "int8" | "int4"
     quant_block: int = 128,
     storage: str = "raw",  # "raw" (native-IO blobs + CRC) | "npz" (v1)
+    tokenizer_src: str | None = None,  # checkpoint dir whose tokenizer files
+    #                                    are copied into the store
 ) -> dict:
     """Write params (optionally quantizing first) into a sharded store.
     Returns the manifest dict."""
     if storage not in ("raw", "npz"):
         raise ValueError(f"unknown storage {storage!r}; raw|npz")
     os.makedirs(out_dir, exist_ok=True)
+    tokenizer_rel: str | None = None
+    if tokenizer_src is not None:
+        import shutil
+
+        found = [
+            f for f in _TOKENIZER_FILES
+            if os.path.isfile(os.path.join(tokenizer_src, f))
+        ]
+        if found:
+            tok_dir = os.path.join(out_dir, TOKENIZER_DIR)
+            os.makedirs(tok_dir, exist_ok=True)
+            for f in found:
+                shutil.copy2(os.path.join(tokenizer_src, f), os.path.join(tok_dir, f))
+            tokenizer_rel = TOKENIZER_DIR
+        else:
+            from ..core.observability import get_logger
+
+            get_logger("store").warning(
+                "tokenizer_src %r contains no recognized tokenizer files; "
+                "store will fall back to byte-level ids at serve time",
+                tokenizer_src,
+            )
     if quantization:
         bits = {"int8": 8, "int4": 4}[quantization]
         params = quant_lib.quantize_tree(params, bits=bits, block=quant_block)
@@ -152,6 +193,7 @@ def save_shards(
         "params": entries,
         "arrays": arrays_meta,
         "model_config": dataclasses.asdict(model_config) if model_config else None,
+        "tokenizer": tokenizer_rel,  # store-relative dir of HF tokenizer files
     }
     with open(os.path.join(out_dir, MANIFEST), "w") as f:
         json.dump(manifest, f, indent=1)

@@ -48,6 +48,7 @@ def init_store(model_id: str, num_shards: int, cfg: Config) -> str:
         params, out_dir, num_shards=num_shards, model_config=model_cfg,
         quantization=cfg.checkpoint.quantization,
         quant_block=cfg.checkpoint.quant_block_size,
+        tokenizer_src=local,  # ship the model's own tokenizer with the store
     )
     print(f"sharded {model_id} -> {out_dir} ({num_shards} shards)")
     return out_dir

@@ -129,6 +129,28 @@ class InferenceEngine:
         if manifest.get("model_config") is None:
             raise ValueError(f"store {store_dir} has no embedded model_config")
         cfg = ModelConfig(**manifest["model_config"])
+        if tokenizer is None:
+            # The store records the model's own tokenizer (save_shards copies
+            # the HF files in — the reference's master-side HF tokenizer,
+            # src/master/node.py:235-245).  Serving a real checkpoint through
+            # byte-level ids produces gibberish; warn loudly if that is about
+            # to happen.
+            import os
+
+            from .tokenizer import ByteTokenizer
+
+            tok_rel = manifest.get("tokenizer")
+            if tok_rel:
+                tokenizer = get_tokenizer(os.path.join(store_dir, tok_rel))
+            if tokenizer is None or isinstance(tokenizer, ByteTokenizer):
+                if cfg.vocab_size > ByteTokenizer.vocab_size:
+                    log.warning(
+                        "store %s has no usable tokenizer (manifest tokenizer=%r) "
+                        "but the model vocab is %d; falling back to byte-level "
+                        "ids — decoded text will be wrong for a real checkpoint. "
+                        "Re-save the store with tokenizer_src=<checkpoint dir>.",
+                        store_dir, tok_rel, cfg.vocab_size,
+                    )
         if rt.serve_quantized:
             # Weight-only quantized serving: decoder-block weights stay
             # int8/int4 in HBM; QuantizedTensor leaves flow through the block
