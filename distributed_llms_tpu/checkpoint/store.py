@@ -108,6 +108,11 @@ def save_shards(
         ]
         if found:
             tok_dir = os.path.join(out_dir, TOKENIZER_DIR)
+            # A reused shard_dir may hold a previous model's tokenizer files;
+            # stale ones (e.g. an old tokenizer.json next to a new
+            # tokenizer.model) would win AutoTokenizer's file preference and
+            # serve the wrong vocab — clear before copying.
+            shutil.rmtree(tok_dir, ignore_errors=True)
             os.makedirs(tok_dir, exist_ok=True)
             for f in found:
                 shutil.copy2(os.path.join(tokenizer_src, f), os.path.join(tok_dir, f))

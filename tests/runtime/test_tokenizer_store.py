@@ -29,7 +29,11 @@ VOCAB = {"<unk>": 0, "<eos>": 1, "hello": 2, "world": 3, "foo": 4, "bar": 5}
 
 def make_hf_tokenizer_dir(path: str) -> str:
     """Write a tiny real-vocab HF tokenizer (WordLevel) to ``path``."""
-    from tokenizers import Tokenizer, models, pre_tokenizers
+    tokenizers = pytest.importorskip("tokenizers")
+    pytest.importorskip("transformers")
+    Tokenizer, models, pre_tokenizers = (
+        tokenizers.Tokenizer, tokenizers.models, tokenizers.pre_tokenizers
+    )
 
     tok = Tokenizer(models.WordLevel(vocab=VOCAB, unk_token="<unk>"))
     tok.pre_tokenizer = pre_tokenizers.Whitespace()
@@ -117,6 +121,7 @@ async def test_cluster_path_decodes_real_words(tmp_path):
     )
     coord = Coordinator(ccfg)
     await coord.start()
+    wt = None
     try:
         w = WorkerHost("127.0.0.1", coord.port, cfg=ccfg, rt=rt)
         wt = asyncio.create_task(w.run())
@@ -136,6 +141,7 @@ async def test_cluster_path_decodes_real_words(tmp_path):
         assert out["text"] == expect.text
         for word in out["text"][0].split():
             assert word in VOCAB
-        wt.cancel()
     finally:
+        if wt is not None:
+            wt.cancel()
         await coord.stop()
