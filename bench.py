@@ -1,20 +1,24 @@
 #!/usr/bin/env python
 """Benchmark harness (driver contract: prints ONE JSON line).
 
-Default mode measures greedy-decode throughput of GPT-2-125M (BASELINE.md
-ladder config 1) on the available accelerator.  The reference publishes no
-numbers (SURVEY §6: README is a title line, no benchmarks/ dir, placeholder
-compute), so ``vs_baseline`` is reported against the driver's north-star
-target of 1000 tok/s aggregate (BASELINE.json).
+Default mode measures the NORTH-STAR metric (BASELINE.json: "tokens/sec/chip
+at 7B"): greedy-decode throughput of Llama-2-7B served int8 weight-only on
+the available accelerator.  When no accelerator is reachable it degrades to
+GPT-2-125M on CPU (marked ``degraded`` in the JSON).  The reference publishes
+no numbers (SURVEY §6: README is a title line, no benchmarks/ dir,
+placeholder compute), so ``vs_baseline`` is reported against the driver's
+north-star target of 1000 tok/s aggregate.
 
 ``--ladder`` additionally measures the BASELINE.md ladder configs that fit
-the local device (tokens/sec/chip + 2N-approx MFU per config, plus the
+the local device (tokens/sec/chip, 2N-approx MFU, achieved weight-stream
+bytes/s and HBM utilization — decode is weight-bandwidth-bound, so that is
+the honest lens — plus a flash-vs-dot prefill microbenchmark and the
 pipeline-hop ppermute latency microbenchmark when >1 device is visible) and
 writes the rows to ``--out`` (default BENCH_LADDER.json).  The final stdout
-line stays the single config-1 JSON object either way.
+line stays the single north-star JSON object either way.
 
-Usage: python bench.py [--preset gpt2-125m] [--batch 8] [--prompt-len 64]
-       [--new-tokens 64] [--dtype bfloat16] [--ladder] [--out FILE]
+Usage: python bench.py [--preset llama-2-7b] [--batch 4] [--prompt-len 64]
+       [--new-tokens 16] [--dtype bfloat16] [--ladder] [--out FILE]
 """
 
 from __future__ import annotations
@@ -41,20 +45,50 @@ PEAK_FLOPS = {
     "v6e": 918e12,
 }
 
+# Peak HBM bandwidth per chip (public specs) — decode is weight-bandwidth
+# bound, so achieved-bytes/s over this peak is the honest utilization lens
+# (VERDICT r2: MFU is the wrong metric for decode).
+PEAK_HBM_BW = {
+    "v5 lite": 819e9,  # TPU v5e
+    "v5e": 819e9,
+    "v4": 1228e9,
+    "v5p": 2765e9,
+    "v6 lite": 1640e9,  # Trillium
+    "v6e": 1640e9,
+}
+
 # BASELINE.md ladder (config 5, multi-host 70B, needs hardware this harness
 # will never see single-chip; it is covered by the dryrun/multi-host tests).
 LADDER = [
     {"config": 1, "preset": "gpt2-125m", "batch": 8, "prompt": 64, "new": 64},
+    # Batch-scaling rows: decode reads the same weight bytes per step
+    # regardless of batch, so larger batches raise aggregate tok/s toward the
+    # same weight-stream ceiling — the lever VERDICT r2 asked the ladder to
+    # demonstrate for configs 1-2.
+    {"config": "1-b32", "preset": "gpt2-125m", "batch": 32, "prompt": 64, "new": 64},
     {"config": 2, "preset": "tinyllama-1.1b", "batch": 8, "prompt": 64, "new": 32},
+    {"config": "2-b32", "preset": "tinyllama-1.1b", "batch": 32, "prompt": 64,
+     "new": 32},
     {"config": 3, "preset": "llama-2-7b", "batch": 4, "prompt": 64, "new": 16},
-    # int8 weight-only variant: block weights resident quantized (dequant
-    # fused per layer), letting 7B fit — and be measured on — one chip.
+    # int8/int4 weight-only variants: block weights resident quantized and
+    # consumed by the fused dequant-matmul kernel, letting 7B (int8) and even
+    # 13B (int4, ~7.8 GB weights) fit — and be measured on — one 16 GB chip.
     {"config": "3-int8", "preset": "llama-2-7b", "batch": 4, "prompt": 64,
      "new": 16, "quant": "int8"},
+    {"config": "3-int4", "preset": "llama-2-7b", "batch": 4, "prompt": 64,
+     "new": 16, "quant": "int4"},
     {"config": 4, "preset": "llama-2-13b", "batch": 2, "prompt": 64, "new": 16},
     {"config": "4-int8", "preset": "llama-2-13b", "batch": 2, "prompt": 64,
      "new": 16, "quant": "int8"},
+    {"config": "4-int4", "preset": "llama-2-13b", "batch": 2, "prompt": 64,
+     "new": 16, "quant": "int4"},
 ]
+
+# Default (no --ladder): the north-star config, with a degraded fallback.
+NORTH_STAR = {"preset": "llama-2-7b", "batch": 4, "prompt": 64, "new": 16,
+              "quant": "int8"}
+FALLBACK = {"preset": "gpt2-125m", "batch": 8, "prompt": 64, "new": 64,
+            "quant": None}
 
 
 def _probe_accelerator(timeout_s: float) -> str | None:
@@ -86,7 +120,7 @@ def _init_backend(probe_timeout: float, attempts: int) -> str | None:
             # No accelerator configured at all: still a CPU measurement.
             return "no accelerator present; measured on cpu"
         if i + 1 < attempts:
-            time.sleep(5.0 * (i + 1))
+            time.sleep(10.0 * (i + 1))
     # Persistent failure: pin the CPU backend before any jax backend use in
     # this process (the axon plugin ignores the JAX_PLATFORMS env var, so this
     # must go through jax.config).
@@ -216,6 +250,9 @@ def _measure_decode(preset: str, batch: int, prompt_len: int, new_tokens: int,
     else:
         tps = batch * (n2 - n1) / (t2 - t1)
 
+    from distributed_llms_tpu.checkpoint.quantize import tree_bytes
+
+    weight_bytes = tree_bytes(params)  # actual resident bytes (quant-aware)
     n_chips = jax.device_count()
     out = {
         "preset": preset,
@@ -226,10 +263,23 @@ def _measure_decode(preset: str, batch: int, prompt_len: int, new_tokens: int,
         "tok_per_s": round(tps, 2),
         "tok_per_s_per_chip": round(tps / n_chips, 2),
         "params_b": round(_param_count(get_preset(preset)) / 1e9, 3),
+        "weight_gb": round(weight_bytes / 1e9, 3),
     }
     mfu = _mfu(tps / n_chips, _param_count(get_preset(preset)))
     if mfu is not None:
         out["mfu_2N"] = mfu
+    # Weight-stream bandwidth: every decode step reads all resident weights
+    # once, so achieved bytes/s = weight_bytes * steps/s.  Utilization over
+    # the chip's peak HBM bandwidth is the decode-honest metric (KV reads
+    # add a little more traffic; this is a lower bound on achieved BW).
+    steps_per_s = tps / batch
+    bw = weight_bytes * steps_per_s
+    out["weight_stream_gb_per_s"] = round(bw / 1e9, 2)
+    kind = getattr(jax.devices()[0], "device_kind", "").lower()
+    for key, peak in PEAK_HBM_BW.items():
+        if key in kind:
+            out["hbm_util"] = round(bw / peak, 4)
+            break
     return out
 
 
@@ -240,6 +290,56 @@ def _mfu(tps_per_chip: float, n_params: int) -> float | None:
         if key in kind:
             return round(tps_per_chip * 2.0 * n_params / peak, 5)
     return None
+
+
+def _measure_prefill_flash(
+    preset: str = "tinyllama-1.1b", batch: int = 2, seq: int = 2048,
+    dtype: str = "bfloat16", iters: int = 5,
+) -> dict:
+    """Prefill (full-forward) throughput, dot vs Pallas flash attention, on
+    the real device — puts ops/flash.py on the record (it otherwise runs only
+    in CPU interpret mode in tests) and checks numerics on-device once.
+    VERDICT r2 weak item 4 / round-1 weak item 7."""
+    import dataclasses
+
+    import numpy as np
+
+    from distributed_llms_tpu.models import model as model_lib
+    from distributed_llms_tpu.models.presets import get_preset
+
+    cfg_dot = get_preset(preset, dtype=dtype)
+    cfg_dot = dataclasses.replace(cfg_dot, attn_impl="dot")
+    cfg_flash = dataclasses.replace(cfg_dot, attn_impl="flash")
+    params = model_lib.init_params(jax.random.key(0), cfg_dot)
+    tokens = jax.random.randint(
+        jax.random.key(1), (batch, seq), 0, cfg_dot.vocab_size, dtype=jnp.int32
+    )
+
+    def timed(cfg) -> tuple[float, jax.Array]:
+        fwd = jax.jit(lambda p, t: model_lib.forward(p, cfg, t)[0])
+        out = np.asarray(fwd(params, tokens))  # compile + numerics capture
+        times = []
+        for _ in range(iters):
+            t0 = time.perf_counter()
+            np.asarray(fwd(params, tokens))
+            times.append(time.perf_counter() - t0)
+        return min(times), out
+
+    t_dot, out_dot = timed(cfg_dot)
+    t_flash, out_flash = timed(cfg_flash)
+    # Last-position logits are what generation consumes; bf16 tolerance.
+    err = float(
+        jnp.max(jnp.abs(out_flash[:, -1].astype(jnp.float32)
+                        - out_dot[:, -1].astype(jnp.float32)))
+    )
+    return {
+        "preset": preset, "batch": batch, "seq": seq,
+        "platform": jax.devices()[0].platform,
+        "prefill_tok_per_s_dot": round(batch * seq / t_dot, 1),
+        "prefill_tok_per_s_flash": round(batch * seq / t_flash, 1),
+        "flash_speedup": round(t_dot / t_flash, 3),
+        "max_logit_err_vs_dot": round(err, 4),
+    }
 
 
 def _measure_hop_latency(d_model: int = 4096, batch: int = 8, iters: int = 50) -> dict | None:
@@ -332,23 +432,47 @@ def run_ladder(args, degraded: str | None) -> list[dict]:
         rows.append(row)
         print(f"#   -> {row}", file=sys.stderr)
         _write_rows(args.out, rows)  # incremental: a later crash keeps these
+    if not on_cpu:
+        # Flash-attention prefill microbenchmark (real kernels only — CPU
+        # interpret mode would measure the emulator, not the kernel).
+        row = {"config": "prefill-flash"}
+        try:
+            row.update(_measure_prefill_flash(dtype=dtype, iters=args.iters))
+        except Exception as exc:
+            row["skipped"] = (
+                f"{type(exc).__name__}: {(str(exc).splitlines() or ['?'])[0][:200]}"
+            )
+        rows.append(row)
+        print(f"# prefill flash: {row}", file=sys.stderr)
+        _write_rows(args.out, rows)
     hop = _measure_hop_latency()
     if hop is not None:
         rows.append({"config": "hop-latency", **hop})
         print(f"# hop latency: {hop}", file=sys.stderr)
+    else:
+        # SURVEY §6 metric is unmeasurable on one chip — record that
+        # explicitly rather than omitting the row (VERDICT r2 weak item 5).
+        rows.append({
+            "config": "hop-latency",
+            "skipped": "needs >1 device; single-chip bench env — CPU "
+                       "fake-mesh upper bound is in BASELINE.md",
+        })
     return rows
 
 
 def main() -> None:
     ap = argparse.ArgumentParser()
-    ap.add_argument("--preset", default="gpt2-125m")
-    ap.add_argument("--batch", type=int, default=8)
-    ap.add_argument("--prompt-len", type=int, default=64)
-    ap.add_argument("--new-tokens", type=int, default=64)
+    ap.add_argument("--preset", default=None,
+                    help="override the measured preset (default: north-star "
+                         "llama-2-7b int8 on an accelerator, gpt2-125m on cpu)")
+    ap.add_argument("--batch", type=int, default=None)
+    ap.add_argument("--prompt-len", type=int, default=None)
+    ap.add_argument("--new-tokens", type=int, default=None)
+    ap.add_argument("--quant", default=None, choices=["int8", "int4"])
     ap.add_argument("--dtype", default="bfloat16")
     ap.add_argument("--iters", type=int, default=3)
-    ap.add_argument("--probe-timeout", type=float, default=120.0)
-    ap.add_argument("--probe-attempts", type=int, default=2)
+    ap.add_argument("--probe-timeout", type=float, default=150.0)
+    ap.add_argument("--probe-attempts", type=int, default=4)
     ap.add_argument("--ladder", action="store_true",
                     help="measure all BASELINE ladder configs that fit")
     ap.add_argument("--out", default="BENCH_LADDER.json",
@@ -365,12 +489,46 @@ def main() -> None:
         rows = run_ladder(args, degraded)
         _write_rows(args.out, rows)
         print(f"# ladder results -> {args.out}", file=sys.stderr)
-        head = next((r for r in rows if "tok_per_s" in r), None)
-    else:
-        head = _measure_decode(
-            args.preset, args.batch, args.prompt_len, args.new_tokens,
-            args.dtype, args.iters,
+        # Headline = the north-star config if it was measured, else the
+        # first measured row.
+        head = next(
+            (r for r in rows if r.get("config") == "3-int8" and "tok_per_s" in r),
+            next((r for r in rows if "tok_per_s" in r), None),
         )
+    else:
+        # Default: the north-star metric (7B int8) on an accelerator; on the
+        # CPU fallback a 7B decode is minutes/token, so degrade to GPT-2.
+        # An explicit --preset measures exactly what was asked (plain bf16
+        # unless --quant is also given) and never silently degrades.
+        explicit = args.preset is not None
+        if explicit:
+            base = {"preset": args.preset, "batch": args.batch or 8,
+                    "prompt": args.prompt_len or 64, "new": args.new_tokens or 64,
+                    "quant": args.quant}
+        else:
+            base = dict(FALLBACK if degraded is not None else NORTH_STAR)
+            base["batch"] = args.batch or base["batch"]
+            base["prompt"] = args.prompt_len or base["prompt"]
+            base["new"] = args.new_tokens or base["new"]
+            base["quant"] = args.quant or base["quant"]
+        try:
+            head = _measure_decode(
+                base["preset"], base["batch"], base["prompt"], base["new"],
+                args.dtype, args.iters, quant=base.get("quant"),
+            )
+        except Exception as exc:
+            if explicit or degraded is not None:
+                raise  # measure what was asked or fail loudly
+            # North-star config failed on the accelerator (e.g. OOM on an
+            # unexpected chip): degrade to the fallback config, marked.
+            degraded = (
+                f"north-star {base['preset']} failed "
+                f"({type(exc).__name__}); measured fallback"
+            )
+            head = _measure_decode(
+                FALLBACK["preset"], FALLBACK["batch"], FALLBACK["prompt"],
+                FALLBACK["new"], args.dtype, args.iters,
+            )
 
     if head is None:  # every ladder config skipped
         result = {
@@ -378,15 +536,17 @@ def main() -> None:
             "vs_baseline": 0.0, "degraded": "all ladder configs skipped",
         }
     else:
+        desc = head["preset"] + (f" {head['quant']}" if head.get("quant") else "")
         result = {
-            "metric": f"decode tokens/sec ({head['preset']}, batch={head['batch']}, "
+            "metric": f"decode tokens/sec ({desc}, batch={head['batch']}, "
             f"{head['platform']}x{head['n_chips']})",
             "value": head["tok_per_s"],
             "unit": "tok/s",
             "vs_baseline": round(head["tok_per_s"] / NORTH_STAR_TOKS_PER_S, 4),
         }
-        if "mfu_2N" in head:
-            result["mfu_2N"] = head["mfu_2N"]
+        for extra in ("mfu_2N", "hbm_util", "weight_stream_gb_per_s"):
+            if extra in head:
+                result[extra] = head[extra]
         if degraded is not None:
             result["degraded"] = degraded
     print(json.dumps(result))
