@@ -470,6 +470,58 @@ class Coordinator:
             timeout=timeout,
         )
 
+    async def generate_spmd(
+        self, prompts: list[str], max_new_tokens: int | None = None,
+        timeout: float | None = None,
+    ) -> Any:
+        """Generate over a mesh that SPANS the worker processes (BASELINE
+        config 5, multi-host).  SPMD semantics: every process participating
+        in the global mesh must run the same jitted computation in lockstep,
+        so the task is dispatched to ALL registered workers concurrently (a
+        single-worker dispatch would deadlock inside the first collective).
+        Each process computes — and returns — the identical full batch; the
+        replies are consistency-checked and one is returned.
+
+        Contrast with the reference's fan-out (src/master/node.py:256-269),
+        where every worker also received the task, but each computed an
+        unrelated partial on its own shard and no cross-worker reduction
+        existed (defect D9 returned the first partial).
+        """
+        wids = list(self.workers)
+        if not wids:
+            raise RuntimeError("no workers registered")
+        # Pre-flight: a worker without a placed engine would reply ERROR
+        # instantly while its peers block inside the first collective waiting
+        # for it — wedging the pool.  Fail fast instead.
+        unplaced = [w for w in wids if not self.workers[w].shards]
+        if unplaced:
+            raise RuntimeError(
+                f"SPMD generate needs every worker placed; missing engine on "
+                f"{unplaced} (run place_shards first)"
+            )
+        results = await asyncio.gather(
+            *(
+                self.submit(
+                    "GENERATE",
+                    {"prompts": prompts, "max_new_tokens": max_new_tokens},
+                    worker_id=w, timeout=timeout,
+                )
+                for w in wids
+            ),
+            return_exceptions=True,
+        )
+        errors = {
+            w: r for w, r in zip(wids, results) if isinstance(r, BaseException)
+        }
+        if errors:
+            raise RuntimeError(f"SPMD generate failed on {errors}")
+        texts = {tuple(r["text"]) for r in results}
+        if len(texts) != 1:
+            raise RuntimeError(
+                f"SPMD generate disagreement across {len(wids)} workers: {texts}"
+            )
+        return results[0]
+
     async def _dispatch_loop(self) -> None:
         while True:
             task = await self.task_queue.get()

@@ -30,6 +30,19 @@ from .tokenizer import get_tokenizer, pad_batch
 log = get_logger("engine")
 
 
+def _to_host(out) -> np.ndarray:
+    """Device->host for generation outputs.  On a mesh spanning multiple
+    processes (BASELINE config 5) the output array is not fully addressable
+    from any one process; allgather the tiles first (every process then
+    holds — and returns — the same full batch)."""
+    out = jax.block_until_ready(out)
+    if not getattr(out, "is_fully_addressable", True):
+        from jax.experimental import multihost_utils
+
+        out = multihost_utils.process_allgather(out, tiled=True)
+    return np.asarray(out)
+
+
 @dataclass
 class GenerationResult:
     text: list[str]
@@ -217,7 +230,7 @@ class InferenceEngine:
                 forward_fn=self._forward_fn, make_cache=self._make_cache,
                 decode_fn=self._decode_fn,
             )
-            out = np.asarray(jax.block_until_ready(out))[:n_real]
+            out = _to_host(out)[:n_real]
         dt = time.perf_counter() - t0
         profiling.record_memory_stats()
 
@@ -284,7 +297,7 @@ class InferenceEngine:
                 top_p=self.rt.top_p, eos_id=tok.eos_id, pad_id=tok.pad_id,
                 forward_fn=self._forward_fn,
             )
-            out = np.asarray(jax.block_until_ready(toks))[: sess.n_real]
+            out = _to_host(toks)[: sess.n_real]
         dt = time.perf_counter() - t0
         sess.cache, sess.valid_mask, sess.real_lens = cache, valid, real
         sess.base += t + n_new

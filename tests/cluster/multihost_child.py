@@ -1,9 +1,15 @@
-"""Child process for the multi-host smoke test (run via subprocess, not
+"""Child process for the multi-host tests (run via subprocess, not
 collected by pytest): joins a 2-process jax.distributed runtime on CPU,
 runs a cross-process psum over the global mesh, and registers with the
 control-plane coordinator as a worker host.
 
-Usage: python multihost_child.py <process_id> <jax_port> <coord_port>
+With a 4th argument (a shard-store dir) the child instead enters SERVE
+mode: it registers a WorkerHost whose engine spans the GLOBAL 4-device
+mesh (data=2 over the two processes x model=2 local) and serves GENERATE
+commands until the coordinator sends SHUTDOWN — the multi-host serving
+round-trip (BASELINE config 5).
+
+Usage: python multihost_child.py <process_id> <jax_port> <coord_port> [store_dir]
 """
 
 import asyncio
@@ -27,6 +33,25 @@ from distributed_llms_tpu.cluster.worker import WorkerHost
 from distributed_llms_tpu.core.config import ClusterConfig
 
 
+def serve(cfg: ClusterConfig, coord_port: int) -> None:
+    """SERVE mode: worker over the global (cross-process) mesh; the engine's
+    collectives span both OS processes, so the coordinator must dispatch
+    GENERATE to all workers at once (Coordinator.generate_spmd).  The shard
+    store reaches the worker via the coordinator's PLACE_SHARDS payload."""
+    from distributed_llms_tpu.core.config import MeshConfig, RuntimeConfig
+
+    rt = RuntimeConfig(max_decode_steps=8)
+    mesh_cfg = MeshConfig(data=2, model=2)  # data crosses the process boundary
+
+    async def run() -> None:
+        w = WorkerHost("127.0.0.1", coord_port, cfg=cfg, rt=rt, mesh_cfg=mesh_cfg)
+        await w.run()  # returns after the coordinator's SHUTDOWN
+
+    asyncio.run(run())
+    print("CHILD_OK serve", flush=True)
+    jax.distributed.shutdown()
+
+
 def main() -> None:
     process_id, jax_port, coord_port = (int(a) for a in sys.argv[1:4])
     cfg = ClusterConfig(
@@ -34,8 +59,12 @@ def main() -> None:
         num_processes=2,
         process_id=process_id,
         heartbeat_interval_s=0.2,
+        heartbeat_timeout_s=120.0,
     )
     initialize_distributed(cfg)
+    if len(sys.argv) > 4:
+        serve(cfg, coord_port)
+        return
     assert jax.process_count() == 2, jax.process_count()
     assert jax.device_count() == 4, jax.device_count()
     assert jax.local_device_count() == 2
