@@ -271,10 +271,11 @@ def _measure_decode(preset: str, batch: int, prompt_len: int, new_tokens: int,
     # Weight-stream bandwidth: every decode step reads all resident weights
     # once, so achieved bytes/s = weight_bytes * steps/s.  Utilization over
     # peak HBM bandwidth is the decode-honest metric (KV reads add a little
-    # more traffic; this is a lower bound on achieved BW).  Per-chip, like
-    # _mfu: on an n-chip host each chip holds/streams 1/n of the weights.
+    # more traffic; this is a lower bound on achieved BW).  This measurement
+    # path runs the whole forward on ONE device (no mesh/forward_fn), so all
+    # weight bytes stream from that chip — no per-chip division.
     steps_per_s = tps / batch
-    bw = weight_bytes * steps_per_s / n_chips
+    bw = weight_bytes * steps_per_s
     out["weight_stream_gb_per_s"] = round(bw / 1e9, 2)
     kind = getattr(jax.devices()[0], "device_kind", "").lower()
     for key, peak in PEAK_HBM_BW.items():

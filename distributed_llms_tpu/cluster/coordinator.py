@@ -464,7 +464,15 @@ class Coordinator:
     async def generate(self, prompts: list[str], max_new_tokens: int | None = None,
                        timeout: float | None = None) -> Any:
         """The run_inference parity point: returns decoded text (not a raw
-        partial, D9)."""
+        partial, D9).  If the registered workers are controllers of one
+        multi-process SPMD runtime, a single-worker dispatch would hang
+        inside the first cross-process collective — route to generate_spmd.
+        """
+        if any(
+            w.capabilities.get("process_count", 1) > 1
+            for w in self.workers.values()
+        ):
+            return await self.generate_spmd(prompts, max_new_tokens, timeout)
         return await self.submit(
             "GENERATE", {"prompts": prompts, "max_new_tokens": max_new_tokens},
             timeout=timeout,

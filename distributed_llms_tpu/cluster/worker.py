@@ -36,6 +36,10 @@ def device_capabilities() -> dict:
         "platform": devs[0].platform,
         "num_devices": len(devs),
         "device_kind": getattr(devs[0], "device_kind", "unknown"),
+        # >1 means this worker is one controller of a multi-process SPMD
+        # runtime: GENERATE must then be dispatched to ALL workers at once
+        # (Coordinator.generate routes to generate_spmd on this signal).
+        "process_count": jax.process_count(),
     }
 
 

@@ -22,6 +22,8 @@ runs full-dtype weights).
 
 from __future__ import annotations
 
+import contextlib
+import contextvars
 import functools
 import os
 
@@ -29,6 +31,22 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
+
+# Trace-time guard: pallas_call has no SPMD partitioning rule, so under a
+# GSPMD-partitioned jit (tensor-parallel serving) the kernel would force XLA
+# to all-gather the full weight — defeating quantized residency.  The
+# dequantize+einsum path partitions cleanly; ParallelModel wraps its GSPMD
+# forward in spmd_fallback().
+_SPMD_FALLBACK = contextvars.ContextVar("dlt_quant_spmd_fallback", default=False)
+
+
+@contextlib.contextmanager
+def spmd_fallback():
+    token = _SPMD_FALLBACK.set(True)
+    try:
+        yield
+    finally:
+        _SPMD_FALLBACK.reset(token)
 
 # Candidate tile sizes, largest first; a dimension uses the first candidate
 # that divides it (grids must tile exactly — no masking on the K/N axes).
@@ -173,7 +191,9 @@ def quant_contract(
     x2 = x.reshape(-1, k)
 
     mode = _kernel_mode()
-    if interpret:
+    if _SPMD_FALLBACK.get():
+        mode = "fallback"
+    if interpret:  # explicit test request wins even inside spmd_fallback
         mode = "interpret"
     if mode != "fallback":
         interpret = mode == "interpret"

@@ -50,6 +50,100 @@ def staged_param_specs(cfg: ModelConfig, mesh: Mesh) -> Params:
     return out
 
 
+def _axis_sz(mesh: Mesh, name) -> int:
+    """Size of a PartitionSpec entry (a name or tuple of names) on a mesh."""
+    if name is None:
+        return 1
+    names = name if isinstance(name, tuple) else (name,)
+    sz = 1
+    for n in names:
+        sz *= mesh.shape.get(n, 1)
+    return sz
+
+
+def _place_quantized(leaf, spec: P, mesh: Mesh, path: str):
+    """Shard a QuantizedTensor under the plain weight's PartitionSpec.
+
+    data shards exactly like the weight (for int4 the pack axis holds
+    adjacent-row pairs, so a contiguous shard of packed rows unpacks to the
+    same contiguous rows — exact).  scale has the weight's shape with the
+    last axis in block units; when the spec shards that last axis, scales
+    are refined (each block's scale repeated k times = block size / k —
+    numerically identical) until shard boundaries land on block boundaries.
+    Un-shardable layouts replicate the leaf, loudly.
+    """
+    from ..checkpoint.quantize import QuantizedTensor
+    from ..core.observability import get_logger
+
+    data, scale = leaf.data, leaf.scale
+    s = tuple(spec)
+    s = s + (None,) * (data.ndim - len(s))  # pad to rank; trailing = replicated
+
+    def replicate(reason: str):
+        get_logger("parallel").warning(
+            "quantized leaf %s cannot shard under %s (%s); replicating",
+            path, spec, reason,
+        )
+        rep = NamedSharding(mesh, P())
+        return QuantizedTensor(
+            data=jax.device_put(data, rep), scale=jax.device_put(scale, rep),
+            bits=leaf.bits, orig_shape=leaf.orig_shape, pack_axis=leaf.pack_axis,
+        )
+
+    pack_ax = data.ndim + leaf.pack_axis if leaf.bits == 4 else None
+    # Divisibility of every sharded data axis (jax would raise; we want the
+    # replicate fallback instead).
+    for ax, name in enumerate(s):
+        if _axis_sz(mesh, name) > 1 and data.shape[ax] % _axis_sz(mesh, name):
+            return replicate(f"data axis {ax} ({data.shape[ax]}) % shards")
+    last = data.ndim - 1
+    tp_last = _axis_sz(mesh, s[last])
+    if tp_last > 1 and pack_ax == last:
+        return replicate("spec shards the int4 pack axis at the last dim")
+    if tp_last > 1:
+        dim = data.shape[last]  # last axis is never int4-packed here
+        n_blocks = scale.shape[-1]
+        block = dim // n_blocks
+        per_shard = dim // tp_last
+        if per_shard % block:
+            # Refine: new block g divides both the old block and the shard
+            # width, so each shard holds whole (finer) blocks.
+            import math
+
+            g = math.gcd(block, per_shard)
+            scale = jnp.repeat(scale, block // g, axis=-1)
+    # scale has data's rank (last axis in block units; the int4 pack axis is
+    # 2x data's, divisible whenever data's is) — the same spec applies.
+    return QuantizedTensor(
+        data=jax.device_put(data, NamedSharding(mesh, P(*s))),
+        scale=jax.device_put(scale, NamedSharding(mesh, P(*s))),
+        bits=leaf.bits, orig_shape=leaf.orig_shape, pack_axis=leaf.pack_axis,
+    )
+
+
+def _place_tree(params: Params, specs: Params, mesh: Mesh) -> Params:
+    """device_put a param tree onto the mesh, keeping QuantizedTensor leaves
+    quantized-resident (sharded data+scale) instead of rehydrating."""
+    from ..checkpoint.quantize import QuantizedTensor
+
+    is_q = lambda x: isinstance(x, QuantizedTensor)  # noqa: E731
+    spec_by_path = {
+        jax.tree_util.keystr(kp): s
+        for kp, s in jax.tree_util.tree_flatten_with_path(
+            specs, is_leaf=lambda x: isinstance(x, P)
+        )[0]
+    }
+
+    def place(kp, leaf):
+        path = jax.tree_util.keystr(kp)
+        spec = spec_by_path[path]
+        if is_q(leaf):
+            return _place_quantized(leaf, spec, mesh, path)
+        return jax.device_put(leaf, NamedSharding(mesh, spec))
+
+    return jax.tree_util.tree_map_with_path(place, params, is_leaf=is_q)
+
+
 @dataclass(frozen=True)
 class ParallelModel:
     """Mesh-placed model.  Build with :func:`make_parallel_model`."""
@@ -74,36 +168,22 @@ class ParallelModel:
     # -- placement ---------------------------------------------------------
 
     def shard_params(self, params: Params) -> Params:
-        """Stage (if pipelined) and place params onto the mesh."""
-        from ..checkpoint.quantize import QuantizedTensor, dequantize_tree
+        """Stage (if pipelined) and place params onto the mesh.
 
-        if any(
-            isinstance(leaf, QuantizedTensor)
-            for leaf in jax.tree.leaves(
-                params, is_leaf=lambda x: isinstance(x, QuantizedTensor)
-            )
-        ):
-            # Quantized-resident serving is single-device for now: blockwise
-            # scale tensors don't divide evenly over TP shards (e.g. 86
-            # scale blocks over tp=4), so mesh placement rehydrates.  Mesh +
-            # quantized-HBM needs shard-aligned quant blocks (future work).
-            # Rehydrate via host: dequantizing on the (single) loading device
-            # would materialize the full-dtype tree NEXT TO the int8 copy —
-            # an OOM spike for exactly the models quantization exists to fit.
-            cpu = jax.devices("cpu")[0]
-            with jax.default_device(cpu):
-                params = dequantize_tree(
-                    jax.device_put(params, cpu), jnp.dtype(self.cfg.dtype)
-                )
+        QuantizedTensor leaves stay quantized-resident on the mesh (SURVEY §7
+        hard part 6): data and scale shard under the plain weight's spec,
+        with scale blocks refined where a shard boundary would split a block
+        (refinement repeats scales to a finer — numerically identical —
+        block size).  Leaves whose layout can't shard cleanly replicate,
+        loudly, instead of rehydrating the whole tree.
+        """
         if self.pipelined:
             params = dict(params)
             params["blocks"] = pipeline_lib.split_stages(params["blocks"], self.num_stages)
             specs = staged_param_specs(self.cfg, self.mesh)
         else:
             specs = specs_lib.param_specs(self.cfg, self.mesh)
-        return jax.tree.map(
-            lambda x, s: jax.device_put(x, NamedSharding(self.mesh, s)), params, specs
-        )
+        return _place_tree(params, specs, self.mesh)
 
     def init_cache(
         self, batch: int, max_len: int, prompt_len: int | None = None
@@ -341,11 +421,17 @@ class ParallelModel:
             return (logits, None, jnp.float32(0.0)) if return_aux else (logits, None)
         cfg = _local_cfg(cfg)
         if not self.pipelined:
-            return model_lib.forward(
-                params, cfg, tokens, positions=positions, cache=cache,
-                cache_index=cache_index, remat=remat, attn_mask=attn_mask,
-                return_aux=return_aux,
-            )
+            # GSPMD path: quantized weights must take the dequant+einsum
+            # route (XLA partitions it); the Pallas kernel has no SPMD
+            # partitioning rule and would force a full-weight all-gather.
+            from ..ops.quant_matmul import spmd_fallback
+
+            with spmd_fallback():
+                return model_lib.forward(
+                    params, cfg, tokens, positions=positions, cache=cache,
+                    cache_index=cache_index, remat=remat, attn_mask=attn_mask,
+                    return_aux=return_aux,
+                )
 
         b, t = tokens.shape
         if positions is None:

@@ -1,0 +1,110 @@
+"""Quantized-resident serving ON A MESH (SURVEY §7 hard part 6, VERDICT r2
+next-step 9): mesh placement keeps QuantizedTensor leaves — data and scale
+sharded under the plain weight's PartitionSpec, scale blocks refined where a
+shard boundary would split a block — instead of rehydrating to full dtype.
+The GSPMD forward routes quantized contractions through dequantize+einsum
+(ops/quant_matmul.spmd_fallback): pallas_call has no SPMD partitioning rule.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, PartitionSpec as P
+
+from distributed_llms_tpu.checkpoint import quantize as quant_lib
+from distributed_llms_tpu.checkpoint import store as store_lib
+from distributed_llms_tpu.core.config import MeshConfig, RuntimeConfig
+from distributed_llms_tpu.models import model as model_lib, presets
+from distributed_llms_tpu.parallel import api as api_lib
+from distributed_llms_tpu.runtime.engine import InferenceEngine
+
+
+def _qleaves(tree):
+    return [
+        x for x in jax.tree.leaves(
+            tree, is_leaf=lambda x: isinstance(x, quant_lib.QuantizedTensor)
+        )
+        if isinstance(x, quant_lib.QuantizedTensor)
+    ]
+
+
+def test_scale_refinement_is_exact(devices8):
+    """Sharding the blocked axis over more shards than block granularity
+    allows refines scales (repeat) — dequantized values must be identical."""
+    mesh = Mesh(np.array(devices8).reshape(8), ("model",))
+    w = jax.random.normal(jax.random.key(0), (64, 256), jnp.float32)
+    qt = quant_lib.quantize(w, bits=8, block=128)  # 2 blocks; 8 shards of 32
+    placed = api_lib._place_quantized(qt, P(None, "model"), mesh, "w")
+    assert placed.scale.shape[-1] == 8  # refined 128 -> 32-wide blocks
+    np.testing.assert_array_equal(
+        np.asarray(quant_lib.dequantize(qt)), np.asarray(quant_lib.dequantize(placed))
+    )
+    # data really is sharded over 'model'
+    assert placed.data.sharding.spec == P(None, "model")
+
+
+def test_unshardable_leaf_replicates(devices8):
+    """A spec that would shard the int4 pack axis at the last dim replicates
+    (loudly) instead of corrupting."""
+    mesh = Mesh(np.array(devices8).reshape(8), ("model",))
+    w = jax.random.normal(jax.random.key(0), (64, 256), jnp.float32)
+    qt = quant_lib.quantize(w, bits=4, block=128, pack_axis=-1)  # legacy layout
+    placed = api_lib._place_quantized(qt, P(None, "model"), mesh, "w")
+    assert placed.data.sharding.spec == P()
+    np.testing.assert_array_equal(
+        np.asarray(quant_lib.dequantize(qt)), np.asarray(quant_lib.dequantize(placed))
+    )
+
+
+@pytest.mark.parametrize("quantization", ["int8", "int4"])
+def test_tp_mesh_serves_quantized_resident(tmp_path, devices8, quantization):
+    """data=2 x model=4 mesh: block weights stay quantized on the mesh and
+    generation matches the single-device quantized engine token-for-token.
+    model=4 over intermediate_size=176 with quant_block=32 forces scale
+    refinement (per-shard 44 % 32 != 0 -> 4-wide blocks) in the real path."""
+    cfg = presets.get_preset("llama-tiny", vocab_size=512)
+    params = model_lib.init_params(jax.random.key(0), cfg)
+    store_lib.save_shards(
+        params, str(tmp_path), num_shards=2, model_config=cfg,
+        quantization=quantization, quant_block=32,
+    )
+    rt = RuntimeConfig(max_decode_steps=6, serve_quantized=True)
+    ref = InferenceEngine.from_store(str(tmp_path), rt=rt)
+    eng = InferenceEngine.from_store(
+        str(tmp_path), rt=rt, mesh_cfg=MeshConfig(data=2, model=4)
+    )
+    qleaves = _qleaves(eng.params["blocks"])
+    assert qleaves, "mesh placement rehydrated the quantized tree"
+    # Sharded, not replicated: at least one leaf's data spans the model axis.
+    assert any(
+        "model" in jax.tree_util.tree_leaves(
+            [n for n in q.data.sharding.spec if n is not None]
+        )
+        for q in qleaves
+    )
+    out_ref = ref.generate_text(["hello quantized mesh"], max_new_tokens=6)
+    out = eng.generate_text(["hello quantized mesh"], max_new_tokens=6)
+    assert out.tokens.tolist() == out_ref.tokens.tolist()
+
+
+@pytest.mark.parametrize("quantization", ["int8"])
+def test_pipelined_mesh_serves_quantized_resident(tmp_path, devices8, quantization):
+    """pipe=2 x model=2 (+data=2) mesh: staged quantized blocks flow through
+    the shard_map pipeline and the wavefront decode, matching single-device."""
+    cfg = presets.get_preset("llama-tiny", vocab_size=512)
+    params = model_lib.init_params(jax.random.key(0), cfg)
+    store_lib.save_shards(
+        params, str(tmp_path), num_shards=2, model_config=cfg,
+        quantization=quantization, quant_block=32,
+    )
+    rt = RuntimeConfig(max_decode_steps=6, serve_quantized=True, microbatches=2)
+    ref = InferenceEngine.from_store(str(tmp_path), rt=rt)
+    eng = InferenceEngine.from_store(
+        str(tmp_path), rt=rt, mesh_cfg=MeshConfig(data=2, pipe=2, model=2)
+    )
+    assert _qleaves(eng.params["blocks"]), "pipeline staging rehydrated"
+    prompts = ["hello quantized pipeline", "second row"]
+    out_ref = ref.generate_text(prompts, max_new_tokens=6)
+    out = eng.generate_text(prompts, max_new_tokens=6)
+    assert out.tokens.tolist() == out_ref.tokens.tolist()
