@@ -192,33 +192,39 @@ def _fits(cfg, batch: int, seq: int, dtype: str, quant: str | None = None) -> tu
     return True, f"~{need / 1e9:.1f} GB of {budget / 1e9:.1f} GB"
 
 
+def _build_params(preset: str, dtype: str, quant: str | None):
+    """Random-init params for a preset, optionally weight-only quantized.
+    Quantization happens host-side: full-dtype 7B/13B weights would OOM the
+    device before quantization could shrink them — only the int8/int4 blocks
+    (plus full-dtype embeddings) ever reach HBM."""
+    from distributed_llms_tpu.models import model as model_lib
+    from distributed_llms_tpu.models.presets import get_preset
+
+    cfg = get_preset(preset, dtype=dtype)
+    if not quant:
+        return cfg, model_lib.init_params(jax.random.key(0), cfg)
+    from distributed_llms_tpu.checkpoint import quantize as quant_lib
+
+    bits = {"int8": 8, "int4": 4}[quant]
+    dev = jax.devices()[0]
+    with jax.default_device(jax.devices("cpu")[0]):
+        params = model_lib.init_params(jax.random.key(0), cfg)
+        params["blocks"] = quant_lib.quantize_tree(params["blocks"], bits=bits)
+    return cfg, jax.device_put(params, dev)
+
+
 def _measure_decode(preset: str, batch: int, prompt_len: int, new_tokens: int,
                     dtype: str, iters: int, quant: str | None = None) -> dict:
     """Two-point greedy-decode throughput at true model shapes (random
     weights — no network in this environment; decode FLOPs are identical).
     ``quant``: int8/int4 weight-only serving (block weights resident
     quantized; dequant fused per layer)."""
-    from distributed_llms_tpu.models import model as model_lib
     from distributed_llms_tpu.models.presets import get_preset
     from distributed_llms_tpu.runtime import generate as gen_lib
 
     import numpy as np
 
-    cfg = get_preset(preset, dtype=dtype)
-    if quant:
-        # Build + quantize on host: full-dtype 7B/13B weights would OOM the
-        # device before quantization could shrink them.  Only the int8/int4
-        # blocks (plus full-dtype embeddings) ever reach HBM.
-        from distributed_llms_tpu.checkpoint import quantize as quant_lib
-
-        bits = {"int8": 8, "int4": 4}[quant]
-        dev = jax.devices()[0]
-        with jax.default_device(jax.devices("cpu")[0]):
-            params = model_lib.init_params(jax.random.key(0), cfg)
-            params["blocks"] = quant_lib.quantize_tree(params["blocks"], bits=bits)
-        params = jax.device_put(params, dev)
-    else:
-        params = model_lib.init_params(jax.random.key(0), cfg)
+    cfg, params = _build_params(preset, dtype, quant)
     prompt = jax.random.randint(
         jax.random.key(1), (batch, prompt_len), 0, cfg.vocab_size, dtype=jnp.int32
     )
@@ -292,6 +298,63 @@ def _mfu(tps_per_chip: float, n_params: int) -> float | None:
         if key in kind:
             return round(tps_per_chip * 2.0 * n_params / peak, 5)
     return None
+
+
+def _measure_serving_latency(
+    preset: str, batch: int, prompt_len: int, dtype: str,
+    quant: str | None = None, requests: int = 8, new_tokens: int = 16,
+) -> dict:
+    """Serving-latency percentiles through the PRODUCT path (InferenceEngine
+    + tokenizer), not raw generate_tokens: TTFT (prefill + first token) and
+    TPOT (steady-state per-token decode) — the p50/p95 latency metrics
+    SURVEY §5.5 calls for next to throughput.
+
+    TTFT = latency of a 1-token generate; TPOT = (t(N) - t(1)) / (N - 1),
+    which cancels prefill and the constant dispatch overhead.
+    """
+    from distributed_llms_tpu.core.config import RuntimeConfig
+    from distributed_llms_tpu.runtime.engine import InferenceEngine
+
+    rt = RuntimeConfig(
+        max_decode_steps=new_tokens, serve_quantized=quant is not None,
+    )
+    cfg, params = _build_params(preset, dtype, quant)
+    eng = InferenceEngine(cfg, rt, params)
+    prompts = ["benchmark " * max(1, prompt_len // 10)] * batch
+
+    # Warm both compilation caches (1-token and N-token loops).
+    eng.generate_text(prompts, max_new_tokens=1)
+    eng.generate_text(prompts, max_new_tokens=new_tokens)
+
+    ttfts, fulls = [], []
+    for _ in range(requests):
+        t0 = time.perf_counter()
+        eng.generate_text(prompts, max_new_tokens=1)
+        ttfts.append(time.perf_counter() - t0)
+        t0 = time.perf_counter()
+        eng.generate_text(prompts, max_new_tokens=new_tokens)
+        fulls.append(time.perf_counter() - t0)
+    ts = sorted(ttfts)
+    out = {
+        "preset": preset,
+        **({"quant": quant} if quant else {}),
+        "batch": batch,
+        "new_tokens": new_tokens,
+        "requests": requests,
+        "platform": jax.devices()[0].platform,
+        "ttft_p50_ms": round(ts[len(ts) // 2] * 1e3, 1),
+        "ttft_p95_ms": round(ts[int(len(ts) * 0.95)] * 1e3, 1),
+    }
+    tpot = (min(fulls) - min(ttfts)) / (new_tokens - 1)
+    if tpot <= 0:
+        # Overhead-dominated (constant dispatch ~ decode time, cf. the
+        # t2<=t1 guard in _measure_decode): the subtraction is noise.
+        out["tpot_ms"] = None
+        out["note"] = "overhead-dominated: full-decode time within noise of TTFT"
+    else:
+        out["tpot_ms"] = round(tpot * 1e3, 2)
+        out["tok_per_s_steady"] = round(batch / tpot, 1)
+    return out
 
 
 def _measure_prefill_flash(
@@ -434,6 +497,24 @@ def run_ladder(args, degraded: str | None) -> list[dict]:
         rows.append(row)
         print(f"#   -> {row}", file=sys.stderr)
         _write_rows(args.out, rows)  # incremental: a later crash keeps these
+    # Serving-latency row (TTFT/TPOT percentiles through the engine): the
+    # north-star config on an accelerator, the CPU fallback config otherwise.
+    srv = FALLBACK if on_cpu else NORTH_STAR
+    row = {"config": "serving-latency"}
+    try:
+        row.update(_measure_serving_latency(
+            srv["preset"], srv["batch"], srv["prompt"], dtype,
+            quant=srv.get("quant"), new_tokens=srv["new"],
+        ))
+        if degraded is not None:
+            row["degraded"] = degraded
+    except Exception as exc:
+        row["skipped"] = (
+            f"{type(exc).__name__}: {(str(exc).splitlines() or ['?'])[0][:200]}"
+        )
+    rows.append(row)
+    print(f"# serving latency: {row}", file=sys.stderr)
+    _write_rows(args.out, rows)
     if not on_cpu:
         # Flash-attention prefill microbenchmark (real kernels only — CPU
         # interpret mode would measure the emulator, not the kernel).
