@@ -315,9 +315,12 @@ def _measure_serving_latency(
     from distributed_llms_tpu.core.config import RuntimeConfig
     from distributed_llms_tpu.runtime.engine import InferenceEngine
 
-    rt = RuntimeConfig(
-        max_decode_steps=new_tokens, serve_quantized=quant is not None,
-    )
+    if new_tokens < 2:
+        raise ValueError("TPOT needs new_tokens >= 2")
+    rt = RuntimeConfig(max_decode_steps=new_tokens)
+    # Rebuilds params even when a decode row just built the same ones — on
+    # purpose: caching jax arrays across rows would pin this config's HBM
+    # while later (bigger) configs run, breaking the crash-isolated ladder.
     cfg, params = _build_params(preset, dtype, quant)
     eng = InferenceEngine(cfg, rt, params)
     prompts = ["benchmark " * max(1, prompt_len // 10)] * batch
@@ -501,33 +504,47 @@ def run_ladder(args, degraded: str | None) -> list[dict]:
     # north-star config on an accelerator, the CPU fallback config otherwise.
     srv = FALLBACK if on_cpu else NORTH_STAR
     row = {"config": "serving-latency"}
-    try:
-        row.update(_measure_serving_latency(
-            srv["preset"], srv["batch"], srv["prompt"], dtype,
-            quant=srv.get("quant"), new_tokens=srv["new"],
-        ))
-        if degraded is not None:
-            row["degraded"] = degraded
-    except Exception as exc:
-        row["skipped"] = (
-            f"{type(exc).__name__}: {(str(exc).splitlines() or ['?'])[0][:200]}"
-        )
+    srv_cfg = get_preset(srv["preset"])
+    ok, why = _fits(
+        srv_cfg, srv["batch"], srv["prompt"] + srv["new"], dtype, srv.get("quant")
+    )
+    if not ok:
+        row.update({"preset": srv["preset"], "skipped": why})
+    else:
+        try:
+            row.update(_measure_serving_latency(
+                srv["preset"], srv["batch"], srv["prompt"], dtype,
+                quant=srv.get("quant"), new_tokens=srv["new"],
+            ))
+            if degraded is not None:
+                row["degraded"] = degraded
+        except Exception as exc:
+            row["skipped"] = (
+                f"{type(exc).__name__}: {(str(exc).splitlines() or ['?'])[0][:200]}"
+            )
     rows.append(row)
     print(f"# serving latency: {row}", file=sys.stderr)
     _write_rows(args.out, rows)
     if not on_cpu:
         # Flash-attention prefill microbenchmark (real kernels only — CPU
         # interpret mode would measure the emulator, not the kernel).
-        row = {"config": "prefill-flash"}
-        try:
-            row.update(_measure_prefill_flash(dtype=dtype, iters=args.iters))
-        except Exception as exc:
-            row["skipped"] = (
-                f"{type(exc).__name__}: {(str(exc).splitlines() or ['?'])[0][:200]}"
-            )
-        rows.append(row)
-        print(f"# prefill flash: {row}", file=sys.stderr)
-        _write_rows(args.out, rows)
+        # seq=2048 is the short-context sanity point; seq=8192 (batch 1) is
+        # the long-context point where the O(T^2) attention share grows and
+        # the flash kernel's tiling should pull ahead of dot.
+        for seq, b in ((2048, 2), (8192, 1)):
+            row = {"config": f"prefill-flash-{seq}"}
+            try:
+                row.update(_measure_prefill_flash(
+                    batch=b, seq=seq, dtype=dtype, iters=args.iters
+                ))
+            except Exception as exc:
+                row["skipped"] = (
+                    f"{type(exc).__name__}: "
+                    f"{(str(exc).splitlines() or ['?'])[0][:200]}"
+                )
+            rows.append(row)
+            print(f"# prefill flash: {row}", file=sys.stderr)
+            _write_rows(args.out, rows)
     hop = _measure_hop_latency()
     if hop is not None:
         rows.append({"config": "hop-latency", **hop})
