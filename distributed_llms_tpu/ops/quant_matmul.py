@@ -161,6 +161,148 @@ def flatten_qt(qt, k_lead: int):
     return q2, s2, n, block
 
 
+def _dequant_flat(q2: jax.Array, s2: jax.Array, bits: int, dtype) -> jax.Array:
+    """Dequantize flat row-packed operands (the kernel's own layout) without
+    the kernel — the local fallback when a (shard's) shape is untileable.
+    Same math as checkpoint.quantize.dequantize for this layout."""
+    q = q2.astype(jnp.int32)
+    if bits == 4:
+        lo = (q << 28) >> 28
+        hi = (q << 24) >> 28
+        q = jnp.stack([lo, hi], axis=1).reshape(q.shape[0] * 2, q.shape[1])
+    n = q.shape[1]
+    nb = s2.shape[1]
+    block = n // nb
+    w = (
+        q.astype(jnp.float32).reshape(q.shape[0], nb, block) * s2[:, :, None]
+    ).reshape(q.shape[0], n)
+    return w.astype(dtype)
+
+
+def _qmm_flat(x2: jax.Array, q2: jax.Array, s2: jax.Array, *, bits: int,
+              interpret: bool) -> jax.Array:
+    """[M, K] @ dequant([K(-packed), N]) from flat operands.  Shapes are the
+    LOCAL (per-shard, under custom_partitioning) shapes: tile sizes, M
+    padding, and the scale regroup all derive from them; untileable shapes
+    take the dequant+matmul fallback, so this is total over any shard."""
+    m, k = x2.shape
+    n = q2.shape[1]
+    nb = s2.shape[1]
+    block = n // nb
+    bk = _pick(k, _BK_CANDIDATES)
+    bn = _pick(n, _BN_CANDIDATES)
+    tileable = (
+        bk is not None and bn is not None
+        and block % 128 == 0 and bn % block == 0
+        and (bits == 8 or bk // 2 >= 8)
+    )
+    if not tileable:
+        return x2 @ _dequant_flat(q2, s2, bits, x2.dtype)
+    bm = min(_BM_MAX, max(16, -(-m // 16) * 16))
+    m_pad = -(-m // bm) * bm
+    if m_pad != m:
+        x2 = jnp.pad(x2, ((0, m_pad - m), (0, 0)))
+    # Regroup scales per N-tile: [K, NB] -> [nj, K, nb].  Tiny arrays
+    # (params/block floats); the transpose is a few % of the int8 bytes.
+    nj, nbt = n // bn, bn // block
+    s3 = s2.reshape(k, nj, nbt).transpose(1, 0, 2)
+    return _quant_matmul_2d(
+        x2, q2, s3, bits=bits, block=block, bm=bm, bk=bk, bn=bn,
+        interpret=interpret,
+    )[:m]
+
+
+def _spec_tuple(info, rank: int) -> tuple:
+    spec = getattr(getattr(info, "sharding", None), "spec", None)
+    t = tuple(spec) if spec is not None else ()
+    return t + (None,) * (rank - len(t))
+
+
+@functools.lru_cache(maxsize=None)
+def _qmm_spmd(bits: int, interpret: bool):
+    """SPMD-partitionable fused quant matmul (opt-in via
+    DLT_QUANT_MATMUL_SPMD=1).  pallas_call has no built-in SPMD partitioning
+    rule; this wrapper supplies one via jax.experimental.custom_partitioning:
+    each shard runs the kernel on its local tiles (N-sharded weights run
+    embarrassingly parallel; K-sharded weights — wo under tensor parallelism
+    — compute partial products and psum over the contracted mesh axes).
+
+    Known limitation: custom_partitioning inside ``lax.scan`` fails in
+    JAX's op_sharding unflattening (superdim KeyError) — the stacked-layer
+    block scan therefore cannot use this path yet, which is why the GSPMD
+    serving forward defaults to the dequantize+einsum fallback.  The wrapper
+    is correct (and tested, tests/parallel/test_quantized_mesh.py::
+    test_spmd_kernel_wrapper_partitions) for contractions traced outside a
+    scan."""
+    from jax.experimental.custom_partitioning import custom_partitioning
+
+    @custom_partitioning
+    def f(x2, q2, s2):
+        return _qmm_flat(x2, q2, s2, bits=bits, interpret=interpret)
+
+    def infer(mesh, arg_infos, result_infos):
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        xs = _spec_tuple(arg_infos[0], 2)
+        qs = _spec_tuple(arg_infos[1], 2)
+        return NamedSharding(mesh, P(xs[0], qs[1]))
+
+    def partition(mesh, arg_infos, result_infos):
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        def names(ax):
+            return () if ax is None else (ax if isinstance(ax, tuple) else (ax,))
+
+        def axis_size(ax):
+            sz = 1
+            for nm in names(ax):
+                sz *= mesh.shape.get(nm, 1)
+            return sz
+
+        xs = _spec_tuple(arg_infos[0], 2)
+        qs = _spec_tuple(arg_infos[1], 2)
+        m_ax = xs[0]
+        n_ax = qs[1]
+        k_ax = qs[0] if qs[0] is not None else xs[1]
+        # Scale blocks must divide over the N shards or each shard's local
+        # block derivation goes wrong — when they don't, keep q AND s
+        # replicated along N together (redundant compute, correct numerics).
+        # Placement-time refinement (parallel.api._place_quantized) normally
+        # makes them divide.
+        nb = arg_infos[2].shape[1]
+        if nb % max(axis_size(n_ax), 1):
+            n_ax = None
+        # A mesh axis may appear once per spec: if the batch axis collides
+        # with the contracted/output axes (FSDP-style placements), replicate
+        # M rather than crash at lowering.
+        if set(names(m_ax)) & (set(names(k_ax)) | set(names(n_ax))):
+            m_ax = None
+        k_names = names(k_ax)
+
+        def lower(x2, q2, s2):
+            y = _qmm_flat(x2, q2, s2, bits=bits, interpret=interpret)
+            if k_names:
+                y = jax.lax.psum(y, k_names)
+            return y
+
+        args = (
+            NamedSharding(mesh, P(m_ax, k_ax)),
+            NamedSharding(mesh, P(k_ax, n_ax)),
+            NamedSharding(mesh, P(k_ax, n_ax)),
+        )
+        return mesh, lower, NamedSharding(mesh, P(m_ax, n_ax)), args
+
+    f.def_partition(
+        infer_sharding_from_operands=infer,
+        partition=partition,
+        # Shardy factor rule: m/n propagate to the output; the contracted and
+        # block axes are independent factors (int4 packs K, so x's K and q's
+        # rows differ in size and cannot share a factor).
+        sharding_rule="m k, p n, q b -> m n",
+    )
+    return f
+
+
 def _kernel_mode() -> str:
     """Resolve DLT_QUANT_MATMUL: "kernel" (compiled Pallas), "interpret"
     (Pallas interpret mode — the CI leg that runs the kernel's exact program
@@ -191,43 +333,27 @@ def quant_contract(
     x2 = x.reshape(-1, k)
 
     mode = _kernel_mode()
-    if _SPMD_FALLBACK.get():
+    in_gspmd = _SPMD_FALLBACK.get()
+    use_spmd_kernel = (
+        in_gspmd and os.environ.get("DLT_QUANT_MATMUL_SPMD", "0") == "1"
+    )
+    if in_gspmd and not use_spmd_kernel:
         mode = "fallback"
     if interpret:  # explicit test request wins even inside spmd_fallback
         mode = "interpret"
-    if mode != "fallback":
+    # int4: the kernel's sublane unpack (and _dequant_flat) assume the pack
+    # pairs run along the LAST K axis (quantize_tree's convention).
+    pack_ok = qt.bits == 8 or qt.data.ndim + qt.pack_axis == k_lead - 1
+    if mode != "fallback" and pack_ok:
         interpret = mode == "interpret"
         q2, s2, n, block = flatten_qt(qt, k_lead)
-        bk = _pick(k, _BK_CANDIDATES)
-        bn = _pick(n, _BN_CANDIDATES)
-        tileable = (
-            bk is not None
-            and bn is not None
-            and block % 128 == 0
-            and bn % block == 0
-            # int4: the kernel's sublane unpack assumes the pack pairs run
-            # along the LAST K axis (quantize_tree's convention); packed row
-            # tiles must still meet the 8-sublane minimum.
-            and (
-                qt.bits == 8
-                or (qt.data.ndim + qt.pack_axis == k_lead - 1 and bk // 2 >= 8)
-            )
-        )
-        if tileable:
-            m = x2.shape[0]
-            bm = min(_BM_MAX, max(16, -(-m // 16) * 16))
-            m_pad = -(-m // bm) * bm
-            if m_pad != m:
-                x2 = jnp.pad(x2, ((0, m_pad - m), (0, 0)))
-            # Regroup scales per N-tile: [K, NB] -> [nj, K, nb].  Tiny arrays
-            # (params/32 floats); the transpose is ~3% of the int8 bytes.
-            nj, nb = n // bn, bn // block
-            s3 = s2.reshape(k, nj, nb).transpose(1, 0, 2)
-            y2 = _quant_matmul_2d(
-                x2, q2, s3, bits=qt.bits, block=block,
-                bm=bm, bk=bk, bn=bn, interpret=interpret,
-            )[:m]
-            return y2.reshape(*lead, *out_tail)
+        if use_spmd_kernel:
+            # GSPMD trace: the custom_partitioning wrapper gives the kernel
+            # an SPMD rule (per-shard tiles; psum over contracted axes).
+            y2 = _qmm_spmd(qt.bits, interpret)(x2, q2, s2)
+        else:
+            y2 = _qmm_flat(x2, q2, s2, bits=qt.bits, interpret=interpret)
+        return y2.reshape(*lead, *out_tail)
 
     # Fallback: dequantize then contract (XLA fuses what it can).  Matches
     # models/model.py's historical dequant-at-use numerics exactly.

@@ -88,6 +88,76 @@ def test_tp_mesh_serves_quantized_resident(tmp_path, devices8, quantization):
     assert out.tokens.tolist() == out_ref.tokens.tolist()
 
 
+@pytest.mark.parametrize(
+    "case,wshape,wspec,xshape,xspec,k_lead,eq",
+    [
+        # Shapes chosen so the LOCAL shard is kernel-tileable (block=128,
+        # local n a multiple of 128) — the Pallas program, not the dequant
+        # fallback, is what runs per shard (asserted via the spy below).
+        ("w_in N-sharded", (256, 1024), P(None, "model"), (4, 256),
+         P("data", None), 1, "md,df->mf"),
+        ("wq head-sharded", (256, 4, 128), P(None, "model", None), (4, 256),
+         P("data", None), 1, "md,dhk->mhk"),
+        ("wo K-sharded psum", (4, 128, 256), P("model", None, None),
+         (4, 4, 128), P("data", "model", None), 2, "mhk,hkd->md"),
+        ("x batched 3d", (256, 1024), P(None, "model"), (2, 3, 256),
+         P("data", None, None), 1, "btd,df->btf"),
+    ],
+)
+def test_spmd_kernel_wrapper_partitions(
+    devices8, monkeypatch, case, wshape, wspec, xshape, xspec, k_lead, eq
+):
+    """DLT_QUANT_MATMUL_SPMD=1: the custom_partitioning wrapper runs the
+    kernel program per shard under GSPMD (interpret mode on CPU) — N-sharded
+    weights embarrassingly parallel, K-sharded wo with a psum — matching the
+    dense dequant+einsum exactly.  (The block *scan* cannot take this path
+    yet — custom_partitioning under lax.scan hits a JAX op_sharding
+    unflattening bug — so this pins the op-level contract.)"""
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding
+
+    from distributed_llms_tpu.checkpoint.quantize import dequantize, quantize
+    from distributed_llms_tpu.ops import quant_matmul as qm
+
+    monkeypatch.setenv("DLT_QUANT_MATMUL_SPMD", "1")
+    monkeypatch.setenv("DLT_QUANT_MATMUL", "interpret")
+    qm._qmm_spmd.cache_clear()  # fresh wrapper so the spy below is seen
+    kernel_calls = []
+    orig = qm._quant_matmul_2d
+    monkeypatch.setattr(
+        qm, "_quant_matmul_2d",
+        lambda *a, **kw: kernel_calls.append(1) or orig(*a, **kw),
+    )
+    mesh = Mesh(np.array(devices8).reshape(2, 4), ("data", "model"))
+    w = jax.random.normal(jax.random.key(0), wshape, jnp.float32)
+    qt = quantize(w, bits=8, block=128)
+    sharded = type(qt)(
+        data=jax.device_put(qt.data, NamedSharding(mesh, wspec)),
+        scale=jax.device_put(qt.scale, NamedSharding(mesh, wspec)),
+        bits=qt.bits, orig_shape=qt.orig_shape, pack_axis=qt.pack_axis,
+    )
+    x = jax.device_put(
+        jax.random.normal(jax.random.key(1), xshape, jnp.float32),
+        NamedSharding(mesh, xspec),
+    )
+    token = qm._SPMD_FALLBACK.set(True)
+    try:
+        f = jax.jit(lambda x_, d_, s_: qm.quant_contract(
+            x_,
+            type(qt)(data=d_, scale=s_, bits=qt.bits,
+                     orig_shape=qt.orig_shape, pack_axis=qt.pack_axis),
+            k_lead, eq,
+        ))
+        y = f(x, sharded.data, sharded.scale)
+    finally:
+        qm._SPMD_FALLBACK.reset(token)
+    assert kernel_calls, "Pallas kernel program was not run under the wrapper"
+    ref = jnp.einsum(eq, x, dequantize(qt, x.dtype))
+    np.testing.assert_allclose(
+        np.asarray(y), np.asarray(ref), rtol=2e-5, atol=2e-5
+    )
+
+
 @pytest.mark.parametrize("quantization", ["int8"])
 def test_pipelined_mesh_serves_quantized_resident(tmp_path, devices8, quantization):
     """pipe=2 x model=2 (+data=2) mesh: staged quantized blocks flow through
