@@ -62,6 +62,17 @@ def _pick(n: int, candidates: tuple[int, ...]) -> int | None:
     return None
 
 
+def _unpack_int4_rows(q: jax.Array) -> jax.Array:
+    """[Kp, N] int32 packed nibbles -> [2*Kp, N] int32 values.  Low nibble =
+    even K-row, high = odd (quantize() packs along the reduction axis):
+    sign-extend via int32 shifts, then a sublane interleave, which Mosaic
+    supports at any lane width.  Shared by the kernel and its flat-dequant
+    fallback so the two layouts cannot diverge."""
+    lo = (q << 28) >> 28
+    hi = (q << 24) >> 28
+    return jnp.stack([lo, hi], axis=1).reshape(q.shape[0] * 2, q.shape[1])
+
+
 def _kernel(x_ref, q_ref, s_ref, o_ref, acc_ref, *, bits, block, nk, out_dtype):
     k = pl.program_id(2)
 
@@ -71,12 +82,7 @@ def _kernel(x_ref, q_ref, s_ref, o_ref, acc_ref, *, bits, block, nk, out_dtype):
 
     q = q_ref[:].astype(jnp.int32)  # [bk, bn] int8, or [bk//2, bn] packed int4
     if bits == 4:
-        # Unpack nibbles (low = even K-row, high = odd — quantize() packs
-        # along the reduction axis): sign-extend via int32 shifts, then a
-        # sublane interleave, which Mosaic supports at any lane width.
-        lo = (q << 28) >> 28
-        hi = (q << 24) >> 28
-        q = jnp.stack([lo, hi], axis=1).reshape(q.shape[0] * 2, q.shape[1])
+        q = _unpack_int4_rows(q)
 
     s = s_ref[0]  # [bk, bn // block] float32 (j-tile's slice of [nj, K, nb])
     bk, bn = q.shape
@@ -90,7 +96,8 @@ def _kernel(x_ref, q_ref, s_ref, o_ref, acc_ref, *, bits, block, nk, out_dtype):
 
 
 @functools.partial(
-    jax.jit, static_argnames=("bits", "block", "bm", "bk", "bn", "interpret")
+    jax.jit,
+    static_argnames=("bits", "block", "bm", "bk", "bn", "interpret", "vma"),
 )
 def _quant_matmul_2d(
     x: jax.Array,  # [M, K] float (M padded to a multiple of bm by caller)
@@ -105,6 +112,7 @@ def _quant_matmul_2d(
     bk: int,
     bn: int,
     interpret: bool = False,
+    vma: frozenset = frozenset(),  # varying manual axes inside shard_map
 ) -> jax.Array:
     m, k_dim = x.shape
     n = q.shape[1]
@@ -114,9 +122,14 @@ def _quant_matmul_2d(
         _kernel, bits=bits, block=block, nk=grid[2], out_dtype=x.dtype
     )
     flops = 2 * m * k_dim * n
+    out_shape = (
+        jax.ShapeDtypeStruct((m, n), x.dtype, vma=vma)
+        if vma
+        else jax.ShapeDtypeStruct((m, n), x.dtype)
+    )
     return pl.pallas_call(
         kernel,
-        out_shape=jax.ShapeDtypeStruct((m, n), x.dtype),
+        out_shape=out_shape,
         grid=grid,
         in_specs=[
             pl.BlockSpec((bm, bk), lambda mi, j, k: (mi, k), memory_space=pltpu.VMEM),
@@ -167,9 +180,7 @@ def _dequant_flat(q2: jax.Array, s2: jax.Array, bits: int, dtype) -> jax.Array:
     Same math as checkpoint.quantize.dequantize for this layout."""
     q = q2.astype(jnp.int32)
     if bits == 4:
-        lo = (q << 28) >> 28
-        hi = (q << 24) >> 28
-        q = jnp.stack([lo, hi], axis=1).reshape(q.shape[0] * 2, q.shape[1])
+        q = _unpack_int4_rows(q)
     n = q.shape[1]
     nb = s2.shape[1]
     block = n // nb
@@ -198,6 +209,17 @@ def _qmm_flat(x2: jax.Array, q2: jax.Array, s2: jax.Array, *, bits: int,
     )
     if not tileable:
         return x2 @ _dequant_flat(q2, s2, bits, x2.dtype)
+    # Inside shard_map (the pipeline stage body) operands carry varying
+    # manual axes; the kernel's out_shape must declare the same set.
+    vma = frozenset().union(
+        *(getattr(jax.typeof(a), "vma", frozenset()) for a in (x2, q2, s2))
+    )
+    if vma and interpret:
+        # The Pallas HLO *interpreter* (off-TPU test path) loses vma on its
+        # internal dynamic_slices (same limitation as ops/flash.py); run the
+        # numerically-identical flat dequant there.  Real TPU lowering takes
+        # the kernel, with vma declared on its out_shape.
+        return x2 @ _dequant_flat(q2, s2, bits, x2.dtype)
     bm = min(_BM_MAX, max(16, -(-m // 16) * 16))
     m_pad = -(-m // bm) * bm
     if m_pad != m:
@@ -208,7 +230,7 @@ def _qmm_flat(x2: jax.Array, q2: jax.Array, s2: jax.Array, *, bits: int,
     s3 = s2.reshape(k, nj, nbt).transpose(1, 0, 2)
     return _quant_matmul_2d(
         x2, q2, s3, bits=bits, block=block, bm=bm, bk=bk, bn=bn,
-        interpret=interpret,
+        interpret=interpret, vma=vma,
     )[:m]
 
 
@@ -240,22 +262,16 @@ def _qmm_spmd(bits: int, interpret: bool):
     def f(x2, q2, s2):
         return _qmm_flat(x2, q2, s2, bits=bits, interpret=interpret)
 
-    def infer(mesh, arg_infos, result_infos):
-        from jax.sharding import NamedSharding, PartitionSpec as P
+    def _names(ax):
+        return () if ax is None else (ax if isinstance(ax, tuple) else (ax,))
 
-        xs = _spec_tuple(arg_infos[0], 2)
-        qs = _spec_tuple(arg_infos[1], 2)
-        return NamedSharding(mesh, P(xs[0], qs[1]))
-
-    def partition(mesh, arg_infos, result_infos):
-        from jax.sharding import NamedSharding, PartitionSpec as P
-
-        def names(ax):
-            return () if ax is None else (ax if isinstance(ax, tuple) else (ax,))
+    def _resolve_axes(mesh, arg_infos):
+        """(m_ax, k_ax, n_ax) with every mesh axis used at most once —
+        shared by infer and partition so they cannot disagree."""
 
         def axis_size(ax):
             sz = 1
-            for nm in names(ax):
+            for nm in _names(ax):
                 sz *= mesh.shape.get(nm, 1)
             return sz
 
@@ -272,12 +288,27 @@ def _qmm_spmd(bits: int, interpret: bool):
         nb = arg_infos[2].shape[1]
         if nb % max(axis_size(n_ax), 1):
             n_ax = None
-        # A mesh axis may appear once per spec: if the batch axis collides
-        # with the contracted/output axes (FSDP-style placements), replicate
-        # M rather than crash at lowering.
-        if set(names(m_ax)) & (set(names(k_ax)) | set(names(n_ax))):
+        # A mesh axis may appear once per spec: prefer the weight's N
+        # sharding over a colliding activation-K sharding, and replicate M
+        # when the batch axis collides with either (FSDP-style placements) —
+        # rather than crash at inference/lowering.
+        if set(_names(k_ax)) & set(_names(n_ax)):
+            k_ax = None
+        if set(_names(m_ax)) & (set(_names(k_ax)) | set(_names(n_ax))):
             m_ax = None
-        k_names = names(k_ax)
+        return m_ax, k_ax, n_ax
+
+    def infer(mesh, arg_infos, result_infos):
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        m_ax, _, n_ax = _resolve_axes(mesh, arg_infos)
+        return NamedSharding(mesh, P(m_ax, n_ax))
+
+    def partition(mesh, arg_infos, result_infos):
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        m_ax, k_ax, n_ax = _resolve_axes(mesh, arg_infos)
+        k_names = _names(k_ax)
 
         def lower(x2, q2, s2):
             y = _qmm_flat(x2, q2, s2, bits=bits, interpret=interpret)

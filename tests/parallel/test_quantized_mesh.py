@@ -178,3 +178,45 @@ def test_pipelined_mesh_serves_quantized_resident(tmp_path, devices8, quantizati
     out_ref = ref.generate_text(prompts, max_new_tokens=6)
     out = eng.generate_text(prompts, max_new_tokens=6)
     assert out.tokens.tolist() == out_ref.tokens.tolist()
+
+
+def test_pipelined_mesh_kernel_inside_shard_map(tmp_path, devices8, monkeypatch):
+    """Unlike the GSPMD path (custom_partitioning + scan is blocked by a JAX
+    bug), the PIPELINED mesh runs blocks inside shard_map where operands are
+    already local — the fused kernel dispatch (_qmm_flat) runs under the
+    layer scan there.  On CPU the Pallas interpreter loses vma, so the
+    numerically-identical flat-dequant branch executes (same limitation and
+    same answer as ops/flash.py's interpret path); on real TPU the kernel
+    lowers with vma declared.  A spy proves the kernel dispatch path (not
+    the einsum fallback) ran; tokens must match fallback serving exactly."""
+    from distributed_llms_tpu.ops import quant_matmul as qm
+
+    cfg = presets.get_preset(
+        "llama-tiny", vocab_size=512, hidden_size=256, intermediate_size=256,
+        num_heads=2, num_kv_heads=2,  # hd = 128
+    )
+    params = model_lib.init_params(jax.random.key(0), cfg)
+    store_dir = str(tmp_path / "s")
+    store_lib.save_shards(
+        params, store_dir, num_shards=1, model_config=cfg, quantization="int8",
+        quant_block=128,
+    )
+    rt = RuntimeConfig(max_decode_steps=4, serve_quantized=True, microbatches=2)
+    monkeypatch.setenv("DLT_QUANT_MATMUL", "fallback")
+    ref = InferenceEngine.from_store(store_dir, rt=rt)
+    out_ref = ref.generate_text(["kernel in pipeline"], max_new_tokens=4)
+
+    monkeypatch.setenv("DLT_QUANT_MATMUL", "interpret")
+    dispatch_calls = []
+    orig = qm._qmm_flat
+    monkeypatch.setattr(
+        qm, "_qmm_flat",
+        lambda *a, **kw: dispatch_calls.append(1) or orig(*a, **kw),
+    )
+    eng = InferenceEngine.from_store(
+        store_dir, rt=rt, mesh_cfg=MeshConfig(pipe=2, model=4)
+    )
+    assert _qleaves(eng.params["blocks"])
+    out = eng.generate_text(["kernel in pipeline"], max_new_tokens=4)
+    assert dispatch_calls, "kernel dispatch did not run inside the pipeline"
+    assert out.tokens.tolist() == out_ref.tokens.tolist()
