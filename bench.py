@@ -360,6 +360,82 @@ def _measure_serving_latency(
     return out
 
 
+def _measure_continuous_batching(
+    preset: str, dtype: str, quant: str | None = None,
+    slots: int = 4, requests: int = 16, chunk_steps: int = 8,
+) -> dict:
+    """Continuous batching vs grouped batching on a mixed-length workload.
+
+    Grouped (the reference's model and round-2's engine): requests enter in
+    batches of ``slots``; every batch decodes until its LONGEST budget, so
+    short rows pad along and the batch drains before the next one starts.
+    Continuous: finished rows are refilled from the queue between decode
+    chunks.  Same requests, same model — the speedup is pure scheduling.
+    """
+    import numpy as np
+
+    from distributed_llms_tpu.runtime import generate as gen_lib
+    from distributed_llms_tpu.runtime.batcher import ContinuousBatcher
+
+    cfg, params = _build_params(preset, dtype, quant)
+    rng = np.random.RandomState(0)
+    lens = rng.randint(8, 65, size=requests)
+    # Long-tailed budgets (mostly short replies, occasional long ones) — the
+    # traffic shape that causes head-of-line blocking in grouped serving.
+    budgets = rng.choice(
+        [8, 8, 12, 16, 16, 24, 32, 64], size=requests
+    ).astype(np.int64)
+    prompts = [rng.randint(1, cfg.vocab_size, size=n).tolist() for n in lens]
+    total_new = int(budgets.sum())
+
+    def run_continuous() -> float:
+        b = ContinuousBatcher(
+            cfg, params, batch_slots=slots, max_len=128, chunk_steps=chunk_steps,
+        )
+        rids = [
+            b.submit(p, max_new_tokens=int(n)) for p, n in zip(prompts, budgets)
+        ]
+        t0 = time.perf_counter()
+        res = b.run()
+        dt = time.perf_counter() - t0
+        assert all(len(res[r]) for r in rids)
+        return dt
+
+    def run_grouped() -> float:
+        t0 = time.perf_counter()
+        for i in range(0, requests, slots):
+            grp = list(range(i, min(i + slots, requests)))
+            t = max(lens[g] for g in grp)
+            arr = np.zeros((len(grp), int(t)), np.int32)
+            for j, g in enumerate(grp):
+                arr[j, : lens[g]] = prompts[g]
+            out = gen_lib.generate_tokens(
+                params, cfg, jnp.asarray(arr),
+                jnp.asarray([int(lens[g]) for g in grp], jnp.int32),
+                jax.random.key(0),
+                max_new_tokens=int(max(budgets[g] for g in grp)),
+            )
+            np.asarray(out)
+        return time.perf_counter() - t0
+
+    # Warm compilation caches for both paths, then time.
+    run_continuous()
+    run_grouped()
+    t_cb = min(run_continuous(), run_continuous())
+    t_grp = min(run_grouped(), run_grouped())
+    return {
+        "preset": preset,
+        **({"quant": quant} if quant else {}),
+        "slots": slots,
+        "requests": requests,
+        "platform": jax.devices()[0].platform,
+        "useful_tokens": total_new,
+        "tok_per_s_continuous": round(total_new / t_cb, 1),
+        "tok_per_s_grouped": round(total_new / t_grp, 1),
+        "speedup": round(t_grp / t_cb, 3),
+    }
+
+
 def _measure_prefill_flash(
     preset: str = "tinyllama-1.1b", batch: int = 2, seq: int = 2048,
     dtype: str = "bfloat16", iters: int = 5,
@@ -524,6 +600,22 @@ def run_ladder(args, degraded: str | None) -> list[dict]:
             )
     rows.append(row)
     print(f"# serving latency: {row}", file=sys.stderr)
+    _write_rows(args.out, rows)
+    # Continuous-batching scheduling gain on a mixed-length workload.
+    row = {"config": "continuous-batching"}
+    cb = FALLBACK if on_cpu else NORTH_STAR
+    try:
+        row.update(_measure_continuous_batching(
+            cb["preset"], dtype, quant=cb.get("quant"),
+        ))
+        if degraded is not None:
+            row["degraded"] = degraded
+    except Exception as exc:
+        row["skipped"] = (
+            f"{type(exc).__name__}: {(str(exc).splitlines() or ['?'])[0][:200]}"
+        )
+    rows.append(row)
+    print(f"# continuous batching: {row}", file=sys.stderr)
     _write_rows(args.out, rows)
     if not on_cpu:
         # Flash-attention prefill microbenchmark (real kernels only — CPU

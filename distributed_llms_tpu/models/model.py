@@ -126,8 +126,24 @@ def _attention(
 
     if layer_cache is not None:
         ck, cv = layer_cache  # [B, S, KVH, HD]
-        ck = jax.lax.dynamic_update_slice(ck, k.astype(ck.dtype), (0, cache_index, 0, 0))
-        cv = jax.lax.dynamic_update_slice(cv, v.astype(cv.dtype), (0, cache_index, 0, 0))
+        if getattr(cache_index, "ndim", 0) == 1:
+            # Per-ROW write slots (continuous batching: rows admitted at
+            # different times sit at different depths).  Only the KV write
+            # scatters; everything else stays batched.  Callers must supply
+            # attn_mask — the shared k_valid derivation below assumes one
+            # scalar frontier.
+            if attn_mask is None:
+                raise ValueError(
+                    "per-row cache_index requires an explicit attn_mask"
+                )
+            row_upd = jax.vmap(
+                lambda c, u, i: jax.lax.dynamic_update_slice(c, u, (i, 0, 0))
+            )
+            ck = row_upd(ck, k.astype(ck.dtype), cache_index)
+            cv = row_upd(cv, v.astype(cv.dtype), cache_index)
+        else:
+            ck = jax.lax.dynamic_update_slice(ck, k.astype(ck.dtype), (0, cache_index, 0, 0))
+            cv = jax.lax.dynamic_update_slice(cv, v.astype(cv.dtype), (0, cache_index, 0, 0))
         if attn_mask is None:
             s = ck.shape[1]
             k_positions = jnp.broadcast_to(jnp.arange(s, dtype=jnp.int32), (x.shape[0], s))
@@ -335,7 +351,8 @@ def forward(
     tokens: jax.Array,  # [B, T] int32
     positions: jax.Array | None = None,  # [B, T] int32
     cache: KVCache | None = None,
-    cache_index: jax.Array | None = None,  # scalar int32: write offset into cache
+    cache_index: jax.Array | None = None,  # scalar int32 write offset, or
+    #   [B] int32 per-row offsets (continuous batching; attn_mask required)
     remat: bool = False,
     attn_mask: jax.Array | None = None,  # broadcastable to [B, H, Tq, S]; True = attend
     return_aux: bool = False,  # also return the MoE load-balance aux loss

@@ -1,0 +1,361 @@
+"""Continuous batching: admit requests into an in-flight decode batch.
+
+The reference (and round-2's engine) serve request *groups*: a batch enters
+prefill together, decodes together, and the whole batch drains before the
+next group starts — short requests wait for the longest one, and free batch
+rows ride along empty.  Continuous batching (the scheduling model of modern
+serving stacks) keeps a fixed set of batch SLOTS decoding at all times:
+when a row finishes, a queued request is prefilled into that row between
+decode chunks while the other rows keep generating.
+
+TPU-native formulation (everything static-shaped, two compiled functions):
+
+- ``admit_row``: prefill ONE request into batch slot ``i`` of the shared
+  KV cache — the row prefills against a transient single-row cache (dense
+  causal, flash-eligible) whose K/V then overwrite that batch row via one
+  ``dynamic_update_slice`` along the batch axis.  Prompts pad to
+  power-of-two buckets so admission compiles once per bucket, not per
+  length.
+- ``decode_chunk``: K decode steps for ALL slots at once, with PER-ROW
+  cache write positions (rows admitted at different times sit at different
+  depths).  The per-row single-token forward is ``jax.vmap``-ed over the
+  batch axis: each row carries its own position, write slot, and validity
+  mask; XLA turns the vmapped ``dynamic_update_slice`` into a scatter and
+  re-batches the matmuls onto the MXU.  Inactive rows compute harmlessly
+  into never-validated slots (no per-step cache select, which would copy
+  the cache) and their outputs are masked to pad.
+
+Invariant pinned by tests/runtime/test_batcher.py: at temperature 0 every
+request's tokens are IDENTICAL to running runtime.generate.generate_tokens
+on that request alone — continuous batching changes scheduling, never
+results.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from dataclasses import dataclass, field
+from functools import partial
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.config import ModelConfig
+from ..core.observability import METRICS, get_logger
+from ..models import model as model_lib
+from ..models.model import KVCache
+from . import sampling
+
+log = get_logger("batcher")
+
+
+def _batch_axis(leaf_ndim: int) -> int:
+    # KVCache leaves end in [..., B, S, KVH, HD]; batch is 4th from the right.
+    return leaf_ndim - 4
+
+
+@partial(
+    jax.jit,
+    static_argnames=("cfg", "temperature", "top_k", "top_p"),
+    donate_argnames=("cache",),
+)
+def admit_row(
+    params: Any,
+    cfg: ModelConfig,
+    cache: Any,  # shared KVCache, [L, B, S, KVH, HD] leaves
+    slot: jax.Array,  # scalar int32 — batch row to fill
+    prompt: jax.Array,  # [Tp] int32, right-padded (bucketed length)
+    plen: jax.Array,  # scalar int32 true length
+    rng: jax.Array,
+    temperature: float = 0.0,
+    top_k: int = 0,
+    top_p: float = 1.0,
+) -> tuple[Any, jax.Array, jax.Array]:
+    """Prefill one request into batch row ``slot``.  Returns
+    (cache', first_token, row_valid [S]) — real_lens/budget bookkeeping is
+    the caller's."""
+    (tp,) = prompt.shape
+    s = cache.k.shape[-3]
+    # Dense causal prefill on a transient single-row cache (flash-eligible:
+    # attn_mask=None), then splice that row into the shared cache.
+    row_cache = model_lib.init_cache(cfg, 1, s, dtype=cache.k.dtype)
+    positions = jnp.arange(tp, dtype=jnp.int32)[None, :]
+    logits, row_cache = model_lib.forward(
+        params, cfg, prompt[None, :], positions=positions,
+        cache=row_cache, cache_index=jnp.int32(0),
+    )
+    next_logits = jnp.take_along_axis(
+        logits, jnp.maximum(plen - 1, 0)[None, None, None], axis=1
+    )[:, 0]
+    tok = sampling.sample(rng, next_logits, temperature, top_k, top_p)[0]
+
+    ax = _batch_axis(cache.k.ndim)
+
+    def splice(full, row):
+        start = [0] * full.ndim
+        start[ax] = slot
+        return jax.lax.dynamic_update_slice(full, row, tuple(start))
+
+    cache = KVCache(k=splice(cache.k, row_cache.k), v=splice(cache.v, row_cache.v))
+    row_valid = jnp.arange(s, dtype=jnp.int32) < plen
+    return cache, tok, row_valid
+
+
+@partial(
+    jax.jit,
+    static_argnames=(
+        "cfg", "chunk_steps", "temperature", "top_k", "top_p", "eos_id",
+        "pad_id",
+    ),
+    donate_argnames=("cache",),
+)
+def decode_chunk(
+    params: Any,
+    cfg: ModelConfig,
+    cache: Any,  # shared KVCache
+    last_tok: jax.Array,  # [B] int32 — each row's most recent token
+    real_lens: jax.Array,  # [B] int32 — tokens resident per row (write pos)
+    valid: jax.Array,  # [B, S] bool — per-row valid cache slots
+    active: jax.Array,  # [B] bool
+    budget: jax.Array,  # [B] int32 — tokens this row may still emit
+    rng: jax.Array,
+    chunk_steps: int,
+    temperature: float = 0.0,
+    top_k: int = 0,
+    top_p: float = 1.0,
+    eos_id: int = -1,
+    pad_id: int = 0,
+) -> tuple[jax.Array, Any, jax.Array, jax.Array, jax.Array, jax.Array, jax.Array]:
+    """K decode steps with per-row positions.  Returns
+    (toks [B, K], cache', last_tok', real_lens', valid', active', budget')."""
+    s = cache.k.shape[-3]
+    slots = jnp.arange(s, dtype=jnp.int32)
+
+    def step(carry, rng_step):
+        cache, last_tok, real_lens, valid, active, budget = carry
+        # One batched forward with PER-ROW write slots (models.model accepts
+        # a [B] cache_index: only the KV write scatters; all matmuls stay
+        # batched).  The mask admits each row's valid slots plus the slot
+        # its own token was just written to.
+        mask = (valid | (slots[None, :] == real_lens[:, None]))[:, None, None, :]
+        logits, cache = model_lib.forward(
+            params, cfg, last_tok[:, None], positions=real_lens[:, None],
+            cache=cache, cache_index=real_lens, attn_mask=mask,
+        )
+        logits = logits[:, 0]
+        # The row just wrote last_tok's K/V at slot real_lens; mark it valid
+        # for rows that were active (inactive rows wrote junk into a slot
+        # that stays invalid — harmless, and re-prefilled on admission).
+        valid = valid | (active[:, None] & (slots[None, :] == real_lens[:, None]))
+        real_lens = real_lens + active.astype(jnp.int32)
+        tok = sampling.sample(rng_step, logits, temperature, top_k, top_p)
+        budget = budget - active.astype(jnp.int32)
+        if eos_id >= 0:
+            active = active & (tok != eos_id)
+        active = active & (budget > 0)
+        out = jnp.where(
+            carry[4], tok, jnp.int32(pad_id)
+        )  # mask with PRE-step active
+        last_tok = jnp.where(carry[4], tok, last_tok)
+        return (cache, last_tok, real_lens, valid, active, budget), out
+
+    rngs = jax.random.split(rng, chunk_steps)
+    carry0 = (cache, last_tok, real_lens, valid, active, budget)
+    (cache, last_tok, real_lens, valid, active, budget), toks = jax.lax.scan(
+        step, carry0, rngs
+    )
+    return toks.T, cache, last_tok, real_lens, valid, active, budget
+
+
+def _bucket(n: int, floor: int = 8) -> int:
+    b = floor
+    while b < n:
+        b *= 2
+    return b
+
+
+@dataclass
+class _Request:
+    rid: int
+    ids: list[int]
+    max_new_tokens: int
+
+
+@dataclass
+class _RowState:
+    rid: int | None = None
+    emitted: list[int] = field(default_factory=list)
+    remaining: int = 0  # decode tokens this row may still emit (host mirror
+    #                     of the device budget — distinguishes real pad-id
+    #                     tokens from post-deactivation padding)
+
+
+class ContinuousBatcher:
+    """Slot-based continuous batching over a single-device engine's model.
+
+    Usage::
+
+        batcher = ContinuousBatcher(cfg, params, tokenizer, batch_slots=8,
+                                    max_len=512)
+        rids = [batcher.submit(p, max_new_tokens=64) for p in prompts]
+        results = batcher.run()   # {rid: token list}
+
+    ``run`` drives admit/decode chunks until the queue drains and every row
+    finishes.  Scheduling policy is FIFO admission into the first free slot.
+    """
+
+    def __init__(
+        self,
+        cfg: ModelConfig,
+        params: Any,
+        tokenizer: Any = None,
+        batch_slots: int = 8,
+        max_len: int = 512,
+        chunk_steps: int = 8,
+        temperature: float = 0.0,
+        top_k: int = 0,
+        top_p: float = 1.0,
+        eos_id: int = -1,
+        pad_id: int = 0,
+        kv_dtype: Any = None,
+        seed: int = 0,
+    ) -> None:
+        if max_len > cfg.max_seq_len:
+            raise ValueError(
+                f"max_len {max_len} exceeds model max_seq_len {cfg.max_seq_len}"
+            )
+        self.cfg = cfg
+        self.params = params
+        self.tokenizer = tokenizer
+        self.b = batch_slots
+        self.s = max_len
+        self.chunk_steps = chunk_steps
+        self.sampling = dict(temperature=temperature, top_k=top_k, top_p=top_p)
+        self.eos_id = eos_id
+        self.pad_id = pad_id
+        self.cache = model_lib.init_cache(
+            cfg, batch_slots, max_len,
+            dtype=jnp.dtype(kv_dtype) if kv_dtype else None,
+        )
+        self.last_tok = jnp.zeros((batch_slots,), jnp.int32)
+        self.real_lens = jnp.zeros((batch_slots,), jnp.int32)
+        self.valid = jnp.zeros((batch_slots, max_len), bool)
+        self.active = jnp.zeros((batch_slots,), bool)
+        self.budget = jnp.zeros((batch_slots,), jnp.int32)
+        self.rows = [_RowState() for _ in range(batch_slots)]
+        self.queue: deque[_Request] = deque()
+        self.results: dict[int, list[int]] = {}
+        self._rng = jax.random.key(seed)
+        self._next_rid = 0
+
+    # -- submission --------------------------------------------------------
+
+    def submit(self, prompt: str | list[int], max_new_tokens: int = 32) -> int:
+        ids = (
+            self.tokenizer.encode(prompt)
+            if isinstance(prompt, str)
+            else list(prompt)
+        )
+        if len(ids) + max_new_tokens > self.s:
+            raise ValueError(
+                f"prompt ({len(ids)} tokens) + {max_new_tokens} new exceeds "
+                f"slot capacity {self.s}"
+            )
+        rid = self._next_rid
+        self._next_rid += 1
+        self.queue.append(_Request(rid, ids, max_new_tokens))
+        return rid
+
+    # -- scheduling loop ---------------------------------------------------
+
+    def _split_rng(self) -> jax.Array:
+        self._rng, sub = jax.random.split(self._rng)
+        return sub
+
+    def _admit_pending(self) -> None:
+        active_host = np.asarray(self.active)
+        for i in range(self.b):
+            if not self.queue:
+                return
+            if active_host[i]:
+                continue
+            req = self.queue.popleft()
+            # Bucket for compile reuse, but never past the slot capacity
+            # (submit() already guaranteed the real prompt fits).
+            tp = min(_bucket(len(req.ids)), self.s)
+            prompt = np.full((tp,), self.pad_id, np.int32)
+            prompt[: len(req.ids)] = req.ids
+            self.cache, tok, row_valid = admit_row(
+                self.params, self.cfg, self.cache, jnp.int32(i),
+                jnp.asarray(prompt), jnp.int32(len(req.ids)),
+                self._split_rng(), **self.sampling,
+            )
+            self.last_tok = self.last_tok.at[i].set(tok)
+            self.real_lens = self.real_lens.at[i].set(len(req.ids))
+            self.valid = self.valid.at[i].set(row_valid)
+            self.active = self.active.at[i].set(True)
+            # The first token came out of admission; the row may emit
+            # budget-1 more from decode chunks.
+            self.budget = self.budget.at[i].set(req.max_new_tokens - 1)
+            self.rows[i] = _RowState(
+                rid=req.rid, emitted=[int(tok)],
+                remaining=req.max_new_tokens - 1,
+            )
+            log.debug("admitted request %d into slot %d", req.rid, i)
+            if req.max_new_tokens == 1 or int(tok) == self.eos_id:
+                self.active = self.active.at[i].set(False)
+            active_host = np.asarray(self.active)
+            METRICS.inc("batcher.admitted")
+
+    def _collect(self, toks: np.ndarray, was_active: np.ndarray) -> None:
+        for i in range(self.b):
+            row = self.rows[i]
+            if row.rid is None or not was_active[i]:
+                continue
+            for t in toks[i]:
+                if row.remaining <= 0:
+                    break
+                t = int(t)
+                row.emitted.append(t)
+                row.remaining -= 1
+                if t == self.eos_id:
+                    break
+        # Rows that finished this chunk publish their result and free up.
+        active_host = np.asarray(self.active)
+        for i in range(self.b):
+            row = self.rows[i]
+            if row.rid is not None and not active_host[i]:
+                # Trim anything emitted past the row's EOS.
+                if self.eos_id >= 0 and self.eos_id in row.emitted:
+                    cut = row.emitted.index(self.eos_id) + 1
+                    row.emitted = row.emitted[:cut]
+                self.results[row.rid] = row.emitted
+                self.rows[i] = _RowState()
+                METRICS.inc("batcher.completed")
+
+    def run(self) -> dict[int, list[int]]:
+        """Drive until every submitted request has a result."""
+        # Publish any 1-token requests finished by admission alone.
+        while self.queue or bool(np.any(np.asarray(self.active))) or any(
+            r.rid is not None for r in self.rows
+        ):
+            self._admit_pending()
+            was_active = np.asarray(self.active)
+            if not was_active.any():
+                self._collect(
+                    np.zeros((self.b, 0), np.int32), was_active
+                )
+                if not self.queue and all(r.rid is None for r in self.rows):
+                    break
+                continue
+            toks, self.cache, self.last_tok, self.real_lens, self.valid, \
+                self.active, self.budget = decode_chunk(
+                    self.params, self.cfg, self.cache, self.last_tok,
+                    self.real_lens, self.valid, self.active, self.budget,
+                    self._split_rng(), self.chunk_steps,
+                    eos_id=self.eos_id, pad_id=self.pad_id, **self.sampling,
+                )
+            self._collect(np.asarray(toks), was_active)
+        return dict(self.results)

@@ -359,3 +359,32 @@ class InferenceEngine:
 
     def end_session(self, sid: str) -> None:
         self.sessions.drop(sid)
+
+    # -- continuous batching ------------------------------------------------
+
+    def continuous_batcher(
+        self, batch_slots: int = 8, max_len: int | None = None,
+        chunk_steps: int = 8,
+    ):
+        """A ContinuousBatcher over this engine's model: requests admit into
+        an in-flight decode batch as rows free up (runtime/batcher.py) —
+        no head-of-line blocking on mixed-length traffic.  Single-device
+        engines only (the mesh decode schedules manage their own batching).
+        """
+        if self.parallel is not None:
+            raise ValueError(
+                "continuous batching currently requires a single-device "
+                "engine (mesh_cfg=None)"
+            )
+        from .batcher import ContinuousBatcher
+
+        tok = self.tokenizer
+        return ContinuousBatcher(
+            self.cfg, self.params, tokenizer=tok,
+            batch_slots=batch_slots,
+            max_len=min(max_len or self.rt.max_seq_len, self.cfg.max_seq_len),
+            chunk_steps=chunk_steps,
+            temperature=self.rt.temperature, top_k=self.rt.top_k,
+            top_p=self.rt.top_p, eos_id=tok.eos_id, pad_id=tok.pad_id,
+            kv_dtype=self.rt.kv_cache_dtype,
+        )
