@@ -56,6 +56,32 @@ def _batch_axis(leaf_ndim: int) -> int:
     return leaf_ndim - 4
 
 
+def _finish_admission(
+    cache, slot, row_cache, logits, last_idx, rng, temperature, top_k, top_p,
+    total_len,
+):
+    """Shared admission tail (plain and prefix-cached paths): sample the
+    first token from the last real position's logits, splice the prefilled
+    row into the shared cache, report the row's valid slots."""
+    next_logits = jnp.take_along_axis(
+        logits, jnp.maximum(last_idx - 1, 0)[None, None, None], axis=1
+    )[:, 0]
+    tok = sampling.sample(rng, next_logits, temperature, top_k, top_p)[0]
+    ax = _batch_axis(cache.k.ndim)
+
+    def splice(full, row):
+        start = [0] * full.ndim
+        start[ax] = slot
+        return jax.lax.dynamic_update_slice(
+            full, row.astype(full.dtype), tuple(start)
+        )
+
+    cache = KVCache(k=splice(cache.k, row_cache.k), v=splice(cache.v, row_cache.v))
+    s = cache.k.shape[-3]
+    row_valid = jnp.arange(s, dtype=jnp.int32) < total_len
+    return cache, tok, row_valid
+
+
 @partial(
     jax.jit,
     static_argnames=("cfg", "temperature", "top_k", "top_p"),
@@ -86,21 +112,55 @@ def admit_row(
         params, cfg, prompt[None, :], positions=positions,
         cache=row_cache, cache_index=jnp.int32(0),
     )
-    next_logits = jnp.take_along_axis(
-        logits, jnp.maximum(plen - 1, 0)[None, None, None], axis=1
-    )[:, 0]
-    tok = sampling.sample(rng, next_logits, temperature, top_k, top_p)[0]
+    return _finish_admission(
+        cache, slot, row_cache, logits, plen, rng, temperature, top_k, top_p,
+        total_len=plen,
+    )
 
-    ax = _batch_axis(cache.k.ndim)
 
-    def splice(full, row):
-        start = [0] * full.ndim
-        start[ax] = slot
-        return jax.lax.dynamic_update_slice(full, row, tuple(start))
-
-    cache = KVCache(k=splice(cache.k, row_cache.k), v=splice(cache.v, row_cache.v))
-    row_valid = jnp.arange(s, dtype=jnp.int32) < plen
-    return cache, tok, row_valid
+@partial(
+    jax.jit,
+    static_argnames=("cfg", "temperature", "top_k", "top_p"),
+    donate_argnames=("cache",),
+)
+def admit_row_with_prefix(
+    params: Any,
+    cfg: ModelConfig,
+    cache: Any,  # shared KVCache
+    slot: jax.Array,  # scalar int32
+    prefix_k: jax.Array,  # [..., 1, S, KVH, HD] — a registered prefix's KV
+    prefix_v: jax.Array,
+    prefix_len: jax.Array,  # scalar int32
+    chunk: jax.Array,  # [Tc] int32 — the request's suffix, right-padded
+    clen: jax.Array,  # scalar int32 true suffix length
+    rng: jax.Array,
+    temperature: float = 0.0,
+    top_k: int = 0,
+    top_p: float = 1.0,
+) -> tuple[Any, jax.Array, jax.Array]:
+    """Prefix-cached admission: the shared prefix's KV (computed ONCE by
+    ``register_prefix``) seeds the row; only the request's suffix prefills —
+    session-style continuation math (runtime/session.py) for one row.
+    Returns (cache', first_token, row_valid)."""
+    (tc,) = chunk.shape
+    s = prefix_k.shape[-3]
+    slots = jnp.arange(s, dtype=jnp.int32)
+    row_cache = KVCache(k=prefix_k, v=prefix_v)
+    positions = (prefix_len + jnp.arange(tc, dtype=jnp.int32))[None, :]
+    rel = slots[None, :] - prefix_len  # [1, S]
+    chunk_causal = (rel[:, None, :] >= 0) & (
+        rel[:, None, :] <= jnp.arange(tc, dtype=jnp.int32)[None, :, None]
+    )  # [1, Tc, S]
+    prefix_valid = (slots < prefix_len)[None, :]  # [1, S]
+    mask = (prefix_valid[:, None, :] | chunk_causal)[:, None, :, :]  # [1,1,Tc,S]
+    logits, row_cache = model_lib.forward(
+        params, cfg, chunk[None, :], positions=positions,
+        cache=row_cache, cache_index=prefix_len, attn_mask=mask,
+    )
+    return _finish_admission(
+        cache, slot, row_cache, logits, clen, rng, temperature, top_k, top_p,
+        total_len=prefix_len + clen,
+    )
 
 
 @partial(
@@ -179,8 +239,16 @@ def _bucket(n: int, floor: int = 8) -> int:
 @dataclass
 class _Request:
     rid: int
-    ids: list[int]
+    ids: list[int]  # suffix ids when prefix is set, else the full prompt
     max_new_tokens: int
+    prefix: str | None = None
+
+
+@dataclass
+class _Prefix:
+    ids: list[int]
+    k: Any  # [..., 1, S, KVH, HD] single-row KV holding the prefix
+    v: Any
 
 
 @dataclass
@@ -247,25 +315,57 @@ class ContinuousBatcher:
         self.rows = [_RowState() for _ in range(batch_slots)]
         self.queue: deque[_Request] = deque()
         self.results: dict[int, list[int]] = {}
+        self.prefixes: dict[str, _Prefix] = {}
         self._rng = jax.random.key(seed)
         self._next_rid = 0
 
+    # -- prefix caching ------------------------------------------------------
+
+    def register_prefix(self, name: str, prefix: str | list[int]) -> None:
+        """Prefill a shared prefix (e.g. a system prompt) ONCE; requests
+        submitted with ``prefix=name`` reuse its KV instead of recomputing
+        it — admission then prefills only the request's suffix."""
+        ids = (
+            self.tokenizer.encode(prefix)
+            if isinstance(prefix, str)
+            else list(prefix)
+        )
+        if len(ids) >= self.s:
+            raise ValueError(
+                f"prefix ({len(ids)} tokens) does not fit slot capacity {self.s}"
+            )
+        row_cache = model_lib.init_cache(self.cfg, 1, self.s, dtype=self.cache.k.dtype)
+        positions = jnp.arange(len(ids), dtype=jnp.int32)[None, :]
+        _, row_cache = model_lib.forward(
+            self.params, self.cfg, jnp.asarray([ids], jnp.int32),
+            positions=positions, cache=row_cache, cache_index=jnp.int32(0),
+        )
+        self.prefixes[name] = _Prefix(ids, jax.block_until_ready(row_cache.k), row_cache.v)
+
     # -- submission --------------------------------------------------------
 
-    def submit(self, prompt: str | list[int], max_new_tokens: int = 32) -> int:
+    def submit(
+        self, prompt: str | list[int], max_new_tokens: int = 32,
+        prefix: str | None = None,
+    ) -> int:
         ids = (
             self.tokenizer.encode(prompt)
             if isinstance(prompt, str)
             else list(prompt)
         )
-        if len(ids) + max_new_tokens > self.s:
+        pfx_len = 0
+        if prefix is not None:
+            if prefix not in self.prefixes:
+                raise KeyError(f"unknown prefix {prefix!r} (register_prefix first)")
+            pfx_len = len(self.prefixes[prefix].ids)
+        if pfx_len + len(ids) + max_new_tokens > self.s:
             raise ValueError(
-                f"prompt ({len(ids)} tokens) + {max_new_tokens} new exceeds "
-                f"slot capacity {self.s}"
+                f"prompt ({pfx_len}+{len(ids)} tokens) + {max_new_tokens} new "
+                f"exceeds slot capacity {self.s}"
             )
         rid = self._next_rid
         self._next_rid += 1
-        self.queue.append(_Request(rid, ids, max_new_tokens))
+        self.queue.append(_Request(rid, ids, max_new_tokens, prefix=prefix))
         return rid
 
     # -- scheduling loop ---------------------------------------------------
@@ -282,18 +382,32 @@ class ContinuousBatcher:
             if active_host[i]:
                 continue
             req = self.queue.popleft()
-            # Bucket for compile reuse, but never past the slot capacity
-            # (submit() already guaranteed the real prompt fits).
-            tp = min(_bucket(len(req.ids)), self.s)
+            pfx = self.prefixes[req.prefix] if req.prefix is not None else None
+            pfx_len = len(pfx.ids) if pfx else 0
+            # Bucket for compile reuse, but never past what fits after the
+            # prefix: forward's contract is cache_index + T <= max_len, and
+            # dynamic_update_slice CLAMPS an overflowing start — the suffix
+            # K/V would land misaligned with its mask/positions, silently
+            # corrupting the row.  (submit() guaranteed the real prompt fits.)
+            tp = min(_bucket(len(req.ids)), self.s - pfx_len)
             prompt = np.full((tp,), self.pad_id, np.int32)
             prompt[: len(req.ids)] = req.ids
-            self.cache, tok, row_valid = admit_row(
-                self.params, self.cfg, self.cache, jnp.int32(i),
-                jnp.asarray(prompt), jnp.int32(len(req.ids)),
-                self._split_rng(), **self.sampling,
-            )
+            if pfx is not None:
+                self.cache, tok, row_valid = admit_row_with_prefix(
+                    self.params, self.cfg, self.cache, jnp.int32(i),
+                    pfx.k, pfx.v, jnp.int32(pfx_len),
+                    jnp.asarray(prompt), jnp.int32(len(req.ids)),
+                    self._split_rng(), **self.sampling,
+                )
+            else:
+                self.cache, tok, row_valid = admit_row(
+                    self.params, self.cfg, self.cache, jnp.int32(i),
+                    jnp.asarray(prompt), jnp.int32(len(req.ids)),
+                    self._split_rng(), **self.sampling,
+                )
+            total_len = pfx_len + len(req.ids)
             self.last_tok = self.last_tok.at[i].set(tok)
-            self.real_lens = self.real_lens.at[i].set(len(req.ids))
+            self.real_lens = self.real_lens.at[i].set(total_len)
             self.valid = self.valid.at[i].set(row_valid)
             self.active = self.active.at[i].set(True)
             # The first token came out of admission; the row may emit
@@ -306,7 +420,6 @@ class ContinuousBatcher:
             log.debug("admitted request %d into slot %d", req.rid, i)
             if req.max_new_tokens == 1 or int(tok) == self.eos_id:
                 self.active = self.active.at[i].set(False)
-            active_host = np.asarray(self.active)
             METRICS.inc("batcher.admitted")
 
     def _collect(self, toks: np.ndarray, was_active: np.ndarray) -> None:

@@ -129,6 +129,64 @@ def test_submit_rejects_oversized(tiny):
         b.submit(list(range(10)), max_new_tokens=10)
 
 
+def test_quantized_params_match_quantized_solo(tiny):
+    """Continuous batching over weight-only quantized params: per-request
+    tokens equal the solo run with the same quantized tree."""
+    from distributed_llms_tpu.checkpoint import quantize as quant_lib
+
+    cfg, params = tiny
+    qparams = {**params, "blocks": quant_lib.quantize_tree(params["blocks"], bits=8)}
+    b = ContinuousBatcher(cfg, qparams, batch_slots=2, max_len=64, chunk_steps=4)
+    r1 = b.submit([7, 1, 9], max_new_tokens=6)
+    r2 = b.submit([4, 4, 4, 4, 4], max_new_tokens=9)
+    res = b.run()
+    assert res[r1] == solo(cfg, qparams, [7, 1, 9], 6)
+    assert res[r2] == solo(cfg, qparams, [4, 4, 4, 4, 4], 9)
+
+
+def test_prefix_cached_requests_match_concatenated_solo(tiny):
+    """Prefix caching: requests sharing a registered prefix must produce
+    exactly the tokens of a solo run on prefix+suffix — the prefix KV is
+    computed once and reused, never recomputed per request."""
+    cfg, params = tiny
+    prefix = [50, 51, 52, 53, 54, 55, 56]
+    b = ContinuousBatcher(cfg, params, batch_slots=2, max_len=64, chunk_steps=4)
+    b.register_prefix("sys", prefix)
+    suffixes = [[7, 1, 9], [4, 4], [9, 8, 7, 6]]
+    rids = [b.submit(sfx, max_new_tokens=6, prefix="sys") for sfx in suffixes]
+    plain = b.submit([3, 3, 3], max_new_tokens=5)  # no prefix, same batch
+    res = b.run()
+    for rid, sfx in zip(rids, suffixes):
+        assert res[rid] == solo(cfg, params, prefix + sfx, 6), f"suffix {sfx}"
+    assert res[plain] == solo(cfg, params, [3, 3, 3], 5)
+
+
+def test_long_prefix_short_suffix_bucket_does_not_overflow(tiny):
+    """A long prefix leaves less room than the suffix's bucket size: the
+    admission must clamp the bucket (forward's cache_index+T contract), not
+    silently clamp the cache write and corrupt the row."""
+    cfg, params = tiny
+    prefix = list(range(100, 150))  # 50 tokens in a 64-slot cache
+    b = ContinuousBatcher(cfg, params, batch_slots=1, max_len=64, chunk_steps=4)
+    b.register_prefix("long", prefix)
+    sfx = [7, 1, 9, 4, 2, 8, 6, 5, 3, 11]  # 10 tokens; bucket(10)=16 > 64-50
+    rid = b.submit(sfx, max_new_tokens=4, prefix="long")
+    res = b.run()
+    assert res[rid] == solo(cfg, params, prefix + sfx, 4)
+
+
+def test_prefix_errors(tiny):
+    cfg, params = tiny
+    b = ContinuousBatcher(cfg, params, batch_slots=1, max_len=32)
+    with pytest.raises(KeyError, match="unknown prefix"):
+        b.submit([1, 2], prefix="nope")
+    with pytest.raises(ValueError, match="does not fit"):
+        b.register_prefix("big", list(range(40)))
+    b.register_prefix("sys", [5, 6, 7])
+    with pytest.raises(ValueError, match="exceeds"):
+        b.submit(list(range(20)), max_new_tokens=20, prefix="sys")
+
+
 def test_engine_integration(tiny):
     """engine.continuous_batcher wires tokenizer + sampling config; text
     prompts round-trip through the byte tokenizer."""
