@@ -468,15 +468,61 @@ class Coordinator:
         multi-process SPMD runtime, a single-worker dispatch would hang
         inside the first cross-process collective — route to generate_spmd.
         """
-        if any(
-            w.capabilities.get("process_count", 1) > 1
-            for w in self.workers.values()
-        ):
+        if self._spmd_pool():
             return await self.generate_spmd(prompts, max_new_tokens, timeout)
         return await self.submit(
             "GENERATE", {"prompts": prompts, "max_new_tokens": max_new_tokens},
             timeout=timeout,
         )
+
+    def _spmd_pool(self) -> bool:
+        """True when registered workers are controllers of one multi-process
+        SPMD runtime (single-worker dispatch would hang in a collective)."""
+        return any(
+            w.capabilities.get("process_count", 1) > 1
+            for w in self.workers.values()
+        )
+
+    async def generate_requests(
+        self, requests: list[dict], timeout: float | None = None,
+    ) -> Any:
+        """Mixed-budget generation: each request is {"prompt": str,
+        "max_new_tokens": int}.  A single-device worker serves them with
+        continuous batching (runtime/batcher.py) — per-request budgets, no
+        head-of-line blocking.  On a multi-process SPMD pool the task is
+        broadcast (like generate_spmd); those workers serve the grouped
+        fallback in lockstep."""
+        payload = {"requests": requests}
+        if self._spmd_pool():
+            return await self._submit_spmd(payload, timeout)
+        return await self.submit("GENERATE", payload, timeout=timeout)
+
+    async def _submit_spmd(self, payload: dict, timeout: float | None) -> Any:
+        wids = list(self.workers)
+        unplaced = [w for w in wids if not self.workers[w].shards]
+        if unplaced:
+            raise RuntimeError(
+                f"SPMD generate needs every worker placed; missing engine on "
+                f"{unplaced} (run place_shards first)"
+            )
+        results = await asyncio.gather(
+            *(
+                self.submit("GENERATE", payload, worker_id=w, timeout=timeout)
+                for w in wids
+            ),
+            return_exceptions=True,
+        )
+        errors = {
+            w: r for w, r in zip(wids, results) if isinstance(r, BaseException)
+        }
+        if errors:
+            raise RuntimeError(f"SPMD generate failed on {errors}")
+        texts = {tuple(r["text"]) for r in results}
+        if len(texts) != 1:
+            raise RuntimeError(
+                f"SPMD generate disagreement across {len(wids)} workers: {texts}"
+            )
+        return results[0]
 
     async def generate_spmd(
         self, prompts: list[str], max_new_tokens: int | None = None,
@@ -495,40 +541,11 @@ class Coordinator:
         unrelated partial on its own shard and no cross-worker reduction
         existed (defect D9 returned the first partial).
         """
-        wids = list(self.workers)
-        if not wids:
+        if not self.workers:
             raise RuntimeError("no workers registered")
-        # Pre-flight: a worker without a placed engine would reply ERROR
-        # instantly while its peers block inside the first collective waiting
-        # for it — wedging the pool.  Fail fast instead.
-        unplaced = [w for w in wids if not self.workers[w].shards]
-        if unplaced:
-            raise RuntimeError(
-                f"SPMD generate needs every worker placed; missing engine on "
-                f"{unplaced} (run place_shards first)"
-            )
-        results = await asyncio.gather(
-            *(
-                self.submit(
-                    "GENERATE",
-                    {"prompts": prompts, "max_new_tokens": max_new_tokens},
-                    worker_id=w, timeout=timeout,
-                )
-                for w in wids
-            ),
-            return_exceptions=True,
+        return await self._submit_spmd(
+            {"prompts": prompts, "max_new_tokens": max_new_tokens}, timeout
         )
-        errors = {
-            w: r for w, r in zip(wids, results) if isinstance(r, BaseException)
-        }
-        if errors:
-            raise RuntimeError(f"SPMD generate failed on {errors}")
-        texts = {tuple(r["text"]) for r in results}
-        if len(texts) != 1:
-            raise RuntimeError(
-                f"SPMD generate disagreement across {len(wids)} workers: {texts}"
-            )
-        return results[0]
 
     async def _dispatch_loop(self) -> None:
         while True:

@@ -197,6 +197,10 @@ class WorkerHost:
         if mtype in ("GENERATE", "SCHEDULE_COMPUTATION"):
             if self.engine is None:
                 raise RuntimeError("no model placed (PLACE_SHARDS first)")
+            if "requests" in payload:
+                return await asyncio.to_thread(
+                    self._generate_requests, payload["requests"]
+                )
             prompts = payload["prompts"]
             res = await asyncio.to_thread(
                 self.engine.generate_text, prompts, payload.get("max_new_tokens")
@@ -211,3 +215,43 @@ class WorkerHost:
             self.stop()
             return {"ok": True}
         raise protocol.ProtocolError(f"unhandled command {mtype}")
+
+    def _generate_requests(self, requests: list[dict]) -> dict:
+        """Mixed-budget batch (GENERATE with a ``requests`` list): served via
+        continuous batching on a single-device engine — per-request budgets,
+        short replies don't wait for long ones.  Mesh engines (whose decode
+        schedules manage their own batching) serve the requests as one
+        grouped batch at the longest budget instead."""
+        import time as _time
+
+        t0 = _time.perf_counter()
+        prompts = [r["prompt"] for r in requests]
+        budgets = [int(r.get("max_new_tokens", 32)) for r in requests]
+        if getattr(self.engine, "parallel", None) is None and hasattr(
+            self.engine, "continuous_batcher"
+        ):
+            batcher = self.engine.continuous_batcher()
+            rids = [
+                batcher.submit(p, max_new_tokens=n)
+                for p, n in zip(prompts, budgets)
+            ]
+            results = batcher.run()
+            tok = self.engine.tokenizer
+            texts = [tok.decode(results[r]) for r in rids]
+            n_gen = sum(len(results[r]) for r in rids)
+        else:
+            res = self.engine.generate_text(prompts, max(budgets))
+            # Grouped fallback decodes max(budgets) for every row; honor each
+            # request's own budget by truncating its token row before decode.
+            tok = self.engine.tokenizer
+            texts = [
+                tok.decode(row[:n]) for row, n in zip(res.tokens, budgets)
+            ]
+            n_gen = sum(min(len(row), n) for row, n in zip(res.tokens, budgets))
+        dt = _time.perf_counter() - t0
+        return {
+            "text": texts,
+            "generated_tokens": n_gen,
+            "seconds": dt,
+            "tokens_per_second": n_gen / max(dt, 1e-9),
+        }

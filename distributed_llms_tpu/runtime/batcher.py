@@ -147,12 +147,10 @@ def admit_row_with_prefix(
     slots = jnp.arange(s, dtype=jnp.int32)
     row_cache = KVCache(k=prefix_k, v=prefix_v)
     positions = (prefix_len + jnp.arange(tc, dtype=jnp.int32))[None, :]
-    rel = slots[None, :] - prefix_len  # [1, S]
-    chunk_causal = (rel[:, None, :] >= 0) & (
-        rel[:, None, :] <= jnp.arange(tc, dtype=jnp.int32)[None, :, None]
-    )  # [1, Tc, S]
+    from .session import continuation_mask
+
     prefix_valid = (slots < prefix_len)[None, :]  # [1, S]
-    mask = (prefix_valid[:, None, :] | chunk_causal)[:, None, :, :]  # [1,1,Tc,S]
+    mask = continuation_mask(prefix_valid, prefix_len, tc, slots)  # [1,1,Tc,S]
     logits, row_cache = model_lib.forward(
         params, cfg, chunk[None, :], positions=positions,
         cache=row_cache, cache_index=prefix_len, attn_mask=mask,
@@ -357,6 +355,12 @@ class ContinuousBatcher:
         if prefix is not None:
             if prefix not in self.prefixes:
                 raise KeyError(f"unknown prefix {prefix!r} (register_prefix first)")
+            if not ids:
+                # register_prefix discards the prefix's last-position logits,
+                # so an empty suffix would sample from a pad token's output.
+                raise ValueError(
+                    "prefix-cached requests need a non-empty suffix"
+                )
             pfx_len = len(self.prefixes[prefix].ids)
         if pfx_len + len(ids) + max_new_tokens > self.s:
             raise ValueError(

@@ -33,6 +33,24 @@ from . import sampling
 log = get_logger("session")
 
 
+def continuation_mask(
+    valid_mask: jax.Array,  # [B, S] (or [1, S]) prior-content slots
+    base: jax.Array,  # scalar int32 — first padded slot of the new chunk
+    t: int,  # chunk length (padded)
+    slots: jax.Array,  # [S] = arange(S)
+) -> jax.Array:
+    """[B, 1, T, S] attention mask for prefilling a chunk at slots
+    [base, base+t) against existing cache content: query i attends prior
+    valid slots plus chunk slots j <= i (right padding means pad slots have
+    j greater than every real query's i).  Shared by session continuation
+    and the continuous batcher's prefix-cached admission."""
+    rel = slots[None, :] - base  # [1, S]: slot index within the chunk
+    chunk_causal = (rel[:, None, :] >= 0) & (
+        rel[:, None, :] <= jnp.arange(t, dtype=jnp.int32)[None, :, None]
+    )  # [1, T, S]
+    return (valid_mask[:, None, :] | chunk_causal)[:, None, :, :]
+
+
 @partial(
     jax.jit,
     static_argnames=(
@@ -80,13 +98,7 @@ def session_step(
 
     # --- chunk prefill at padded slots [base, base+t)
     positions = real_lens[:, None] + jnp.arange(t, dtype=jnp.int32)[None, :]
-    rel = slots[None, :] - base  # [1, S]: slot index within the chunk
-    # query i attends: prior-turn slots, plus chunk slots j <= i (right
-    # padding means pad slots have j > every real query's i).
-    chunk_causal = (rel[:, None, :] >= 0) & (
-        rel[:, None, :] <= jnp.arange(t, dtype=jnp.int32)[None, :, None]
-    )  # [1, T, S]
-    mask = (valid_mask[:, None, :] | chunk_causal)[:, None, :, :]  # [B,1,T,S]
+    mask = continuation_mask(valid_mask, base, t, slots)  # [B,1,T,S]
     logits, cache = forward_fn(
         params, cfg, chunk, positions=positions, cache=cache,
         cache_index=base, attn_mask=mask,
@@ -95,6 +107,7 @@ def session_step(
     next_logits = jnp.take_along_axis(logits, last_idx[:, None, None], axis=1)[:, 0]
 
     # slots valid after the chunk: prior turns + this chunk's real tokens
+    rel = slots[None, :] - base  # [1, S]: slot index within the chunk
     chunk_valid = (rel >= 0) & (rel < chunk_lens[:, None])  # [B, S]
     valid_after_chunk = valid_mask | chunk_valid
     real_after_chunk = real_lens + chunk_lens

@@ -109,6 +109,48 @@ def test_explicit_tokenizer_arg_still_wins(tmp_path):
 
 
 @pytest.mark.asyncio
+async def test_cluster_mixed_budget_requests_via_continuous_batching(tmp_path):
+    """coordinator.generate_requests: per-request budgets served through the
+    worker's continuous batcher; each text equals the single-device engine
+    generating that request alone."""
+    store_dir = make_store(tmp_path, with_tokenizer=True)
+    rt = RuntimeConfig(max_decode_steps=8)
+    ccfg = ClusterConfig(
+        coordinator_host="127.0.0.1", coordinator_port=0,
+        heartbeat_interval_s=0.2, heartbeat_timeout_s=60.0, task_timeout_s=120.0,
+    )
+    coord = Coordinator(ccfg)
+    await coord.start()
+    wt = None
+    try:
+        w = WorkerHost("127.0.0.1", coord.port, cfg=ccfg, rt=rt)
+        wt = asyncio.create_task(w.run())
+        for _ in range(100):
+            if w.worker_id is not None:
+                break
+            await asyncio.sleep(0.02)
+        coord.plan_shards(1, store_dir=store_dir)
+        await coord.place_shards()
+
+        reqs = [
+            {"prompt": "hello world", "max_new_tokens": 3},
+            {"prompt": "foo bar", "max_new_tokens": 7},
+            {"prompt": "hello", "max_new_tokens": 5},
+        ]
+        out = await coord.generate_requests(reqs)
+        ref_eng = InferenceEngine.from_store(store_dir, rt=rt)
+        for got, req in zip(out["text"], reqs):
+            expect = ref_eng.generate_text(
+                [req["prompt"]], max_new_tokens=req["max_new_tokens"]
+            )
+            assert got == expect.text[0], req
+    finally:
+        if wt is not None:
+            wt.cancel()
+        await coord.stop()
+
+
+@pytest.mark.asyncio
 async def test_cluster_path_decodes_real_words(tmp_path):
     """coordinator -> WorkerHost (default engine factory) -> generated text
     decoded with the store's real tokenizer, matching the single-device
