@@ -56,7 +56,7 @@ def init_store(model_id: str, num_shards: int, cfg: Config) -> str:
 
 async def repl(coord: Coordinator, cfg: Config) -> None:
     print("commands: init <model> [shards] | assign [shards] [policy] | "
-          "distribute | rebalance | inference | status | metrics | exit")
+          "distribute | rebalance | inference | batch | status | metrics | exit")
     store_dir: str | None = None
     while True:
         try:
@@ -90,6 +90,31 @@ async def repl(coord: Coordinator, cfg: Config) -> None:
                 print(out["text"][0])
                 print(f"[{out['generated_tokens']} tokens, "
                       f"{out['tokens_per_second']:.1f} tok/s]")
+            elif cmd == "batch":
+                # Mixed-budget batch: N lines of "<max_new_tokens> <prompt>",
+                # blank line ends; served via continuous batching.
+                print("one request per line: <max_new_tokens> <prompt>; "
+                      "blank line runs the batch")
+                reqs = []
+                while True:
+                    line2 = (await _ainput("req: ")).strip()
+                    if not line2:
+                        break
+                    n_str, _, ptext = line2.partition(" ")
+                    try:
+                        n_new = int(n_str)
+                    except ValueError:
+                        # Don't let one malformed line discard the batch.
+                        print(f"expected '<max_new_tokens> <prompt>', got "
+                              f"{line2!r}; line skipped")
+                        continue
+                    reqs.append({"prompt": ptext, "max_new_tokens": n_new})
+                if reqs:
+                    out = await coord.generate_requests(reqs)
+                    for i, t in enumerate(out["text"]):
+                        print(f"[{i}] {t}")
+                    print(f"[{out['generated_tokens']} tokens, "
+                          f"{out['tokens_per_second']:.1f} tok/s]")
             elif cmd == "status":
                 print(json.dumps(coord.status(), indent=1))
             elif cmd == "metrics":
