@@ -104,9 +104,11 @@ async def repl(coord: Coordinator, cfg: Config) -> None:
                     try:
                         n_new = int(n_str)
                     except ValueError:
+                        n_new = 0
+                    if n_new < 1 or not ptext.strip():
                         # Don't let one malformed line discard the batch.
-                        print(f"expected '<max_new_tokens> <prompt>', got "
-                              f"{line2!r}; line skipped")
+                        print(f"expected '<max_new_tokens> <prompt>' with a "
+                              f"positive budget, got {line2!r}; line skipped")
                         continue
                     reqs.append({"prompt": ptext, "max_new_tokens": n_new})
                 if reqs:

@@ -351,6 +351,11 @@ class ContinuousBatcher:
             if isinstance(prompt, str)
             else list(prompt)
         )
+        if not ids:
+            # admit_row would sample the "first token" from a pad position.
+            raise ValueError("empty prompt")
+        if max_new_tokens < 1:
+            raise ValueError(f"max_new_tokens must be >= 1, got {max_new_tokens}")
         pfx_len = 0
         if prefix is not None:
             if prefix not in self.prefixes:

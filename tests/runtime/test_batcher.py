@@ -122,11 +122,39 @@ def test_late_submission_joins_inflight_batch(tiny):
     assert res[r3] == solo(cfg, params, [9, 9, 1], 6)
 
 
+@pytest.mark.parametrize("seed", [0, 1])
+def test_random_workload_property(tiny, seed):
+    """Seeded stress: random prompt lengths, budgets, slot counts, and
+    chunk sizes — every request must still match its solo run exactly."""
+    rng = np.random.RandomState(seed)
+    cfg, params = tiny
+    slots = int(rng.randint(1, 5))
+    chunk = int(rng.randint(2, 7))
+    b = ContinuousBatcher(
+        cfg, params, batch_slots=slots, max_len=64, chunk_steps=chunk
+    )
+    reqs = []
+    for _ in range(int(rng.randint(3, 9))):
+        n_prompt = int(rng.randint(1, 20))
+        ids = rng.randint(1, 500, size=n_prompt).tolist()
+        budget = int(rng.randint(1, 64 - n_prompt))
+        reqs.append((b.submit(ids, max_new_tokens=budget), ids, budget))
+    res = b.run()
+    for rid, ids, budget in reqs:
+        assert res[rid] == solo(cfg, params, ids, budget), (
+            f"seed={seed} slots={slots} chunk={chunk} ids={ids} budget={budget}"
+        )
+
+
 def test_submit_rejects_oversized(tiny):
     cfg, params = tiny
     b = ContinuousBatcher(cfg, params, batch_slots=1, max_len=16)
     with pytest.raises(ValueError, match="exceeds"):
         b.submit(list(range(10)), max_new_tokens=10)
+    with pytest.raises(ValueError, match="empty prompt"):
+        b.submit([], max_new_tokens=4)
+    with pytest.raises(ValueError, match="max_new_tokens"):
+        b.submit([1, 2], max_new_tokens=0)
 
 
 def test_quantized_params_match_quantized_solo(tiny):
