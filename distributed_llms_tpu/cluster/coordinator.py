@@ -492,6 +492,17 @@ class Coordinator:
         head-of-line blocking.  On a multi-process SPMD pool the task is
         broadcast (like generate_spmd); those workers serve the grouped
         fallback in lockstep."""
+        # Validate before dispatch so single-device (batcher) and mesh
+        # (grouped) workers see only well-formed batches — the two engines
+        # would otherwise diverge on how a bad request degrades.
+        for i, r in enumerate(requests):
+            if not str(r.get("prompt", "")):
+                raise ValueError(f"request {i}: empty prompt")
+            if int(r.get("max_new_tokens", 32)) < 1:
+                raise ValueError(
+                    f"request {i}: max_new_tokens must be >= 1, got "
+                    f"{r.get('max_new_tokens')}"
+                )
         payload = {"requests": requests}
         if self._spmd_pool():
             return await self._submit_spmd(payload, timeout)

@@ -360,12 +360,6 @@ class ContinuousBatcher:
         if prefix is not None:
             if prefix not in self.prefixes:
                 raise KeyError(f"unknown prefix {prefix!r} (register_prefix first)")
-            if not ids:
-                # register_prefix discards the prefix's last-position logits,
-                # so an empty suffix would sample from a pad token's output.
-                raise ValueError(
-                    "prefix-cached requests need a non-empty suffix"
-                )
             pfx_len = len(self.prefixes[prefix].ids)
         if pfx_len + len(ids) + max_new_tokens > self.s:
             raise ValueError(

@@ -61,6 +61,29 @@ def test_engine_serves_quantized_store(tmp_path, quantization):
     assert out.text == out_ref.text
 
 
+@pytest.mark.parametrize("quantization", ["int8", "int4"])
+def test_sessions_over_quantized_weights(tmp_path, quantization):
+    """Multi-turn sessions with quantized-resident block weights: both the
+    first turn and a continuation must match the engine serving the same
+    store dequantized (identical q*scale math, dequant-at-use vs at-load)."""
+    cfg = presets.get_preset("llama-tiny", vocab_size=512)
+    params = model_lib.init_params(jax.random.key(0), cfg)
+    store_lib.save_shards(
+        params, str(tmp_path), num_shards=1, model_config=cfg,
+        quantization=quantization, quant_block=32,
+    )
+    rt_q = RuntimeConfig(max_decode_steps=5, serve_quantized=True, max_seq_len=64)
+    rt_d = RuntimeConfig(max_decode_steps=5, max_seq_len=64)
+    eng_q = InferenceEngine.from_store(str(tmp_path), rt=rt_q)
+    eng_d = InferenceEngine.from_store(str(tmp_path), rt=rt_d)
+    sid_q, first_q = eng_q.start_session(["hello world"])
+    sid_d, first_d = eng_d.start_session(["hello world"])
+    assert first_q.tokens.tolist() == first_d.tokens.tolist()
+    more_q = eng_q.continue_session(sid_q, [" again"])
+    more_d = eng_d.continue_session(sid_d, [" again"])
+    assert more_q.tokens.tolist() == more_d.tokens.tolist()
+
+
 def test_serve_quantized_requires_quantized_store(tmp_path):
     cfg = presets.get_preset("llama-tiny", vocab_size=512)
     params = model_lib.init_params(jax.random.key(0), cfg)
