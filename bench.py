@@ -91,6 +91,67 @@ FALLBACK = {"preset": "gpt2-125m", "batch": 8, "prompt": 64, "new": 64,
             "quant": None}
 
 
+def _cpu_fallback_line(args) -> dict:
+    """Measure the fallback config on CPU in a fresh subprocess (this
+    process's JAX backend is pinned to the wedged accelerator).  The child
+    pins CPU explicitly (--force-cpu) so a half-alive tunnel cannot lure it
+    back onto the TPU, and never arms its own watchdog."""
+    try:
+        r = subprocess.run(
+            [sys.executable, __file__, "--force-cpu", "--iters",
+             str(args.iters), "--measure-timeout", "0"],
+            capture_output=True, text=True, timeout=1800,
+        )
+        lines = r.stdout.strip().splitlines()
+    except subprocess.TimeoutExpired:
+        lines = []
+    for line in reversed(lines):
+        try:
+            out = json.loads(line)
+            out["degraded"] = (
+                "accelerator hung mid-measurement; cpu fallback via subprocess"
+            )
+            return out
+        except json.JSONDecodeError:
+            continue
+    return {
+        "metric": "decode tokens/sec", "value": 0.0, "unit": "tok/s",
+        "vs_baseline": 0.0,
+        "degraded": "accelerator hung mid-measurement; fallback failed too",
+    }
+
+
+def _arm_watchdog(seconds: float, args):
+    """Watchdog THREAD (not SIGALRM — a signal handler can only run when the
+    main thread re-enters Python bytecode, which never happens while it is
+    wedged inside the axon plugin's C++ RPC wait): if the measurement has
+    not finished after ``seconds``, print the CPU-fallback JSON line and
+    hard-exit so the driver always captures one line.  Returns an Event to
+    set on completion; seconds<=0 disables."""
+    import os
+    import threading
+
+    done = threading.Event()
+    if seconds <= 0:
+        return done
+
+    def fire():
+        if not done.wait(seconds):
+            try:
+                out = _cpu_fallback_line(args)
+            except Exception as exc:  # never die silently
+                out = {
+                    "metric": "decode tokens/sec", "value": 0.0,
+                    "unit": "tok/s", "vs_baseline": 0.0,
+                    "degraded": f"measurement hung; fallback crashed: {exc}",
+                }
+            print(json.dumps(out), flush=True)
+            os._exit(0)
+
+    threading.Thread(target=fire, daemon=True).start()
+    return done
+
+
 def _probe_accelerator(timeout_s: float) -> str | None:
     """Check in a subprocess (hard-killed on timeout) whether the default JAX
     backend initializes.  The axon TPU plugin, when its tunnel is down, blocks
@@ -665,13 +726,31 @@ def main() -> None:
     ap.add_argument("--iters", type=int, default=3)
     ap.add_argument("--probe-timeout", type=float, default=150.0)
     ap.add_argument("--probe-attempts", type=int, default=4)
+    ap.add_argument("--measure-timeout", type=float, default=2700.0,
+                    help="watchdog deadline for accelerator measurements; a "
+                         "mid-measurement tunnel hang prints a CPU-subprocess "
+                         "fallback line and exits instead of capturing "
+                         "nothing (0 = off)")
+    ap.add_argument("--force-cpu", action="store_true",
+                    help="pin the CPU backend before init (watchdog child)")
     ap.add_argument("--ladder", action="store_true",
                     help="measure all BASELINE ladder configs that fit")
     ap.add_argument("--out", default="BENCH_LADDER.json",
                     help="ladder results file (with --ladder)")
     args = ap.parse_args()
 
-    degraded = _init_backend(args.probe_timeout, args.probe_attempts)
+    if args.force_cpu:
+        # Child-process mode for the mid-measurement watchdog: pin CPU
+        # before any backend init (the axon plugin ignores JAX_PLATFORMS).
+        jax.config.update("jax_platforms", "cpu")
+        degraded = "accelerator-unavailable; measured on cpu fallback"
+    else:
+        degraded = _init_backend(args.probe_timeout, args.probe_attempts)
+    # Arm the hang watchdog only when measuring on a (possibly flaky)
+    # accelerator — it covers BOTH default and --ladder modes.
+    watchdog_done = _arm_watchdog(
+        args.measure_timeout if degraded is None else 0, args
+    )
     if degraded is not None:
         # CPU can't hold bf16 numerics through XLA's collective passes and is
         # slower in bf16 anyway; measure the fallback in f32.
@@ -741,6 +820,7 @@ def main() -> None:
                 result[extra] = head[extra]
         if degraded is not None:
             result["degraded"] = degraded
+    watchdog_done.set()
     print(json.dumps(result))
 
 

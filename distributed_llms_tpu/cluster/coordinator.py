@@ -496,12 +496,16 @@ class Coordinator:
         # (grouped) workers see only well-formed batches — the two engines
         # would otherwise diverge on how a bad request degrades.
         for i, r in enumerate(requests):
-            if not str(r.get("prompt", "")):
-                raise ValueError(f"request {i}: empty prompt")
-            if int(r.get("max_new_tokens", 32)) < 1:
+            prompt = r.get("prompt")
+            if not isinstance(prompt, str) or not prompt:
                 raise ValueError(
-                    f"request {i}: max_new_tokens must be >= 1, got "
-                    f"{r.get('max_new_tokens')}"
+                    f"request {i}: prompt must be a non-empty string, got "
+                    f"{prompt!r}"
+                )
+            n = r.get("max_new_tokens", 32)
+            if not isinstance(n, int) or n < 1:
+                raise ValueError(
+                    f"request {i}: max_new_tokens must be an int >= 1, got {n!r}"
                 )
         payload = {"requests": requests}
         if self._spmd_pool():
