@@ -32,11 +32,13 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-# Trace-time guard: pallas_call has no SPMD partitioning rule, so under a
-# GSPMD-partitioned jit (tensor-parallel serving) the kernel would force XLA
-# to all-gather the full weight — defeating quantized residency.  The
-# dequantize+einsum path partitions cleanly; ParallelModel wraps its GSPMD
-# forward in spmd_fallback().
+# Trace-time marker: "this contraction is being traced under a
+# GSPMD-partitioned jit" (tensor-parallel serving).  A plain pallas_call has
+# no SPMD partitioning rule there — XLA would all-gather the full weight,
+# defeating quantized residency — so quant_contract either takes the
+# custom_partitioning wrapper (_qmm_spmd, the default when the kernel would
+# run) or the dequantize+einsum fallback (DLT_QUANT_MATMUL_SPMD=0, or
+# non-TPU).  ParallelModel wraps its GSPMD forward in spmd_fallback().
 _SPMD_FALLBACK = contextvars.ContextVar("dlt_quant_spmd_fallback", default=False)
 
 
@@ -242,20 +244,23 @@ def _spec_tuple(info, rank: int) -> tuple:
 
 @functools.lru_cache(maxsize=None)
 def _qmm_spmd(bits: int, interpret: bool):
-    """SPMD-partitionable fused quant matmul (opt-in via
-    DLT_QUANT_MATMUL_SPMD=1).  pallas_call has no built-in SPMD partitioning
-    rule; this wrapper supplies one via jax.experimental.custom_partitioning:
-    each shard runs the kernel on its local tiles (N-sharded weights run
-    embarrassingly parallel; K-sharded weights — wo under tensor parallelism
-    — compute partial products and psum over the contracted mesh axes).
+    """SPMD-partitionable fused quant matmul (default under GSPMD whenever
+    the kernel would run; DLT_QUANT_MATMUL_SPMD=0 disables).  pallas_call
+    has no built-in SPMD partitioning rule; this wrapper supplies one via
+    jax.experimental.custom_partitioning: each shard runs the kernel on its
+    local tiles (N-sharded weights run embarrassingly parallel; K-sharded
+    weights — wo under tensor parallelism — compute partial products and
+    psum over the contracted mesh axes).
 
-    Known limitation: custom_partitioning inside ``lax.scan`` fails in
-    JAX's op_sharding unflattening (superdim KeyError) — the stacked-layer
-    block scan therefore cannot use this path yet, which is why the GSPMD
-    serving forward defaults to the dequantize+einsum fallback.  The wrapper
-    is correct (and tested, tests/parallel/test_quantized_mesh.py::
-    test_spmd_kernel_wrapper_partitions) for contractions traced outside a
-    scan."""
+    History: earlier JAX releases failed on custom_partitioning inside
+    ``lax.scan`` (op_sharding superdim KeyError), which forced round 3's
+    GSPMD serving onto the dequantize+einsum fallback.  The JAX in this
+    image compiles the wrapper under a scan both with scan-invariant
+    weights and with the stacked weights scanned as xs (pinned by
+    tests/parallel/test_quantized_mesh.py::
+    test_spmd_kernel_wrapper_under_scan), so GSPMD quantized serving now
+    takes the kernel by default; DLT_QUANT_MATMUL_SPMD=0 is the
+    kill-switch if real-TPU Mosaic lowering disagrees."""
     from jax.experimental.custom_partitioning import custom_partitioning
 
     @custom_partitioning
@@ -365,11 +370,27 @@ def quant_contract(
 
     mode = _kernel_mode()
     in_gspmd = _SPMD_FALLBACK.get()
-    use_spmd_kernel = (
-        in_gspmd and os.environ.get("DLT_QUANT_MATMUL_SPMD", "0") == "1"
+    spmd_env = os.environ.get("DLT_QUANT_MATMUL_SPMD", "auto")
+    # Under a GSPMD trace the kernel needs its custom_partitioning wrapper
+    # (plain pallas_call has no SPMD rule; XLA would all-gather the weight).
+    # Default ("auto"): take the wrapper whenever the kernel itself would run
+    # — the JAX in this image no longer hits the op_sharding superdim bug
+    # with the wrapper under lax.scan, even with the stacked weights scanned
+    # as xs (verified both ways; see test_spmd_kernel_wrapper_under_scan).
+    # "0" restores the round-3 dequant+einsum fallback (kill-switch if
+    # Mosaic + scan misbehaves on real hardware); "1" forces the wrapper
+    # even when mode would resolve to fallback.
+    use_spmd_kernel = in_gspmd and (
+        spmd_env == "1" or (spmd_env != "0" and mode != "fallback")
     )
     if in_gspmd and not use_spmd_kernel:
         mode = "fallback"
+    elif use_spmd_kernel and mode == "fallback":
+        # "1" really does force the wrapper, even on a backend whose mode
+        # resolved to fallback — otherwise the dispatch gate below would
+        # quietly run dequant+einsum while the operator believes the
+        # wrapper was exercised.
+        mode = "kernel"
     if interpret:  # explicit test request wins even inside spmd_fallback
         mode = "interpret"
     # int4: the kernel's sublane unpack (and _dequant_flat) assume the pack

@@ -217,9 +217,15 @@ class ParallelModel:
                     NamedSharding(self.mesh, spec),
                 )
 
-            pref = region(prompt_len, P(None, "data", "seq", kv_ax, None))
-            dec = region(max_len - prompt_len, P(None, "data", None, kv_ax, None))
-            return KVCache(k=(pref, dec), v=(pref, dec))
+            # k and v must be DISTINCT buffers: callers (runtime/batcher.py)
+            # donate the cache, and donating one aliased buffer through two
+            # tree leaves is an XLA Execute error.
+            return KVCache(
+                k=(region(prompt_len, P(None, "data", "seq", kv_ax, None)),
+                   region(max_len - prompt_len, P(None, "data", None, kv_ax, None))),
+                v=(region(prompt_len, P(None, "data", "seq", kv_ax, None)),
+                   region(max_len - prompt_len, P(None, "data", None, kv_ax, None))),
+            )
         if self.pipelined:
             p, lp = self.num_stages, cfg.num_layers // self.num_stages
             shape = (p, lp, batch, max_len, kvh, hd)
@@ -228,12 +234,18 @@ class ParallelModel:
             shape = (cfg.num_layers, batch, max_len, kvh, hd)
             spec = P(None, "data", None, kv_ax, None)
         sharding = NamedSharding(self.mesh, spec)
+
         # with_sharding_constraint works both eagerly and under jit (the
         # decode loop allocates its cache inside generate_tokens' trace).
-        z = jax.lax.with_sharding_constraint(
-            jnp.zeros(shape, jnp.dtype(self.kv_dtype or cfg.dtype)), sharding
-        )
-        return KVCache(k=z, v=z)
+        # k and v are DISTINCT allocations: callers (runtime/batcher.py)
+        # donate the cache, and two tree leaves aliasing one buffer is an
+        # XLA "donate the same buffer twice" Execute error.
+        def z():
+            return jax.lax.with_sharding_constraint(
+                jnp.zeros(shape, jnp.dtype(self.kv_dtype or cfg.dtype)), sharding
+            )
+
+        return KVCache(k=z(), v=z())
 
     # -- adapters for runtime.generate (hashable bound methods; frozen
     # dataclass => stable hash => jit cache hits across calls) --------------
@@ -421,9 +433,12 @@ class ParallelModel:
             return (logits, None, jnp.float32(0.0)) if return_aux else (logits, None)
         cfg = _local_cfg(cfg)
         if not self.pipelined:
-            # GSPMD path: quantized weights must take the dequant+einsum
-            # route (XLA partitions it); the Pallas kernel has no SPMD
-            # partitioning rule and would force a full-weight all-gather.
+            # GSPMD path: mark the trace so quantized contractions route
+            # through the custom_partitioning kernel wrapper (per-shard
+            # Pallas tiles + psum over contracted axes — the bandwidth win
+            # now applies to plain-TP serving) or, on non-TPU backends /
+            # DLT_QUANT_MATMUL_SPMD=0, the dequant+einsum fallback XLA can
+            # partition.  A bare pallas_call here would all-gather weights.
             from ..ops.quant_matmul import spmd_fallback
 
             with spmd_fallback():

@@ -1,9 +1,11 @@
 """Quantized-resident serving ON A MESH (SURVEY §7 hard part 6, VERDICT r2
-next-step 9): mesh placement keeps QuantizedTensor leaves — data and scale
-sharded under the plain weight's PartitionSpec, scale blocks refined where a
-shard boundary would split a block — instead of rehydrating to full dtype.
-The GSPMD forward routes quantized contractions through dequantize+einsum
-(ops/quant_matmul.spmd_fallback): pallas_call has no SPMD partitioning rule.
+next-step 9, r3 next-step 7): mesh placement keeps QuantizedTensor leaves —
+data and scale sharded under the plain weight's PartitionSpec, scale blocks
+refined where a shard boundary would split a block — instead of rehydrating
+to full dtype.  The GSPMD forward routes quantized contractions through the
+custom_partitioning kernel wrapper whenever the kernel would run (per-shard
+Pallas tiles; the bandwidth win applies to plain-TP serving), falling back
+to dequantize+einsum on non-TPU backends or DLT_QUANT_MATMUL_SPMD=0.
 """
 
 import jax
@@ -156,6 +158,150 @@ def test_spmd_kernel_wrapper_partitions(
     np.testing.assert_allclose(
         np.asarray(y), np.asarray(ref), rtol=2e-5, atol=2e-5
     )
+
+
+@pytest.mark.parametrize("stacked_xs", [False, True])
+def test_spmd_kernel_wrapper_under_scan(devices8, monkeypatch, stacked_xs):
+    """The wrapper compiles and matches the dense reference INSIDE a
+    ``lax.scan`` — both with scan-invariant (closed-over) weights, the shape
+    of the decode loop, and with stacked weights scanned as xs, the shape of
+    the layer loop.  Earlier JAX releases failed here (op_sharding superdim
+    KeyError — the round-3 reason GSPMD serving was forced onto the
+    dequant+einsum fallback); this pins the fix the default path now relies
+    on.  If it regresses after a JAX upgrade, set DLT_QUANT_MATMUL_SPMD=0."""
+    from jax.sharding import NamedSharding
+
+    from distributed_llms_tpu.checkpoint.quantize import dequantize, quantize
+    from distributed_llms_tpu.ops import quant_matmul as qm
+
+    monkeypatch.setenv("DLT_QUANT_MATMUL", "interpret")
+    monkeypatch.delenv("DLT_QUANT_MATMUL_SPMD", raising=False)  # auto
+    qm._qmm_spmd.cache_clear()
+    kernel_calls = []
+    orig = qm._quant_matmul_2d
+    monkeypatch.setattr(
+        qm, "_quant_matmul_2d",
+        lambda *a, **kw: kernel_calls.append(1) or orig(*a, **kw),
+    )
+    mesh = Mesh(np.array(devices8).reshape(2, 4), ("data", "model"))
+    # Local N per 'model' shard must stay kernel-tileable (>=128, block 128)
+    # or the wrapper's per-shard dispatch takes its internal dequant branch
+    # and the spy below would prove nothing.
+    L, d = 3, 1024
+    w = jax.random.normal(jax.random.key(0), (L, d, d), jnp.float32) * d**-0.5
+    qt = quant_lib.quantize(w, bits=8, block=128)
+    wspec = P(None, None, "model")
+    data = jax.device_put(qt.data, NamedSharding(mesh, wspec))
+    scale = jax.device_put(qt.scale, NamedSharding(mesh, wspec))
+    x = jax.device_put(
+        jax.random.normal(jax.random.key(1), (4, d), jnp.float32),
+        NamedSharding(mesh, P("data", None)),
+    )
+
+    def layer(c, d_, s_):
+        q = type(qt)(data=d_, scale=s_, bits=qt.bits,
+                     orig_shape=(d, d), pack_axis=qt.pack_axis)
+        return qm.quant_contract(c, q, 1, "md,df->mf")
+
+    if stacked_xs:
+        def f(x_, d_, s_):
+            return jax.lax.scan(
+                lambda c, xs: (layer(c, *xs), None), x_, (d_, s_)
+            )[0]
+    else:
+        def f(x_, d_, s_):
+            def body(c, _):
+                return layer(c, d_[0], s_[0]), None
+            return jax.lax.scan(body, x_, None, length=L)[0]
+
+    token = qm._SPMD_FALLBACK.set(True)
+    try:
+        y = jax.jit(f)(x, data, scale)
+    finally:
+        qm._SPMD_FALLBACK.reset(token)
+    assert kernel_calls, "kernel program did not run under the scan"
+    ref = np.asarray(x)
+    wd = np.asarray(dequantize(qt, jnp.float32))
+    if stacked_xs:
+        for i in range(L):
+            ref = ref @ wd[i]
+    else:
+        for _ in range(L):
+            ref = ref @ wd[0]
+    np.testing.assert_allclose(np.asarray(y), ref, rtol=2e-4, atol=2e-4)
+
+
+def test_tp_mesh_quantized_kernel_active(tmp_path, devices8, monkeypatch):
+    """VERDICT r3 next-step 7 done-criterion: plain-TP (GSPMD) quantized
+    serving dispatches the fused kernel program (spy on _quant_matmul_2d —
+    the Pallas program itself, wrapped by custom_partitioning) under the
+    layer scan AND the decode scan, and the tokens match fallback serving
+    exactly."""
+    from distributed_llms_tpu.ops import quant_matmul as qm
+
+    cfg = presets.get_preset(
+        "llama-tiny", vocab_size=512, hidden_size=256, intermediate_size=256,
+        num_heads=2, num_kv_heads=2,  # hd=128: local TP shards stay tileable
+    )
+    params = model_lib.init_params(jax.random.key(0), cfg)
+    store_dir = str(tmp_path / "s")
+    store_lib.save_shards(
+        params, store_dir, num_shards=1, model_config=cfg, quantization="int8",
+        quant_block=128,
+    )
+    rt = RuntimeConfig(max_decode_steps=4, serve_quantized=True)
+    monkeypatch.setenv("DLT_QUANT_MATMUL", "fallback")
+    ref = InferenceEngine.from_store(store_dir, rt=rt)
+    out_ref = ref.generate_text(["kernel under gspmd"], max_new_tokens=4)
+
+    monkeypatch.setenv("DLT_QUANT_MATMUL", "interpret")
+    monkeypatch.delenv("DLT_QUANT_MATMUL_SPMD", raising=False)  # auto: on
+    qm._qmm_spmd.cache_clear()
+    kernel_calls = []
+    orig = qm._quant_matmul_2d
+    monkeypatch.setattr(
+        qm, "_quant_matmul_2d",
+        lambda *a, **kw: kernel_calls.append(1) or orig(*a, **kw),
+    )
+    eng = InferenceEngine.from_store(
+        store_dir, rt=rt, mesh_cfg=MeshConfig(data=4, model=2)
+    )
+    assert _qleaves(eng.params["blocks"])
+    out = eng.generate_text(["kernel under gspmd"], max_new_tokens=4)
+    assert kernel_calls, "fused kernel was not dispatched under GSPMD serving"
+    assert out.tokens.tolist() == out_ref.tokens.tolist()
+
+
+def test_tp_mesh_quantized_spmd_kill_switch(tmp_path, devices8, monkeypatch):
+    """DLT_QUANT_MATMUL_SPMD=0 restores the round-3 dequant+einsum fallback
+    under GSPMD (the hardware-day escape hatch) — same tokens, no kernel."""
+    from distributed_llms_tpu.ops import quant_matmul as qm
+
+    cfg = presets.get_preset("llama-tiny", vocab_size=512)
+    params = model_lib.init_params(jax.random.key(0), cfg)
+    store_dir = str(tmp_path / "s")
+    store_lib.save_shards(
+        params, store_dir, num_shards=1, model_config=cfg, quantization="int8",
+        quant_block=32,
+    )
+    rt = RuntimeConfig(max_decode_steps=4, serve_quantized=True)
+    monkeypatch.setenv("DLT_QUANT_MATMUL", "interpret")
+    monkeypatch.setenv("DLT_QUANT_MATMUL_SPMD", "0")
+    kernel_calls = []
+    orig = qm._quant_matmul_2d
+    monkeypatch.setattr(
+        qm, "_quant_matmul_2d",
+        lambda *a, **kw: kernel_calls.append(1) or orig(*a, **kw),
+    )
+    ref = InferenceEngine.from_store(store_dir, rt=rt)
+    out_ref = ref.generate_text(["kill switch"], max_new_tokens=4)
+    n_single = len(kernel_calls)  # single-device engine: kernel allowed
+    eng = InferenceEngine.from_store(
+        store_dir, rt=rt, mesh_cfg=MeshConfig(data=2, model=4)
+    )
+    out = eng.generate_text(["kill switch"], max_new_tokens=4)
+    assert len(kernel_calls) == n_single, "kill switch did not disable wrapper"
+    assert out.tokens.tolist() == out_ref.tokens.tolist()
 
 
 @pytest.mark.parametrize("quantization", ["int8"])
