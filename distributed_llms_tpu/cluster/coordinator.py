@@ -487,10 +487,12 @@ class Coordinator:
         self, requests: list[dict], timeout: float | None = None,
     ) -> Any:
         """Mixed-budget generation: each request is {"prompt": str,
-        "max_new_tokens": int}.  A single-device worker serves them with
-        continuous batching (runtime/batcher.py) — per-request budgets, no
-        head-of-line blocking.  On a multi-process SPMD pool the task is
-        broadcast (like generate_spmd); those workers serve the grouped
+        "max_new_tokens": int}.  Served with continuous batching
+        (runtime/batcher.py) — per-request budgets, no head-of-line blocking
+        — on single-device workers and on single-process GSPMD data/tensor-
+        parallel meshes.  Pipelined / sequence-parallel meshes, and meshes
+        SPANNING worker processes (multi-host SPMD pools: the task is
+        broadcast like generate_spmd), serve the grouped longest-budget
         fallback in lockstep."""
         # Validate before dispatch so single-device (batcher) and mesh
         # (grouped) workers see only well-formed batches — the two engines
@@ -503,7 +505,8 @@ class Coordinator:
                     f"{prompt!r}"
                 )
             n = r.get("max_new_tokens", 32)
-            if not isinstance(n, int) or n < 1:
+            # bool is an int subclass: True would silently serve 1 token.
+            if not isinstance(n, int) or isinstance(n, bool) or n < 1:
                 raise ValueError(
                     f"request {i}: max_new_tokens must be an int >= 1, got {n!r}"
                 )

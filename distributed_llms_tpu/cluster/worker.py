@@ -218,18 +218,34 @@ class WorkerHost:
 
     def _generate_requests(self, requests: list[dict]) -> dict:
         """Mixed-budget batch (GENERATE with a ``requests`` list): served via
-        continuous batching on a single-device engine — per-request budgets,
-        short replies don't wait for long ones.  Mesh engines (whose decode
-        schedules manage their own batching) serve the requests as one
-        grouped batch at the longest budget instead."""
+        continuous batching — per-request budgets, short replies don't wait
+        for long ones — on single-device engines AND on single-process GSPMD
+        data/tensor-parallel meshes (runtime/batcher.py shards the KV cache
+        and keeps the scheduling state replicated).  Pipelined / sequence-
+        parallel meshes (own decode schedules) and meshes spanning processes
+        (untested batcher lockstep) fall back to one grouped batch at the
+        longest budget."""
         import time as _time
 
         t0 = _time.perf_counter()
         prompts = [r["prompt"] for r in requests]
         budgets = [int(r.get("max_new_tokens", 32)) for r in requests]
-        if getattr(self.engine, "parallel", None) is None and hasattr(
-            self.engine, "continuous_batcher"
-        ):
+        pm = getattr(self.engine, "parallel", None)
+        multi_process = pm is not None and len(
+            {d.process_index for d in pm.mesh.devices.flat}
+        ) > 1
+        # Batcher: single-device engines and single-process GSPMD dp/tp
+        # meshes.  A mesh SPANNING processes stays on the proven grouped
+        # lockstep path until a 2-process test pins the batcher's
+        # replicated-state lockstep there (its host mirrors come from
+        # process-local arrays; that legality is untested multi-process).
+        batcher_ok = hasattr(self.engine, "continuous_batcher") and (
+            pm is None
+            or not (pm.pipelined or pm.seq_parallel or multi_process)
+        )
+        if batcher_ok:
+            # engine.continuous_batcher rounds the slot count up to divide
+            # the mesh 'data' axis, so the default serves any dp shape.
             batcher = self.engine.continuous_batcher()
             rids = [
                 batcher.submit(p, max_new_tokens=n)
@@ -242,12 +258,21 @@ class WorkerHost:
         else:
             res = self.engine.generate_text(prompts, max(budgets))
             # Grouped fallback decodes max(budgets) for every row; honor each
-            # request's own budget by truncating its token row before decode.
+            # request's own budget — and stop at the row's EOS, never the
+            # post-EOS pad tail — so text AND the throughput accounting match
+            # the batcher branch's basis exactly.
             tok = self.engine.tokenizer
-            texts = [
-                tok.decode(row[:n]) for row, n in zip(res.tokens, budgets)
-            ]
-            n_gen = sum(min(len(row), n) for row, n in zip(res.tokens, budgets))
+
+            def _emitted(row, n):
+                row = list(row[:n])
+                eos = getattr(tok, "eos_id", None)
+                if eos is not None and eos in row:
+                    return row[: row.index(eos) + 1]
+                return row
+
+            rows = [_emitted(row, n) for row, n in zip(res.tokens, budgets)]
+            texts = [tok.decode(row) for row in rows]
+            n_gen = sum(len(row) for row in rows)
         dt = _time.perf_counter() - t0
         return {
             "text": texts,

@@ -369,15 +369,26 @@ class InferenceEngine:
         """A ContinuousBatcher over this engine's model: requests admit into
         an in-flight decode batch as rows free up (runtime/batcher.py) —
         no head-of-line blocking on mixed-length traffic.  Single-device
-        engines only (the mesh decode schedules manage their own batching).
+        engines and GSPMD data/tensor-parallel meshes; pipelined and
+        sequence-parallel meshes keep their own decode schedules (the
+        batcher constructor rejects them).
         """
-        if self.parallel is not None:
+        if self.parallel is not None and (
+            self.parallel.pipelined or self.parallel.seq_parallel
+        ):
             raise ValueError(
-                "continuous batching currently requires a single-device "
-                "engine (mesh_cfg=None)"
+                "continuous batching requires a single-device engine or a "
+                "pure data/tensor-parallel mesh (no pipe/seq axes)"
             )
         from .batcher import ContinuousBatcher
 
+        if self.parallel is not None:
+            # The shared cache shards its batch over 'data'; round the slot
+            # count up so every mesh shape serves (extra slots are harmless
+            # capacity — the constructor would otherwise reject e.g. the
+            # default 8 on a data=16 mesh).
+            dp = self.parallel.mesh.shape.get("data", 1)
+            batch_slots = -(-batch_slots // dp) * dp
         tok = self.tokenizer
         return ContinuousBatcher(
             self.cfg, self.params, tokenizer=tok,
@@ -387,4 +398,5 @@ class InferenceEngine:
             temperature=self.rt.temperature, top_k=self.rt.top_k,
             top_p=self.rt.top_p, eos_id=tok.eos_id, pad_id=tok.pad_id,
             kv_dtype=self.rt.kv_cache_dtype,
+            parallel=self.parallel,
         )

@@ -232,7 +232,18 @@ def test_engine_integration(tiny):
     from distributed_llms_tpu.core.config import MeshConfig
     from distributed_llms_tpu.parallel.api import make_parallel_model
 
+    # GSPMD dp/tp meshes get a mesh-capable batcher since round 4
+    # (tests/parallel/test_mesh_batcher.py); only pipelined / seq-parallel
+    # meshes — which bring their own decode schedules — are rejected.
     pm = make_parallel_model(cfg, MeshConfig(data=2, model=4))
     mesh_eng = InferenceEngine(cfg, RuntimeConfig(), params, parallel=pm)
-    with pytest.raises(ValueError, match="single-device"):
-        mesh_eng.continuous_batcher()
+    mb = mesh_eng.continuous_batcher(batch_slots=2)
+    # The engine's kv_cache_dtype is threaded onto the (frozen) mesh model —
+    # same mesh, explicit kv dtype, never silently dropped.
+    assert mb.pm.mesh is pm.mesh
+    assert mb.pm.kv_dtype == RuntimeConfig().kv_cache_dtype
+    assert mb.cache.k.dtype == jnp.bfloat16
+    pm_pipe = make_parallel_model(cfg, MeshConfig(pipe=2, model=4))
+    pipe_eng = InferenceEngine(cfg, RuntimeConfig(), params, parallel=pm_pipe)
+    with pytest.raises(ValueError, match="data/tensor-parallel"):
+        pipe_eng.continuous_batcher()
