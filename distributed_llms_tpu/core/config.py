@@ -62,6 +62,12 @@ class ModelConfig:
     # "ulysses" (sequence-parallel all-to-all head scatter over 'seq';
     # needs num_heads and num_kv_heads divisible by the seq axis).
     attn_impl: str = "dot"
+    # Ragged single-token decode attention (ops/decode_attn.py): row b reads
+    # only its cache prefix [0, cache_index[b]] instead of the full width S.
+    # Opt-in CONTRACT flag, not just a speed knob: setting it asserts the
+    # caller's attn_mask on the per-row-cache_index decode path is exactly
+    # that prefix mask (the ContinuousBatcher's is; arbitrary masks are not).
+    ragged_decode: bool = False
 
     def __post_init__(self):
         if self.attn_impl not in _ATTN_IMPLS:

@@ -141,6 +141,20 @@ def _attention(
             )
             ck = row_upd(ck, k.astype(ck.dtype), cache_index)
             cv = row_upd(cv, v.astype(cv.dtype), cache_index)
+            if cfg.ragged_decode and x.shape[1] == 1:
+                # Ragged read: row b touches only [0, cache_index[b]] of the
+                # cache (lengths = cache_index + 1 includes the slot just
+                # written above).  cfg.ragged_decode is the caller's promise
+                # that attn_mask IS that prefix mask (core/config.py).
+                from ..ops import decode_attn
+
+                # ck/cv go in at the CACHE's dtype — the kernel casts per
+                # block in VMEM, so a kv_dtype != compute dtype never costs
+                # a full-width HBM copy of the cache.
+                out = decode_attn.ragged_decode_attention(
+                    q, ck, cv, cache_index + 1,
+                )
+                return layers.out_project(out, p), (ck, cv)
         else:
             ck = jax.lax.dynamic_update_slice(ck, k.astype(ck.dtype), (0, cache_index, 0, 0))
             cv = jax.lax.dynamic_update_slice(cv, v.astype(cv.dtype), (0, cache_index, 0, 0))

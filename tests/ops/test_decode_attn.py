@@ -1,0 +1,119 @@
+"""Ragged decode attention (ops/decode_attn.py, VERDICT r3 weak #5).
+
+Parity: the kernel program (interpret mode on CPU — same program the TPU
+compiles) must match the dense prefix-masked reference, and the batcher's
+exact-token invariant must hold end-to-end with the ragged path active.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from distributed_llms_tpu.ops import decode_attn
+
+
+def _rand(key, shape, dtype=jnp.float32):
+    return jax.random.normal(jax.random.key(key), shape, dtype)
+
+
+@pytest.mark.parametrize(
+    "b,s,h,kvh,d,lengths",
+    [
+        (4, 256, 8, 8, 128, [1, 100, 256, 17]),       # MHA, mixed depths
+        (2, 512, 8, 2, 128, [512, 300]),              # GQA g=4, partial block
+        (3, 256, 4, 4, 128, [1, 1, 1]),               # minimum depth
+        (1, 1024, 16, 8, 128, [769]),                 # many blocks, ragged tail
+        (2, 384, 4, 4, 128, [129, 384]),              # 128-mult, not 256-mult:
+        #   block stepping must keep the kernel (bk=128), not fall back dense
+    ],
+)
+def test_kernel_matches_dense_reference(monkeypatch, b, s, h, kvh, d, lengths):
+    monkeypatch.setenv("DLT_RAGGED_DECODE", "interpret")
+    q = _rand(0, (b, 1, h, d))
+    k = _rand(1, (b, s, kvh, d))
+    v = _rand(2, (b, s, kvh, d))
+    ln = jnp.asarray(lengths, jnp.int32)
+    got = decode_attn.ragged_decode_attention(q, k, v, ln)
+    want = decode_attn._dense_reference(q, k, v, ln)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), rtol=2e-5, atol=2e-5
+    )
+
+
+def test_block_stepping_keeps_kernel_at_384(monkeypatch):
+    """Cache width 384 (a 128-multiple but not a 256-multiple) must step the
+    K block down to 128 and stay on the kernel — not silently serve the
+    dense full-width fallback."""
+    import jax.experimental.pallas as pl_mod
+
+    monkeypatch.setenv("DLT_RAGGED_DECODE", "interpret")
+    calls = []
+    orig = pl_mod.pallas_call
+    monkeypatch.setattr(
+        decode_attn.pl, "pallas_call",
+        lambda *a, **kw: calls.append(1) or orig(*a, **kw),
+    )
+    q = _rand(0, (2, 1, 4, 128))
+    k = _rand(1, (2, 384, 4, 128))
+    v = _rand(2, (2, 384, 4, 128))
+    ln = jnp.asarray([129, 384], jnp.int32)
+    got = decode_attn.ragged_decode_attention(q, k, v, ln)
+    assert calls, "kernel was not used for the 384-wide cache"
+    want = decode_attn._dense_reference(q, k, v, ln)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), rtol=2e-5, atol=2e-5
+    )
+
+
+def test_untileable_head_dim_falls_back(monkeypatch):
+    """d=64 is not a 128-lane multiple: the dense fallback must serve it."""
+    monkeypatch.setenv("DLT_RAGGED_DECODE", "interpret")
+    q = _rand(0, (2, 1, 4, 64))
+    k = _rand(1, (2, 128, 4, 64))
+    v = _rand(2, (2, 128, 4, 64))
+    ln = jnp.asarray([5, 99], jnp.int32)
+    got = decode_attn.ragged_decode_attention(q, k, v, ln)
+    want = decode_attn._dense_reference(q, k, v, ln)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), rtol=2e-5, atol=2e-5
+    )
+
+
+def test_batcher_exact_tokens_with_ragged_decode(monkeypatch):
+    """End-to-end: the ContinuousBatcher with the ragged kernel (interpret)
+    emits tokens identical to solo generate_tokens — scheduling AND the
+    ragged read change nothing about results.  head_dim 128 AND max_len 128
+    make the cache kernel-tileable, so the kernel PROGRAM (not the dense
+    fallback) is what runs — the spy is on pallas_call itself, which the
+    fallback never reaches."""
+    from distributed_llms_tpu.models import model as model_lib, presets
+    from distributed_llms_tpu.runtime import generate as gen_lib
+    from distributed_llms_tpu.runtime.batcher import ContinuousBatcher
+
+    monkeypatch.setenv("DLT_RAGGED_DECODE", "interpret")
+    calls = []
+    orig = decode_attn.pl.pallas_call
+    monkeypatch.setattr(
+        decode_attn.pl, "pallas_call",
+        lambda *a, **kw: calls.append(1) or orig(*a, **kw),
+    )
+    cfg = presets.get_preset(
+        "llama-tiny", vocab_size=512, hidden_size=256, num_heads=2,
+        num_kv_heads=2,  # head_dim 128 — kernel-tileable
+    )
+    params = model_lib.init_params(jax.random.key(0), cfg)
+    b = ContinuousBatcher(cfg, params, batch_slots=2, max_len=128, chunk_steps=4)
+    assert b.cfg_decode.ragged_decode
+    reqs = [([7, 1, 9], 6), ([4, 4, 4, 4, 4], 9), ([11, 12], 3)]
+    rids = [b.submit(ids, max_new_tokens=n) for ids, n in reqs]
+    res = b.run()
+    assert calls, "ragged decode attention did not run"
+    for rid, (ids, n) in zip(rids, reqs):
+        solo = gen_lib.generate_tokens(
+            params, cfg, jnp.asarray([ids], jnp.int32),
+            jnp.asarray([len(ids)], jnp.int32), jax.random.key(9),
+            max_new_tokens=n,
+        )
+        assert res[rid] == np.asarray(solo)[0].tolist(), f"req {rid} diverged"
