@@ -31,6 +31,7 @@ import time
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 NORTH_STAR_TOKS_PER_S = 1000.0  # BASELINE.json: >=1000 tok/s aggregate
 
@@ -145,6 +146,11 @@ def _arm_watchdog(seconds: float, args):
                     "unit": "tok/s", "vs_baseline": 0.0,
                     "degraded": f"measurement hung; fallback crashed: {exc}",
                 }
+            # The fallback subprocess can take many minutes; if the wedged
+            # measurement recovered and printed meanwhile (main sets `done`
+            # BEFORE printing), drop the fallback line — one JSON line only.
+            if done.is_set():
+                return
             print(json.dumps(out), flush=True)
             os._exit(0)
 
@@ -398,7 +404,10 @@ def _measure_serving_latency(
         t0 = time.perf_counter()
         eng.generate_text(prompts, max_new_tokens=new_tokens)
         fulls.append(time.perf_counter() - t0)
-    ts = sorted(ttfts)
+    # Interpolated percentiles: with the default requests=8, a positional
+    # index at 0.95 would be the sample MAX — one outlier would fully
+    # determine the reported p95 (ADVICE r3).
+    p50, p95 = np.percentile(np.asarray(ttfts), [50.0, 95.0])
     out = {
         "preset": preset,
         **({"quant": quant} if quant else {}),
@@ -406,8 +415,8 @@ def _measure_serving_latency(
         "new_tokens": new_tokens,
         "requests": requests,
         "platform": jax.devices()[0].platform,
-        "ttft_p50_ms": round(ts[len(ts) // 2] * 1e3, 1),
-        "ttft_p95_ms": round(ts[int(len(ts) * 0.95)] * 1e3, 1),
+        "ttft_p50_ms": round(float(p50) * 1e3, 1),
+        "ttft_p95_ms": round(float(p95) * 1e3, 1),
     }
     tpot = (min(fulls) - min(ttfts)) / (new_tokens - 1)
     if tpot <= 0:
@@ -578,14 +587,26 @@ def _measure_hop_latency(d_model: int = 4096, batch: int = 8, iters: int = 50) -
         t0 = time.perf_counter()
         jax.block_until_ready(f(x))
         times.append(time.perf_counter() - t0)
-    ts = sorted(times)
+    # Interpolated percentiles — a positional index at 0.95 is the sample
+    # max at small --iters (same defect class as the serving-latency p95).
+    p50, p95 = np.percentile(np.asarray(times), [50.0, 95.0])
     return {
         "hop_bytes": batch * d_model * jnp.dtype(dtype).itemsize,
         "n_devices": n,
-        "p50_us": round(ts[len(ts) // 2] * 1e6, 1),
-        "p95_us": round(ts[int(len(ts) * 0.95)] * 1e6, 1),
+        "p50_us": round(float(p50) * 1e6, 1),
+        "p95_us": round(float(p95) * 1e6, 1),
         "note": "jit dispatch included; one full ring rotation per sample",
     }
+
+
+def _stamp() -> str:
+    """Per-row measurement provenance: UTC date + platform.  VERDICT r3 weak
+    #2: a ladder row must say when/where it was measured so instrumented-but-
+    never-run configs can't read as results."""
+    import datetime
+
+    date = datetime.datetime.now(datetime.timezone.utc).strftime("%Y-%m-%d")
+    return f"{date} {jax.devices()[0].platform}"
 
 
 def _write_rows(path: str, rows: list[dict]) -> None:
@@ -626,6 +647,7 @@ def run_ladder(args, degraded: str | None) -> list[dict]:
                 entry["preset"], entry["batch"], entry["prompt"], entry["new"],
                 dtype, args.iters, quant=quant,
             ))
+            row["measured_on"] = _stamp()
             if degraded is not None:
                 row["degraded"] = degraded
         except Exception as exc:  # one config's OOM must not kill the ladder
@@ -653,6 +675,7 @@ def run_ladder(args, degraded: str | None) -> list[dict]:
                 srv["preset"], srv["batch"], srv["prompt"], dtype,
                 quant=srv.get("quant"), new_tokens=srv["new"],
             ))
+            row["measured_on"] = _stamp()
             if degraded is not None:
                 row["degraded"] = degraded
         except Exception as exc:
@@ -669,6 +692,7 @@ def run_ladder(args, degraded: str | None) -> list[dict]:
         row.update(_measure_continuous_batching(
             cb["preset"], dtype, quant=cb.get("quant"),
         ))
+        row["measured_on"] = _stamp()
         if degraded is not None:
             row["degraded"] = degraded
     except Exception as exc:
@@ -690,6 +714,7 @@ def run_ladder(args, degraded: str | None) -> list[dict]:
                 row.update(_measure_prefill_flash(
                     batch=b, seq=seq, dtype=dtype, iters=args.iters
                 ))
+                row["measured_on"] = _stamp()
             except Exception as exc:
                 row["skipped"] = (
                     f"{type(exc).__name__}: "
@@ -700,7 +725,7 @@ def run_ladder(args, degraded: str | None) -> list[dict]:
             _write_rows(args.out, rows)
     hop = _measure_hop_latency()
     if hop is not None:
-        rows.append({"config": "hop-latency", **hop})
+        rows.append({"config": "hop-latency", **hop, "measured_on": _stamp()})
         print(f"# hop latency: {hop}", file=sys.stderr)
     else:
         # SURVEY §6 metric is unmeasurable on one chip — record that

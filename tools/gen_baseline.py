@@ -1,0 +1,110 @@
+#!/usr/bin/env python
+"""Regenerate BASELINE.md's ladder-of-record section from BENCH_LADDER.json.
+
+VERDICT r3 weak #2 / next-step 8: BASELINE.md's performance claims must come
+from the measured artifact, not hand-maintained prose — a config that is
+merely *instrumented* must read NOT YET MEASURED until a row with a
+``measured_on`` stamp exists.  This script rewrites everything between the
+AUTOGEN markers in BASELINE.md from the JSON; run it after every ladder run
+(tools/tpu_runbook.sh reminds you).
+"""
+from __future__ import annotations
+
+import json
+import os
+import re
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+BEGIN = "<!-- BEGIN AUTOGEN LADDER (tools/gen_baseline.py) -->"
+END = "<!-- END AUTOGEN LADDER -->"
+
+
+def _result_cell(row: dict) -> str:
+    if "skipped" in row:
+        return f"SKIPPED — {row['skipped']}"
+    cells = []
+    if "tok_per_s" in row:
+        cells.append(f"**{row['tok_per_s']:.1f} tok/s**")
+    for k, label in (
+        ("mfu_2N", "MFU_2N"), ("hbm_util", "hbm_util"),
+        ("weight_stream_gb_per_s", "weight-stream GB/s"),
+        ("ttft_p50_ms", "TTFT p50 ms"), ("ttft_p95_ms", "TTFT p95 ms"),
+        ("tpot_ms", "TPOT ms"), ("tok_per_s_steady", "steady tok/s"),
+        ("speedup_vs_grouped", "vs grouped"),
+        ("flash_ms", "flash ms"), ("dot_ms", "dot ms"),
+        ("p50_us", "p50 µs"), ("p95_us", "p95 µs"),
+    ):
+        if row.get(k) is not None:
+            v = row[k]
+            cells.append(f"{label} {v:.3g}" if isinstance(v, float) else f"{label} {v}")
+    if row.get("degraded"):
+        cells.append(f"DEGRADED: {row['degraded']}")
+    return ", ".join(cells) or json.dumps(
+        {k: v for k, v in row.items() if k not in ("config", "measured_on")}
+    )[:120]
+
+
+def generate(ladder_path: str) -> str:
+    import bench  # repo-root bench.py — the ladder definition of record
+
+    with open(ladder_path) as f:
+        rows = {str(r.get("config")): r for r in json.load(f)["rows"]}
+    lines = [
+        BEGIN,
+        "",
+        "## Ladder of record (auto-generated from BENCH_LADDER.json)",
+        "",
+        "A config with no `measured on` stamp has **never produced a "
+        "number** — treat every claim about it as design intent, not data.",
+        "",
+        "| Config | Preset | Result | Measured on |",
+        "|--------|--------|--------|-------------|",
+    ]
+    listed = [str(e["config"]) for e in bench.LADDER] + [
+        # Aux rows run_ladder appends after the decode configs.
+        "serving-latency", "continuous-batching",
+        "prefill-flash-2048", "prefill-flash-8192", "hop-latency",
+    ]
+    extras = [c for c in rows if c not in listed]
+    for cfg_id in listed + extras:
+        row = rows.get(cfg_id)
+        entry = next(
+            (e for e in bench.LADDER if str(e["config"]) == cfg_id), {}
+        )
+        preset = (row or {}).get("preset", entry.get("preset", "—"))
+        if row is None:
+            lines.append(
+                f"| {cfg_id} | {preset} | NOT YET MEASURED (instrumented in "
+                f"bench.py; no row in the artifact) | — |"
+            )
+            continue
+        stamp = row.get("measured_on", "pre-r4 artifact (no stamp)")
+        if "skipped" in row:
+            stamp = "—"
+        lines.append(f"| {cfg_id} | {preset} | {_result_cell(row)} | {stamp} |")
+    lines += ["", END]
+    return "\n".join(lines)
+
+
+def main() -> int:
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    ladder = os.path.join(repo, "BENCH_LADDER.json")
+    baseline = os.path.join(repo, "BASELINE.md")
+    section = generate(ladder)
+    with open(baseline) as f:
+        text = f.read()
+    if BEGIN in text and END in text:
+        pattern = re.escape(BEGIN) + r".*?" + re.escape(END)
+        text = re.sub(pattern, lambda _m: section, text, flags=re.DOTALL)
+    else:
+        text = text.rstrip() + "\n\n" + section + "\n"
+    with open(baseline, "w") as f:
+        f.write(text)
+    print(f"BASELINE.md ladder section regenerated from {ladder}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

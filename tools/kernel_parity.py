@@ -1,0 +1,123 @@
+#!/usr/bin/env python
+"""On-device kernel parity check: the first thing the TPU runbook runs.
+
+Compiles BOTH Pallas kernels (fused dequant-matmul, flash attention) on the
+default JAX backend and compares against the einsum/dense references.
+Interpret-mode CI (tests/ops/) proves the kernels' *programs*; this script
+proves Mosaic *lowering* — tiling, VMEM budgets, sublane int4 unpack — which
+interpret mode cannot catch.  Exit 0 = all parities hold compiled on this
+backend; exit 1 = mismatch or lowering failure (stack trace printed).
+
+Run via tools/tpu_runbook.sh; standalone: `python tools/kernel_parity.py`.
+"""
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+if os.environ.get("DLT_PARITY_CPU") == "1":
+    # The axon plugin ignores JAX_PLATFORMS=cpu; pin via jax.config BEFORE
+    # the first backend query or a dead tunnel wedges this script ~25 min.
+    jax.config.update("jax_platforms", "cpu")
+
+# TPU: force the compiled-kernel path (never a silent fallback "pass").
+# Elsewhere: interpret mode — validates this script's own logic, proves
+# nothing about Mosaic lowering (the runbook only fires it on TPU).
+ON_TPU = jax.default_backend() == "tpu"
+os.environ["DLT_QUANT_MATMUL"] = "kernel" if ON_TPU else "interpret"
+
+import jax.numpy as jnp
+import numpy as np
+
+from distributed_llms_tpu.checkpoint.quantize import dequantize, quantize
+from distributed_llms_tpu.ops import decode_attn
+from distributed_llms_tpu.ops.flash import _dense_reference, flash_attention
+from distributed_llms_tpu.ops.quant_matmul import quant_contract
+
+os.environ["DLT_RAGGED_DECODE"] = "kernel" if ON_TPU else "interpret"
+
+
+def check(name: str, got, want, rtol: float, atol: float) -> None:
+    got = np.asarray(got, np.float32)
+    want = np.asarray(want, np.float32)
+    np.testing.assert_allclose(got, want, rtol=rtol, atol=atol)
+    err = float(np.max(np.abs(got - want)))
+    print(f"  PASS {name:40s} max|err|={err:.3e}")
+
+
+def quant_parity() -> None:
+    key = jax.random.PRNGKey(0)
+    for bits in (8, 4):
+        for m, k, n in ((8, 1024, 2048), (4, 4096, 4096)):
+            kx, kw = jax.random.split(jax.random.fold_in(key, bits * 100 + m))
+            x = jax.random.normal(kx, (m, k), jnp.bfloat16)
+            w = jax.random.normal(kw, (k, n), jnp.float32) / np.sqrt(k)
+            qt = quantize(w, bits=bits)
+            got = jax.jit(lambda x, qt: quant_contract(x, qt, k_lead=1))(x, qt)
+            want = jnp.asarray(x, jnp.float32) @ dequantize(qt, jnp.float32)
+            # bf16 activations: kernel accumulates f32 but inputs quantize the
+            # signal; match the suite's bf16 tolerance.
+            check(f"quant int{bits} [{m}x{k}]@[{k}x{n}]", got, want,
+                  rtol=2e-2, atol=2e-2)
+
+
+def flash_parity() -> None:
+    key = jax.random.PRNGKey(1)
+    for b, t, s, h, kvh, d in ((2, 512, 512, 8, 4, 128), (1, 2048, 2048, 8, 8, 128)):
+        ks = jax.random.split(jax.random.fold_in(key, t), 3)
+        q = jax.random.normal(ks[0], (b, t, h, d), jnp.bfloat16)
+        kk = jax.random.normal(ks[1], (b, s, kvh, d), jnp.bfloat16)
+        v = jax.random.normal(ks[2], (b, s, kvh, d), jnp.bfloat16)
+        got = jax.jit(
+            lambda q, k, v: flash_attention(q, k, v, causal=True,
+                                            interpret=not ON_TPU)
+        )(q, kk, v)
+        want = _dense_reference(q, kk, v, None, None, None, True)
+        check(f"flash causal B{b} T{t} S{s} H{h}/{kvh}", got, want,
+              rtol=3e-2, atol=3e-2)
+
+
+def ragged_parity() -> None:
+    key = jax.random.PRNGKey(2)
+    for b, s, h, kvh, d, lengths in (
+        (4, 512, 8, 4, 128, (3, 200, 512, 64)),
+        (2, 2048, 8, 8, 128, (1500, 2048)),
+    ):
+        ks = jax.random.split(jax.random.fold_in(key, s), 3)
+        q = jax.random.normal(ks[0], (b, 1, h, d), jnp.bfloat16)
+        kk = jax.random.normal(ks[1], (b, s, kvh, d), jnp.bfloat16)
+        v = jax.random.normal(ks[2], (b, s, kvh, d), jnp.bfloat16)
+        ln = jnp.asarray(lengths, jnp.int32)
+        got = jax.jit(decode_attn.ragged_decode_attention)(q, kk, v, ln)
+        want = decode_attn._dense_reference(q, kk, v, ln)
+        check(f"ragged decode B{b} S{s} H{h}/{kvh}", got, want,
+              rtol=3e-2, atol=3e-2)
+
+
+def main() -> int:
+    backend = jax.default_backend()
+    print(f"kernel_parity: backend={backend} devices={jax.device_count()}")
+    if backend != "tpu":
+        print(f"  WARNING: backend={backend} — running kernels in INTERPRET "
+              "mode (validates this script, NOT Mosaic lowering).")
+    quant_parity()
+    flash_parity()
+    ragged_parity()
+    mode = "compiled" if ON_TPU else "interpret"
+    print(f"kernel_parity: ALL PASS ({mode}, backend={backend})")
+    return 0
+
+
+if __name__ == "__main__":
+    try:
+        sys.exit(main())
+    except Exception:
+        import traceback
+
+        traceback.print_exc()
+        print("kernel_parity: FAIL")
+        sys.exit(1)

@@ -1,0 +1,57 @@
+#!/usr/bin/env bash
+# TPU evidence runbook (VERDICT r3 task 1).  Run the moment a chip answers:
+#
+#   1. probe     — jax.devices() in a subprocess with a hard timeout (the axon
+#                  plugin blocks ~25 min when the tunnel is down and ignores
+#                  JAX_PLATFORMS=cpu, so never probe in-process).
+#   2. parity    — tools/kernel_parity.py: both Pallas kernels Mosaic-compiled
+#                  on the chip vs references (interpret-mode CI can't catch
+#                  lowering failures).
+#   3. ladder    — python bench.py --ladder  → BENCH_LADDER.json
+#                  (configs 1-4 incl. 3-int8/3-int4/4-int4, flash prefill
+#                  rows, serving latency, continuous batching, hbm_util).
+#   4. default   — python bench.py           → the north-star 7B-int8 line.
+#
+# Artifacts land in tools/runbook_out/<UTC timestamp>/ AND BENCH_LADDER.json
+# is updated in place (commit it + regenerate BASELINE.md afterwards:
+# `python tools/gen_baseline.py`).
+#
+# Usage: tools/tpu_runbook.sh [--probe-timeout SECS]
+set -u
+cd "$(dirname "$0")/.."
+
+PROBE_TIMEOUT=150
+[ "${1:-}" = "--probe-timeout" ] && PROBE_TIMEOUT="$2"
+
+STAMP=$(date -u +%Y%m%dT%H%M%SZ)
+OUT="tools/runbook_out/$STAMP"
+mkdir -p "$OUT"
+log() { echo "[runbook $(date -u +%H:%M:%S)] $*" | tee -a "$OUT/runbook.log"; }
+
+log "probe (timeout ${PROBE_TIMEOUT}s)..."
+PLATFORM=$(timeout "$PROBE_TIMEOUT" python -c \
+  "import jax; print(jax.devices()[0].platform)" 2>"$OUT/probe.err" | tail -1)
+if [ "$PLATFORM" != "tpu" ]; then
+  log "probe FAILED (platform='$PLATFORM') — tunnel down or no TPU; aborting."
+  exit 2
+fi
+log "probe OK: tpu"
+
+log "kernel parity (compiled on chip)..."
+if timeout 1800 python tools/kernel_parity.py 2>&1 | tee "$OUT/parity.log"; then
+  log "parity OK"
+else
+  log "parity FAILED — ladder still runs (fallback paths measure), but the"
+  log "kernel rows are suspect; see $OUT/parity.log"
+fi
+
+log "ladder (bench.py --ladder)..."
+timeout 14400 python bench.py --ladder --out BENCH_LADDER.json \
+  2>&1 | tee "$OUT/ladder.log"
+cp -f BENCH_LADDER.json "$OUT/" 2>/dev/null || true
+
+log "default bench (north star)..."
+timeout 3600 python bench.py 2>&1 | tee "$OUT/default.log"
+
+log "done — artifacts in $OUT; now: python tools/gen_baseline.py && git add"
+log "BENCH_LADDER.json BASELINE.md && git commit"

@@ -1,0 +1,25 @@
+#!/usr/bin/env bash
+# Probe the TPU tunnel every INTERVAL seconds; the first time it answers,
+# fire tools/tpu_runbook.sh exactly once and exit.  Designed to run in the
+# background (nohup tools/tpu_watch.sh & ) while CPU-side work continues.
+#
+# Usage: tools/tpu_watch.sh [INTERVAL_SECS (default 180)] [PROBE_TIMEOUT (90)]
+set -u
+cd "$(dirname "$0")/.."
+INTERVAL="${1:-180}"
+PROBE_TIMEOUT="${2:-90}"
+LOG=tools/runbook_out/watch.log
+mkdir -p tools/runbook_out
+
+while true; do
+  P=$(timeout "$PROBE_TIMEOUT" python -c \
+    "import jax; print(jax.devices()[0].platform)" 2>/dev/null | tail -1)
+  if [ "$P" = "tpu" ]; then
+    echo "[watch $(date -u +%H:%M:%S)] TPU UP — firing runbook" >> "$LOG"
+    tools/tpu_runbook.sh >> "$LOG" 2>&1
+    echo "[watch $(date -u +%H:%M:%S)] runbook finished (rc=$?)" >> "$LOG"
+    exit 0
+  fi
+  echo "[watch $(date -u +%H:%M:%S)] tunnel down (probe='$P')" >> "$LOG"
+  sleep "$INTERVAL"
+done
