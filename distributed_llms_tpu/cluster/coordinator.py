@@ -489,11 +489,12 @@ class Coordinator:
         """Mixed-budget generation: each request is {"prompt": str,
         "max_new_tokens": int}.  Served with continuous batching
         (runtime/batcher.py) — per-request budgets, no head-of-line blocking
-        — on single-device workers and on single-process GSPMD data/tensor-
-        parallel meshes.  Pipelined / sequence-parallel meshes, and meshes
-        SPANNING worker processes (multi-host SPMD pools: the task is
-        broadcast like generate_spmd), serve the grouped longest-budget
-        fallback in lockstep."""
+        — on single-device workers and on GSPMD data/tensor-parallel meshes,
+        including multi-host SPMD pools (the batch is broadcast like
+        generate_spmd and every process drives the same batcher in
+        lockstep: scheduling state is host-mirrored numpy, identical
+        everywhere).  Only pipelined / sequence-parallel meshes serve the
+        grouped longest-budget fallback."""
         # Validate before dispatch so single-device (batcher) and mesh
         # (grouped) workers see only well-formed batches — the two engines
         # would otherwise diverge on how a bad request degrades.

@@ -219,29 +219,27 @@ class WorkerHost:
     def _generate_requests(self, requests: list[dict]) -> dict:
         """Mixed-budget batch (GENERATE with a ``requests`` list): served via
         continuous batching — per-request budgets, short replies don't wait
-        for long ones — on single-device engines AND on single-process GSPMD
-        data/tensor-parallel meshes (runtime/batcher.py shards the KV cache
-        and keeps the scheduling state replicated).  Pipelined / sequence-
-        parallel meshes (own decode schedules) and meshes spanning processes
-        (untested batcher lockstep) fall back to one grouped batch at the
-        longest budget."""
+        for long ones — on single-device engines AND on GSPMD data/tensor-
+        parallel meshes, multi-host included (runtime/batcher.py shards the
+        KV cache and host-mirrors the scheduling state so every process
+        stays in lockstep).  Only pipelined / sequence-parallel meshes,
+        whose decode schedules manage their own batching, fall back to one
+        grouped batch at the longest budget."""
         import time as _time
 
         t0 = _time.perf_counter()
         prompts = [r["prompt"] for r in requests]
         budgets = [int(r.get("max_new_tokens", 32)) for r in requests]
         pm = getattr(self.engine, "parallel", None)
-        multi_process = pm is not None and len(
-            {d.process_index for d in pm.mesh.devices.flat}
-        ) > 1
-        # Batcher: single-device engines and single-process GSPMD dp/tp
-        # meshes.  A mesh SPANNING processes stays on the proven grouped
-        # lockstep path until a 2-process test pins the batcher's
-        # replicated-state lockstep there (its host mirrors come from
-        # process-local arrays; that legality is untested multi-process).
+        # Batcher: single-device engines and GSPMD dp/tp meshes — including
+        # meshes SPANNING processes: the scheduling state lives as host
+        # numpy mirrors fed to every process's jit as replicated inputs, so
+        # all hosts drive identical admit/decode sequences (pinned by the
+        # 2-process mixed-budget leg of tests/cluster/test_multihost.py).
+        # Only pipelined / sequence-parallel meshes, whose decode schedules
+        # manage their own batching, take the grouped fallback.
         batcher_ok = hasattr(self.engine, "continuous_batcher") and (
-            pm is None
-            or not (pm.pipelined or pm.seq_parallel or multi_process)
+            pm is None or not (pm.pipelined or pm.seq_parallel)
         )
         if batcher_ok:
             # engine.continuous_batcher rounds the slot count up to divide

@@ -13,12 +13,14 @@ next-step 5): pass ``parallel=`` (a parallel.api.ParallelModel with no
 pipe/seq axes) and the shared KV cache shards over the mesh ('data' on the
 batch axis, 'model' on KV heads) while the per-chunk scheduling state
 (last_tok, valid, active, budget — a few hundred bytes) is constrained
-replicated.  The replication is DESIGNED to let every host of a
-multi-process mesh mirror the same values and drive the admission loop in
-lockstep, but that leg is untested — the cluster worker routes meshes
-spanning processes to its grouped fallback until a 2-process test pins it.
-Pipelined / sequence-parallel meshes keep their own decode schedules
-(wavefront, ring) — the batcher rejects them loudly.
+replicated, then pulled back to HOST numpy mirrors between chunks.  On a
+mesh spanning processes every host therefore feeds identical replicated
+inputs to the same jit sequence and reads back identical mirrors — the
+admission loop stays in lockstep with no cross-host control traffic at
+all (pinned by the 2-process mixed-budget leg of
+tests/cluster/test_multihost.py).  Pipelined / sequence-parallel meshes
+keep their own decode schedules (wavefront, ring) — the batcher rejects
+them loudly.
 
 TPU-native formulation (everything static-shaped, two compiled functions):
 
@@ -395,17 +397,28 @@ class ContinuousBatcher:
                         f"kv_dtype {want!r} conflicts with the mesh's "
                         f"kv_dtype {parallel.kv_dtype!r}"
                     )
-            self.cache = parallel.init_cache(batch_slots, max_len)
+            # Under jit so the zeros+constraint build the GLOBAL sharded
+            # cache directly — on a mesh spanning processes an eager
+            # host-local zeros could not be constrained onto it.
+            self.cache = jax.jit(
+                lambda: parallel.init_cache(batch_slots, max_len)
+            )()
         else:
             self.cache = model_lib.init_cache(
                 cfg, batch_slots, max_len,
                 dtype=jnp.dtype(kv_dtype) if kv_dtype else None,
             )
-        self.last_tok = jnp.zeros((batch_slots,), jnp.int32)
-        self.real_lens = jnp.zeros((batch_slots,), jnp.int32)
-        self.valid = jnp.zeros((batch_slots, max_len), bool)
-        self.active = jnp.zeros((batch_slots,), bool)
-        self.budget = jnp.zeros((batch_slots,), jnp.int32)
+        # Scheduling state lives as HOST numpy mirrors: every process holds
+        # the same values (the jitted chunk fns return them constrained
+        # replicated, and np.asarray of a replicated output is legal on all
+        # processes), and feeding numpy back in treats it as a replicated
+        # input — no eager device ops on global arrays anywhere, which is
+        # what keeps a multi-process mesh in lockstep.
+        self.last_tok = np.zeros((batch_slots,), np.int32)
+        self.real_lens = np.zeros((batch_slots,), np.int32)
+        self.valid = np.zeros((batch_slots, max_len), bool)
+        self.active = np.zeros((batch_slots,), bool)
+        self.budget = np.zeros((batch_slots,), np.int32)
         self.rows = [_RowState() for _ in range(batch_slots)]
         self.queue: deque[_Request] = deque()
         self.results: dict[int, list[int]] = {}
@@ -474,7 +487,7 @@ class ContinuousBatcher:
         return sub
 
     def _admit_pending(self) -> None:
-        active_host = np.asarray(self.active)
+        active_host = self.active
         for i in range(self.b):
             if not self.queue:
                 return
@@ -505,20 +518,21 @@ class ContinuousBatcher:
                     self._split_rng(), pm=self.pm, **self.sampling,
                 )
             total_len = pfx_len + len(req.ids)
-            self.last_tok = self.last_tok.at[i].set(tok)
-            self.real_lens = self.real_lens.at[i].set(total_len)
-            self.valid = self.valid.at[i].set(row_valid)
-            self.active = self.active.at[i].set(True)
+            tok = int(tok)  # replicated scalar — identical on every process
+            self.last_tok[i] = tok
+            self.real_lens[i] = total_len
+            self.valid[i] = np.asarray(row_valid)
+            self.active[i] = True
             # The first token came out of admission; the row may emit
             # budget-1 more from decode chunks.
-            self.budget = self.budget.at[i].set(req.max_new_tokens - 1)
+            self.budget[i] = req.max_new_tokens - 1
             self.rows[i] = _RowState(
-                rid=req.rid, emitted=[int(tok)],
+                rid=req.rid, emitted=[tok],
                 remaining=req.max_new_tokens - 1,
             )
             log.debug("admitted request %d into slot %d", req.rid, i)
-            if req.max_new_tokens == 1 or int(tok) == self.eos_id:
-                self.active = self.active.at[i].set(False)
+            if req.max_new_tokens == 1 or tok == self.eos_id:
+                self.active[i] = False
             METRICS.inc("batcher.admitted")
 
     def _collect(self, toks: np.ndarray, was_active: np.ndarray) -> None:
@@ -535,7 +549,7 @@ class ContinuousBatcher:
                 if t == self.eos_id:
                     break
         # Rows that finished this chunk publish their result and free up.
-        active_host = np.asarray(self.active)
+        active_host = self.active
         for i in range(self.b):
             row = self.rows[i]
             if row.rid is not None and not active_host[i]:
@@ -550,11 +564,11 @@ class ContinuousBatcher:
     def run(self) -> dict[int, list[int]]:
         """Drive until every submitted request has a result."""
         # Publish any 1-token requests finished by admission alone.
-        while self.queue or bool(np.any(np.asarray(self.active))) or any(
+        while self.queue or bool(self.active.any()) or any(
             r.rid is not None for r in self.rows
         ):
             self._admit_pending()
-            was_active = np.asarray(self.active)
+            was_active = self.active.copy()
             if not was_active.any():
                 self._collect(
                     np.zeros((self.b, 0), np.int32), was_active
@@ -562,13 +576,21 @@ class ContinuousBatcher:
                 if not self.queue and all(r.rid is None for r in self.rows):
                     break
                 continue
-            toks, self.cache, self.last_tok, self.real_lens, self.valid, \
-                self.active, self.budget = decode_chunk(
+            toks, self.cache, last_tok, real_lens, valid, active, budget = \
+                decode_chunk(
                     self.params, self.cfg_decode, self.cache, self.last_tok,
                     self.real_lens, self.valid, self.active, self.budget,
                     self._split_rng(), self.chunk_steps,
                     eos_id=self.eos_id, pad_id=self.pad_id, pm=self.pm,
                     **self.sampling,
                 )
+            # Back to host numpy mirrors (replicated outputs — every
+            # process reads identical values).  np.array, not asarray:
+            # device views are read-only and admission writes into these.
+            self.last_tok = np.array(last_tok)
+            self.real_lens = np.array(real_lens)
+            self.valid = np.array(valid)
+            self.active = np.array(active)
+            self.budget = np.array(budget)
             self._collect(np.asarray(toks), was_active)
         return dict(self.results)

@@ -125,6 +125,23 @@ async def test_two_process_generate_roundtrip(tmp_path):
         expect = ref.generate_text(["hello multi host"], max_new_tokens=8)
         assert out["text"] == expect.text
 
+        # Mixed-budget leg: the pool serves a requests list through the
+        # MULTI-HOST continuous batcher (runtime/batcher.py host-mirrors
+        # the scheduling state, so both processes drive identical
+        # admit/decode sequences over the cross-process mesh).  Each
+        # reply must equal the single-process engine at that request's
+        # own budget — per-request budgets survive the mesh.
+        mixed = [
+            {"prompt": "hello multi host", "max_new_tokens": 3},
+            {"prompt": "second request", "max_new_tokens": 8},
+        ]
+        out2 = await coord.generate_requests(mixed, timeout=240.0)
+        for i, req in enumerate(mixed):
+            want = ref.generate_text(
+                [req["prompt"]], max_new_tokens=req["max_new_tokens"]
+            )
+            assert out2["text"][i] == want.text[0], (i, out2["text"], want.text)
+
         # Clean shutdown: workers exit their serve loop and the children
         # print CHILD_OK with rc=0.
         for wid in list(coord.workers):

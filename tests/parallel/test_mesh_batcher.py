@@ -71,9 +71,11 @@ def test_mesh_mixed_budgets_match_solo(tiny, devices8):
     # while the shared cache batch axis shards over 'data'.
     rids = [b.submit(ids, max_new_tokens=n) for ids, n in reqs]
     res = b.run()
-    assert b.active.sharding.is_fully_replicated
-    assert b.last_tok.sharding.is_fully_replicated
-    assert not b.cache.k.sharding.is_fully_replicated  # batch axis on 'data'
+    # Scheduling state lives as host numpy mirrors (identical on every
+    # process of a multi-host mesh); only the cache stays on-device, with
+    # its batch axis sharded over 'data'.
+    assert isinstance(b.active, np.ndarray) and isinstance(b.last_tok, np.ndarray)
+    assert not b.cache.k.sharding.is_fully_replicated
     for rid, (ids, n) in zip(rids, reqs):
         assert res[rid] == solo(cfg, params, ids, n), f"request {rid} diverged"
 

@@ -104,8 +104,8 @@ def test_late_submission_joins_inflight_batch(tiny):
     r2 = b.submit([4, 4, 4, 4], max_new_tokens=13)
     # Drive a couple of chunks manually, then inject a new request.
     b._admit_pending()
-    was = np.asarray(b.active)
-    toks, b.cache, b.last_tok, b.real_lens, b.valid, b.active, b.budget = (
+    was = b.active.copy()
+    toks, b.cache, last_tok, real_lens, valid, active, budget = (
         __import__(
             "distributed_llms_tpu.runtime.batcher", fromlist=["decode_chunk"]
         ).decode_chunk(
@@ -113,6 +113,11 @@ def test_late_submission_joins_inflight_batch(tiny):
             b.active, b.budget, b._split_rng(), b.chunk_steps,
             eos_id=b.eos_id, pad_id=b.pad_id, **b.sampling,
         )
+    )
+    # State mirrors are host numpy (writable) — same conversion run() does.
+    b.last_tok, b.real_lens, b.valid, b.active, b.budget = (
+        np.array(last_tok), np.array(real_lens), np.array(valid),
+        np.array(active), np.array(budget),
     )
     b._collect(np.asarray(toks), was)
     r3 = b.submit([9, 9, 1], max_new_tokens=6)
