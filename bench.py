@@ -430,6 +430,83 @@ def _measure_serving_latency(
     return out
 
 
+def _measure_ragged_decode(
+    preset: str = "tinyllama-1.1b", dtype: str = "bfloat16",
+    max_len: int = 8192, slots: int = 8, iters: int = 5,
+) -> dict:
+    """Long-context decode-chunk latency: dense full-width attention vs the
+    ragged decode kernel (ops/decode_attn.py) on a batch whose rows sit at
+    very different cache depths — the continuous-batcher traffic shape.  The
+    dense path reads all B*S KV slots per step; the ragged kernel reads only
+    sum(lengths).  Real kernels only (TPU) — interpret mode would time the
+    emulator."""
+    import dataclasses
+    import os
+
+    import numpy as np
+
+    from distributed_llms_tpu.models import model as model_lib
+    from distributed_llms_tpu.models.presets import get_preset
+    from distributed_llms_tpu.runtime import batcher as batcher_lib
+
+    # Extend max_seq_len to the measured width (RoPE is computed, not a
+    # table — positions past the trained range are numerically fine for a
+    # throughput measurement); without this the tinyllama preset's 2048 cap
+    # would silently shrink the "8k" row to a 2k measurement.
+    cfg = get_preset(preset, dtype=dtype, max_seq_len=max_len)
+    params = model_lib.init_params(jax.random.key(0), cfg)
+    rng = np.random.RandomState(0)
+    # Mixed depths: a few deep rows, mostly shallow — mean fill ~35%.
+    # Ranges clamp so tiny max_len (CPU smoke) stays valid.
+    n_deep = max(1, slots // 4)
+    deep_lo, deep_hi = max_len // 2, max(max_len // 2 + 1, max_len - 64)
+    shal_lo, shal_hi = min(64, max(1, max_len // 8)), max_len // 4
+    shal_hi = max(shal_hi, shal_lo + 1)
+    lens = np.concatenate([
+        rng.randint(deep_lo, deep_hi, size=n_deep),
+        rng.randint(shal_lo, shal_hi, size=slots - n_deep),
+    ]).astype(np.int32)
+    cache = model_lib.init_cache(cfg, slots, max_len)
+    last_tok = np.ones((slots,), np.int32)
+    valid = (np.arange(max_len)[None, :] < lens[:, None])
+    active = np.ones((slots,), bool)
+    budget = np.full((slots,), 1 << 20, np.int32)
+
+    def time_mode(ragged: bool) -> float:
+        c = dataclasses.replace(cfg, ragged_decode=ragged)
+        # Fresh donate-able cache per timing (decode_chunk donates).
+        args = (params, c, jax.tree.map(jnp.copy, cache), jnp.asarray(last_tok),
+                jnp.asarray(lens), jnp.asarray(valid), jnp.asarray(active),
+                jnp.asarray(budget), jax.random.key(0))
+        out = batcher_lib.decode_chunk(*args, 8)  # warm compile
+        jax.block_until_ready(out[1].k)
+        best = float("inf")
+        for _ in range(iters):
+            args = (params, c, jax.tree.map(jnp.copy, cache),
+                    jnp.asarray(last_tok), jnp.asarray(lens),
+                    jnp.asarray(valid), jnp.asarray(active),
+                    jnp.asarray(budget), jax.random.key(0))
+            t0 = time.perf_counter()
+            out = batcher_lib.decode_chunk(*args, 8)
+            jax.block_until_ready(out[1].k)
+            best = min(best, time.perf_counter() - t0)
+        return best
+
+    os.environ.setdefault("DLT_RAGGED_DECODE", "auto")
+    t_dense = time_mode(False)
+    t_ragged = time_mode(True)
+    return {
+        "preset": preset,
+        "max_len": max_len,
+        "slots": slots,
+        "mean_fill": round(float(lens.mean()) / max_len, 3),
+        "platform": jax.devices()[0].platform,
+        "dense_chunk_ms": round(t_dense * 1e3, 1),
+        "ragged_chunk_ms": round(t_ragged * 1e3, 1),
+        "speedup": round(t_dense / t_ragged, 3),
+    }
+
+
 def _measure_continuous_batching(
     preset: str, dtype: str, quant: str | None = None,
     slots: int = 4, requests: int = 16, chunk_steps: int = 8,
@@ -703,6 +780,20 @@ def run_ladder(args, degraded: str | None) -> list[dict]:
     print(f"# continuous batching: {row}", file=sys.stderr)
     _write_rows(args.out, rows)
     if not on_cpu:
+        # Long-context ragged decode: dense full-width vs the ragged kernel
+        # at 8k cache width, mixed row depths (real kernels only).
+        row = {"config": "ragged-decode-8k"}
+        try:
+            row.update(_measure_ragged_decode(dtype=dtype))
+            row["measured_on"] = _stamp()
+        except Exception as exc:
+            row["skipped"] = (
+                f"{type(exc).__name__}: "
+                f"{(str(exc).splitlines() or ['?'])[0][:200]}"
+            )
+        rows.append(row)
+        print(f"# ragged decode: {row}", file=sys.stderr)
+        _write_rows(args.out, rows)
         # Flash-attention prefill microbenchmark (real kernels only — CPU
         # interpret mode would measure the emulator, not the kernel).
         # seq=2048 is the short-context sanity point; seq=8192 (batch 1) is

@@ -32,7 +32,10 @@ def _result_cell(row: dict) -> str:
         ("weight_stream_gb_per_s", "weight-stream GB/s"),
         ("ttft_p50_ms", "TTFT p50 ms"), ("ttft_p95_ms", "TTFT p95 ms"),
         ("tpot_ms", "TPOT ms"), ("tok_per_s_steady", "steady tok/s"),
-        ("speedup_vs_grouped", "vs grouped"),
+        ("tok_per_s_continuous", "continuous tok/s"),
+        ("tok_per_s_grouped", "grouped tok/s"),
+        ("dense_chunk_ms", "dense ms"), ("ragged_chunk_ms", "ragged ms"),
+        ("speedup", "speedup"),
         ("flash_ms", "flash ms"), ("dot_ms", "dot ms"),
         ("p50_us", "p50 µs"), ("p95_us", "p95 µs"),
     ):
@@ -64,7 +67,7 @@ def generate(ladder_path: str) -> str:
     ]
     listed = [str(e["config"]) for e in bench.LADDER] + [
         # Aux rows run_ladder appends after the decode configs.
-        "serving-latency", "continuous-batching",
+        "serving-latency", "continuous-batching", "ragged-decode-8k",
         "prefill-flash-2048", "prefill-flash-8192", "hop-latency",
     ]
     extras = [c for c in rows if c not in listed]
