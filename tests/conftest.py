@@ -20,6 +20,22 @@ import jax
 
 jax.config.update("jax_platforms", "cpu")
 
+# Persistent compilation cache: the suite is compile-bound on the 1-core
+# fake mesh (~23 min cold), and XLA recompiles identical programs every
+# run.  A warm cache cuts the heavy jit waits ~5x (measured 10.8s -> 1.9s
+# on the pipelined train step).  Safe on one machine; set DLT_TEST_NO_CACHE=1
+# to measure cold-compile behavior.  CI persists the directory via
+# actions/cache.
+if os.environ.get("DLT_TEST_NO_CACHE") != "1":
+    _cache_dir = os.environ.get(
+        "DLT_TEST_CACHE_DIR",
+        os.path.join(
+            os.environ.get("TMPDIR", "/tmp"), "dlt-jax-test-cache"
+        ),
+    )
+    jax.config.update("jax_compilation_cache_dir", _cache_dir)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+
 import asyncio
 import inspect
 
