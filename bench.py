@@ -76,6 +76,15 @@ LADDER = [
     # 13B (int4, ~7.8 GB weights) fit — and be measured on — one 16 GB chip.
     {"config": "3-int8", "preset": "llama-2-7b", "batch": 4, "prompt": 64,
      "new": 16, "quant": "int8"},
+    # Batch sweep for the quantized north star: decode reads the same
+    # weight bytes per step regardless of batch, so aggregate tok/s should
+    # climb toward the weight-stream ceiling (~480 tok/s at batch 4 rises
+    # ~linearly until activations/KV contend) — the next lever after the
+    # fused kernel itself (VERDICT r3 next-step 2).
+    {"config": "3-int8-b8", "preset": "llama-2-7b", "batch": 8, "prompt": 64,
+     "new": 16, "quant": "int8"},
+    {"config": "3-int8-b16", "preset": "llama-2-7b", "batch": 16,
+     "prompt": 64, "new": 16, "quant": "int8"},
     {"config": "3-int4", "preset": "llama-2-7b", "batch": 4, "prompt": 64,
      "new": 16, "quant": "int4"},
     {"config": 4, "preset": "llama-2-13b", "batch": 2, "prompt": 64, "new": 16},
