@@ -183,6 +183,16 @@ def convert_llama(sd: StateDict, cfg: ModelConfig) -> dict[str, Any]:
                 "wk": _stack(sd, "layers.{i}.self_attn.k_proj.weight", L, lambda w: w.T.reshape(D, KVH, HD)),
                 "wv": _stack(sd, "layers.{i}.self_attn.v_proj.weight", L, lambda w: w.T.reshape(D, KVH, HD)),
                 "wo": _stack(sd, "layers.{i}.self_attn.o_proj.weight", L, lambda w: w.T.reshape(H, HD, D)),
+                # Qwen2: llama layout plus q/k/v biases (cfg.qkv_bias).
+                **(
+                    {
+                        "bq": _stack(sd, "layers.{i}.self_attn.q_proj.bias", L, lambda b: b.reshape(H, HD)),
+                        "bk": _stack(sd, "layers.{i}.self_attn.k_proj.bias", L, lambda b: b.reshape(KVH, HD)),
+                        "bv": _stack(sd, "layers.{i}.self_attn.v_proj.bias", L, lambda b: b.reshape(KVH, HD)),
+                    }
+                    if cfg.qkv_bias
+                    else {}
+                ),
             },
             "mlp": (
                 {
@@ -358,6 +368,32 @@ def config_from_hf(hf_config: Mapping[str, Any]) -> ModelConfig:
             # HF "gelu" is the exact erf form; "gelu_new" the tanh approx.
             # Anything else is rejected rather than silently approximated.
             activation=_opt_activation(hf_config.get("activation_function", "relu")),
+        )
+    if model_type == "qwen2" or "qwen2for" in arch:
+        # Qwen2 = llama layout + q/k/v biases.  Sliding-window attention is
+        # off for the released dense checkpoints' default configs; reject a
+        # config that actually enables it rather than silently attending
+        # globally.
+        if hf_config.get("use_sliding_window", False):
+            raise ValueError(
+                "Qwen2 with use_sliding_window=True is not supported "
+                "(global attention only)"
+            )
+        return ModelConfig(
+            family="llama",
+            qkv_bias=True,
+            vocab_size=hf_config["vocab_size"],
+            hidden_size=hf_config["hidden_size"],
+            intermediate_size=hf_config["intermediate_size"],
+            num_layers=hf_config["num_hidden_layers"],
+            num_heads=hf_config["num_attention_heads"],
+            num_kv_heads=hf_config.get(
+                "num_key_value_heads", hf_config["num_attention_heads"]
+            ),
+            max_seq_len=hf_config.get("max_position_embeddings", 32768),
+            rope_theta=hf_config.get("rope_theta", 1e6),
+            norm_eps=hf_config.get("rms_norm_eps", 1e-6),
+            tie_embeddings=hf_config.get("tie_word_embeddings", False),
         )
     if model_type in ("llama", "mixtral") or "llama" in arch or "mixtral" in arch:
         return ModelConfig(

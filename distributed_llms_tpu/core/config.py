@@ -62,6 +62,9 @@ class ModelConfig:
     # "ulysses" (sequence-parallel all-to-all head scatter over 'seq';
     # needs num_heads and num_kv_heads divisible by the seq axis).
     attn_impl: str = "dot"
+    # Llama-layout blocks with q/k/v projection biases (Qwen2's one
+    # architectural delta from Llama); gpt2/opt layouts always carry theirs.
+    qkv_bias: bool = False
     # Ragged single-token decode attention (ops/decode_attn.py): row b reads
     # only its cache prefix [0, cache_index[b]] instead of the full width S.
     # Opt-in CONTRACT flag, not just a speed knob: setting it asserts the

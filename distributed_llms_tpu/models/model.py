@@ -455,15 +455,20 @@ def init_params(rng: jax.Array, cfg: ModelConfig, dtype: Any = None) -> Params:
                 "w_up": dense(next(keys), (L, D, F), D),
                 "w_down": dense(next(keys), (L, F, D), F),
             }
+        attn = {
+            "wq": dense(next(keys), (L, D, H, HD), D),
+            "wk": dense(next(keys), (L, D, KVH, HD), D),
+            "wv": dense(next(keys), (L, D, KVH, HD), D),
+            "wo": dense(next(keys), (L, H, HD, D), H * HD),
+        }
+        if cfg.qkv_bias:  # Qwen2-style llama blocks
+            attn["bq"] = jnp.zeros((L, H, HD), dtype)
+            attn["bk"] = jnp.zeros((L, KVH, HD), dtype)
+            attn["bv"] = jnp.zeros((L, KVH, HD), dtype)
         params["blocks"] = {
             "ln1": {"scale": jnp.ones((L, D), dtype)},
             "ln2": {"scale": jnp.ones((L, D), dtype)},
-            "attn": {
-                "wq": dense(next(keys), (L, D, H, HD), D),
-                "wk": dense(next(keys), (L, D, KVH, HD), D),
-                "wv": dense(next(keys), (L, D, KVH, HD), D),
-                "wo": dense(next(keys), (L, H, HD, D), H * HD),
-            },
+            "attn": attn,
             "mlp": mlp,
         }
     else:

@@ -46,6 +46,11 @@ PRESETS: dict[str, ModelConfig] = {
         num_layers=80, num_heads=64, num_kv_heads=8, max_seq_len=8192,
         rope_theta=500000.0, norm_eps=1e-5, tie_embeddings=False,
     ),
+    "qwen2-7b": ModelConfig(
+        family="llama", qkv_bias=True, vocab_size=152064, hidden_size=3584,
+        intermediate_size=18944, num_layers=28, num_heads=28, num_kv_heads=4,
+        max_seq_len=32768, rope_theta=1e6, norm_eps=1e-6, tie_embeddings=False,
+    ),
     "mixtral-8x7b": ModelConfig(
         family="llama", vocab_size=32000, hidden_size=4096, intermediate_size=14336,
         num_layers=32, num_heads=32, num_kv_heads=8, max_seq_len=32768,
@@ -85,6 +90,7 @@ HF_REPOS: dict[str, str] = {
     "llama-2-7b": "meta-llama/Llama-2-7b-hf",
     "llama-2-13b": "meta-llama/Llama-2-13b-hf",
     "llama-3-70b": "meta-llama/Meta-Llama-3-70B",
+    "qwen2-7b": "Qwen/Qwen2-7B",
 }
 
 

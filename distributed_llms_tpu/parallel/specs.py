@@ -53,13 +53,17 @@ def param_specs(cfg: ModelConfig, mesh: Mesh) -> Params:
         "wv": P(pipe, None, kv_ax, None),
         "wo": P(pipe, q_ax, None, None),
     }
+    if cfg.qkv_bias or cfg.family in ("gpt2", "opt"):
+        # q/k/v biases shard with their head axes (gpt2/opt always carry
+        # them; llama only in the Qwen2-style qkv_bias layout).
+        attn.update(
+            bq=P(pipe, q_ax, None), bk=P(pipe, kv_ax, None),
+            bv=P(pipe, kv_ax, None),
+        )
     if cfg.family in ("gpt2", "opt"):
         specs["embed"]["wpe"] = P(None, None)
         specs["final_norm"]["bias"] = P(None)
-        attn.update(
-            bq=P(pipe, q_ax, None), bk=P(pipe, kv_ax, None),
-            bv=P(pipe, kv_ax, None), bo=P(pipe, None),
-        )
+        attn["bo"] = P(pipe, None)
         mlp = {
             "w_in": P(pipe, None, f_ax), "b_in": P(pipe, f_ax),
             "w_out": P(pipe, f_ax, None), "b_out": P(pipe, None),

@@ -109,9 +109,31 @@ def _hf_opt_pair():
     return hf_model, cfg, params
 
 
+def _hf_qwen2_pair():
+    import torch
+    from transformers import Qwen2Config, Qwen2ForCausalLM
+
+    hf_cfg = Qwen2Config(
+        vocab_size=97, hidden_size=32, intermediate_size=88,
+        num_hidden_layers=3, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=64, rms_norm_eps=1e-6,
+        tie_word_embeddings=False, attention_dropout=0.0,
+        use_sliding_window=False,
+    )
+    torch.manual_seed(0)
+    hf_model = Qwen2ForCausalLM(hf_cfg).eval()
+    cfg = convert.config_from_hf(hf_cfg.to_dict())
+    assert cfg.qkv_bias  # Qwen2's delta from llama
+    cfg = cfg.__class__(**{**cfg.__dict__, "dtype": "float32"})
+    sd = convert.torch_state_dict_to_numpy(hf_model.state_dict())
+    params = convert.convert_state_dict(sd, cfg)
+    assert "bq" in params["blocks"]["attn"]
+    return hf_model, cfg, params
+
+
 @pytest.mark.parametrize(
-    "maker", [_hf_gpt2_pair, _hf_llama_pair, _hf_opt_pair],
-    ids=["gpt2", "llama", "opt"],
+    "maker", [_hf_gpt2_pair, _hf_llama_pair, _hf_opt_pair, _hf_qwen2_pair],
+    ids=["gpt2", "llama", "opt", "qwen2"],
 )
 def test_golden_parity_vs_transformers(maker):
     import torch
