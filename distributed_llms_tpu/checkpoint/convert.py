@@ -172,12 +172,15 @@ def convert_llama(sd: StateDict, cfg: ModelConfig) -> dict[str, Any]:
     sd = _strip_prefix(sd, ("model.",))
     D, H, KVH, HD = cfg.hidden_size, cfg.num_heads, cfg.num_kv_heads, cfg.head_dim_
     L = cfg.num_layers
+    # Gemma's RMSNorm computes with (1 + weight); fold the +1 into the
+    # stored scales so the runtime rms_norm stays one implementation.
+    norm_of = (lambda w: w + 1.0) if cfg.norm_plus_one else (lambda w: w)
     params = {
         "embed": {"wte": np.asarray(sd["embed_tokens.weight"])},
-        "final_norm": {"scale": np.asarray(sd["norm.weight"])},
+        "final_norm": {"scale": norm_of(np.asarray(sd["norm.weight"]))},
         "blocks": {
-            "ln1": {"scale": _stack(sd, "layers.{i}.input_layernorm.weight", L, lambda x: x)},
-            "ln2": {"scale": _stack(sd, "layers.{i}.post_attention_layernorm.weight", L, lambda x: x)},
+            "ln1": {"scale": _stack(sd, "layers.{i}.input_layernorm.weight", L, norm_of)},
+            "ln2": {"scale": _stack(sd, "layers.{i}.post_attention_layernorm.weight", L, norm_of)},
             "attn": {
                 "wq": _stack(sd, "layers.{i}.self_attn.q_proj.weight", L, lambda w: w.T.reshape(D, H, HD)),
                 "wk": _stack(sd, "layers.{i}.self_attn.k_proj.weight", L, lambda w: w.T.reshape(D, KVH, HD)),
@@ -395,9 +398,48 @@ def config_from_hf(hf_config: Mapping[str, Any]) -> ModelConfig:
             norm_eps=hf_config.get("rms_norm_eps", 1e-6),
             tie_embeddings=hf_config.get("tie_word_embeddings", False),
         )
+    if model_type == "gemma" or "gemmafor" in arch:
+        # Gemma-1 = llama layout with GeGLU, (1+w) RMSNorm (folded at
+        # convert), sqrt(hidden) embedding scale, explicit head_dim, tied
+        # embeddings.  Gemma-2 (model_type "gemma2": logit softcapping,
+        # alternating local attention) is a different architecture —
+        # rejected by falling through to the ValueError below.
+        act = hf_config.get("hidden_activation") or hf_config.get("hidden_act")
+        if act not in (None, "gelu_pytorch_tanh"):
+            # HF honors an explicit exact-erf "gelu" here; reject rather
+            # than silently approximate (same convention as _opt_activation).
+            raise ValueError(
+                f"gemma hidden_activation {act!r} is not supported "
+                "(gelu_pytorch_tanh only)"
+            )
+        hidden = hf_config["hidden_size"]
+        return ModelConfig(
+            family="llama",
+            gate_act="gelu_tanh",
+            qkv_bias=bool(hf_config.get("attention_bias", False)),
+            norm_plus_one=True,
+            embed_scale=float(hidden) ** 0.5,
+            vocab_size=hf_config["vocab_size"],
+            hidden_size=hidden,
+            intermediate_size=hf_config["intermediate_size"],
+            num_layers=hf_config["num_hidden_layers"],
+            num_heads=hf_config["num_attention_heads"],
+            num_kv_heads=hf_config.get(
+                "num_key_value_heads", hf_config["num_attention_heads"]
+            ),
+            head_dim=hf_config.get("head_dim"),
+            max_seq_len=hf_config.get("max_position_embeddings", 8192),
+            rope_theta=hf_config.get("rope_theta", 10000.0),
+            norm_eps=hf_config.get("rms_norm_eps", 1e-6),
+            tie_embeddings=hf_config.get("tie_word_embeddings", True),
+        )
     if model_type in ("llama", "mixtral") or "llama" in arch or "mixtral" in arch:
         return ModelConfig(
             family="llama",
+            # Community fine-tunes sometimes enable projection biases on the
+            # llama architecture; converting them without the bias leaves
+            # would be silently wrong logits.
+            qkv_bias=bool(hf_config.get("attention_bias", False)),
             vocab_size=hf_config["vocab_size"],
             hidden_size=hf_config["hidden_size"],
             intermediate_size=hf_config["intermediate_size"],

@@ -65,6 +65,16 @@ class ModelConfig:
     # Llama-layout blocks with q/k/v projection biases (Qwen2's one
     # architectural delta from Llama); gpt2/opt layouts always carry theirs.
     qkv_bias: bool = False
+    # Gated-MLP activation for the llama family: "silu" (Llama/Qwen2) or
+    # "gelu_tanh" (Gemma's GeGLU).  MoE blocks stay silu (Mixtral).
+    gate_act: str = "silu"
+    # Embedding multiplier applied after lookup (Gemma: sqrt(hidden_size)).
+    embed_scale: float = 1.0
+    # CONVERTER-ONLY flag: the checkpoint's RMSNorm computes with
+    # (1 + weight) (Gemma); convert folds the +1 into the stored scales so
+    # the runtime rms_norm stays unchanged.  Random init (ones) is already
+    # the folded identity.
+    norm_plus_one: bool = False
     # Ragged single-token decode attention (ops/decode_attn.py): row b reads
     # only its cache prefix [0, cache_index[b]] instead of the full width S.
     # Opt-in CONTRACT flag, not just a speed knob: setting it asserts the
@@ -77,6 +87,14 @@ class ModelConfig:
             raise ValueError(
                 f"unknown attn_impl {self.attn_impl!r}; choose from {sorted(_ATTN_IMPLS)}"
             )
+        if self.gate_act not in ("silu", "gelu_tanh"):
+            raise ValueError(
+                f"unknown gate_act {self.gate_act!r}; choose silu or gelu_tanh"
+            )
+        if self.gate_act != "silu" and self.num_experts > 0:
+            # moe_swiglu hardcodes silu (Mixtral); accepting another
+            # activation here would silently ignore it.
+            raise ValueError("MoE blocks support gate_act='silu' only")
     # MoE (expert parallelism); num_experts == 0 -> dense MLP.
     num_experts: int = 0
     num_experts_per_token: int = 2
@@ -151,6 +169,10 @@ class RuntimeConfig:
     remat: bool = False  # jax.checkpoint on decoder blocks
     seed: int = 0
     profile_dir: str | None = None  # capture jax.profiler traces of generate
+    # Persistent XLA compilation cache: a serving process restarted on the
+    # same model skips the first-compile wait (~20-40 s on TPU for a 7B
+    # decode graph).  Enabled once per process, before the first jit.
+    compilation_cache_dir: str | None = None
 
 
 @dataclass(frozen=True)

@@ -183,11 +183,16 @@ def mlp_gelu(x: jax.Array, p: Params, activation: str = "gelu") -> jax.Array:
     return _contract(h, p["w_out"], "btf,fd->btd", 1) + _plain(p["b_out"])
 
 
-def mlp_swiglu(x: jax.Array, p: Params) -> jax.Array:
-    """Llama MLP: (silu(x W_gate) * (x W_up)) W_down, no biases."""
+def mlp_swiglu(x: jax.Array, p: Params, gate_act: str = "silu") -> jax.Array:
+    """Gated MLP: (act(x W_gate) * (x W_up)) W_down, no biases.
+    ``gate_act``: "silu" (Llama/Qwen2) or "gelu_tanh" (Gemma's GeGLU)."""
     gate = _contract(x, p["w_gate"], "btd,df->btf", 1)
     up = _contract(x, p["w_up"], "btd,df->btf", 1)
-    h = jax.nn.silu(gate) * up
+    act = (
+        jax.nn.silu if gate_act == "silu"
+        else lambda g: jax.nn.gelu(g, approximate=True)
+    )
+    h = act(gate) * up
     return _contract(h, p["w_down"], "btf,fd->btd", 1)
 
 

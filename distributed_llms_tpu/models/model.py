@@ -280,7 +280,7 @@ def llama_block(x, p, cfg, positions, layer_cache, cache_index, attn_mask=None, 
     if "router" in p["mlp"]:  # MoE block (cfg.num_experts > 0)
         mlp_out, aux = layers.moe_swiglu(h, p["mlp"], cfg)
         return x + mlp_out, new_cache, aux
-    x = x + layers.mlp_swiglu(h, p["mlp"])
+    x = x + layers.mlp_swiglu(h, p["mlp"], cfg.gate_act)
     return x, new_cache, jnp.float32(0.0)
 
 
@@ -342,7 +342,12 @@ def embed(params: Params, cfg: ModelConfig, tokens: jax.Array, positions: jax.Ar
         # (OPTLearnedPositionalEmbedding); the converted table keeps it.
         off = 2 if cfg.family == "opt" else 0
         x = x + jnp.take(params["embed"]["wpe"], positions + off, axis=0)
-    return x.astype(jnp.dtype(cfg.dtype))
+    x = x.astype(jnp.dtype(cfg.dtype))
+    if cfg.embed_scale != 1.0:
+        # Gemma scales embeddings by sqrt(hidden) in the compute dtype
+        # (HF casts the normalizer to hidden_states.dtype before the mul).
+        x = x * jnp.asarray(cfg.embed_scale, x.dtype)
+    return x
 
 
 def unembed(params: Params, cfg: ModelConfig, x: jax.Array) -> jax.Array:

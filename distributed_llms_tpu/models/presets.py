@@ -51,6 +51,13 @@ PRESETS: dict[str, ModelConfig] = {
         intermediate_size=18944, num_layers=28, num_heads=28, num_kv_heads=4,
         max_seq_len=32768, rope_theta=1e6, norm_eps=1e-6, tie_embeddings=False,
     ),
+    "gemma-7b": ModelConfig(
+        family="llama", gate_act="gelu_tanh", norm_plus_one=True,
+        embed_scale=3072.0**0.5, vocab_size=256000, hidden_size=3072,
+        intermediate_size=24576, num_layers=28, num_heads=16, num_kv_heads=16,
+        head_dim=256, max_seq_len=8192, rope_theta=10000.0, norm_eps=1e-6,
+        tie_embeddings=True,
+    ),
     "mixtral-8x7b": ModelConfig(
         family="llama", vocab_size=32000, hidden_size=4096, intermediate_size=14336,
         num_layers=32, num_heads=32, num_kv_heads=8, max_seq_len=32768,
@@ -91,6 +98,7 @@ HF_REPOS: dict[str, str] = {
     "llama-2-13b": "meta-llama/Llama-2-13b-hf",
     "llama-3-70b": "meta-llama/Meta-Llama-3-70B",
     "qwen2-7b": "Qwen/Qwen2-7B",
+    "gemma-7b": "google/gemma-7b",
 }
 
 

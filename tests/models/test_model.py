@@ -131,9 +131,33 @@ def _hf_qwen2_pair():
     return hf_model, cfg, params
 
 
+def _hf_gemma_pair():
+    import torch
+    from transformers import GemmaConfig, GemmaForCausalLM
+
+    hf_cfg = GemmaConfig(
+        vocab_size=97, hidden_size=32, intermediate_size=88,
+        num_hidden_layers=3, num_attention_heads=4, num_key_value_heads=2,
+        head_dim=16,  # explicit: heads * head_dim != hidden is Gemma-legal
+        max_position_embeddings=64, rms_norm_eps=1e-6,
+        hidden_activation="gelu_pytorch_tanh", attention_dropout=0.0,
+    )
+    torch.manual_seed(0)
+    hf_model = GemmaForCausalLM(hf_cfg).eval()
+    cfg = convert.config_from_hf(hf_cfg.to_dict())
+    assert cfg.gate_act == "gelu_tanh" and cfg.norm_plus_one
+    assert cfg.head_dim_ == 16 and cfg.embed_scale == 32.0**0.5
+    cfg = cfg.__class__(**{**cfg.__dict__, "dtype": "float32"})
+    sd = convert.torch_state_dict_to_numpy(hf_model.state_dict())
+    params = convert.convert_state_dict(sd, cfg)
+    return hf_model, cfg, params
+
+
 @pytest.mark.parametrize(
-    "maker", [_hf_gpt2_pair, _hf_llama_pair, _hf_opt_pair, _hf_qwen2_pair],
-    ids=["gpt2", "llama", "opt", "qwen2"],
+    "maker",
+    [_hf_gpt2_pair, _hf_llama_pair, _hf_opt_pair, _hf_qwen2_pair,
+     _hf_gemma_pair],
+    ids=["gpt2", "llama", "opt", "qwen2", "gemma"],
 )
 def test_golden_parity_vs_transformers(maker):
     import torch
