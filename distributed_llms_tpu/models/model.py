@@ -76,11 +76,43 @@ def _attention(
     std_layout: bool = False,  # positions are the standard arange (forward
     #                            generated them itself) — unlocks the flash
     #                            kernel's static-causal fast path
+    kv_tables: jax.Array | None = None,  # [B, P] int32 page table: the
+    #                            layer cache is a PAGE POOL [NB, BLK, KVH,
+    #                            HD] and row b's slot s lives at
+    #                            (tables[b, s//BLK], s%BLK).  Decode-only
+    #                            (T == 1, per-row cache_index); the mask is
+    #                            implicitly the prefix [0, cache_index[b]].
 ) -> tuple[jax.Array, tuple[jax.Array, jax.Array] | None]:
     q, k, v = layers.qkv_project(x, p, cfg)
     if use_rope:
         q = layers.apply_rope(q, positions, cfg.rope_theta)
         k = layers.apply_rope(k, positions, cfg.rope_theta)
+
+    if kv_tables is not None:
+        if layer_cache is None or getattr(cache_index, "ndim", 0) != 1 or x.shape[1] != 1:
+            raise ValueError(
+                "paged attention is single-token decode with a per-row "
+                "cache_index over a page-pool cache"
+            )
+        from ..ops import decode_attn
+
+        ck, cv = layer_cache  # [NB, BLK, KVH, HD] page pools
+        blk = ck.shape[1]
+        rows = jnp.arange(x.shape[0], dtype=jnp.int32)
+        page = kv_tables[rows, cache_index // blk]
+        off = cache_index % blk
+        # Per-row single-slot write into each row's current page.  LIVE
+        # rows own distinct pages, but FREED rows' tables are zeroed to the
+        # shared scratch page, so two inactive rows CAN produce identical
+        # (page, off) indices — the scatter must tolerate duplicates (XLA
+        # picks a winner; the scratch page is never read by a live row).
+        # Do NOT add unique_indices=True here.
+        ck = ck.at[page, off].set(k[:, 0].astype(ck.dtype))
+        cv = cv.at[page, off].set(v[:, 0].astype(cv.dtype))
+        out = decode_attn.paged_decode_attention(
+            q, ck, cv, cache_index + 1, kv_tables
+        )
+        return layers.out_project(out, p), (ck, cv)
 
     if (
         cfg.attn_impl == "flash"
@@ -259,22 +291,22 @@ def _seq_cached_attention(
     return layers.out_project(out, p), ((ck_pref, ck_dec), (cv_pref, cv_dec))
 
 
-def gpt2_block(x, p, cfg, positions, layer_cache, cache_index, attn_mask=None, std_layout=False):
+def gpt2_block(x, p, cfg, positions, layer_cache, cache_index, attn_mask=None, std_layout=False, kv_tables=None):
     """-> (x, new_cache, aux): aux is the MoE load-balance term (0 here).
     Shared by the gpt2 and opt families (pre-LN + learned positions);
     cfg.activation picks the MLP nonlinearity (gelu vs relu)."""
     h = layers.layer_norm(x, p["ln1"]["scale"], p["ln1"]["bias"], cfg.norm_eps)
-    attn_out, new_cache = _attention(h, p["attn"], cfg, positions, layer_cache, cache_index, use_rope=False, attn_mask=attn_mask, std_layout=std_layout)
+    attn_out, new_cache = _attention(h, p["attn"], cfg, positions, layer_cache, cache_index, use_rope=False, attn_mask=attn_mask, std_layout=std_layout, kv_tables=kv_tables)
     x = x + attn_out
     h = layers.layer_norm(x, p["ln2"]["scale"], p["ln2"]["bias"], cfg.norm_eps)
     x = x + layers.mlp_gelu(h, p["mlp"], cfg.activation)
     return x, new_cache, jnp.float32(0.0)
 
 
-def llama_block(x, p, cfg, positions, layer_cache, cache_index, attn_mask=None, std_layout=False):
+def llama_block(x, p, cfg, positions, layer_cache, cache_index, attn_mask=None, std_layout=False, kv_tables=None):
     """-> (x, new_cache, aux): aux is the MoE load-balance term."""
     h = layers.rms_norm(x, p["ln1"]["scale"], cfg.norm_eps)
-    attn_out, new_cache = _attention(h, p["attn"], cfg, positions, layer_cache, cache_index, use_rope=True, attn_mask=attn_mask, std_layout=std_layout)
+    attn_out, new_cache = _attention(h, p["attn"], cfg, positions, layer_cache, cache_index, use_rope=True, attn_mask=attn_mask, std_layout=std_layout, kv_tables=kv_tables)
     x = x + attn_out
     h = layers.rms_norm(x, p["ln2"]["scale"], cfg.norm_eps)
     if "router" in p["mlp"]:  # MoE block (cfg.num_experts > 0)
@@ -298,6 +330,7 @@ def run_blocks(
     remat: bool = False,
     attn_mask: jax.Array | None = None,
     std_layout: bool = False,
+    kv_tables: jax.Array | None = None,
 ) -> tuple[jax.Array, tuple[jax.Array, jax.Array] | None, jax.Array]:
     """Scan the stacked blocks over x.  Used both for the whole model and for
     a single pipeline stage (blocks then hold only the stage's layer slice).
@@ -322,7 +355,7 @@ def run_blocks(
 
     def body(carry, xs):
         layer_params, ck, cv = xs
-        y, new_cache, aux = block_fn(carry, layer_params, cfg, positions, (ck, cv), cache_index, attn_mask, std_layout)
+        y, new_cache, aux = block_fn(carry, layer_params, cfg, positions, (ck, cv), cache_index, attn_mask, std_layout, kv_tables)
         return y, (new_cache, aux)
 
     if remat:
@@ -375,6 +408,9 @@ def forward(
     remat: bool = False,
     attn_mask: jax.Array | None = None,  # broadcastable to [B, H, Tq, S]; True = attend
     return_aux: bool = False,  # also return the MoE load-balance aux loss
+    kv_tables: jax.Array | None = None,  # [B, P] page table: the cache holds
+    #   page POOLS [L, NB, BLK, KVH, HD] (paged continuous batching; see
+    #   _attention's kv_tables contract — decode-only)
 ) -> tuple[jax.Array, KVCache | None] | tuple[jax.Array, KVCache | None, jax.Array]:
     """Full forward.  Returns (logits [B, T, V] float32, updated cache), plus
     the summed MoE aux loss when ``return_aux`` (scale by
@@ -398,7 +434,7 @@ def forward(
         out = (unembed(params, cfg, x), None)
     else:
         x, (new_k, new_v), aux = run_blocks(
-            x, params["blocks"], cfg, positions, cache.k, cache.v, cache_index, remat, attn_mask, std_layout
+            x, params["blocks"], cfg, positions, cache.k, cache.v, cache_index, remat, attn_mask, std_layout, kv_tables
         )
         out = (unembed(params, cfg, x), KVCache(k=new_k, v=new_v))
     return (*out, aux) if return_aux else out

@@ -100,6 +100,14 @@ def _kernel(
         o_ref[0] = (acc_ref[...] / l[:, None]).astype(o_ref.dtype)
 
 
+def _kernel_paged(lengths_ref, tables_ref, *rest, **kw):
+    """Paged variant: the page table is consumed ONLY by the BlockSpec index
+    maps (it redirects each K block's DMA to the row's page in the pool);
+    the compute body is identical to the contiguous kernel."""
+    del tables_ref
+    return _kernel(lengths_ref, *rest, **kw)
+
+
 def _dense_reference(q, k, v, lengths):
     """Masked dot-product prefix attention — the numerics the kernel must
     match and the fallback for untileable shapes / non-kernel modes.
@@ -196,4 +204,79 @@ def ragged_decode_attention(
         v,
     )
     out = out.reshape(b, kvh, gp, d)[:, :, :g]  # [B, KVH, G, D]
+    return out.reshape(b, 1, h, d)
+
+
+def paged_decode_attention(
+    q: jax.Array,  # [B, 1, H, D]
+    k_pages: jax.Array,  # [NB, BLK, KVH, D] — the shared page pool
+    v_pages: jax.Array,  # [NB, BLK, KVH, D]
+    lengths: jax.Array,  # [B] int32 — row b attends its first lengths[b] slots
+    tables: jax.Array,  # [B, P] int32 — page ids; entries past the row's
+    #                     depth may be arbitrary (never dereferenced by the
+    #                     kernel: the index map clamps to the last needed
+    #                     page; the fallback masks their scores)
+) -> jax.Array:
+    """Paged variant of :func:`ragged_decode_attention`: the KV cache lives
+    as pool pages indexed per row through a block table (vLLM-style memory
+    management, TPU-native static shapes).  The page table is scalar-
+    prefetched and consumed by the K/V BlockSpec index maps, so each row's
+    DMA walks its own pages and reads only its real depth.  Returns
+    [B, 1, H, D] in q.dtype.  Inference-only."""
+    mode = _mode()
+    b, t, h, d = q.shape
+    assert t == 1, "paged decode attention is single-token by construction"
+    nb, blk, kvh = k_pages.shape[0], k_pages.shape[1], k_pages.shape[2]
+    p = tables.shape[1]
+    g = h // kvh
+    tileable = blk % 8 == 0 and d % 128 == 0
+    if mode == "fallback" or not tileable:
+        # Gather the rows' pages into contiguous [B, P*BLK] caches (the
+        # fallback materializes; the kernel never does).
+        k_rows = k_pages[tables].reshape(b, p * blk, kvh, d)
+        v_rows = v_pages[tables].reshape(b, p * blk, kvh, d)
+        return _dense_reference(q, k_rows, v_rows, lengths)
+
+    gp = _round_up(g, 8)
+    qt = q[:, 0].reshape(b, kvh, g, d)
+    if gp != g:
+        qt = jnp.pad(qt, ((0, 0), (0, 0), (0, gp - g), (0, 0)))
+
+    def kv_index(bi, hi, ji, lengths_ref, tables_ref):
+        last = jax.lax.div(jnp.maximum(lengths_ref[bi] - 1, 0), blk)
+        return (tables_ref[bi, jnp.minimum(ji, last)], 0, hi, 0)
+
+    out = pl.pallas_call(
+        functools.partial(
+            _kernel_paged, scale=d**-0.5, block_k=blk, num_k_blocks=p
+        ),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=(b, kvh, p),
+            in_specs=[
+                pl.BlockSpec(
+                    (1, gp, d), lambda bi, hi, ji, L, T: (bi * kvh + hi, 0, 0)
+                ),
+                pl.BlockSpec((1, blk, 1, d), kv_index),
+                pl.BlockSpec((1, blk, 1, d), kv_index),
+            ],
+            out_specs=pl.BlockSpec(
+                (1, gp, d), lambda bi, hi, ji, L, T: (bi * kvh + hi, 0, 0)
+            ),
+            scratch_shapes=[
+                pltpu.VMEM((gp, d), jnp.float32),
+                pltpu.VMEM((gp, 128), jnp.float32),
+                pltpu.VMEM((gp, 128), jnp.float32),
+            ],
+        ),
+        out_shape=jax.ShapeDtypeStruct((b * kvh, gp, d), q.dtype),
+        interpret=mode == "interpret",
+    )(
+        lengths.astype(jnp.int32),
+        tables.astype(jnp.int32),
+        qt.reshape(b * kvh, gp, d),
+        k_pages,
+        v_pages,
+    )
+    out = out.reshape(b, kvh, gp, d)[:, :, :g]
     return out.reshape(b, 1, h, d)

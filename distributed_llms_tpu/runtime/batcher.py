@@ -92,6 +92,49 @@ def _replicated(pm, *xs):
     return out if len(out) > 1 else out[0]
 
 
+def _sample_first(logits, last_idx, rng, temperature, top_k, top_p):
+    """Sample the admitted row's first token from the last real position's
+    logits — the one sampling tail shared by every admission path."""
+    next_logits = jnp.take_along_axis(
+        logits, jnp.maximum(last_idx - 1, 0)[None, None, None], axis=1
+    )[:, 0]
+    return sampling.sample(rng, next_logits, temperature, top_k, top_p)[0]
+
+
+def _prefill_row(fwd, params, cfg, cache_dtype, s, prompt):
+    """Dense causal prefill of one request into a transient single-row
+    cache (flash-eligible: attn_mask=None) — shared by the contiguous and
+    paged admissions.  ``fwd`` is _fwd(pm): the mesh-parallel forward on a
+    mesh batcher, the plain model forward otherwise."""
+    (tp,) = prompt.shape
+    row_cache = model_lib.init_cache(cfg, 1, s, dtype=cache_dtype)
+    positions = jnp.arange(tp, dtype=jnp.int32)[None, :]
+    return fwd(
+        params, cfg, prompt[None, :], positions=positions,
+        cache=row_cache, cache_index=jnp.int32(0),
+    )
+
+
+def _prefill_row_with_prefix(fwd, params, cfg, prefix_k, prefix_v, prefix_len,
+                             chunk):
+    """Prefix-seeded prefill: only the request's suffix runs through the
+    model (session-style continuation math) — shared by the contiguous and
+    paged prefix admissions."""
+    (tc,) = chunk.shape
+    s = prefix_k.shape[-3]
+    slots = jnp.arange(s, dtype=jnp.int32)
+    row_cache = KVCache(k=prefix_k, v=prefix_v)
+    positions = (prefix_len + jnp.arange(tc, dtype=jnp.int32))[None, :]
+    from .session import continuation_mask
+
+    prefix_valid = (slots < prefix_len)[None, :]  # [1, S]
+    mask = continuation_mask(prefix_valid, prefix_len, tc, slots)  # [1,1,Tc,S]
+    return fwd(
+        params, cfg, chunk[None, :], positions=positions,
+        cache=row_cache, cache_index=prefix_len, attn_mask=mask,
+    )
+
+
 def _finish_admission(
     cache, slot, row_cache, logits, last_idx, rng, temperature, top_k, top_p,
     total_len,
@@ -99,10 +142,7 @@ def _finish_admission(
     """Shared admission tail (plain and prefix-cached paths): sample the
     first token from the last real position's logits, splice the prefilled
     row into the shared cache, report the row's valid slots."""
-    next_logits = jnp.take_along_axis(
-        logits, jnp.maximum(last_idx - 1, 0)[None, None, None], axis=1
-    )[:, 0]
-    tok = sampling.sample(rng, next_logits, temperature, top_k, top_p)[0]
+    tok = _sample_first(logits, last_idx, rng, temperature, top_k, top_p)
     ax = _batch_axis(cache.k.ndim)
 
     def splice(full, row):
@@ -138,18 +178,11 @@ def admit_row(
 ) -> tuple[Any, jax.Array, jax.Array]:
     """Prefill one request into batch row ``slot``.  Returns
     (cache', first_token, row_valid [S]) — real_lens/budget bookkeeping is
-    the caller's."""
-    (tp,) = prompt.shape
-    s = cache.k.shape[-3]
-    # Dense causal prefill on a transient single-row cache (flash-eligible:
-    # attn_mask=None), then splice that row into the shared cache.  The row
-    # cache is deliberately NOT mesh-constrained: batch 1 can't shard over
-    # 'data'; XLA places it (TP still shards the matmuls via the weights).
-    row_cache = model_lib.init_cache(cfg, 1, s, dtype=cache.k.dtype)
-    positions = jnp.arange(tp, dtype=jnp.int32)[None, :]
-    logits, row_cache = _fwd(pm)(
-        params, cfg, prompt[None, :], positions=positions,
-        cache=row_cache, cache_index=jnp.int32(0),
+    the caller's.  The transient row cache is deliberately NOT
+    mesh-constrained: batch 1 can't shard over 'data'; XLA places it (TP
+    still shards the matmuls via the weights)."""
+    logits, row_cache = _prefill_row(
+        _fwd(pm), params, cfg, cache.k.dtype, cache.k.shape[-3], prompt
     )
     cache, tok, row_valid = _finish_admission(
         cache, slot, row_cache, logits, plen, rng, temperature, top_k, top_p,
@@ -183,24 +216,107 @@ def admit_row_with_prefix(
     ``register_prefix``) seeds the row; only the request's suffix prefills —
     session-style continuation math (runtime/session.py) for one row.
     Returns (cache', first_token, row_valid)."""
-    (tc,) = chunk.shape
-    s = prefix_k.shape[-3]
-    slots = jnp.arange(s, dtype=jnp.int32)
-    row_cache = KVCache(k=prefix_k, v=prefix_v)
-    positions = (prefix_len + jnp.arange(tc, dtype=jnp.int32))[None, :]
-    from .session import continuation_mask
-
-    prefix_valid = (slots < prefix_len)[None, :]  # [1, S]
-    mask = continuation_mask(prefix_valid, prefix_len, tc, slots)  # [1,1,Tc,S]
-    logits, row_cache = _fwd(pm)(
-        params, cfg, chunk[None, :], positions=positions,
-        cache=row_cache, cache_index=prefix_len, attn_mask=mask,
+    logits, row_cache = _prefill_row_with_prefix(
+        _fwd(pm), params, cfg, prefix_k, prefix_v, prefix_len, chunk
     )
     cache, tok, row_valid = _finish_admission(
         cache, slot, row_cache, logits, clen, rng, temperature, top_k, top_p,
         total_len=prefix_len + clen,
     )
     return (cache, *_replicated(pm, tok, row_valid))
+
+
+def _paged_pool(cfg: ModelConfig, num_pages: int, page_size: int, dtype=None):
+    """KV page pools [L, NB, BLK, KVH, HD] (distinct k/v buffers — the
+    chunk fns donate the cache)."""
+    l, kvh, hd = cfg.num_layers, cfg.num_kv_heads, cfg.head_dim_
+    dt = jnp.dtype(dtype) if dtype else jnp.dtype(cfg.dtype)
+    shape = (l, num_pages, page_size, kvh, hd)
+    return KVCache(k=jnp.zeros(shape, dt), v=jnp.zeros(shape, dt))
+
+
+def _paged_splice(cache, page_list, row_cache, logits, last_idx, rng,
+                  temperature, top_k, top_p):
+    """Admission tail for the paged pool: sample the first token, then
+    scatter the contiguous transient row cache into the row's pages.
+    ``page_list`` [P] is padded with the reserved scratch page 0 past the
+    allocation, so the fixed-shape scatter stays compiled once — the extra
+    writes land in the scratch page, whose contents no LIVE row ever reads
+    (freed rows' clamped decode reads do touch it, but their outputs are
+    masked to pad)."""
+    tok = _sample_first(logits, last_idx, rng, temperature, top_k, top_p)
+    p = page_list.shape[0]
+    blk = cache.k.shape[2]
+
+    def splice(pool, row):  # row: [L, 1, P*BLK, KVH, HD]
+        l, _, _, kvh, hd = row.shape
+        pages = row[:, 0].reshape(l, p, blk, kvh, hd).astype(pool.dtype)
+        return pool.at[:, page_list].set(pages)
+
+    cache = KVCache(
+        k=splice(cache.k, row_cache.k), v=splice(cache.v, row_cache.v)
+    )
+    return cache, tok
+
+
+@partial(
+    jax.jit,
+    static_argnames=("cfg", "temperature", "top_k", "top_p"),
+    donate_argnames=("cache",),
+)
+def admit_row_paged(
+    params: Any,
+    cfg: ModelConfig,
+    cache: Any,  # page-pool KVCache, [L, NB, BLK, KVH, HD] leaves
+    page_list: jax.Array,  # [P] int32 — the row's pages, scratch-padded
+    prompt: jax.Array,  # [Tp] int32, right-padded (bucketed)
+    plen: jax.Array,  # scalar int32 true length
+    rng: jax.Array,
+    temperature: float = 0.0,
+    top_k: int = 0,
+    top_p: float = 1.0,
+) -> tuple[Any, jax.Array]:
+    """Paged admission: dense causal prefill on a transient contiguous row
+    cache, then scatter its pages into the pool.  Returns (cache', tok)."""
+    logits, row_cache = _prefill_row(
+        _fwd(None), params, cfg, cache.k.dtype,
+        page_list.shape[0] * cache.k.shape[2], prompt,
+    )
+    return _paged_splice(
+        cache, page_list, row_cache, logits, plen, rng, temperature, top_k,
+        top_p,
+    )
+
+
+@partial(
+    jax.jit,
+    static_argnames=("cfg", "temperature", "top_k", "top_p"),
+    donate_argnames=("cache",),
+)
+def admit_row_with_prefix_paged(
+    params: Any,
+    cfg: ModelConfig,
+    cache: Any,  # page-pool KVCache
+    page_list: jax.Array,  # [P] int32, scratch-padded
+    prefix_k: jax.Array,  # [L, 1, S, KVH, HD] contiguous prefix KV
+    prefix_v: jax.Array,
+    prefix_len: jax.Array,  # scalar int32
+    chunk: jax.Array,  # [Tc] int32 suffix, right-padded
+    clen: jax.Array,  # scalar int32
+    rng: jax.Array,
+    temperature: float = 0.0,
+    top_k: int = 0,
+    top_p: float = 1.0,
+) -> tuple[Any, jax.Array]:
+    """Prefix-cached paged admission: the prefix KV seeds the transient row
+    cache, only the suffix prefills, then the pages scatter into the pool."""
+    logits, row_cache = _prefill_row_with_prefix(
+        _fwd(None), params, cfg, prefix_k, prefix_v, prefix_len, chunk
+    )
+    return _paged_splice(
+        cache, page_list, row_cache, logits, clen, rng, temperature, top_k,
+        top_p,
+    )
 
 
 @partial(
@@ -228,28 +344,43 @@ def decode_chunk(
     eos_id: int = -1,
     pad_id: int = 0,
     pm: Any = None,  # ParallelModel — GSPMD dp/tp mesh batching
+    tables: jax.Array | None = None,  # [B, P] page table — cache is a pool
 ) -> tuple[jax.Array, Any, jax.Array, jax.Array, jax.Array, jax.Array, jax.Array]:
     """K decode steps with per-row positions.  Returns
     (toks [B, K], cache', last_tok', real_lens', valid', active', budget')."""
-    s = cache.k.shape[-3]
-    slots = jnp.arange(s, dtype=jnp.int32)
+    if tables is None:
+        s = cache.k.shape[-3]
+        slots = jnp.arange(s, dtype=jnp.int32)
 
     def step(carry, rng_step):
         cache, last_tok, real_lens, valid, active, budget = carry
         # One batched forward with PER-ROW write slots (models.model accepts
         # a [B] cache_index: only the KV write scatters; all matmuls stay
-        # batched).  The mask admits each row's valid slots plus the slot
-        # its own token was just written to.
-        mask = (valid | (slots[None, :] == real_lens[:, None]))[:, None, None, :]
-        logits, cache = _fwd(pm)(
-            params, cfg, last_tok[:, None], positions=real_lens[:, None],
-            cache=cache, cache_index=real_lens, attn_mask=mask,
-        )
+        # batched).  Paged mode: the page table routes each row's read and
+        # write; the prefix mask is implicit.  Contiguous mode: the mask
+        # admits each row's valid slots plus the slot its own token was
+        # just written to.
+        if tables is not None:
+            logits, cache = _fwd(pm)(
+                params, cfg, last_tok[:, None], positions=real_lens[:, None],
+                cache=cache, cache_index=real_lens, kv_tables=tables,
+            )
+        else:
+            mask = (valid | (slots[None, :] == real_lens[:, None]))[:, None, None, :]
+            logits, cache = _fwd(pm)(
+                params, cfg, last_tok[:, None], positions=real_lens[:, None],
+                cache=cache, cache_index=real_lens, attn_mask=mask,
+            )
         logits = logits[:, 0]
         # The row just wrote last_tok's K/V at slot real_lens; mark it valid
         # for rows that were active (inactive rows wrote junk into a slot
         # that stays invalid — harmless, and re-prefilled on admission).
-        valid = valid | (active[:, None] & (slots[None, :] == real_lens[:, None]))
+        # Paged mode has no mask to maintain: validity is implicit in
+        # real_lens (the kernel's prefix contract).
+        if tables is None:
+            valid = valid | (
+                active[:, None] & (slots[None, :] == real_lens[:, None])
+            )
         real_lens = real_lens + active.astype(jnp.int32)
         tok = sampling.sample(rng_step, logits, temperature, top_k, top_p)
         budget = budget - active.astype(jnp.int32)
@@ -302,6 +433,8 @@ class _RowState:
     remaining: int = 0  # decode tokens this row may still emit (host mirror
     #                     of the device budget — distinguishes real pad-id
     #                     tokens from post-deactivation padding)
+    pages: list[int] = field(default_factory=list)  # paged mode: the pool
+    #                     pages this row owns (freed on completion)
 
 
 class ContinuousBatcher:
@@ -335,11 +468,32 @@ class ContinuousBatcher:
         kv_dtype: Any = None,
         seed: int = 0,
         parallel: Any = None,  # parallel.api.ParallelModel (GSPMD dp/tp)
+        paged_pages: int | None = None,  # KV page-pool size (pages) — paged
+        #   mode: rows allocate only the pages their prompt+budget need, so
+        #   the pool can be far smaller than batch_slots * max_len; a full
+        #   pool back-pressures admission instead of OOMing.
+        page_size: int = 64,
     ) -> None:
         if max_len > cfg.max_seq_len:
             raise ValueError(
                 f"max_len {max_len} exceeds model max_seq_len {cfg.max_seq_len}"
             )
+        if paged_pages is not None:
+            if parallel is not None:
+                raise ValueError(
+                    "paged KV is single-device for now (no SPMD rule for "
+                    "the paged kernel)"
+                )
+            if max_len % page_size:
+                raise ValueError(
+                    f"max_len {max_len} must be a multiple of page_size "
+                    f"{page_size}"
+                )
+            if paged_pages < max_len // page_size + 1:
+                raise ValueError(
+                    f"paged_pages {paged_pages} cannot hold even one "
+                    f"full-depth row (+1 scratch page)"
+                )
         if parallel is not None:
             if parallel.pipelined or parallel.seq_parallel:
                 raise ValueError(
@@ -403,11 +557,24 @@ class ContinuousBatcher:
             self.cache = jax.jit(
                 lambda: parallel.init_cache(batch_slots, max_len)
             )()
+        elif paged_pages is not None:
+            self.cache = _paged_pool(
+                cfg, paged_pages, page_size,
+                dtype=jnp.dtype(kv_dtype) if kv_dtype else None,
+            )
         else:
             self.cache = model_lib.init_cache(
                 cfg, batch_slots, max_len,
                 dtype=jnp.dtype(kv_dtype) if kv_dtype else None,
             )
+        self.page_size = page_size
+        self.paged = paged_pages is not None
+        if self.paged:
+            self.pages_per_row = max_len // page_size
+            # Page 0 is the permanent scratch page: fixed-shape admissions
+            # pad their page lists with it, and no row ever reads it.
+            self.free_pages = list(range(1, paged_pages))
+            self.tables = np.zeros((batch_slots, self.pages_per_row), np.int32)
         # Scheduling state lives as HOST numpy mirrors: every process holds
         # the same values (the jitted chunk fns return them constrained
         # replicated, and np.asarray of a replicated output is legal on all
@@ -496,6 +663,20 @@ class ContinuousBatcher:
             req = self.queue.popleft()
             pfx = self.prefixes[req.prefix] if req.prefix is not None else None
             pfx_len = len(pfx.ids) if pfx else 0
+            total_len = pfx_len + len(req.ids)
+            pages: list[int] = []
+            if self.paged:
+                # Allocate only the pages prompt+budget need; a dry pool
+                # back-pressures the queue (FIFO: put the request back and
+                # stop admitting) instead of overcommitting.
+                n_pages = -(-(total_len + req.max_new_tokens) // self.page_size)
+                if len(self.free_pages) < n_pages:
+                    self.queue.appendleft(req)
+                    return
+                pages = [self.free_pages.pop() for _ in range(n_pages)]
+                page_list = np.zeros((self.pages_per_row,), np.int32)
+                page_list[: n_pages] = pages  # scratch-page padded
+                self.tables[i] = page_list
             # Bucket for compile reuse, but never past what fits after the
             # prefix: forward's contract is cache_index + T <= max_len, and
             # dynamic_update_slice CLAMPS an overflowing start — the suffix
@@ -504,7 +685,22 @@ class ContinuousBatcher:
             tp = min(_bucket(len(req.ids)), self.s - pfx_len)
             prompt = np.full((tp,), self.pad_id, np.int32)
             prompt[: len(req.ids)] = req.ids
-            if pfx is not None:
+            if self.paged and pfx is not None:
+                self.cache, tok = admit_row_with_prefix_paged(
+                    self.params, self.cfg, self.cache, jnp.asarray(page_list),
+                    pfx.k, pfx.v, jnp.int32(pfx_len),
+                    jnp.asarray(prompt), jnp.int32(len(req.ids)),
+                    self._split_rng(), **self.sampling,
+                )
+                row_valid = np.arange(self.s) < total_len
+            elif self.paged:
+                self.cache, tok = admit_row_paged(
+                    self.params, self.cfg, self.cache, jnp.asarray(page_list),
+                    jnp.asarray(prompt), jnp.int32(len(req.ids)),
+                    self._split_rng(), **self.sampling,
+                )
+                row_valid = np.arange(self.s) < total_len
+            elif pfx is not None:
                 self.cache, tok, row_valid = admit_row_with_prefix(
                     self.params, self.cfg, self.cache, jnp.int32(i),
                     pfx.k, pfx.v, jnp.int32(pfx_len),
@@ -517,7 +713,6 @@ class ContinuousBatcher:
                     jnp.asarray(prompt), jnp.int32(len(req.ids)),
                     self._split_rng(), pm=self.pm, **self.sampling,
                 )
-            total_len = pfx_len + len(req.ids)
             tok = int(tok)  # replicated scalar — identical on every process
             self.last_tok[i] = tok
             self.real_lens[i] = total_len
@@ -528,7 +723,7 @@ class ContinuousBatcher:
             self.budget[i] = req.max_new_tokens - 1
             self.rows[i] = _RowState(
                 rid=req.rid, emitted=[tok],
-                remaining=req.max_new_tokens - 1,
+                remaining=req.max_new_tokens - 1, pages=pages,
             )
             log.debug("admitted request %d into slot %d", req.rid, i)
             if req.max_new_tokens == 1 or tok == self.eos_id:
@@ -558,6 +753,9 @@ class ContinuousBatcher:
                     cut = row.emitted.index(self.eos_id) + 1
                     row.emitted = row.emitted[:cut]
                 self.results[row.rid] = row.emitted
+                if row.pages:  # paged: return the row's pool pages
+                    self.free_pages.extend(row.pages)
+                    self.tables[i] = 0
                 self.rows[i] = _RowState()
                 METRICS.inc("batcher.completed")
 
@@ -582,6 +780,7 @@ class ContinuousBatcher:
                     self.real_lens, self.valid, self.active, self.budget,
                     self._split_rng(), self.chunk_steps,
                     eos_id=self.eos_id, pad_id=self.pad_id, pm=self.pm,
+                    tables=jnp.asarray(self.tables) if self.paged else None,
                     **self.sampling,
                 )
             # Back to host numpy mirrors (replicated outputs — every

@@ -372,7 +372,8 @@ class InferenceEngine:
 
     def continuous_batcher(
         self, batch_slots: int = 8, max_len: int | None = None,
-        chunk_steps: int = 8,
+        chunk_steps: int = 8, paged_pages: int | None = None,
+        page_size: int = 64,
     ):
         """A ContinuousBatcher over this engine's model: requests admit into
         an in-flight decode batch as rows free up (runtime/batcher.py) —
@@ -407,4 +408,5 @@ class InferenceEngine:
             top_p=self.rt.top_p, eos_id=tok.eos_id, pad_id=tok.pad_id,
             kv_dtype=self.rt.kv_cache_dtype,
             parallel=self.parallel,
+            paged_pages=paged_pages, page_size=page_size,
         )

@@ -35,6 +35,11 @@ if os.environ.get("DLT_TEST_NO_CACHE") != "1":
     )
     jax.config.update("jax_compilation_cache_dir", _cache_dir)
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    # No XLA:CPU AOT results in the cache: reloading them spews bogus
+    # machine-feature-mismatch warnings (XLA pseudo-features like
+    # prefer-no-scatter) on every test; the jit-program cache alone gives
+    # the ~5x warm-run win.
+    jax.config.update("jax_persistent_cache_enable_xla_caches", "none")
 
 import asyncio
 import inspect

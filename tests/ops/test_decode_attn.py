@@ -67,6 +67,61 @@ def test_block_stepping_keeps_kernel_at_384(monkeypatch):
     )
 
 
+@pytest.mark.parametrize(
+    "b,pool,blk,pages,h,kvh,d,lengths",
+    [
+        (3, 32, 64, 4, 8, 4, 128, [1, 130, 256]),   # GQA, scattered pages
+        (2, 16, 128, 2, 4, 4, 128, [255, 7]),       # page == K block
+        (4, 64, 8, 8, 8, 8, 128, [64, 1, 33, 17]),  # tiny 8-slot pages
+    ],
+)
+def test_paged_matches_contiguous(monkeypatch, b, pool, blk, pages, h, kvh, d, lengths):
+    """Rows' KV scattered over a shuffled page pool must attend exactly like
+    the same data laid out contiguously."""
+    monkeypatch.setenv("DLT_RAGGED_DECODE", "interpret")
+    rng = np.random.RandomState(0)
+    # Distinct physical pages per (row, logical page).
+    perm = rng.permutation(pool)[: b * pages]
+    tables = jnp.asarray(perm.reshape(b, pages), jnp.int32)
+    q = _rand(0, (b, 1, h, d))
+    k_rows = _rand(1, (b, pages * blk, kvh, d))
+    v_rows = _rand(2, (b, pages * blk, kvh, d))
+    k_pool = jnp.zeros((pool, blk, kvh, d)).at[tables.reshape(-1)].set(
+        k_rows.reshape(b * pages, blk, kvh, d)
+    )
+    v_pool = jnp.zeros((pool, blk, kvh, d)).at[tables.reshape(-1)].set(
+        v_rows.reshape(b * pages, blk, kvh, d)
+    )
+    ln = jnp.asarray(lengths, jnp.int32)
+    got = decode_attn.paged_decode_attention(q, k_pool, v_pool, ln, tables)
+    want = decode_attn._dense_reference(q, k_rows, v_rows, ln)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), rtol=2e-5, atol=2e-5
+    )
+
+
+def test_paged_fallback_matches_reference(monkeypatch):
+    """The dense fallback (untileable head_dim) gathers pages correctly."""
+    monkeypatch.setenv("DLT_RAGGED_DECODE", "interpret")
+    b, pool, blk, pages, h, d = 2, 8, 16, 2, 4, 64  # d=64: fallback path
+    tables = jnp.asarray([[3, 0], [5, 7]], jnp.int32)
+    q = _rand(0, (b, 1, h, d))
+    k_rows = _rand(1, (b, pages * blk, h, d))
+    v_rows = _rand(2, (b, pages * blk, h, d))
+    k_pool = jnp.zeros((pool, blk, h, d)).at[tables.reshape(-1)].set(
+        k_rows.reshape(b * pages, blk, h, d)
+    )
+    v_pool = jnp.zeros((pool, blk, h, d)).at[tables.reshape(-1)].set(
+        v_rows.reshape(b * pages, blk, h, d)
+    )
+    ln = jnp.asarray([17, 32], jnp.int32)
+    got = decode_attn.paged_decode_attention(q, k_pool, v_pool, ln, tables)
+    want = decode_attn._dense_reference(q, k_rows, v_rows, ln)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), rtol=2e-5, atol=2e-5
+    )
+
+
 def test_untileable_head_dim_falls_back(monkeypatch):
     """d=64 is not a 128-lane multiple: the dense fallback must serve it."""
     monkeypatch.setenv("DLT_RAGGED_DECODE", "interpret")
