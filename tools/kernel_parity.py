@@ -81,6 +81,32 @@ def flash_parity() -> None:
               rtol=3e-2, atol=3e-2)
 
 
+def paged_parity() -> None:
+    key = jax.random.PRNGKey(3)
+    b, pool, blk, pages, h, kvh, d = 4, 48, 128, 8, 8, 4, 128
+    rng = np.random.RandomState(0)
+    tables = jnp.asarray(
+        rng.permutation(pool)[: b * pages].reshape(b, pages), jnp.int32
+    )
+    ks = jax.random.split(key, 3)
+    q = jax.random.normal(ks[0], (b, 1, h, d), jnp.bfloat16)
+    k_rows = jax.random.normal(ks[1], (b, pages * blk, kvh, d), jnp.bfloat16)
+    v_rows = jax.random.normal(ks[2], (b, pages * blk, kvh, d), jnp.bfloat16)
+    k_pool = jnp.zeros((pool, blk, kvh, d), jnp.bfloat16).at[
+        tables.reshape(-1)
+    ].set(k_rows.reshape(b * pages, blk, kvh, d))
+    v_pool = jnp.zeros((pool, blk, kvh, d), jnp.bfloat16).at[
+        tables.reshape(-1)
+    ].set(v_rows.reshape(b * pages, blk, kvh, d))
+    ln = jnp.asarray([1, 300, pages * blk, 129], jnp.int32)
+    got = jax.jit(decode_attn.paged_decode_attention)(
+        q, k_pool, v_pool, ln, tables
+    )
+    want = decode_attn._dense_reference(q, k_rows, v_rows, ln)
+    check(f"paged decode B{b} pool{pool} blk{blk}", got, want,
+          rtol=3e-2, atol=3e-2)
+
+
 def ragged_parity() -> None:
     key = jax.random.PRNGKey(2)
     for b, s, h, kvh, d, lengths in (
@@ -107,6 +133,7 @@ def main() -> int:
     quant_parity()
     flash_parity()
     ragged_parity()
+    paged_parity()
     mode = "compiled" if ON_TPU else "interpret"
     print(f"kernel_parity: ALL PASS ({mode}, backend={backend})")
     return 0
