@@ -516,6 +516,81 @@ def _measure_ragged_decode(
     }
 
 
+def _measure_paged_batching(
+    preset: str = "tinyllama-1.1b", dtype: str = "bfloat16",
+    max_len: int = 2048, slots: int = 8, requests: int = 16,
+    page_size: int = 128, pool_frac: float = 0.45,
+) -> dict:
+    """Paged vs contiguous continuous batching on the same mixed workload:
+    the paged pool holds ``pool_frac`` of the contiguous cache's slots yet
+    serves identical tokens — the memory headroom is the point; throughput
+    should hold (the paged kernel reads only real depths).  TPU-only in the
+    ladder (real kernels)."""
+    import numpy as np
+
+    from distributed_llms_tpu.runtime.batcher import ContinuousBatcher
+
+    cfg, params = _build_params(preset, dtype, None)
+    if max_len > cfg.max_seq_len:
+        max_len = cfg.max_seq_len
+    rng = np.random.RandomState(0)
+    # Prompt/budget ranges scale with max_len so small CPU-smoke shapes
+    # stay admissible: longest prompt + longest budget <= max_len / 2.
+    lens = rng.randint(max(4, max_len // 128), max(8, max_len // 8) + 1,
+                       size=requests)
+    base = max(2, max_len // 128)
+    budgets = rng.choice(
+        [base, base, 2 * base, 4 * base, 4 * base, 8 * base, 16 * base],
+        size=requests,
+    )
+    budgets = np.minimum(budgets, max_len // 4)
+    prompts = [rng.randint(1, cfg.vocab_size, size=n).tolist() for n in lens]
+    n_pages = max(
+        int(pool_frac * slots * max_len / page_size),
+        max_len // page_size + 1,
+    )
+
+    def run(paged: bool) -> tuple[float, dict, int]:
+        b = ContinuousBatcher(
+            cfg, params, batch_slots=slots, max_len=max_len, chunk_steps=8,
+            paged_pages=n_pages if paged else None, page_size=page_size,
+        )
+        kv_bytes = int(
+            b.cache.k.size * b.cache.k.dtype.itemsize
+            + b.cache.v.size * b.cache.v.dtype.itemsize
+        )
+        rids = [
+            b.submit(p, max_new_tokens=int(n))
+            for p, n in zip(prompts, budgets)
+        ]
+        t0 = time.perf_counter()
+        res = b.run()
+        return time.perf_counter() - t0, {r: res[r] for r in rids}, kv_bytes
+
+    run(False), run(True)  # warm compiles
+    t_dense, out_dense, bytes_dense = run(False)
+    t_paged, out_paged, bytes_paged = run(True)
+    # min-of-2 like the sibling measures: this row's claim is the
+    # throughput RATIO at reduced memory — one host stall must not skew it.
+    t_dense = min(t_dense, run(False)[0])
+    t_paged = min(t_paged, run(True)[0])
+    total_new = int(sum(len(v) for v in out_dense.values()))
+    if list(out_dense.values()) != list(out_paged.values()):
+        raise AssertionError("paged tokens diverge from contiguous tokens")
+    return {
+        "preset": preset,
+        "max_len": max_len,
+        "slots": slots,
+        "requests": requests,
+        "platform": jax.devices()[0].platform,
+        "kv_bytes_contiguous": bytes_dense,
+        "kv_bytes_paged": bytes_paged,
+        "kv_memory_ratio": round(bytes_paged / bytes_dense, 3),
+        "tok_per_s_contiguous": round(total_new / t_dense, 1),
+        "tok_per_s_paged": round(total_new / t_paged, 1),
+    }
+
+
 def _measure_continuous_batching(
     preset: str, dtype: str, quant: str | None = None,
     slots: int = 4, requests: int = 16, chunk_steps: int = 8,
@@ -789,6 +864,20 @@ def run_ladder(args, degraded: str | None) -> list[dict]:
     print(f"# continuous batching: {row}", file=sys.stderr)
     _write_rows(args.out, rows)
     if not on_cpu:
+        # Paged vs contiguous batching: same tokens, pool at ~45% of the
+        # contiguous KV bytes (real kernels only).
+        row = {"config": "paged-batching"}
+        try:
+            row.update(_measure_paged_batching(dtype=dtype))
+            row["measured_on"] = _stamp()
+        except Exception as exc:
+            row["skipped"] = (
+                f"{type(exc).__name__}: "
+                f"{(str(exc).splitlines() or ['?'])[0][:200]}"
+            )
+        rows.append(row)
+        print(f"# paged batching: {row}", file=sys.stderr)
+        _write_rows(args.out, rows)
         # Long-context ragged decode: dense full-width vs the ragged kernel
         # at 8k cache width, mixed row depths (real kernels only).
         row = {"config": "ragged-decode-8k"}

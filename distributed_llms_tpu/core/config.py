@@ -173,6 +173,11 @@ class RuntimeConfig:
     # same model skips the first-compile wait (~20-40 s on TPU for a 7B
     # decode graph).  Enabled once per process, before the first jit.
     compilation_cache_dir: str | None = None
+    # Paged KV cache for continuous batching (runtime/batcher.py): rows
+    # allocate pages from a shared pool instead of owning max_seq_len slots;
+    # a dry pool back-pressures admission.  None = contiguous per-slot KV.
+    paged_pages: int | None = None
+    page_size: int = 64
 
 
 @dataclass(frozen=True)

@@ -373,7 +373,7 @@ class InferenceEngine:
     def continuous_batcher(
         self, batch_slots: int = 8, max_len: int | None = None,
         chunk_steps: int = 8, paged_pages: int | None = None,
-        page_size: int = 64,
+        page_size: int | None = None,
     ):
         """A ContinuousBatcher over this engine's model: requests admit into
         an in-flight decode batch as rows free up (runtime/batcher.py) —
@@ -391,6 +391,32 @@ class InferenceEngine:
             )
         from .batcher import ContinuousBatcher
 
+        # RuntimeConfig knobs are the defaults so the cluster worker's
+        # mixed-budget endpoint serves paged when the config says to;
+        # explicit arguments win (paged_pages=0 explicitly requests
+        # contiguous even on a paged-configured engine).
+        explicit = paged_pages is not None
+        if paged_pages is None:
+            paged_pages = self.rt.paged_pages
+        if paged_pages == 0:
+            paged_pages = None
+        if page_size is None:
+            page_size = self.rt.page_size
+        if paged_pages is not None and self.parallel is not None:
+            if explicit:
+                raise ValueError(
+                    "paged KV serving is single-device for now; pass "
+                    "paged_pages=0 (or unset runtime.paged_pages) on mesh "
+                    "engines"
+                )
+            # A shared cluster config with runtime.paged_pages set must not
+            # turn mesh workers' requests into errors — serve contiguous.
+            log.warning(
+                "runtime.paged_pages=%d ignored on a mesh engine (paged KV "
+                "is single-device for now); serving contiguous",
+                paged_pages,
+            )
+            paged_pages = None
         if self.parallel is not None:
             # The shared cache shards its batch over 'data'; round the slot
             # count up so every mesh shape serves (extra slots are harmless

@@ -126,6 +126,34 @@ def test_paged_kernel_program_runs(tiny, monkeypatch):
         assert res[rid] == solo(cfg, params, ids, n)
 
 
+def test_runtime_config_knobs_reach_engine_batcher(tiny):
+    """RuntimeConfig.paged_pages/page_size flow through
+    engine.continuous_batcher (the path the cluster worker uses), and a
+    mesh engine rejects paged loudly."""
+    from distributed_llms_tpu.core.config import MeshConfig, RuntimeConfig
+    from distributed_llms_tpu.parallel.api import make_parallel_model
+    from distributed_llms_tpu.runtime.engine import InferenceEngine
+
+    cfg, params = tiny
+    rt = RuntimeConfig(max_seq_len=64, paged_pages=9, page_size=16)
+    eng = InferenceEngine(cfg, rt, params)
+    b = eng.continuous_batcher(batch_slots=2)
+    assert b.paged and b.page_size == 16 and len(b.free_pages) == 8
+    rid = b.submit([5, 6, 7], max_new_tokens=4)
+    assert b.run()[rid] == solo(cfg, params, [5, 6, 7], 4)
+    # paged_pages=0 explicitly opts back into contiguous.
+    assert not eng.continuous_batcher(batch_slots=2, paged_pages=0).paged
+
+    pm = make_parallel_model(cfg, MeshConfig(data=2, model=4))
+    mesh_eng = InferenceEngine(cfg, rt, params, parallel=pm)
+    # Config-INHERITED paged on a mesh degrades to contiguous (a shared
+    # cluster config must not error mesh workers' requests)...
+    assert not mesh_eng.continuous_batcher(batch_slots=2).paged
+    # ...but an EXPLICIT request raises.
+    with pytest.raises(ValueError, match="single-device"):
+        mesh_eng.continuous_batcher(paged_pages=9)
+
+
 def test_paged_rejects_bad_config(tiny):
     cfg, params = tiny
     with pytest.raises(ValueError, match="multiple of page_size"):

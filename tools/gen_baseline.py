@@ -34,6 +34,9 @@ def _result_cell(row: dict) -> str:
         ("tpot_ms", "TPOT ms"), ("tok_per_s_steady", "steady tok/s"),
         ("tok_per_s_continuous", "continuous tok/s"),
         ("tok_per_s_grouped", "grouped tok/s"),
+        ("tok_per_s_paged", "paged tok/s"),
+        ("tok_per_s_contiguous", "contiguous tok/s"),
+        ("kv_memory_ratio", "paged/contiguous KV bytes"),
         ("dense_chunk_ms", "dense ms"), ("ragged_chunk_ms", "ragged ms"),
         ("speedup", "speedup"),
         ("flash_ms", "flash ms"), ("dot_ms", "dot ms"),
@@ -67,7 +70,8 @@ def generate(ladder_path: str) -> str:
     ]
     listed = [str(e["config"]) for e in bench.LADDER] + [
         # Aux rows run_ladder appends after the decode configs.
-        "serving-latency", "continuous-batching", "ragged-decode-8k",
+        "serving-latency", "continuous-batching", "paged-batching",
+        "ragged-decode-8k",
         "prefill-flash-2048", "prefill-flash-8192", "hop-latency",
     ]
     extras = [c for c in rows if c not in listed]
