@@ -17,7 +17,7 @@
 # `python tools/gen_baseline.py`).
 #
 # Usage: tools/tpu_runbook.sh [--probe-timeout SECS]
-set -u
+set -u -o pipefail  # pipefail: `python ... | tee` must report python's status
 cd "$(dirname "$0")/.."
 
 PROBE_TIMEOUT=150
@@ -46,8 +46,14 @@ else
 fi
 
 log "ladder (bench.py --ladder)..."
-timeout 14400 python bench.py --ladder --out BENCH_LADDER.json \
-  2>&1 | tee "$OUT/ladder.log"
+if timeout 14400 python bench.py --ladder --out BENCH_LADDER.json \
+    2>&1 | tee "$OUT/ladder.log"; then
+  log "ladder OK"
+else
+  log "ladder FAILED/TIMED OUT (rc=$?) — BENCH_LADDER.json may be PARTIAL"
+  log "(bench.py writes it incrementally); do NOT commit it without checking"
+  log "it still carries every config row; see $OUT/ladder.log"
+fi
 cp -f BENCH_LADDER.json "$OUT/" 2>/dev/null || true
 
 log "default bench (north star)..."
