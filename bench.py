@@ -24,7 +24,9 @@ Usage: python bench.py [--preset llama-2-7b] [--batch 4] [--prompt-len 64]
 from __future__ import annotations
 
 import argparse
+import functools
 import json
+import os
 import subprocess
 import sys
 import time
@@ -268,25 +270,111 @@ def _fits(cfg, batch: int, seq: int, dtype: str, quant: str | None = None) -> tu
     return True, f"~{need / 1e9:.1f} GB of {budget / 1e9:.1f} GB"
 
 
+# Latest built param tree, keyed by (preset, dtype, quant): consecutive
+# ladder rows (3-int8 / 3-int8-b8 / 3-int8-b16, then serving-latency and
+# continuous-batching on the same north-star config) differ only in batch —
+# rebuilding identical 7B weights for each row is pure setup waste.  One
+# entry only, and the old tree is dropped BEFORE the next build so HBM never
+# holds two big models.
+_PARAMS_CACHE: dict = {}
+
+
 def _build_params(preset: str, dtype: str, quant: str | None):
     """Random-init params for a preset, optionally weight-only quantized.
-    Quantization happens host-side: full-dtype 7B/13B weights would OOM the
-    device before quantization could shrink them — only the int8/int4 blocks
-    (plus full-dtype embeddings) ever reach HBM."""
+
+    Quantized big models are generated AND quantized directly on the
+    accelerator, streamed tensor-by-tensor (layer-chunked so full-precision
+    transients stay ~2 GB): the previous host-side path random-inited 7B
+    f32 on one CPU core and shipped ~7 GB over the tunnel — ~25 minutes of
+    setup per ladder row, which is how round 4's first ladder run ran into
+    its own watchdog.  Only the int8/int4 blocks (plus full-dtype
+    embeddings) are ever resident on device."""
     from distributed_llms_tpu.models import model as model_lib
     from distributed_llms_tpu.models.presets import get_preset
 
+    key = (preset, dtype, quant)
+    if key in _PARAMS_CACHE:
+        return _PARAMS_CACHE[key]
+    _PARAMS_CACHE.clear()  # free the previous model before building the next
+
     cfg = get_preset(preset, dtype=dtype)
     if not quant:
-        return cfg, model_lib.init_params(jax.random.key(0), cfg)
-    from distributed_llms_tpu.checkpoint import quantize as quant_lib
+        out = cfg, model_lib.init_params(jax.random.key(0), cfg)
+    elif jax.devices()[0].platform == "cpu":
+        # Host fallback: quantize host-side (same numerics as the store path).
+        from distributed_llms_tpu.checkpoint import quantize as quant_lib
 
-    bits = {"int8": 8, "int4": 4}[quant]
-    dev = jax.devices()[0]
-    with jax.default_device(jax.devices("cpu")[0]):
+        bits = {"int8": 8, "int4": 4}[quant]
         params = model_lib.init_params(jax.random.key(0), cfg)
         params["blocks"] = quant_lib.quantize_tree(params["blocks"], bits=bits)
-    return cfg, jax.device_put(params, dev)
+        out = cfg, params
+    else:
+        out = cfg, _gen_quantized_on_device(cfg, quant)
+    _PARAMS_CACHE[key] = out
+    return out
+
+
+def _gen_quantized_on_device(cfg, quant: str):
+    """Random weights for benchmarking, generated on the accelerator.
+
+    Walks init_params' tree structure via eval_shape (never materializing
+    it), generating each leaf on-device: matmul block weights are generated
+    in <=2 GB f32 layer-chunks and quantized immediately, so peak HBM is
+    the quantized model plus one chunk.  Values are NOT bit-identical to
+    init_params (per-leaf fold_in keys, approximate fan-in) — irrelevant
+    for throughput rows, which only need finite bf16 activations."""
+    from distributed_llms_tpu.checkpoint import quantize as quant_lib
+    from distributed_llms_tpu.models import model as model_lib
+
+    bits = {"int8": 8, "int4": 4}[quant]
+    shapes = jax.eval_shape(
+        lambda k: model_lib.init_params(k, cfg), jax.random.key(0)
+    )
+    base = jax.random.key(0)
+    counter = iter(range(1 << 20))
+
+    def gen_dense(leaf_key, shape, dtype, fan_in):
+        x = jax.random.normal(leaf_key, shape, jnp.float32)
+        return (x * fan_in**-0.5).astype(dtype)
+
+    def visit(path, sd):
+        name = "/".join(str(getattr(p, "key", p)) for p in path)
+        leaf = name.split("/")[-1]
+        leaf_key = jax.random.fold_in(base, next(counter))
+        if leaf.startswith("scale") or leaf == "g":
+            return jnp.ones(sd.shape, sd.dtype)
+        if leaf.startswith("bias") or leaf.startswith("b"):
+            return jnp.zeros(sd.shape, sd.dtype)
+        # fan-in approximation: D sits at axis 1 for stacked [L, D, ...]
+        # weights, at the last axis for 2-D embeddings.
+        fan_in = sd.shape[1] if len(sd.shape) >= 3 else sd.shape[-1]
+        # leaf_plan is the serving path's own selection logic — the rows
+        # must measure exactly the quantization the engine serves.
+        should, pack_axis = quant_lib.leaf_plan(name, sd)
+        if not (name.startswith("blocks/") and should):
+            return gen_dense(leaf_key, sd.shape, sd.dtype, fan_in)
+        layers = sd.shape[0]
+        per_layer = int(np.prod(sd.shape[1:]))
+        chunk = max(1, min(layers, int(2e9 // (per_layer * 4))))
+        datas, scales = [], []
+        for lo in range(0, layers, chunk):
+            n = min(chunk, layers - lo)
+            x = gen_dense(
+                jax.random.fold_in(leaf_key, lo), (n, *sd.shape[1:]),
+                jnp.float32, fan_in,
+            )
+            qt = quant_lib.quantize(x, bits=bits, pack_axis=pack_axis)
+            datas.append(qt.data)
+            scales.append(qt.scale)
+            del x, qt
+        return quant_lib.QuantizedTensor(
+            data=jnp.concatenate(datas, 0) if len(datas) > 1 else datas[0],
+            scale=jnp.concatenate(scales, 0) if len(scales) > 1 else scales[0],
+            bits=bits, orig_shape=tuple(sd.shape), pack_axis=pack_axis,
+        )
+
+    with jax.default_device(jax.devices()[0]):
+        return jax.tree_util.tree_map_with_path(visit, shapes)
 
 
 def _measure_decode(preset: str, batch: int, prompt_len: int, new_tokens: int,
@@ -327,7 +415,12 @@ def _measure_decode(preset: str, batch: int, prompt_len: int, new_tokens: int,
 
     n1, n2 = new_tokens, 2 * new_tokens
     t1, t2 = timed(n1), timed(n2)
-    if t2 <= t1:  # overhead-dominated; fall back to the single-shot number
+    overhead_dominated = t2 <= t1
+    if overhead_dominated:
+        # The two-point delta collapsed into dispatch noise; the single-shot
+        # number still folds prefill + ~80ms tunnel overhead into tok/s, so
+        # mark the row — otherwise a deflated batch-scaling row reads as
+        # batching regressing throughput.
         tps = batch * n2 / t2
     else:
         tps = batch * (n2 - n1) / (t2 - t1)
@@ -346,6 +439,9 @@ def _measure_decode(preset: str, batch: int, prompt_len: int, new_tokens: int,
         "tok_per_s_per_chip": round(tps / n_chips, 2),
         "params_b": round(_param_count(get_preset(preset)) / 1e9, 3),
         "weight_gb": round(weight_bytes / 1e9, 3),
+        **({"note": "overhead-dominated: two-point delta collapsed; "
+                    "single-shot number includes prefill + dispatch"}
+           if overhead_dominated else {}),
     }
     mfu = _mfu(tps / n_chips, _param_count(get_preset(preset)))
     if mfu is not None:
@@ -771,8 +867,120 @@ def _stamp() -> str:
 
 
 def _write_rows(path: str, rows: list[dict]) -> None:
-    with open(path, "w") as f:
+    # Atomic (tmp + rename): emit() runs after every ladder row, and a
+    # crash mid-json.dump must never leave the artifact of record truncated
+    # — the merge logic would later read the wreck as "no prior rows".
+    tmp = f"{path}.tmp"
+    with open(tmp, "w") as f:
         json.dump({"rows": rows}, f, indent=1)
+    os.replace(tmp, path)
+
+
+def _measure_quant_matmul_bw(
+    batch: int = 4, d: int = 4096, f: int = 11008, inner: int = 16,
+    iters: int = 5,
+) -> dict:
+    """Isolated fused dequant-matmul bandwidth at north-star decode shapes.
+
+    The serving-path 3-int8 row measures the whole stack; this row times
+    ONLY the weight-streaming matmuls, distinguishing "the kernel is slow"
+    from "the stack around it is slow".  Three paths in the identical
+    harness: the Pallas kernel, the XLA dequant+einsum fallback it
+    replaces, and a dense bf16 matmul (the HBM-bandwidth roofline).  The
+    harness scans an MLP up/down projection pair over ``inner`` stacked
+    per-layer weights — exactly the serving loop's structure, which also
+    stops XLA hoisting a loop-invariant dequantize out of the measurement
+    (a chained-loop-over-one-weight harness would let it).  A per-call
+    measurement would be useless here: ~80 ms tunnel dispatch vs ~56 us of
+    compute; scan amortizes dispatch over 2*inner matmuls."""
+    from distributed_llms_tpu.checkpoint.quantize import (
+        QuantizedTensor, dequantize, quantize,
+    )
+    from distributed_llms_tpu.ops.quant_matmul import quant_contract
+
+    _PARAMS_CACHE.clear()  # headroom: stacked bf16 weights are ~3 GB
+    key = jax.random.key(7)
+    kx, ku, kd = jax.random.split(key, 3)
+    x0 = jax.random.normal(kx, (batch, d), jnp.bfloat16)
+
+    def gen(base, i, shape, fan_in):
+        k = jax.random.fold_in(base, i)
+        return jax.random.normal(k, shape, jnp.float32) * fan_in**-0.5
+
+    def stacked_quant(bits):
+        qs = []
+        for base, shape, fan in ((ku, (d, f), d), (kd, (f, d), f)):
+            per = [quantize(gen(base, i, shape, fan), bits=bits)
+                   for i in range(inner)]
+            qs.append(QuantizedTensor(
+                data=jnp.stack([q.data for q in per]),
+                scale=jnp.stack([q.scale for q in per]),
+                bits=bits, orig_shape=(inner, *shape), pack_axis=-2,
+            ))
+        return tuple(qs)
+
+    def rms(y):
+        sq = jnp.mean(jnp.square(y.astype(jnp.float32))) + 1e-6
+        return (y.astype(jnp.float32) * jax.lax.rsqrt(sq)).astype(y.dtype)
+
+    def harness(step):
+        def body(y, per_layer):
+            return rms(step(y, per_layer)), None
+
+        return jax.jit(lambda y, ws: jax.lax.scan(body, y, ws)[0])
+
+    def qt_bytes(qts):
+        return sum(q.data.size + q.scale.size * 4 for q in qts) // inner
+
+    out = {"batch": batch, "d": d, "f": f, "layers_scanned": inner,
+           "platform": jax.devices()[0].platform}
+    jobs = []
+    for bits, tag in ((8, "int8"), (4, "int4")):
+        ws = stacked_quant(bits)
+        jobs.append((f"kernel_{tag}", harness(
+            lambda y, w: quant_contract(quant_contract(y, w[0], k_lead=1),
+                                        w[1], k_lead=1)), ws, qt_bytes(ws)))
+        jobs.append((f"dequant_{tag}", harness(
+            lambda y, w: (y @ dequantize(w[0], y.dtype))
+            @ dequantize(w[1], y.dtype)), ws, qt_bytes(ws)))
+    dense = tuple(
+        jnp.stack([gen(base, i, shape, fan).astype(jnp.bfloat16)
+                   for i in range(inner)])
+        for base, shape, fan in ((ku, (d, f), d), (kd, (f, d), f))
+    )
+    jobs.append(("dense_bf16", harness(
+        lambda y, w: (y @ w[0]) @ w[1]), dense, 2 * 2 * d * f))
+    for name, fn, ws, nbytes in jobs:
+        y = np.asarray(fn(x0, ws))  # compile + numerics guard
+        if not np.isfinite(y).all():
+            raise FloatingPointError(f"{name}: non-finite output")
+        ts = []
+        for _ in range(iters):
+            t0 = time.perf_counter()
+            np.asarray(fn(x0, ws))
+            ts.append(time.perf_counter() - t0)
+        out[f"gbps_{name}"] = round(nbytes * inner / min(ts) / 1e9, 1)
+    del jobs, dense
+    kind = getattr(jax.devices()[0], "device_kind", "").lower()
+    for k, peak in PEAK_HBM_BW.items():
+        if k in kind:
+            out["hbm_util_kernel_int8"] = round(
+                out["gbps_kernel_int8"] * 1e9 / peak, 3
+            )
+            break
+    return out
+
+
+def _merge_rows(prior: list[dict], fresh: list[dict]) -> list[dict]:
+    """Replace prior rows by config name (prior order kept), append new."""
+    by_cfg = {str(r.get("config")): r for r in fresh}
+    merged = [by_cfg.pop(str(r.get("config")), r) for r in prior]
+    merged.extend(by_cfg.values())
+    return merged
+
+
+class _RowSkip(Exception):
+    """A ladder row that cannot run in this environment (doesn't fit)."""
 
 
 def run_ladder(args, degraded: str | None) -> list[dict]:
@@ -780,8 +988,63 @@ def run_ladder(args, degraded: str | None) -> list[dict]:
 
     dtype = "float32" if degraded is not None else args.dtype
     on_cpu = jax.devices()[0].platform == "cpu"
-    rows = []
+    # --rows: refresh only the named rows and MERGE into the existing
+    # artifact — a kernel fix must not cost a multi-hour full re-run, and
+    # untouched rows keep their original measured_on stamps.
+    only = (
+        {s.strip() for s in args.rows.split(",") if s.strip()}
+        if args.rows else None
+    )
+    if only is not None:
+        known = {str(e["config"]) for e in LADDER} | {
+            "serving-latency", "continuous-batching", "paged-batching",
+            "ragged-decode-8k", "quant-matmul-bw", "prefill-flash-2048",
+            "prefill-flash-8192", "hop-latency",
+        }
+        unknown = only - known
+        if unknown:  # a typo must not masquerade as a clean zero-row run
+            raise SystemExit(
+                f"--rows: unknown config name(s) {sorted(unknown)}; "
+                f"known: {sorted(known)}"
+            )
+
+    def want(name) -> bool:
+        return only is None or str(name) in only
+
+    # ALWAYS merge into the existing artifact (not only under --rows): the
+    # incremental writes below would otherwise replace a complete artifact
+    # with a truncated one the moment row 1 lands, and a mid-run crash
+    # (tunnel wedge, OOM) would erase every not-yet-reached row — round 4's
+    # first run lost its config-4 skip rows exactly this way.  A completed
+    # run replaces every row it measured; unreachable rows keep their last
+    # recorded state and stamp.
+    prior: list[dict] = []
+    if os.path.exists(args.out):
+        try:
+            with open(args.out) as f:
+                prior = json.load(f).get("rows", [])
+        except (json.JSONDecodeError, OSError) as exc:
+            # Never silently discard the artifact of record: preserve the
+            # unreadable file and say so, or a --rows refresh would measure
+            # one row and overwrite everything else with it.
+            backup = f"{args.out}.corrupt"
+            try:
+                os.replace(args.out, backup)
+            except OSError:
+                backup = "unrecoverable"
+            print(f"# WARNING: {args.out} unreadable ({exc}); preserved as "
+                  f"{backup}; starting a fresh rows list", file=sys.stderr)
+
+    rows: list[dict] = []
+
+    def emit() -> list[dict]:
+        merged = _merge_rows(prior, rows)
+        _write_rows(args.out, merged)  # incremental: a crash keeps these
+        return merged
+
     for entry in LADDER:
+        if not want(entry["config"]):
+            continue
         cfg = get_preset(entry["preset"])
         if on_cpu and _param_count(cfg) > 0.5e9:
             rows.append({
@@ -819,111 +1082,82 @@ def run_ladder(args, degraded: str | None) -> list[dict]:
             })
         rows.append(row)
         print(f"#   -> {row}", file=sys.stderr)
-        _write_rows(args.out, rows)  # incremental: a later crash keeps these
-    # Serving-latency row (TTFT/TPOT percentiles through the engine): the
-    # north-star config on an accelerator, the CPU fallback config otherwise.
+        emit()
+
+    # Aux rows, one uniform measure/record/emit loop.  serving-latency and
+    # continuous-batching use the north-star config on an accelerator and
+    # the CPU fallback config otherwise; the kernel rows (paged, ragged,
+    # flash prefill) run on real hardware only — CPU interpret mode would
+    # measure the emulator, not the kernel.
     srv = FALLBACK if on_cpu else NORTH_STAR
-    row = {"config": "serving-latency"}
     srv_cfg = get_preset(srv["preset"])
-    ok, why = _fits(
-        srv_cfg, srv["batch"], srv["prompt"] + srv["new"], dtype, srv.get("quant")
-    )
-    if not ok:
-        row.update({"preset": srv["preset"], "skipped": why})
-    else:
+
+    def _serving():
+        ok, why = _fits(srv_cfg, srv["batch"], srv["prompt"] + srv["new"],
+                        dtype, srv.get("quant"))
+        if not ok:
+            raise _RowSkip(why)
+        return _measure_serving_latency(
+            srv["preset"], srv["batch"], srv["prompt"], dtype,
+            quant=srv.get("quant"), new_tokens=srv["new"],
+        )
+
+    aux = [
+        ("serving-latency", _serving),
+        ("continuous-batching", lambda: _measure_continuous_batching(
+            srv["preset"], dtype, quant=srv.get("quant"))),
+    ]
+    if not on_cpu:
+        # Paged vs contiguous batching (pool at ~45% of contiguous KV
+        # bytes); ragged vs dense decode at 8k cache width; flash prefill
+        # pair (2048 = short-context sanity point, 8192 = long-context where
+        # the O(T^2) attention share grows and tiling should beat dot).
+        aux += [
+            ("paged-batching", lambda: _measure_paged_batching(dtype=dtype)),
+            ("ragged-decode-8k", lambda: _measure_ragged_decode(dtype=dtype)),
+            ("quant-matmul-bw", lambda: _measure_quant_matmul_bw(
+                iters=max(args.iters, 5))),
+        ]
+        aux += [
+            (f"prefill-flash-{seq}", functools.partial(
+                _measure_prefill_flash, batch=b, seq=seq, dtype=dtype,
+                iters=args.iters))
+            for seq, b in ((2048, 2), (8192, 1))
+        ]
+    for name, fn in aux:
+        if not want(name):
+            continue
+        row = {"config": name}
         try:
-            row.update(_measure_serving_latency(
-                srv["preset"], srv["batch"], srv["prompt"], dtype,
-                quant=srv.get("quant"), new_tokens=srv["new"],
-            ))
+            row.update(fn())
             row["measured_on"] = _stamp()
             if degraded is not None:
                 row["degraded"] = degraded
-        except Exception as exc:
-            row["skipped"] = (
-                f"{type(exc).__name__}: {(str(exc).splitlines() or ['?'])[0][:200]}"
-            )
-    rows.append(row)
-    print(f"# serving latency: {row}", file=sys.stderr)
-    _write_rows(args.out, rows)
-    # Continuous-batching scheduling gain on a mixed-length workload.
-    row = {"config": "continuous-batching"}
-    cb = FALLBACK if on_cpu else NORTH_STAR
-    try:
-        row.update(_measure_continuous_batching(
-            cb["preset"], dtype, quant=cb.get("quant"),
-        ))
-        row["measured_on"] = _stamp()
-        if degraded is not None:
-            row["degraded"] = degraded
-    except Exception as exc:
-        row["skipped"] = (
-            f"{type(exc).__name__}: {(str(exc).splitlines() or ['?'])[0][:200]}"
-        )
-    rows.append(row)
-    print(f"# continuous batching: {row}", file=sys.stderr)
-    _write_rows(args.out, rows)
-    if not on_cpu:
-        # Paged vs contiguous batching: same tokens, pool at ~45% of the
-        # contiguous KV bytes (real kernels only).
-        row = {"config": "paged-batching"}
-        try:
-            row.update(_measure_paged_batching(dtype=dtype))
-            row["measured_on"] = _stamp()
+        except _RowSkip as skip:
+            row.update({"preset": srv["preset"], "skipped": str(skip)})
         except Exception as exc:
             row["skipped"] = (
                 f"{type(exc).__name__}: "
                 f"{(str(exc).splitlines() or ['?'])[0][:200]}"
             )
         rows.append(row)
-        print(f"# paged batching: {row}", file=sys.stderr)
-        _write_rows(args.out, rows)
-        # Long-context ragged decode: dense full-width vs the ragged kernel
-        # at 8k cache width, mixed row depths (real kernels only).
-        row = {"config": "ragged-decode-8k"}
-        try:
-            row.update(_measure_ragged_decode(dtype=dtype))
-            row["measured_on"] = _stamp()
-        except Exception as exc:
-            row["skipped"] = (
-                f"{type(exc).__name__}: "
-                f"{(str(exc).splitlines() or ['?'])[0][:200]}"
-            )
-        rows.append(row)
-        print(f"# ragged decode: {row}", file=sys.stderr)
-        _write_rows(args.out, rows)
-        # Flash-attention prefill microbenchmark (real kernels only — CPU
-        # interpret mode would measure the emulator, not the kernel).
-        # seq=2048 is the short-context sanity point; seq=8192 (batch 1) is
-        # the long-context point where the O(T^2) attention share grows and
-        # the flash kernel's tiling should pull ahead of dot.
-        for seq, b in ((2048, 2), (8192, 1)):
-            row = {"config": f"prefill-flash-{seq}"}
-            try:
-                row.update(_measure_prefill_flash(
-                    batch=b, seq=seq, dtype=dtype, iters=args.iters
-                ))
-                row["measured_on"] = _stamp()
-            except Exception as exc:
-                row["skipped"] = (
-                    f"{type(exc).__name__}: "
-                    f"{(str(exc).splitlines() or ['?'])[0][:200]}"
-                )
-            rows.append(row)
-            print(f"# prefill flash: {row}", file=sys.stderr)
-            _write_rows(args.out, rows)
-    hop = _measure_hop_latency()
-    if hop is not None:
-        rows.append({"config": "hop-latency", **hop, "measured_on": _stamp()})
-        print(f"# hop latency: {hop}", file=sys.stderr)
-    else:
-        # SURVEY §6 metric is unmeasurable on one chip — record that
-        # explicitly rather than omitting the row (VERDICT r2 weak item 5).
-        rows.append({
-            "config": "hop-latency",
-            "skipped": "needs >1 device; single-chip bench env — CPU "
-                       "fake-mesh upper bound is in BASELINE.md",
-        })
+        print(f"# {name}: {row}", file=sys.stderr)
+        emit()
+    if want("hop-latency"):
+        hop = _measure_hop_latency()
+        if hop is not None:
+            rows.append({"config": "hop-latency", **hop,
+                         "measured_on": _stamp()})
+            print(f"# hop latency: {hop}", file=sys.stderr)
+        else:
+            # SURVEY §6 metric is unmeasurable on one chip — record that
+            # explicitly rather than omitting the row (VERDICT r2 weak 5).
+            rows.append({
+                "config": "hop-latency",
+                "skipped": "needs >1 device; single-chip bench env — CPU "
+                           "fake-mesh upper bound is in BASELINE.md",
+            })
+    emit()
     return rows
 
 
@@ -951,6 +1185,11 @@ def main() -> None:
                     help="measure all BASELINE ladder configs that fit")
     ap.add_argument("--out", default="BENCH_LADDER.json",
                     help="ladder results file (with --ladder)")
+    ap.add_argument("--rows", default=None,
+                    help="comma-separated config names (e.g. "
+                         "'3-int8,ragged-decode-8k'): run only these ladder "
+                         "rows and merge them into --out, leaving every "
+                         "other row untouched")
     args = ap.parse_args()
 
     if args.force_cpu:
@@ -962,8 +1201,15 @@ def main() -> None:
         degraded = _init_backend(args.probe_timeout, args.probe_attempts)
     # Arm the hang watchdog only when measuring on a (possibly flaky)
     # accelerator — it covers BOTH default and --ladder modes.
+    # Default mode only: the watchdog guarantees the driver its ONE JSON
+    # line when the tunnel wedges mid-measurement.  A full --ladder run
+    # legitimately takes hours, so a flat deadline would kill it mid-flight
+    # (round 4's first run died exactly this way at minute 45); ladder runs
+    # are crash-isolated per row and deadline-guarded by the runbook's
+    # `timeout` instead.
     watchdog_done = _arm_watchdog(
-        args.measure_timeout if degraded is None else 0, args
+        args.measure_timeout if degraded is None and not args.ladder else 0,
+        args,
     )
     if degraded is not None:
         # CPU can't hold bf16 numerics through XLA's collective passes and is
@@ -971,8 +1217,10 @@ def main() -> None:
         args.dtype = "float32"
 
     if args.ladder:
-        rows = run_ladder(args, degraded)
-        _write_rows(args.out, rows)
+        rows = run_ladder(args, degraded)  # returns THIS run's rows; emit()
+        # inside already wrote the merged artifact, and headline selection
+        # must not resurface a prior run's row (a CPU --rows refresh would
+        # otherwise print a stale TPU headline).
         print(f"# ladder results -> {args.out}", file=sys.stderr)
         # Headline = the north-star config if it was measured, else the
         # first measured row.
@@ -980,6 +1228,20 @@ def main() -> None:
             (r for r in rows if r.get("config") == "3-int8" and "tok_per_s" in r),
             next((r for r in rows if "tok_per_s" in r), None),
         )
+        if head is None and args.rows:
+            # A --rows refresh may touch only non-throughput rows (e.g.
+            # quant-matmul-bw); report the artifact's standing headline
+            # rather than a false "all configs skipped" collapse.
+            try:
+                with open(args.out) as f:
+                    merged = json.load(f).get("rows", [])
+            except (OSError, json.JSONDecodeError):
+                merged = []
+            head = next(
+                (r for r in merged
+                 if r.get("config") == "3-int8" and "tok_per_s" in r),
+                next((r for r in merged if "tok_per_s" in r), None),
+            )
     else:
         # Default: the north-star metric (7B int8) on an accelerator; on the
         # CPU fallback a 7B decode is minutes/token, so degrade to GPT-2.

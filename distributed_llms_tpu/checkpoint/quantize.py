@@ -153,13 +153,23 @@ def _should_quantize(path: str, x: Any) -> bool:
     return True
 
 
+def leaf_plan(path: str, x: Any) -> tuple[bool, int]:
+    """(quantize?, pack_axis) for a named leaf — the single source of truth
+    for which leaves quantize and how they pack, shared by quantize_tree
+    and streaming builders (bench.py generates-and-quantizes on device leaf
+    by leaf and must make the exact decisions the serving path makes)."""
+    if not _should_quantize(path, x):
+        return False, -2
+    return True, _PACK_AXIS_BY_NAME.get(path.split("/")[-1], -2)
+
+
 def quantize_tree(params: Any, bits: int = 8, block: int = 128) -> Any:
     """Quantize matmul weights in a param tree; other leaves pass through."""
 
     def visit(path, x):
         key = "/".join(str(getattr(p, "key", p)) for p in path)
-        if _should_quantize(key, x):
-            pack_axis = _PACK_AXIS_BY_NAME.get(key.split("/")[-1], -2)
+        should, pack_axis = leaf_plan(key, x)
+        if should:
             return quantize(x, bits=bits, block=block, pack_axis=pack_axis)
         return x
 
