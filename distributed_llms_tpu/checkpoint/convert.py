@@ -433,6 +433,33 @@ def config_from_hf(hf_config: Mapping[str, Any]) -> ModelConfig:
             norm_eps=hf_config.get("rms_norm_eps", 1e-6),
             tie_embeddings=hf_config.get("tie_word_embeddings", True),
         )
+    if model_type == "mistral" or "mistralfor" in arch:
+        # Mistral = llama layout (identical weight names; convert_llama
+        # applies) + sliding-window attention.  v0.1 ships window 4096;
+        # v0.2+ releases set sliding_window null (global attention) — both
+        # map cleanly.  window >= max_position_embeddings degenerates to
+        # global causal; keep None there so the mask stays the cheap one.
+        window = hf_config.get("sliding_window")
+        max_len = hf_config.get("max_position_embeddings", 32768)
+        if window is not None and window >= max_len:
+            window = None
+        return ModelConfig(
+            family="llama",
+            sliding_window=window,
+            vocab_size=hf_config["vocab_size"],
+            hidden_size=hf_config["hidden_size"],
+            intermediate_size=hf_config["intermediate_size"],
+            num_layers=hf_config["num_hidden_layers"],
+            num_heads=hf_config["num_attention_heads"],
+            num_kv_heads=hf_config.get(
+                "num_key_value_heads", hf_config["num_attention_heads"]
+            ),
+            head_dim=hf_config.get("head_dim"),
+            max_seq_len=max_len,
+            rope_theta=hf_config.get("rope_theta", 10000.0),
+            norm_eps=hf_config.get("rms_norm_eps", 1e-5),
+            tie_embeddings=hf_config.get("tie_word_embeddings", False),
+        )
     if model_type in ("llama", "mixtral") or "llama" in arch or "mixtral" in arch:
         return ModelConfig(
             family="llama",

@@ -81,6 +81,14 @@ class ModelConfig:
     # caller's attn_mask on the per-row-cache_index decode path is exactly
     # that prefix mask (the ContinuousBatcher's is; arbitrary masks are not).
     ragged_decode: bool = False
+    # Sliding-window attention (Mistral): query at position p attends keys in
+    # (p - window, p].  None = global causal.  Enforced via masks on the dot
+    # paths; the flash kernel falls back to dot (no windowed fast path yet),
+    # and the ragged/paged decode kernels + seq-parallel impls reject it
+    # (they read the full cache prefix by construction).  The KV cache keeps
+    # max_seq_len slots (no rolling buffer yet) — masking is what bounds the
+    # attention span, not cache size.
+    sliding_window: int | None = None
 
     def __post_init__(self):
         if self.attn_impl not in _ATTN_IMPLS:
@@ -95,6 +103,26 @@ class ModelConfig:
             # moe_swiglu hardcodes silu (Mixtral); accepting another
             # activation here would silently ignore it.
             raise ValueError("MoE blocks support gate_act='silu' only")
+        if self.sliding_window is not None:
+            if self.sliding_window < 1:
+                raise ValueError(
+                    f"sliding_window must be >= 1, got {self.sliding_window}"
+                )
+            if self.attn_impl in ("ring", "ulysses"):
+                # The seq-parallel impls attend the full (causal) global
+                # sequence; silently ignoring the window would be wrong
+                # numerics for any prompt longer than it.
+                raise ValueError(
+                    "sliding_window is not supported with ring/ulysses "
+                    "sequence parallelism (global causal attention only)"
+                )
+            if self.ragged_decode:
+                # The ragged decode kernel reads the whole cache prefix
+                # [0, cache_index[b]] — it cannot honor a window lower bound.
+                raise ValueError(
+                    "sliding_window is incompatible with ragged_decode "
+                    "(the prefix-read kernel cannot mask the pre-window span)"
+                )
     # MoE (expert parallelism); num_experts == 0 -> dense MLP.
     num_experts: int = 0
     num_experts_per_token: int = 2

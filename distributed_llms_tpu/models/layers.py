@@ -105,13 +105,38 @@ def dot_product_attention(
     return out
 
 
-def causal_mask(q_positions: jax.Array, k_positions: jax.Array, k_valid: jax.Array | None = None) -> jax.Array:
+def causal_mask(
+    q_positions: jax.Array,
+    k_positions: jax.Array,
+    k_valid: jax.Array | None = None,
+    window: int | None = None,
+) -> jax.Array:
     """Boolean mask [B, 1, Tq, Tk]: query at position p attends keys at
-    positions <= p.  ``k_valid`` ([B, Tk] bool) masks unwritten cache slots."""
+    positions <= p.  ``k_valid`` ([B, Tk] bool) masks unwritten cache slots.
+    ``window`` (Mistral sliding-window attention) further restricts keys to
+    positions in (p - window, p]."""
     mask = k_positions[:, None, None, :] <= q_positions[:, None, :, None]
     if k_valid is not None:
         mask = jnp.logical_and(mask, k_valid[:, None, None, :])
+    if window is not None:
+        mask = and_window(mask, q_positions, k_positions, window)
     return mask
+
+
+def and_window(
+    mask: jax.Array,
+    q_positions: jax.Array,
+    k_positions: jax.Array,
+    window: int,
+) -> jax.Array:
+    """AND the sliding-window lower bound (keys in (p - window, p]) into an
+    existing attention mask — the single definition of the window semantics,
+    shared by causal_mask and the caller-supplied-mask paths in
+    models.model._attention."""
+    return jnp.logical_and(
+        mask,
+        k_positions[:, None, None, :] > q_positions[:, None, :, None] - window,
+    )
 
 
 # ---------------------------------------------------------------------------

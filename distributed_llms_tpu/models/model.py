@@ -94,6 +94,11 @@ def _attention(
                 "paged attention is single-token decode with a per-row "
                 "cache_index over a page-pool cache"
             )
+        if cfg.sliding_window is not None:
+            raise ValueError(
+                "paged decode attends each row's full cache prefix; it "
+                "cannot honor sliding_window"
+            )
         from ..ops import decode_attn
 
         ck, cv = layer_cache  # [NB, BLK, KVH, HD] page pools
@@ -118,6 +123,7 @@ def _attention(
         cfg.attn_impl == "flash"
         and attn_mask is None
         and layer_cache is None
+        and cfg.sliding_window is None  # no windowed fast path; dot masks it
     ):
         # Self-attention over the input block (training / no-cache eval).
         from ..ops import flash
@@ -194,7 +200,7 @@ def _attention(
             s = ck.shape[1]
             k_positions = jnp.broadcast_to(jnp.arange(s, dtype=jnp.int32), (x.shape[0], s))
             k_valid = k_positions < (cache_index + x.shape[1])
-            if cfg.attn_impl == "flash" and x.shape[1] > 1:
+            if cfg.attn_impl == "flash" and x.shape[1] > 1 and cfg.sliding_window is None:
                 # Prefill into a (longer, padded) cache: the flash kernel
                 # masks the unwritten tail instead of computing a dense
                 # [Tq, max_len] score matrix.  Single-token decode stays on
@@ -207,13 +213,34 @@ def _attention(
                     k_valid=k_valid, causal=True,
                 )
                 return layers.out_project(out, p), (ck, cv)
-            attn_mask = layers.causal_mask(positions, k_positions, k_valid)
+            attn_mask = layers.causal_mask(
+                positions, k_positions, k_valid, window=cfg.sliding_window
+            )
+        elif cfg.sliding_window is not None:
+            # Caller-supplied masks (continuous batching's per-row prefix
+            # masks, padded prefill) carry causality/validity but not the
+            # window — AND it in here so no dense cached path can silently
+            # attend past the window.
+            s = ck.shape[1]
+            k_positions = jnp.broadcast_to(
+                jnp.arange(s, dtype=jnp.int32), (x.shape[0], s)
+            )
+            attn_mask = layers.and_window(
+                attn_mask, positions, k_positions, cfg.sliding_window
+            )
         k_full = layers.repeat_kv(ck.astype(q.dtype), cfg.q_per_kv)
         v_full = layers.repeat_kv(cv.astype(q.dtype), cfg.q_per_kv)
         out = layers.dot_product_attention(q, k_full, v_full, attn_mask)
         new_cache = (ck, cv)
     else:
-        mask = layers.causal_mask(positions, positions) if attn_mask is None else attn_mask
+        if attn_mask is None:
+            mask = layers.causal_mask(positions, positions, window=cfg.sliding_window)
+        else:
+            mask = attn_mask
+            if cfg.sliding_window is not None:
+                mask = layers.and_window(
+                    mask, positions, positions, cfg.sliding_window
+                )
         k_full = layers.repeat_kv(k, cfg.q_per_kv)
         v_full = layers.repeat_kv(v, cfg.q_per_kv)
         out = layers.dot_product_attention(q, k_full, v_full, mask)

@@ -58,6 +58,12 @@ PRESETS: dict[str, ModelConfig] = {
         head_dim=256, max_seq_len=8192, rope_theta=10000.0, norm_eps=1e-6,
         tie_embeddings=True,
     ),
+    "mistral-7b": ModelConfig(
+        family="llama", sliding_window=4096, vocab_size=32000, hidden_size=4096,
+        intermediate_size=14336, num_layers=32, num_heads=32, num_kv_heads=8,
+        max_seq_len=32768, rope_theta=10000.0, norm_eps=1e-5,
+        tie_embeddings=False,
+    ),
     "mixtral-8x7b": ModelConfig(
         family="llama", vocab_size=32000, hidden_size=4096, intermediate_size=14336,
         num_layers=32, num_heads=32, num_kv_heads=8, max_seq_len=32768,
@@ -99,6 +105,7 @@ HF_REPOS: dict[str, str] = {
     "llama-3-70b": "meta-llama/Meta-Llama-3-70B",
     "qwen2-7b": "Qwen/Qwen2-7B",
     "gemma-7b": "google/gemma-7b",
+    "mistral-7b": "mistralai/Mistral-7B-v0.1",
 }
 
 

@@ -484,6 +484,12 @@ class ContinuousBatcher:
                     "paged KV is single-device for now (no SPMD rule for "
                     "the paged kernel)"
                 )
+            if cfg.sliding_window is not None:
+                raise ValueError(
+                    "paged KV cannot serve sliding-window models (the paged "
+                    "decode kernel attends the full cache prefix); use "
+                    "contiguous mode"
+                )
             if max_len % page_size:
                 raise ValueError(
                     f"max_len {max_len} must be a multiple of page_size "
@@ -519,9 +525,13 @@ class ContinuousBatcher:
 
         from ..ops import decode_attn
 
+        # (Sliding-window models keep the masked dense path: the ragged
+        # kernel reads the full prefix and cannot honor the window — the
+        # window is AND-ed into the batcher's masks by models._attention.)
         self.cfg_decode = (
             dataclasses.replace(cfg, ragged_decode=True)
             if parallel is None and decode_attn._mode() != "fallback"
+            and cfg.sliding_window is None
             else cfg
         )
         self.params = params
