@@ -82,6 +82,15 @@ def _attention(
     #                            (tables[b, s//BLK], s%BLK).  Decode-only
     #                            (T == 1, per-row cache_index); the mask is
     #                            implicitly the prefix [0, cache_index[b]].
+    key_positions: jax.Array | None = None,  # [B, S] true RoPE position of
+    #                            each cache slot — ONLY consulted by the
+    #                            sliding-window mask.  Contiguous layouts
+    #                            (slot == position: batcher, sessions) leave
+    #                            it None; the right-padded generate layout
+    #                            (prompt slots 0..T-1, generated token j at
+    #                            slot T+j but position len+j) MUST pass it
+    #                            or the window silently widens by the pad
+    #                            amount on generated keys.
 ) -> tuple[jax.Array, tuple[jax.Array, jax.Array] | None]:
     q, k, v = layers.qkv_project(x, p, cfg)
     if use_rope:
@@ -213,20 +222,27 @@ def _attention(
                     k_valid=k_valid, causal=True,
                 )
                 return layers.out_project(out, p), (ck, cv)
-            attn_mask = layers.causal_mask(
-                positions, k_positions, k_valid, window=cfg.sliding_window
-            )
+            # Causality/validity compare SLOT indices (the write frontier);
+            # the window compares POSITIONS — for gapped layouts the caller
+            # supplies key_positions (see the parameter comment above).
+            attn_mask = layers.causal_mask(positions, k_positions, k_valid)
+            if cfg.sliding_window is not None:
+                kpos = k_positions if key_positions is None else key_positions
+                attn_mask = layers.and_window(
+                    attn_mask, positions, kpos, cfg.sliding_window
+                )
         elif cfg.sliding_window is not None:
             # Caller-supplied masks (continuous batching's per-row prefix
             # masks, padded prefill) carry causality/validity but not the
             # window — AND it in here so no dense cached path can silently
             # attend past the window.
-            s = ck.shape[1]
-            k_positions = jnp.broadcast_to(
-                jnp.arange(s, dtype=jnp.int32), (x.shape[0], s)
-            )
+            if key_positions is None:
+                s = ck.shape[1]
+                key_positions = jnp.broadcast_to(
+                    jnp.arange(s, dtype=jnp.int32), (x.shape[0], s)
+                )
             attn_mask = layers.and_window(
-                attn_mask, positions, k_positions, cfg.sliding_window
+                attn_mask, positions, key_positions, cfg.sliding_window
             )
         k_full = layers.repeat_kv(ck.astype(q.dtype), cfg.q_per_kv)
         v_full = layers.repeat_kv(cv.astype(q.dtype), cfg.q_per_kv)
@@ -318,22 +334,22 @@ def _seq_cached_attention(
     return layers.out_project(out, p), ((ck_pref, ck_dec), (cv_pref, cv_dec))
 
 
-def gpt2_block(x, p, cfg, positions, layer_cache, cache_index, attn_mask=None, std_layout=False, kv_tables=None):
+def gpt2_block(x, p, cfg, positions, layer_cache, cache_index, attn_mask=None, std_layout=False, kv_tables=None, key_positions=None):
     """-> (x, new_cache, aux): aux is the MoE load-balance term (0 here).
     Shared by the gpt2 and opt families (pre-LN + learned positions);
     cfg.activation picks the MLP nonlinearity (gelu vs relu)."""
     h = layers.layer_norm(x, p["ln1"]["scale"], p["ln1"]["bias"], cfg.norm_eps)
-    attn_out, new_cache = _attention(h, p["attn"], cfg, positions, layer_cache, cache_index, use_rope=False, attn_mask=attn_mask, std_layout=std_layout, kv_tables=kv_tables)
+    attn_out, new_cache = _attention(h, p["attn"], cfg, positions, layer_cache, cache_index, use_rope=False, attn_mask=attn_mask, std_layout=std_layout, kv_tables=kv_tables, key_positions=key_positions)
     x = x + attn_out
     h = layers.layer_norm(x, p["ln2"]["scale"], p["ln2"]["bias"], cfg.norm_eps)
     x = x + layers.mlp_gelu(h, p["mlp"], cfg.activation)
     return x, new_cache, jnp.float32(0.0)
 
 
-def llama_block(x, p, cfg, positions, layer_cache, cache_index, attn_mask=None, std_layout=False, kv_tables=None):
+def llama_block(x, p, cfg, positions, layer_cache, cache_index, attn_mask=None, std_layout=False, kv_tables=None, key_positions=None):
     """-> (x, new_cache, aux): aux is the MoE load-balance term."""
     h = layers.rms_norm(x, p["ln1"]["scale"], cfg.norm_eps)
-    attn_out, new_cache = _attention(h, p["attn"], cfg, positions, layer_cache, cache_index, use_rope=True, attn_mask=attn_mask, std_layout=std_layout, kv_tables=kv_tables)
+    attn_out, new_cache = _attention(h, p["attn"], cfg, positions, layer_cache, cache_index, use_rope=True, attn_mask=attn_mask, std_layout=std_layout, kv_tables=kv_tables, key_positions=key_positions)
     x = x + attn_out
     h = layers.rms_norm(x, p["ln2"]["scale"], cfg.norm_eps)
     if "router" in p["mlp"]:  # MoE block (cfg.num_experts > 0)
@@ -358,6 +374,7 @@ def run_blocks(
     attn_mask: jax.Array | None = None,
     std_layout: bool = False,
     kv_tables: jax.Array | None = None,
+    key_positions: jax.Array | None = None,  # see _attention
 ) -> tuple[jax.Array, tuple[jax.Array, jax.Array] | None, jax.Array]:
     """Scan the stacked blocks over x.  Used both for the whole model and for
     a single pipeline stage (blocks then hold only the stage's layer slice).
@@ -382,7 +399,7 @@ def run_blocks(
 
     def body(carry, xs):
         layer_params, ck, cv = xs
-        y, new_cache, aux = block_fn(carry, layer_params, cfg, positions, (ck, cv), cache_index, attn_mask, std_layout, kv_tables)
+        y, new_cache, aux = block_fn(carry, layer_params, cfg, positions, (ck, cv), cache_index, attn_mask, std_layout, kv_tables, key_positions)
         return y, (new_cache, aux)
 
     if remat:
@@ -438,6 +455,9 @@ def forward(
     kv_tables: jax.Array | None = None,  # [B, P] page table: the cache holds
     #   page POOLS [L, NB, BLK, KVH, HD] (paged continuous batching; see
     #   _attention's kv_tables contract — decode-only)
+    key_positions: jax.Array | None = None,  # [B, S] true RoPE positions of
+    #   cache slots, for the sliding-window mask under gapped (right-padded
+    #   generate) cache layouts — see _attention's parameter comment
 ) -> tuple[jax.Array, KVCache | None] | tuple[jax.Array, KVCache | None, jax.Array]:
     """Full forward.  Returns (logits [B, T, V] float32, updated cache), plus
     the summed MoE aux loss when ``return_aux`` (scale by
@@ -461,7 +481,7 @@ def forward(
         out = (unembed(params, cfg, x), None)
     else:
         x, (new_k, new_v), aux = run_blocks(
-            x, params["blocks"], cfg, positions, cache.k, cache.v, cache_index, remat, attn_mask, std_layout, kv_tables
+            x, params["blocks"], cfg, positions, cache.k, cache.v, cache_index, remat, attn_mask, std_layout, kv_tables, key_positions
         )
         out = (unembed(params, cfg, x), KVCache(k=new_k, v=new_v))
     return (*out, aux) if return_aux else out

@@ -505,6 +505,19 @@ def make_parallel_model(
             "ring attention and the pipeline schedule are alternative "
             "shardings of the layer loop — use one, with 'data'/'model' axes"
         )
+    if cfg.sliding_window is not None:
+        # The mesh decode paths (wavefront pipeline, GSPMD generate) do not
+        # yet thread the slot->position map the window mask needs for the
+        # right-padded generate layout (models.model._attention
+        # key_positions); serving a windowed model there would silently
+        # widen the window by each row's pad amount.  Single-device engines
+        # and the continuous batcher (contiguous layout) serve Mistral-style
+        # models correctly today.
+        raise ValueError(
+            "sliding_window models are single-device for now (mesh decode "
+            "does not thread key_positions); serve via a single-device "
+            "engine or its continuous batcher"
+        )
     return ParallelModel(
         cfg=cfg, mesh=mesh, num_microbatches=num_microbatches, kv_dtype=kv_dtype
     )

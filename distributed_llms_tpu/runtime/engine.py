@@ -436,3 +436,94 @@ class InferenceEngine:
             parallel=self.parallel,
             paged_pages=paged_pages, page_size=page_size,
         )
+
+    # -- speculative decoding (runtime/speculative.py): greedy-exact ------
+
+    def attach_draft(
+        self, draft_cfg: Any = None, draft_params: Any = None,
+        quantize_bits: int | None = None,
+    ) -> None:
+        """Attach a draft model for ``generate_text_speculative``.
+
+        Either pass an explicit ``(draft_cfg, draft_params)`` pair (any
+        model sharing this engine's vocabulary — a smaller family member is
+        the classic choice), or ``quantize_bits=4|8`` for self-speculation:
+        the draft is this engine's own decoder blocks weight-only quantized
+        (reads a fraction of the weight bytes per draft step, agrees with
+        the target often — and exactness never depends on how often).
+        """
+        if quantize_bits is not None:
+            if draft_cfg is not None or draft_params is not None:
+                raise ValueError("pass draft_cfg/draft_params OR quantize_bits")
+            from ..checkpoint.quantize import QuantizedTensor, quantize_tree
+
+            leaves = jax.tree_util.tree_leaves(
+                self.params.get("blocks", {}),
+                is_leaf=lambda x: isinstance(x, QuantizedTensor),
+            )
+            if any(isinstance(x, QuantizedTensor) for x in leaves):
+                raise ValueError(
+                    "engine already serves quantized weights; build the "
+                    "draft explicitly (attach_draft(draft_cfg, draft_params))"
+                )
+            draft_cfg = self.cfg
+            draft_params = {
+                **self.params,
+                "blocks": quantize_tree(self.params["blocks"], bits=quantize_bits),
+            }
+        if draft_cfg is None or draft_params is None:
+            raise ValueError("need draft_cfg + draft_params (or quantize_bits)")
+        if draft_cfg.vocab_size != self.cfg.vocab_size:
+            raise ValueError(
+                f"draft vocab {draft_cfg.vocab_size} != target vocab "
+                f"{self.cfg.vocab_size}"
+            )
+        self.draft_cfg = draft_cfg
+        self.draft_params = draft_params
+
+    def generate_text_speculative(
+        self, prompts: list[str], max_new_tokens: int | None = None,
+        k: int = 4,
+    ) -> GenerationResult:
+        """Greedy generation through the speculative decode loop — emits
+        exactly ``generate_text``'s tokens (temperature 0), faster whenever
+        the attached draft's acceptance covers its cost.  Single-device
+        engines only (the loop drives models.model.forward directly)."""
+        if getattr(self, "draft_params", None) is None:
+            raise ValueError("no draft attached; call attach_draft(...) first")
+        if self.parallel is not None:
+            raise ValueError(
+                "speculative decoding is single-device for now (mesh engines "
+                "serve via generate_text / continuous_batcher)"
+            )
+        if self.rt.temperature != 0.0:
+            raise ValueError(
+                "speculative decoding is greedy-only; set runtime.temperature=0"
+            )
+        from .speculative import speculative_generate_tokens
+
+        tok = self.tokenizer
+        prompt_arr, lens, n_real = self._encode_rows(prompts, batch=None)
+        n_new = self.rt.max_decode_steps if max_new_tokens is None else max_new_tokens
+        gen_lib.check_sequence_budget(
+            prompt_arr.shape[1] + k + 1, n_new, self.rt, self.cfg
+        )
+        t0 = time.perf_counter()
+        with self._timer.step(tokens=n_real * n_new):
+            out, stats = speculative_generate_tokens(
+                self.params, self.cfg, self.draft_params, self.draft_cfg,
+                jnp.asarray(prompt_arr), jnp.asarray(lens),
+                k=k, max_new_tokens=n_new,
+                eos_id=tok.eos_id, pad_id=tok.pad_id, return_stats=True,
+            )
+            out = _to_host(out)[:n_real]
+        dt = time.perf_counter() - t0
+        drafted = max(int(stats["drafted"]), 1)
+        METRICS.inc("engine.generated_tokens", int(out.shape[0] * out.shape[1]))
+        METRICS.observe("engine.spec_acceptance",
+                        int(stats["accepted"]) / drafted)
+        return GenerationResult(
+            text=[tok.decode(row) for row in out], tokens=out,
+            prompt_tokens=int(lens[:n_real].sum()),
+            generated_tokens=int(out.shape[0] * out.shape[1]), seconds=dt,
+        )

@@ -27,10 +27,11 @@ from ..models import model as model_lib
 from . import sampling
 
 
-def _default_forward(params, cfg, tokens, positions=None, cache=None, cache_index=None, attn_mask=None):
+def _default_forward(params, cfg, tokens, positions=None, cache=None, cache_index=None, attn_mask=None, key_positions=None):
     return model_lib.forward(
         params, cfg, tokens, positions=positions, cache=cache,
         cache_index=cache_index, attn_mask=attn_mask,
+        key_positions=key_positions,
     )
 
 
@@ -103,6 +104,17 @@ def generate_tokens(
 
     slots = jnp.arange(max_len, dtype=jnp.int32)  # [S]
     prompt_valid = slots[None, :] < prompt_lens[:, None]  # [B, S]
+    # Sliding-window models: the decode mask below carries causality and
+    # validity in SLOT space, but the window bound compares RoPE POSITIONS —
+    # and in this right-padded layout generated slot T+j sits at position
+    # len+j.  Hand the true slot->position map to the forward or the window
+    # silently widens by the pad amount (models.model._attention).
+    win_kwargs = {}
+    if cfg.sliding_window is not None:
+        win_kwargs["key_positions"] = jnp.where(
+            slots[None, :] < t, slots[None, :],
+            prompt_lens[:, None] + (slots[None, :] - t),
+        )
 
     def step(carry, inputs):
         cache, cur_logits, done = carry
@@ -119,6 +131,7 @@ def generate_tokens(
         logits, new_cache = forward_fn(
             params, cfg, tok[:, None],
             positions=positions, cache=cache, cache_index=t + j, attn_mask=mask,
+            **win_kwargs,
         )
         return (new_cache, logits[:, 0], done), tok
 

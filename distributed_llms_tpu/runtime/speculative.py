@@ -1,0 +1,254 @@
+"""Speculative decoding: draft k tokens with a cheap model, verify them all
+in ONE target forward, commit the longest agreeing prefix plus one token.
+
+Decode on TPU is weight-bandwidth bound (BASELINE.md: one token per full
+weight stream).  Verification reads the target's weights once per ROUND of
+up to k+1 tokens instead of once per token, so end-to-end speed multiplies
+by ~(mean accepted + 1) while the MXU does a (k+1)-token matmul it is far
+better shaped for than single-token decode.  The reference framework has no
+speculative path at all (its inference is one placeholder matmul per worker,
+src/worker/node.py:24-32) — this is a beyond-parity serving feature.
+
+Greedy-only and EXACT: at temperature 0 the emitted tokens are identical to
+``generate.generate_tokens``'s, for ANY draft model and any k — the draft
+only affects speed.  (tests/runtime/test_speculative.py pins this with a
+deliberately different draft model.)
+
+TPU-first formulation — the whole loop is one jitted ``lax.while_loop``
+with static shapes:
+
+- Rows advance by different amounts per round (per-row acceptance), so all
+  cache writes use the per-row ``cache_index`` vector + explicit masks path
+  of ``models.model._attention`` (the continuous batcher's machinery).
+- Rollback is free: a rejected draft slot is never "undone" — the per-row
+  attention masks cap every read at that row's committed frontier, and the
+  slot is overwritten the next time the frontier reaches it.  The same
+  argument keeps the DRAFT cache correct: its KVs match the committed
+  sequence exactly up to the accepted prefix, and everything later is
+  masked junk awaiting overwrite.
+
+Slot convention (matches ``generate.generate_tokens``): emitted token i of
+row b lives at cache slot T + i with RoPE position prompt_lens[b] + i; a
+token's KV is written by the forward call that CONSUMES it.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from ..core.config import ModelConfig
+from ..models import model as model_lib
+
+
+def _prefill(params, cfg, prompt, prompt_lens, max_len):
+    b, t = prompt.shape
+    cache = model_lib.init_cache(cfg, b, max_len)
+    positions = jnp.broadcast_to(jnp.arange(t, dtype=jnp.int32), (b, t))
+    logits, cache = model_lib.forward(
+        params, cfg, prompt, positions=positions, cache=cache,
+        cache_index=jnp.int32(0),
+    )
+    last = jnp.maximum(prompt_lens - 1, 0)
+    return jnp.take_along_axis(logits, last[:, None, None], axis=1)[:, 0], cache
+
+
+@partial(
+    jax.jit,
+    static_argnames=(
+        "target_cfg", "draft_cfg", "k", "max_new_tokens", "eos_id", "pad_id",
+        "return_stats",
+    ),
+)
+def speculative_generate_tokens(
+    target_params: Any,
+    target_cfg: ModelConfig,
+    draft_params: Any,
+    draft_cfg: ModelConfig,
+    prompt: jax.Array,        # [B, T] int32, right-padded with pad_id
+    prompt_lens: jax.Array,   # [B] int32 true lengths
+    k: int = 4,               # draft tokens per round
+    max_new_tokens: int = 32,
+    eos_id: int = -1,         # -1 => never stops early
+    pad_id: int = 0,
+    return_stats: bool = False,
+) -> jax.Array | tuple[jax.Array, dict[str, jax.Array]]:
+    """Greedy speculative decode.  Returns new tokens [B, max_new_tokens]
+    (positions after a row's EOS hold pad_id) — bit-identical to
+    ``generate_tokens(..., temperature=0.0)`` on the target alone.
+
+    With ``return_stats``: also ``{"rounds": scalar, "drafted": scalar,
+    "accepted": scalar}`` summed over the batch — mean accepted/drafted is
+    the acceptance rate; (accepted + rounds·1)/rounds is tokens per target
+    forward, the speedup lever.
+    """
+    if k < 1:
+        raise ValueError(f"k must be >= 1, got {k}")
+    if max_new_tokens < 1:
+        raise ValueError(f"max_new_tokens must be >= 1, got {max_new_tokens}")
+    for cfg, who in ((target_cfg, "target"), (draft_cfg, "draft")):
+        if cfg.ragged_decode:
+            # The ragged kernel reads each row's full slot prefix — including
+            # right-pad slots the masks here exclude.
+            raise ValueError(f"{who} cfg.ragged_decode is unsupported here")
+    if target_cfg.vocab_size != draft_cfg.vocab_size:
+        raise ValueError(
+            "draft and target must share a vocabulary: "
+            f"{draft_cfg.vocab_size} != {target_cfg.vocab_size}"
+        )
+
+    b, t = prompt.shape
+    # Verify can write up to k+1 slots past the last in-budget frontier.
+    max_len = t + max_new_tokens + k + 1
+    tgt_logits0, tgt_cache = _prefill(
+        target_params, target_cfg, prompt, prompt_lens, max_len
+    )
+    _, drf_cache = _prefill(draft_params, draft_cfg, prompt, prompt_lens, max_len)
+
+    slots = jnp.arange(max_len, dtype=jnp.int32)          # [S]
+    prompt_valid = slots[None, :] < prompt_lens[:, None]  # [B, S]
+    rows = jnp.arange(b, dtype=jnp.int32)
+    # Sliding-window models: true slot->position map for the window mask
+    # (this right-padded layout puts generated slot t+i at position len+i;
+    # see generate.generate_tokens / models.model._attention).
+    def _win_kwargs(cfg):
+        if cfg.sliding_window is None:
+            return {}
+        return {"key_positions": jnp.where(
+            slots[None, :] < t, slots[None, :],
+            prompt_lens[:, None] + (slots[None, :] - t),
+        )}
+
+    tgt_win = _win_kwargs(target_cfg)
+    drf_win = _win_kwargs(draft_cfg)
+
+    def gen_mask(e, q_off):
+        """[B, 1, 1, S] valid-keys mask for a query at emitted-index
+        e - 1 + q_off (its own write slot included)."""
+        hi = t + e - 1 + q_off
+        gen = jnp.logical_and(slots[None, :] >= t, slots[None, :] <= hi[:, None])
+        return jnp.logical_or(prompt_valid, gen)[:, None, None, :]
+
+    tok0 = jnp.argmax(tgt_logits0, axis=-1).astype(jnp.int32)
+    out0 = jnp.full((b, max_new_tokens + k + 1), pad_id, jnp.int32)
+    out0 = out0.at[:, 0].set(tok0)
+    e0 = jnp.ones((b,), jnp.int32)           # tokens emitted so far
+    done0 = (tok0 == eos_id) if eos_id >= 0 else jnp.zeros((b,), bool)
+    stats0 = jnp.zeros((3,), jnp.int32)      # rounds, drafted, accepted
+
+    def cond(carry):
+        _, _, _, e, _, done, _ = carry
+        return jnp.any(jnp.logical_and(~done, e < max_new_tokens))
+
+    def body(carry):
+        tgt_cache, drf_cache, out, e, y, done, stats = carry
+
+        # --- draft: k single-token greedy steps (batched, per-row index).
+        def draft_step(dc, j):
+            drf_cache, cur = dc
+            idx = t + e - 1 + j
+            logits, drf_cache = model_lib.forward(
+                draft_params, draft_cfg, cur[:, None],
+                positions=(prompt_lens + e - 1 + j)[:, None],
+                cache=drf_cache, cache_index=idx, attn_mask=gen_mask(e, j),
+                **drf_win,
+            )
+            nxt = jnp.argmax(logits[:, 0], axis=-1).astype(jnp.int32)
+            return (drf_cache, nxt), nxt
+
+        (drf_cache, _), drafts = jax.lax.scan(
+            draft_step, (drf_cache, y), jnp.arange(k, dtype=jnp.int32)
+        )
+        drafts = drafts.T  # [B, k]: d_1..d_k
+
+        # --- verify: ONE target forward over [y, d_1..d_k] (k+1 tokens).
+        vtoks = jnp.concatenate([y[:, None], drafts], axis=1)  # [B, k+1]
+        voff = jnp.arange(k + 1, dtype=jnp.int32)
+        vmask = jnp.concatenate(
+            [gen_mask(e, q) for q in range(k + 1)], axis=2
+        )  # [B, 1, k+1, S]
+        vlogits, tgt_cache = model_lib.forward(
+            target_params, target_cfg, vtoks,
+            positions=prompt_lens[:, None] + e[:, None] - 1 + voff[None, :],
+            cache=tgt_cache, cache_index=t + e - 1, attn_mask=vmask,
+            **tgt_win,
+        )
+        greedy = jnp.argmax(vlogits, axis=-1).astype(jnp.int32)  # [B, k+1]
+        # g_{j+1} = greedy[:, j] is the target's token AFTER consuming
+        # position j of the verify block.
+
+        # Longest agreeing prefix: a = #leading j with d_j == g_j.
+        agree = drafts == greedy[:, :k]                      # [B, k]
+        lead = jnp.cumprod(agree.astype(jnp.int32), axis=1)  # [B, k]
+        a = jnp.sum(lead, axis=1)                            # [B] in 0..k
+        # Committed candidates: accepted drafts then the bonus/correction.
+        j_ar = jnp.arange(k + 1, dtype=jnp.int32)
+        cand = jnp.where(j_ar[None, :] < a[:, None],
+                         jnp.concatenate([drafts, drafts[:, -1:]], axis=1),
+                         greedy)                             # [B, k+1]
+
+        m = a + 1                                            # tokens to commit
+        if eos_id >= 0:
+            # Truncate at the first committed EOS (inclusive).
+            is_eos = jnp.logical_and(cand == eos_id, j_ar[None, :] < m[:, None])
+            eos_pos = jnp.argmax(is_eos, axis=1)             # first True, else 0
+            has_eos = jnp.any(is_eos, axis=1)
+            m = jnp.where(has_eos, jnp.minimum(m, eos_pos + 1), m)
+        else:
+            has_eos = jnp.zeros((b,), bool)
+        m = jnp.minimum(m, max_new_tokens - e)               # budget clamp
+        m = jnp.where(done, 0, m)
+
+        # Scatter the committed tokens into the (padded-wide) out buffer.
+        valid = j_ar[None, :] < m[:, None]                   # [B, k+1]
+        idx = jnp.where(valid, e[:, None] + j_ar[None, :],
+                        out.shape[1] - 1)                    # scratch col
+        vals = jnp.where(valid, cand, pad_id)
+        out = out.at[rows[:, None], idx].set(vals)
+        # (Duplicate scratch-column writes: XLA picks a winner; all pad_id.)
+        out = out.at[:, out.shape[1] - 1].set(pad_id)
+
+        y = jnp.where(
+            m > 0, jnp.take_along_axis(cand, jnp.maximum(m - 1, 0)[:, None],
+                                       axis=1)[:, 0], y,
+        )
+        e = e + m
+        done = jnp.logical_or(done, jnp.logical_and(has_eos, m > 0))
+
+        # --- draft backfill: after a FULLY accepted round (m == k+1) the
+        # draft proposed d_k but never consumed it, leaving a zero-KV hole
+        # at slot t+e-2 that the next round's masks would expose (and
+        # silently wreck acceptance from then on).  One discarded-logits
+        # draft step writes it.  Rounds with 2 <= m <= k rewrite an
+        # already-correct slot with the same token (harmless); m < 2
+        # redirects to the frontier slot, which the next round's first
+        # draft feed overwrites before any query reads it.
+        bf_idx = jnp.where(m >= 2, t + e - 2, t + e - 1)
+        bf_tok = jnp.take_along_axis(
+            cand, jnp.maximum(m - 2, 0)[:, None], axis=1)[:, 0]
+        bf_gen = jnp.logical_and(slots[None, :] >= t,
+                                 slots[None, :] <= bf_idx[:, None])
+        bf_mask = jnp.logical_or(prompt_valid, bf_gen)[:, None, None, :]
+        _, drf_cache = model_lib.forward(
+            draft_params, draft_cfg, bf_tok[:, None],
+            positions=(prompt_lens + bf_idx - t)[:, None],
+            cache=drf_cache, cache_index=bf_idx, attn_mask=bf_mask,
+            **drf_win,
+        )
+        stats = stats + jnp.array([1, 0, 0], jnp.int32)
+        stats = stats.at[1].add(jnp.sum(jnp.where(m > 0, k, 0)))
+        # Committed drafts this round: all m tokens when a clamp (EOS/budget)
+        # cut the round short of its bonus token, else the a accepted drafts.
+        stats = stats.at[2].add(jnp.sum(jnp.minimum(a, m)))
+        return tgt_cache, drf_cache, out, e, y, done, stats
+
+    carry = (tgt_cache, drf_cache, out0, e0, tok0, done0, stats0)
+    *_, out, _, _, _, stats = jax.lax.while_loop(cond, body, carry)
+    toks = out[:, :max_new_tokens]
+    if return_stats:
+        return toks, {"rounds": stats[0], "drafted": stats[1],
+                      "accepted": stats[2]}
+    return toks

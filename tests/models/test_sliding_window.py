@@ -170,6 +170,65 @@ def test_batcher_serves_windowed_model_exactly():
         assert res[rid] == np.asarray(out)[0].tolist()
 
 
+def test_ragged_batch_windowed_decode_matches_solo():
+    """REGRESSION (r4 review): the right-padded generate layout puts
+    generated slot T+j at position len+j; the window mask must compare
+    POSITIONS, not slots, or short rows in a ragged batch attend (T - len)
+    positions past the window.  Each padded row must match its own solo
+    (pad-free) run exactly."""
+    from distributed_llms_tpu.runtime import generate as gen_lib
+
+    cfg = presets.get_preset("llama-tiny", vocab_size=512, sliding_window=3)
+    params = model.init_params(jax.random.key(0), cfg)
+    prompts = [[7, 1, 9], [4, 4, 4, 4, 4, 4, 4, 4]]
+    t = max(len(p) for p in prompts)
+    padded = jnp.asarray([p + [0] * (t - len(p)) for p in prompts], jnp.int32)
+    lens = jnp.asarray([len(p) for p in prompts], jnp.int32)
+    batch = np.asarray(gen_lib.generate_tokens(
+        params, cfg, padded, lens, jax.random.key(1), max_new_tokens=12,
+    ))
+    for i, p in enumerate(prompts):
+        solo = np.asarray(gen_lib.generate_tokens(
+            params, cfg, jnp.asarray([p], jnp.int32),
+            jnp.asarray([len(p)], jnp.int32), jax.random.key(1),
+            max_new_tokens=12,
+        ))
+        np.testing.assert_array_equal(batch[i], solo[0])
+
+
+def test_ragged_windowed_speculative_matches_generate():
+    """Same regression through the speculative loop (shares the layout)."""
+    from distributed_llms_tpu.runtime import generate as gen_lib
+    from distributed_llms_tpu.runtime.speculative import (
+        speculative_generate_tokens,
+    )
+
+    cfg = presets.get_preset("llama-tiny", vocab_size=512, sliding_window=3)
+    params = model.init_params(jax.random.key(0), cfg)
+    dcfg = presets.get_preset("llama-tiny", vocab_size=512, num_layers=2)
+    dparams = model.init_params(jax.random.key(5), dcfg)
+    prompt = jnp.asarray([[7, 1, 9, 0, 0, 0, 0, 0], [4] * 8], jnp.int32)
+    lens = jnp.asarray([3, 8], jnp.int32)
+    want = np.asarray(gen_lib.generate_tokens(
+        params, cfg, prompt, lens, jax.random.key(1), max_new_tokens=12,
+    ))
+    got = speculative_generate_tokens(
+        params, cfg, dparams, dcfg, prompt, lens, k=3, max_new_tokens=12,
+    )
+    np.testing.assert_array_equal(np.asarray(got), want)
+
+
+def test_mesh_refuses_windowed_model():
+    """Mesh decode doesn't thread key_positions yet — loud guard, not
+    silently-widened windows (parallel/api.py)."""
+    from distributed_llms_tpu.core.config import MeshConfig
+    from distributed_llms_tpu.parallel.api import make_parallel_model
+
+    cfg = presets.get_preset("llama-tiny", sliding_window=4)
+    with pytest.raises(ValueError, match="single-device"):
+        make_parallel_model(cfg, MeshConfig(data=2), devices=jax.devices()[:2])
+
+
 def test_paged_batcher_refuses_windowed_model():
     from distributed_llms_tpu.runtime.batcher import ContinuousBatcher
 

@@ -1,0 +1,203 @@
+"""Speculative decoding (runtime/speculative.py).
+
+Core invariant: greedy speculative decode emits EXACTLY the tokens of
+``generate.generate_tokens(..., temperature=0.0)`` on the target model alone
+— for ANY draft model and any k.  The draft only changes speed (acceptance),
+never results.  A deliberately different-seed draft exercises the rejection
+path hard; draft == target exercises full acceptance (a == k every round).
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_llms_tpu.models import model as model_lib, presets
+from distributed_llms_tpu.runtime import generate as gen_lib
+from distributed_llms_tpu.runtime.speculative import speculative_generate_tokens
+
+
+@pytest.fixture(scope="module")
+def pair():
+    tcfg = presets.get_preset("llama-tiny", vocab_size=512)
+    tparams = model_lib.init_params(jax.random.key(0), tcfg)
+    dcfg = presets.get_preset("llama-tiny", vocab_size=512, num_layers=2)
+    dparams = model_lib.init_params(jax.random.key(99), dcfg)  # unrelated
+    return tcfg, tparams, dcfg, dparams
+
+
+def ref_greedy(tcfg, tparams, prompt, lens, n, eos_id=-1):
+    out = gen_lib.generate_tokens(
+        tparams, tcfg, prompt, lens, jax.random.key(7), max_new_tokens=n,
+        temperature=0.0, eos_id=eos_id, pad_id=0,
+    )
+    return np.asarray(out)
+
+
+@pytest.mark.parametrize("k", [1, 3, 7])
+def test_exact_match_any_draft(pair, k):
+    tcfg, tparams, dcfg, dparams = pair
+    prompt = jnp.asarray([[7, 1, 9, 4, 0, 0], [11, 12, 13, 14, 15, 16]],
+                         jnp.int32)
+    lens = jnp.asarray([4, 6], jnp.int32)
+    want = ref_greedy(tcfg, tparams, prompt, lens, 13)
+    got = speculative_generate_tokens(
+        tparams, tcfg, dparams, dcfg, prompt, lens, k=k, max_new_tokens=13,
+    )
+    np.testing.assert_array_equal(np.asarray(got), want)
+
+
+def test_self_draft_full_acceptance(pair):
+    """Draft == target: every draft agrees, so rounds ≈ ceil(n / (k+1)) and
+    acceptance is 100%."""
+    tcfg, tparams, _, _ = pair
+    prompt = jnp.asarray([[3, 5, 8]], jnp.int32)
+    lens = jnp.asarray([3], jnp.int32)
+    n, k = 12, 3
+    want = ref_greedy(tcfg, tparams, prompt, lens, n)
+    got, stats = speculative_generate_tokens(
+        tparams, tcfg, tparams, tcfg, prompt, lens, k=k, max_new_tokens=n,
+        return_stats=True,
+    )
+    np.testing.assert_array_equal(np.asarray(got), want)
+    rounds = int(stats["rounds"])
+    # tok0 comes from prefill; each round then commits k+1 tokens.
+    assert rounds == -(-(n - 1) // (k + 1)), rounds
+    # Self-draft never disagrees: every drafted token is accepted (budget
+    # clamps keep min(a, m) == m == remaining, still counted as accepted).
+    assert int(stats["accepted"]) == int(stats["drafted"]) == rounds * k
+
+
+def test_eos_freeze_matches_reference(pair):
+    """Pick an EOS id that actually occurs in the reference output; rows must
+    emit it then pad, exactly like generate_tokens."""
+    tcfg, tparams, dcfg, dparams = pair
+    prompt = jnp.asarray([[7, 1, 9, 4], [2, 2, 2, 2]], jnp.int32)
+    lens = jnp.asarray([4, 4], jnp.int32)
+    free = ref_greedy(tcfg, tparams, prompt, lens, 12)
+    eos_id = int(free[0, 4])  # forces an early stop mid-round for row 0
+    want = ref_greedy(tcfg, tparams, prompt, lens, 12, eos_id=eos_id)
+    got = speculative_generate_tokens(
+        tparams, tcfg, dparams, dcfg, prompt, lens, k=4, max_new_tokens=12,
+        eos_id=eos_id,
+    )
+    np.testing.assert_array_equal(np.asarray(got), want)
+
+
+def test_eos_on_first_token(pair):
+    tcfg, tparams, dcfg, dparams = pair
+    prompt = jnp.asarray([[7, 1, 9, 4]], jnp.int32)
+    lens = jnp.asarray([4], jnp.int32)
+    free = ref_greedy(tcfg, tparams, prompt, lens, 6)
+    eos_id = int(free[0, 0])
+    got = speculative_generate_tokens(
+        tparams, tcfg, dparams, dcfg, prompt, lens, k=3, max_new_tokens=6,
+        eos_id=eos_id,
+    )
+    assert np.asarray(got)[0].tolist() == [eos_id, 0, 0, 0, 0, 0]
+
+
+def test_budget_not_exceeded_and_stats(pair):
+    tcfg, tparams, dcfg, dparams = pair
+    prompt = jnp.asarray([[5, 6, 7]], jnp.int32)
+    lens = jnp.asarray([3], jnp.int32)
+    got, stats = speculative_generate_tokens(
+        tparams, tcfg, dparams, dcfg, prompt, lens, k=5, max_new_tokens=4,
+        return_stats=True,
+    )
+    assert np.asarray(got).shape == (1, 4)
+    want = ref_greedy(tcfg, tparams, prompt, lens, 4)
+    np.testing.assert_array_equal(np.asarray(got), want)
+    assert int(stats["rounds"]) >= 1
+    assert 0 <= int(stats["accepted"]) <= int(stats["drafted"])
+
+
+def test_windowed_target_exact(pair):
+    """Sliding-window target (Mistral-style): the per-row masks AND the
+    window in, so speculative equals plain windowed greedy."""
+    _, _, dcfg, dparams = pair
+    tcfg = presets.get_preset("llama-tiny", vocab_size=512, sliding_window=4)
+    tparams = model_lib.init_params(jax.random.key(0), tcfg)
+    prompt = jnp.asarray([[7, 1, 9, 4, 8, 2]], jnp.int32)
+    lens = jnp.asarray([6], jnp.int32)
+    want = ref_greedy(tcfg, tparams, prompt, lens, 10)
+    got = speculative_generate_tokens(
+        tparams, tcfg, dparams, dcfg, prompt, lens, k=3, max_new_tokens=10,
+    )
+    np.testing.assert_array_equal(np.asarray(got), want)
+
+
+def test_quantized_draft_of_target(pair):
+    """The self-speculation recipe: draft = int4-quantized target.  Exact
+    output regardless of how well the quantized draft tracks the target."""
+    from distributed_llms_tpu.checkpoint import quantize as quant_lib
+
+    tcfg, tparams, _, _ = pair
+    qparams = {**tparams,
+               "blocks": quant_lib.quantize_tree(tparams["blocks"], bits=4)}
+    prompt = jnp.asarray([[9, 8, 7, 6]], jnp.int32)
+    lens = jnp.asarray([4], jnp.int32)
+    want = ref_greedy(tcfg, tparams, prompt, lens, 10)
+    got, stats = speculative_generate_tokens(
+        tparams, tcfg, qparams, tcfg, prompt, lens, k=4, max_new_tokens=10,
+        return_stats=True,
+    )
+    np.testing.assert_array_equal(np.asarray(got), want)
+
+
+def test_engine_speculative_matches_generate_text():
+    """Product path: attach a quantized self-draft, texts must equal plain
+    generate_text exactly."""
+    from distributed_llms_tpu.core.config import RuntimeConfig
+    from distributed_llms_tpu.runtime.engine import InferenceEngine
+
+    eng = InferenceEngine.from_preset(
+        "llama-tiny", RuntimeConfig(max_decode_steps=10, max_seq_len=128),
+        vocab_size=300,
+    )
+    prompts = ["hello world", "abc"]
+    want = eng.generate_text(prompts, max_new_tokens=10)
+    eng.attach_draft(quantize_bits=4)
+    got = eng.generate_text_speculative(prompts, max_new_tokens=10, k=3)
+    assert got.text == want.text
+    np.testing.assert_array_equal(got.tokens, want.tokens)
+
+
+def test_engine_speculative_guards():
+    from distributed_llms_tpu.core.config import RuntimeConfig
+    from distributed_llms_tpu.runtime.engine import InferenceEngine
+
+    eng = InferenceEngine.from_preset(
+        "llama-tiny", RuntimeConfig(max_decode_steps=8, max_seq_len=128),
+        vocab_size=300,
+    )
+    with pytest.raises(ValueError, match="no draft"):
+        eng.generate_text_speculative(["x"])
+    with pytest.raises(ValueError, match="OR quantize_bits"):
+        eng.attach_draft(eng.cfg, eng.params, quantize_bits=4)
+    eng2 = InferenceEngine.from_preset(
+        "llama-tiny",
+        RuntimeConfig(max_decode_steps=8, max_seq_len=128, temperature=0.7),
+        vocab_size=300,
+    )
+    eng2.attach_draft(quantize_bits=8)
+    with pytest.raises(ValueError, match="greedy-only"):
+        eng2.generate_text_speculative(["x"])
+
+
+def test_rejects_bad_args(pair):
+    tcfg, tparams, dcfg, dparams = pair
+    prompt = jnp.asarray([[1, 2]], jnp.int32)
+    lens = jnp.asarray([2], jnp.int32)
+    with pytest.raises(ValueError, match="k must be"):
+        speculative_generate_tokens(tparams, tcfg, dparams, dcfg, prompt,
+                                    lens, k=0)
+    with pytest.raises(ValueError, match="vocabulary"):
+        bad = presets.get_preset("llama-tiny", vocab_size=97)
+        bparams = model_lib.init_params(jax.random.key(1), bad)
+        speculative_generate_tokens(tparams, tcfg, bparams, bad, prompt, lens)
+    with pytest.raises(ValueError, match="ragged_decode"):
+        rcfg = dataclasses.replace(tcfg, ragged_decode=True)
+        speculative_generate_tokens(tparams, rcfg, dparams, dcfg, prompt, lens)

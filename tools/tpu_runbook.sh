@@ -78,31 +78,39 @@ ragged-decode-8k quant-matmul-bw prefill-flash-2048 prefill-flash-8192 \
 hop-latency"
 
 run_row() {  # run_row <name> <timeout-secs>; rc 0 = row recorded, 3 = abort
-  local r="$1" tmo="$2" attempt p
+  local r="$1" tmo="$2" attempt p rc
   for attempt in 1 2 3; do
-    if [ "$(date +%s)" -ge "$DEADLINE" ]; then
-      log "row $r: RUNBOOK DEADLINE reached — aborting remaining rows"
-      return 3
-    fi
-    p="$(probe)"
-    if [ "$p" != "tpu" ]; then
+    # Wait (bounded by deadline + circuit breaker) for a live tunnel WITHOUT
+    # consuming a bench attempt — a few-minute blip must not permanently
+    # skip a north-star row while lesser rows then measure for hours.
+    while true; do
+      if [ "$(date +%s)" -ge "$DEADLINE" ]; then
+        log "row $r: RUNBOOK DEADLINE reached — aborting remaining rows"
+        return 3
+      fi
+      p="$(probe)"
+      if [ "$p" = "tpu" ]; then
+        PROBE_FAILS=0
+        break
+      fi
       PROBE_FAILS=$((PROBE_FAILS + 1))
       if [ "$PROBE_FAILS" -ge 5 ]; then
         log "row $r: tunnel dead ($PROBE_FAILS consecutive failed probes)" \
             "— circuit open, aborting remaining rows (watcher can re-fire)"
         return 3
       fi
-      log "row $r: tunnel down (platform='$p', attempt $attempt); waiting 150s"
+      log "row $r: tunnel down (platform='$p'); waiting 150s" \
+          "(probe fail $PROBE_FAILS/5)"
       sleep 150
-      continue
-    fi
-    PROBE_FAILS=0
-    if timeout "$tmo" python bench.py --ladder --rows "$r" \
-        --out BENCH_LADDER.json 2>&1 | tee -a "$OUT/ladder.log"; then
+    done
+    timeout "$tmo" python bench.py --ladder --rows "$r" \
+        --out BENCH_LADDER.json 2>&1 | tee -a "$OUT/ladder.log"
+    rc=$?  # pipefail: python/timeout's status, not tee's (nor a reset 0)
+    if [ "$rc" -eq 0 ]; then
       log "row $r: OK"
       return 0
     fi
-    log "row $r: failed/timed out (attempt $attempt, rc=$?, timeout ${tmo}s)"
+    log "row $r: failed/timed out (attempt $attempt, rc=$rc, timeout ${tmo}s)"
   done
   log "row $r: GIVING UP after 3 attempts (artifact keeps its prior state)"
   return 1
