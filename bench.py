@@ -535,6 +535,101 @@ def _measure_serving_latency(
     return out
 
 
+def _measure_speculative(
+    preset: str, dtype: str, target_quant: str | None = None,
+    k: int = 4, batch: int = 4, prompt_len: int = 64, new_tokens: int = 32,
+    iters: int = 3,
+) -> dict:
+    """Speculative vs plain greedy decode (runtime/speculative.py): target =
+    ``preset`` (optionally weight-only quantized), draft = the same weights
+    at int4 — the self-speculation recipe, whose draft steps read a fraction
+    of the target's weight bytes.  Reports both throughputs, the speedup,
+    and the measured acceptance rate.  Exactness is asserted on-device
+    (speculative tokens must equal plain greedy bit-for-bit) so this row is
+    also a hardware parity check of the whole loop.
+
+    With random weights the acceptance rate measures how often int4
+    quantization preserves the argmax of an essentially flat logit
+    landscape — a PESSIMISTIC bound; real checkpoints' peaked logits accept
+    far more.  The row records it honestly either way."""
+    import numpy as np
+
+    from distributed_llms_tpu.runtime import generate as gen_lib
+    from distributed_llms_tpu.runtime.speculative import (
+        speculative_generate_tokens,
+    )
+
+    cfg, tparams = _build_params(preset, dtype, target_quant)
+    _, dparams = _build_params(preset, dtype, "int4")
+    prompt = jax.random.randint(
+        jax.random.key(1), (batch, prompt_len), 0, cfg.vocab_size,
+        dtype=jnp.int32,
+    )
+    lens = jnp.full((batch,), prompt_len, dtype=jnp.int32)
+    rng = jax.random.key(2)
+
+    def timed(fn) -> float:
+        np.asarray(fn())  # warm compile + force transfer (tunnel overhead)
+        times = []
+        for _ in range(iters):
+            t0 = time.perf_counter()
+            np.asarray(fn())
+            times.append(time.perf_counter() - t0)
+        return min(times)
+
+    def plain(n):
+        return gen_lib.generate_tokens(
+            tparams, cfg, prompt, lens, rng, max_new_tokens=n)
+
+    def spec(n):
+        # Stats ride the while_loop carry either way, so timing the
+        # return_stats variant costs nothing — and reusing it for the
+        # exactness/acceptance reads below avoids compiling a second
+        # (stats-free) n2 program inside the TPU availability window.
+        toks, _ = speculative_generate_tokens(
+            tparams, cfg, dparams, cfg, prompt, lens, k=k, max_new_tokens=n,
+            return_stats=True,
+        )
+        return toks
+
+    n1, n2 = new_tokens, 2 * new_tokens
+    # On-device exactness: the whole speculative loop (draft scan, per-row
+    # verify write, rollback masks, backfill) against the plain scan loop.
+    spec_toks, stats = speculative_generate_tokens(
+        tparams, cfg, dparams, cfg, prompt, lens, k=k, max_new_tokens=n2,
+        return_stats=True,
+    )
+    exact = bool(np.array_equal(np.asarray(spec_toks), np.asarray(plain(n2))))
+    drafted = max(int(stats["drafted"]), 1)
+    acceptance = int(stats["accepted"]) / drafted
+
+    tp1, tp2 = timed(lambda: plain(n1)), timed(lambda: plain(n2))
+    ts1, ts2 = timed(lambda: spec(n1)), timed(lambda: spec(n2))
+    out = {
+        "preset": preset,
+        **({"quant": target_quant} if target_quant else {}),
+        "draft": "self-int4",
+        "k": k,
+        "batch": batch,
+        "platform": jax.devices()[0].platform,
+        "exact_vs_greedy": exact,
+        "acceptance": round(acceptance, 4),
+    }
+    if tp2 > tp1 and ts2 > ts1:
+        plain_tps = batch * (n2 - n1) / (tp2 - tp1)
+        spec_tps = batch * (n2 - n1) / (ts2 - ts1)
+        out["tok_per_s_plain"] = round(plain_tps, 2)
+        out["tok_per_s_spec"] = round(spec_tps, 2)
+        out["speedup"] = round(spec_tps / plain_tps, 3)
+    else:
+        out["note"] = ("overhead-dominated: two-point deltas collapsed; "
+                       "throughputs unreliable at these shapes")
+    if not exact:
+        out["note"] = (out.get("note", "") +
+                       " EXACTNESS FAILED: speculative != greedy").strip()
+    return out
+
+
 def _measure_ragged_decode(
     preset: str = "tinyllama-1.1b", dtype: str = "bfloat16",
     max_len: int = 8192, slots: int = 8, iters: int = 5,
@@ -999,7 +1094,8 @@ def run_ladder(args, degraded: str | None) -> list[dict]:
         known = {str(e["config"]) for e in LADDER} | {
             "serving-latency", "continuous-batching", "paged-batching",
             "ragged-decode-8k", "quant-matmul-bw", "prefill-flash-2048",
-            "prefill-flash-8192", "hop-latency",
+            "prefill-flash-8192", "hop-latency", "spec-decode",
+            "spec-decode-7b-int8",
         }
         unknown = only - known
         if unknown:  # a typo must not masquerade as a clean zero-row run
@@ -1117,6 +1213,19 @@ def run_ladder(args, degraded: str | None) -> list[dict]:
             ("ragged-decode-8k", lambda: _measure_ragged_decode(dtype=dtype)),
             ("quant-matmul-bw", lambda: _measure_quant_matmul_bw(
                 iters=max(args.iters, 5))),
+            # Speculative decoding (runtime/speculative.py): small-model
+            # sanity row + the north-star shape (7B int8 target, int4
+            # self-draft).  Both assert on-device exactness vs plain greedy.
+            # Targets are quantized so target and draft share the same
+            # on-device-generated base weights (_gen_quantized_on_device
+            # keys leaves identically across bit-widths; the bf16 path
+            # draws DIFFERENT values, which would make the "self"-draft an
+            # unrelated model and the acceptance rate meaningless).
+            ("spec-decode", lambda: _measure_speculative(
+                "tinyllama-1.1b", dtype, target_quant="int8",
+                iters=args.iters)),
+            ("spec-decode-7b-int8", lambda: _measure_speculative(
+                "llama-2-7b", dtype, target_quant="int8", iters=args.iters)),
         ]
         aux += [
             (f"prefill-flash-{seq}", functools.partial(

@@ -250,15 +250,34 @@ class ParallelModel:
     # -- adapters for runtime.generate (hashable bound methods; frozen
     # dataclass => stable hash => jit cache hits across calls) --------------
 
+    def _guard_windowed_decode(self) -> None:
+        """Mesh decode of sliding-window models is unsupported: the decode
+        adapters do not thread the slot->position map the window mask needs
+        for the right-padded generate layout (models.model._attention
+        key_positions), so serving one here would silently widen the window
+        by each row's pad amount.  Training and no-cache forwards window
+        correctly (position space throughout) and stay available; serve
+        windowed models via a single-device engine or its continuous
+        batcher (contiguous layout: slot == position)."""
+        if self.cfg.sliding_window is not None:
+            raise ValueError(
+                "mesh decode of sliding_window models is unsupported (the "
+                "decode adapters do not thread key_positions); serve via a "
+                "single-device engine or its continuous batcher"
+            )
+
     def as_forward_fn(self):
+        self._guard_windowed_decode()
         return self._forward_adapter
 
     def as_make_cache(self):
+        self._guard_windowed_decode()
         return self._make_cache_adapter
 
     def as_decode_fn(self):
         """Fused wavefront decode loop (pipeline.pipeline_decode) for
         runtime.generate: only meaningful when pipelined."""
+        self._guard_windowed_decode()
         return self._decode_adapter if self.pipelined else None
 
     def _decode_adapter(
@@ -505,19 +524,9 @@ def make_parallel_model(
             "ring attention and the pipeline schedule are alternative "
             "shardings of the layer loop — use one, with 'data'/'model' axes"
         )
-    if cfg.sliding_window is not None:
-        # The mesh decode paths (wavefront pipeline, GSPMD generate) do not
-        # yet thread the slot->position map the window mask needs for the
-        # right-padded generate layout (models.model._attention
-        # key_positions); serving a windowed model there would silently
-        # widen the window by each row's pad amount.  Single-device engines
-        # and the continuous batcher (contiguous layout) serve Mistral-style
-        # models correctly today.
-        raise ValueError(
-            "sliding_window models are single-device for now (mesh decode "
-            "does not thread key_positions); serve via a single-device "
-            "engine or its continuous batcher"
-        )
+# NOTE: sliding_window models mesh-TRAIN fine (the cache=None forward
+# windows in position space directly); only the mesh DECODE adapters are
+# guarded — see ParallelModel._guard_windowed_decode.
     return ParallelModel(
         cfg=cfg, mesh=mesh, num_microbatches=num_microbatches, kv_dtype=kv_dtype
     )

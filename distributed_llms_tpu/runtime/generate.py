@@ -35,6 +35,19 @@ def _default_forward(params, cfg, tokens, positions=None, cache=None, cache_inde
     )
 
 
+def window_key_positions(t: int, prompt_lens: jax.Array, max_len: int) -> jax.Array:
+    """[B, S] true RoPE position of every cache slot under THE right-padded
+    generate layout (prompt slots 0..t-1, generated token j at slot t+j,
+    position len+j) — the single definition of the slot->position map the
+    sliding-window mask needs (models.model._attention key_positions).
+    Shared by generate_tokens and runtime.speculative."""
+    slots = jnp.arange(max_len, dtype=jnp.int32)
+    return jnp.where(
+        slots[None, :] < t, slots[None, :],
+        prompt_lens[:, None] + (slots[None, :] - t),
+    )
+
+
 @partial(
     jax.jit,
     static_argnames=(
@@ -111,10 +124,7 @@ def generate_tokens(
     # silently widens by the pad amount (models.model._attention).
     win_kwargs = {}
     if cfg.sliding_window is not None:
-        win_kwargs["key_positions"] = jnp.where(
-            slots[None, :] < t, slots[None, :],
-            prompt_lens[:, None] + (slots[None, :] - t),
-        )
+        win_kwargs["key_positions"] = window_key_positions(t, prompt_lens, max_len)
 
     def step(carry, inputs):
         cache, cur_logits, done = carry

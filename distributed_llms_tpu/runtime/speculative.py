@@ -112,15 +112,13 @@ def speculative_generate_tokens(
     prompt_valid = slots[None, :] < prompt_lens[:, None]  # [B, S]
     rows = jnp.arange(b, dtype=jnp.int32)
     # Sliding-window models: true slot->position map for the window mask
-    # (this right-padded layout puts generated slot t+i at position len+i;
-    # see generate.generate_tokens / models.model._attention).
+    # (shared definition: generate.window_key_positions).
+    from .generate import window_key_positions
+
     def _win_kwargs(cfg):
         if cfg.sliding_window is None:
             return {}
-        return {"key_positions": jnp.where(
-            slots[None, :] < t, slots[None, :],
-            prompt_lens[:, None] + (slots[None, :] - t),
-        )}
+        return {"key_positions": window_key_positions(t, prompt_lens, max_len)}
 
     tgt_win = _win_kwargs(target_cfg)
     drf_win = _win_kwargs(draft_cfg)
@@ -199,7 +197,8 @@ def speculative_generate_tokens(
             m = jnp.where(has_eos, jnp.minimum(m, eos_pos + 1), m)
         else:
             has_eos = jnp.zeros((b,), bool)
-        m = jnp.minimum(m, max_new_tokens - e)               # budget clamp
+        budget = max_new_tokens - e                         # pre-commit
+        m = jnp.minimum(m, budget)                          # budget clamp
         m = jnp.where(done, 0, m)
 
         # Scatter the committed tokens into the (padded-wide) out buffer.
@@ -239,7 +238,16 @@ def speculative_generate_tokens(
             **drf_win,
         )
         stats = stats + jnp.array([1, 0, 0], jnp.int32)
-        stats = stats.at[1].add(jnp.sum(jnp.where(m > 0, k, 0)))
+        # Drafted counts only drafts that HAD a chance to commit: the budget
+        # caps a round at `budget` tokens, so at most min(k, budget) drafts
+        # were in play — counting the full k would deflate the acceptance
+        # rate of a perfect draft whenever (n-1) % (k+1) lands mid-round.
+        # (EOS truncation still counts the post-EOS drafts: that loss is
+        # data, not bookkeeping.)  Self-draft, no EOS => accepted == drafted
+        # exactly, for ANY n and k — the verify invariant.
+        stats = stats.at[1].add(
+            jnp.sum(jnp.where(m > 0, jnp.minimum(k, budget), 0))
+        )
         # Committed drafts this round: all m tokens when a clamp (EOS/budget)
         # cut the round short of its bonus token, else the a accepted drafts.
         stats = stats.at[2].add(jnp.sum(jnp.minimum(a, m)))

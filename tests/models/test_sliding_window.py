@@ -218,15 +218,28 @@ def test_ragged_windowed_speculative_matches_generate():
     np.testing.assert_array_equal(np.asarray(got), want)
 
 
-def test_mesh_refuses_windowed_model():
-    """Mesh decode doesn't thread key_positions yet — loud guard, not
-    silently-widened windows (parallel/api.py)."""
+def test_mesh_windowed_trains_but_refuses_decode():
+    """Mesh TRAINING of windowed models is fine (the cache=None forward
+    windows in position space); only the decode adapters — which don't
+    thread key_positions — must refuse (parallel/api.py)."""
     from distributed_llms_tpu.core.config import MeshConfig
     from distributed_llms_tpu.parallel.api import make_parallel_model
+    from distributed_llms_tpu.runtime import train
 
-    cfg = presets.get_preset("llama-tiny", sliding_window=4)
-    with pytest.raises(ValueError, match="single-device"):
-        make_parallel_model(cfg, MeshConfig(data=2), devices=jax.devices()[:2])
+    cfg = presets.get_preset(
+        "llama-tiny", sliding_window=4, num_layers=1, dtype="float32"
+    )
+    pm = make_parallel_model(cfg, MeshConfig(data=2), devices=jax.devices()[:2])
+    params = pm.shard_params(model.init_params(jax.random.key(0), cfg))
+    trainer = train.Trainer(cfg, train.default_optimizer(1e-3), parallel=pm)
+    step = trainer.make_step()
+    toks = jax.random.randint(jax.random.key(1), (2, 9), 0, cfg.vocab_size,
+                              dtype=jnp.int32)
+    _, _, loss = step(params, trainer.init(params), toks, None)
+    assert jnp.isfinite(loss)
+    for entry in (pm.as_forward_fn, pm.as_make_cache, pm.as_decode_fn):
+        with pytest.raises(ValueError, match="mesh decode"):
+            entry()
 
 
 def test_paged_batcher_refuses_windowed_model():

@@ -49,13 +49,15 @@ def test_exact_match_any_draft(pair, k):
     np.testing.assert_array_equal(np.asarray(got), want)
 
 
-def test_self_draft_full_acceptance(pair):
-    """Draft == target: every draft agrees, so rounds ≈ ceil(n / (k+1)) and
-    acceptance is 100%."""
+@pytest.mark.parametrize("n,k", [(12, 3), (8, 4), (5, 7)])
+def test_self_draft_full_acceptance(pair, n, k):
+    """Draft == target: every in-play draft agrees, so rounds ==
+    ceil((n-1)/(k+1)) and acceptance is exactly 100% for ANY n, k —
+    `drafted` is budget-aware (min(k, remaining) per round), so a mid-round
+    budget clamp must not read as a rejection."""
     tcfg, tparams, _, _ = pair
     prompt = jnp.asarray([[3, 5, 8]], jnp.int32)
     lens = jnp.asarray([3], jnp.int32)
-    n, k = 12, 3
     want = ref_greedy(tcfg, tparams, prompt, lens, n)
     got, stats = speculative_generate_tokens(
         tparams, tcfg, tparams, tcfg, prompt, lens, k=k, max_new_tokens=n,
@@ -63,11 +65,9 @@ def test_self_draft_full_acceptance(pair):
     )
     np.testing.assert_array_equal(np.asarray(got), want)
     rounds = int(stats["rounds"])
-    # tok0 comes from prefill; each round then commits k+1 tokens.
+    # tok0 comes from prefill; each round then commits up to k+1 tokens.
     assert rounds == -(-(n - 1) // (k + 1)), rounds
-    # Self-draft never disagrees: every drafted token is accepted (budget
-    # clamps keep min(a, m) == m == remaining, still counted as accepted).
-    assert int(stats["accepted"]) == int(stats["drafted"]) == rounds * k
+    assert int(stats["accepted"]) == int(stats["drafted"]) > 0
 
 
 def test_eos_freeze_matches_reference(pair):
