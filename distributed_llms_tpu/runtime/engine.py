@@ -296,7 +296,7 @@ class InferenceEngine:
         rng = jax.random.key(seed if seed is not None else self.rt.seed)
         t0 = time.perf_counter()
         with self._timer.step(tokens=sess.n_real * n_new):
-            toks, cache, valid, real = session_lib.session_step(
+            toks, cache, valid, real, spos = session_lib.session_step(
                 self.params, self.cfg, chunk, lens,
                 sess.real_lens, sess.valid_mask, sess.cache,
                 jnp.int32(sess.base), rng,
@@ -304,10 +304,12 @@ class InferenceEngine:
                 temperature=self.rt.temperature, top_k=self.rt.top_k,
                 top_p=self.rt.top_p, eos_id=tok.eos_id, pad_id=tok.pad_id,
                 forward_fn=self._forward_fn,
+                slot_positions=sess.slot_positions,
             )
             out = _to_host(toks)[: sess.n_real]
         dt = time.perf_counter() - t0
         sess.cache, sess.valid_mask, sess.real_lens = cache, valid, real
+        sess.slot_positions = spos
         sess.base += t + n_new
         texts = [tok.decode(row) for row in out]
         gen_count = int(out.shape[0] * out.shape[1])
@@ -340,6 +342,11 @@ class InferenceEngine:
         real = jnp.zeros((b,), jnp.int32)
         sess = self.sessions.new_session(cache, valid, real, base=0, max_len=max_len)
         sess.n_real = n_real
+        if self.cfg.sliding_window is not None:
+            # Sliding-window session state: the padded multi-turn layout
+            # makes slot != position, and the window mask compares positions
+            # (session_step maintains the map turn by turn).
+            sess.slot_positions = jnp.zeros((b, max_len), jnp.int32)
         try:
             res = self._session_turn(sess, chunk, lens, n_new, seed)
         except Exception:

@@ -75,7 +75,12 @@ def session_step(
     eos_id: int = -1,
     pad_id: int = 0,
     forward_fn: Any = None,
-) -> tuple[jax.Array, Any, jax.Array, jax.Array]:
+    slot_positions: jax.Array | None = None,  # [B, S] true RoPE position of
+    #   each cache slot — REQUIRED session state for sliding-window models:
+    #   the padded multi-turn layout makes slot != position, and the map
+    #   depends on every prior turn's base/lens, so it must be carried, not
+    #   recomputed.  None for global-attention models.
+) -> tuple[jax.Array, Any, jax.Array, jax.Array, jax.Array | None]:
     """Append a chunk to the session and decode.
 
     Generalizes runtime.generate.generate_tokens: the one-shot case is
@@ -88,7 +93,8 @@ def session_step(
     per-row masks keep attention on real slots only; per-row positions
     (``real_lens + i``) keep RoPE/learned-pos correct across turns.
 
-    Returns (new_tokens [B, N], cache, valid_mask', real_lens').
+    Returns (new_tokens [B, N], cache, valid_mask', real_lens',
+    slot_positions' | None).
     """
     if forward_fn is None:
         forward_fn = _default_forward
@@ -96,12 +102,28 @@ def session_step(
     s = cache.k.shape[-3]  # [..., B, S, KVH, HD] -> S
     slots = jnp.arange(s, dtype=jnp.int32)  # [S]
 
+    windowed = cfg.sliding_window is not None
+    if windowed and slot_positions is None:
+        raise ValueError(
+            "sliding-window sessions need the slot_positions state (the "
+            "padded multi-turn layout makes slot != position; engine "
+            "sessions allocate and carry it)"
+        )
+
     # --- chunk prefill at padded slots [base, base+t)
     positions = real_lens[:, None] + jnp.arange(t, dtype=jnp.int32)[None, :]
     mask = continuation_mask(valid_mask, base, t, slots)  # [B,1,T,S]
+    chunk_kw = {}
+    if windowed:
+        rel0 = slots[None, :] - base  # [1, S]
+        slot_positions = jnp.where(
+            (rel0 >= 0) & (rel0 < t),
+            real_lens[:, None] + jnp.clip(rel0, 0, t - 1), slot_positions,
+        )
+        chunk_kw["key_positions"] = slot_positions
     logits, cache = forward_fn(
         params, cfg, chunk, positions=positions, cache=cache,
-        cache_index=base, attn_mask=mask,
+        cache_index=base, attn_mask=mask, **chunk_kw,
     )
     last_idx = jnp.maximum(chunk_lens - 1, 0)
     next_logits = jnp.take_along_axis(logits, last_idx[:, None, None], axis=1)[:, 0]
@@ -113,6 +135,16 @@ def session_step(
     real_after_chunk = real_lens + chunk_lens
 
     gen_base = base + t  # padded slot where generated tokens start
+    gen_kw = {}
+    if windowed:
+        # Generated slot gen_base + j holds position real_after_chunk + j —
+        # fill the whole gen region once (slots past the current step are
+        # masked invalid, so early values are never consulted).
+        gen_rel = slots[None, :] - gen_base
+        slot_positions = jnp.where(
+            gen_rel >= 0, real_after_chunk[:, None] + gen_rel, slot_positions
+        )
+        gen_kw["key_positions"] = slot_positions
 
     def step(carry, inputs):
         cache, cur_logits, done = carry
@@ -127,7 +159,7 @@ def session_step(
         logits, new_cache = forward_fn(
             params, cfg, tok[:, None],
             positions=positions, cache=cache, cache_index=gen_base + j,
-            attn_mask=mask,
+            attn_mask=mask, **gen_kw,
         )
         return (new_cache, logits[:, 0], done), tok
 
@@ -142,14 +174,17 @@ def session_step(
     )
     valid_final = valid_after_chunk | gen_valid_final
     real_final = real_after_chunk + max_new_tokens
-    return toks, cache, valid_final, real_final
+    return toks, cache, valid_final, real_final, (
+        slot_positions if windowed else None
+    )
 
 
 def _default_forward(params, cfg, tokens, positions=None, cache=None,
-                     cache_index=None, attn_mask=None):
+                     cache_index=None, attn_mask=None, key_positions=None):
     return model_lib.forward(
         params, cfg, tokens, positions=positions, cache=cache,
         cache_index=cache_index, attn_mask=attn_mask,
+        key_positions=key_positions,
     )
 
 
@@ -166,6 +201,9 @@ class Session:
     base: int  # next free padded slot (python int — static per call shape)
     max_len: int
     n_real: int = 0  # caller's row count (rest is mesh-divisibility padding)
+    # [B, S] true RoPE position per cache slot — sliding-window models only
+    # (session_step carries it turn to turn; None for global attention).
+    slot_positions: jax.Array | None = None
     last_used: float = field(default_factory=time.monotonic)
 
     @property

@@ -218,6 +218,31 @@ def test_ragged_windowed_speculative_matches_generate():
     np.testing.assert_array_equal(np.asarray(got), want)
 
 
+def test_windowed_ragged_session_matches_solo():
+    """Multi-turn sessions use the same padded (gapped) layout as generate —
+    the per-turn slot->position map is session STATE (slot_positions).  A
+    ragged 2-row session must match per-row solo sessions exactly (solo B=1
+    has no pad gap, so it is layout-independent ground truth)."""
+    from distributed_llms_tpu.core.config import RuntimeConfig
+    from distributed_llms_tpu.runtime.engine import InferenceEngine
+
+    rt = RuntimeConfig(max_decode_steps=6, max_seq_len=128)
+    eng = InferenceEngine.from_preset(
+        "llama-tiny", rt, vocab_size=512, sliding_window=5
+    )
+    turn1 = ["hello world", "hi"]
+    turn2 = ["more text", "y"]
+    sid, r1 = eng.start_session(turn1, max_new_tokens=6)
+    r2 = eng.continue_session(sid, turn2, max_new_tokens=6)
+    solo = InferenceEngine(eng.cfg, eng.rt, eng.params)
+    for i in range(2):
+        ssid, s1 = solo.start_session([turn1[i]], max_new_tokens=6)
+        s2 = solo.continue_session(ssid, [turn2[i]], max_new_tokens=6)
+        np.testing.assert_array_equal(r1.tokens[i], s1.tokens[0])
+        np.testing.assert_array_equal(r2.tokens[i], s2.tokens[0])
+        solo.end_session(ssid)
+
+
 def test_mesh_windowed_trains_but_refuses_decode():
     """Mesh TRAINING of windowed models is fine (the cache=None forward
     windows in position space); only the decode adapters — which don't
