@@ -165,11 +165,33 @@ def _stack_experts(
     return np.stack(per_layer)
 
 
+def _split_phi3_fused(sd: StateDict, cfg: ModelConfig) -> StateDict:
+    """Phi-3 fuses attention into one ``qkv_proj`` (rows: q | k | v) and the
+    gated MLP into one ``gate_up_proj`` (rows: gate | up), both [out, in].
+    Split them into the separate llama projection names so convert_llama's
+    single mapping serves the family."""
+    H, KVH, HD = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim_
+    out = dict(sd)
+    for i in range(cfg.num_layers):
+        qkv = np.asarray(out.pop(f"layers.{i}.self_attn.qkv_proj.weight"))
+        q, k, v = np.split(qkv, [H * HD, H * HD + KVH * HD], axis=0)
+        out[f"layers.{i}.self_attn.q_proj.weight"] = q
+        out[f"layers.{i}.self_attn.k_proj.weight"] = k
+        out[f"layers.{i}.self_attn.v_proj.weight"] = v
+        gu = np.asarray(out.pop(f"layers.{i}.mlp.gate_up_proj.weight"))
+        gate, up = np.split(gu, 2, axis=0)
+        out[f"layers.{i}.mlp.gate_proj.weight"] = gate
+        out[f"layers.{i}.mlp.up_proj.weight"] = up
+    return out
+
+
 def convert_llama(sd: StateDict, cfg: ModelConfig) -> dict[str, Any]:
     """Llama/TinyLlama/Llama-3 use nn.Linear: stored [out, in] -> transpose.
     With cfg.num_experts > 0 the MLP mapping follows Mixtral's
     ``block_sparse_moe`` layout (gate router + per-expert w1/w2/w3)."""
     sd = _strip_prefix(sd, ("model.",))
+    if "layers.0.self_attn.qkv_proj.weight" in sd:  # Phi-3 fused layout
+        sd = _split_phi3_fused(sd, cfg)
     D, H, KVH, HD = cfg.hidden_size, cfg.num_heads, cfg.num_kv_heads, cfg.head_dim_
     L = cfg.num_layers
     # Gemma's RMSNorm computes with (1 + weight); fold the +1 into the
@@ -455,6 +477,41 @@ def config_from_hf(hf_config: Mapping[str, Any]) -> ModelConfig:
                 "num_key_value_heads", hf_config["num_attention_heads"]
             ),
             head_dim=hf_config.get("head_dim"),
+            max_seq_len=max_len,
+            rope_theta=hf_config.get("rope_theta", 10000.0),
+            norm_eps=hf_config.get("rms_norm_eps", 1e-5),
+            tie_embeddings=hf_config.get("tie_word_embeddings", False),
+        )
+    if model_type == "phi3" or "phi3for" in arch:
+        # Phi-3 = llama layout with fused qkv/gate_up projections (split at
+        # convert) + sliding-window attention.  The 128k "longrope" variants
+        # carry rope_scaling — a different position scheme; reject rather
+        # than silently serve wrong positions.
+        if hf_config.get("rope_scaling"):
+            raise ValueError(
+                "phi3 rope_scaling (longrope 128k variants) is not supported"
+            )
+        pr = hf_config.get("partial_rotary_factor", 1.0) or 1.0
+        if pr != 1.0:
+            raise ValueError(
+                f"phi3 partial_rotary_factor {pr} is not supported (full "
+                "rotary only)"
+            )
+        window = hf_config.get("sliding_window")
+        max_len = hf_config.get("max_position_embeddings", 4096)
+        if window is not None and window >= max_len:
+            window = None
+        return ModelConfig(
+            family="llama",
+            sliding_window=window,
+            vocab_size=hf_config["vocab_size"],
+            hidden_size=hf_config["hidden_size"],
+            intermediate_size=hf_config["intermediate_size"],
+            num_layers=hf_config["num_hidden_layers"],
+            num_heads=hf_config["num_attention_heads"],
+            num_kv_heads=hf_config.get(
+                "num_key_value_heads", hf_config["num_attention_heads"]
+            ),
             max_seq_len=max_len,
             rope_theta=hf_config.get("rope_theta", 10000.0),
             norm_eps=hf_config.get("rms_norm_eps", 1e-5),

@@ -85,12 +85,15 @@ def _attention(
     key_positions: jax.Array | None = None,  # [B, S] true RoPE position of
     #                            each cache slot — ONLY consulted by the
     #                            sliding-window mask.  Contiguous layouts
-    #                            (slot == position: batcher, sessions) leave
-    #                            it None; the right-padded generate layout
-    #                            (prompt slots 0..T-1, generated token j at
-    #                            slot T+j but position len+j) MUST pass it
+    #                            (slot == position: the continuous batcher)
+    #                            leave it None; gapped layouts MUST pass it
     #                            or the window silently widens by the pad
-    #                            amount on generated keys.
+    #                            amount on generated keys.  Gapped = the
+    #                            right-padded generate/speculative layout
+    #                            (prompt slots 0..T-1, generated token j at
+    #                            slot T+j but position len+j) AND multi-turn
+    #                            sessions (session_step carries the map as
+    #                            Session.slot_positions state).
 ) -> tuple[jax.Array, tuple[jax.Array, jax.Array] | None]:
     q, k, v = layers.qkv_project(x, p, cfg)
     if use_rope:

@@ -63,6 +63,12 @@ PRESETS: dict[str, ModelConfig] = {
         head_dim=256, max_seq_len=8192, rope_theta=10000.0, norm_eps=1e-6,
         tie_embeddings=True,
     ),
+    "phi-3-mini-4k": ModelConfig(
+        family="llama", sliding_window=2047, vocab_size=32064,
+        hidden_size=3072, intermediate_size=8192, num_layers=32,
+        num_heads=32, num_kv_heads=32, max_seq_len=4096,
+        rope_theta=10000.0, norm_eps=1e-5, tie_embeddings=False,
+    ),
     "mistral-7b": ModelConfig(
         family="llama", sliding_window=4096, vocab_size=32000, hidden_size=4096,
         intermediate_size=14336, num_layers=32, num_heads=32, num_kv_heads=8,
@@ -112,6 +118,7 @@ HF_REPOS: dict[str, str] = {
     "qwen2-7b": "Qwen/Qwen2-7B",
     "gemma-7b": "google/gemma-7b",
     "mistral-7b": "mistralai/Mistral-7B-v0.1",
+    "phi-3-mini-4k": "microsoft/Phi-3-mini-4k-instruct",
 }
 
 

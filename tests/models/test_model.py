@@ -153,11 +153,35 @@ def _hf_gemma_pair():
     return hf_model, cfg, params
 
 
+def _hf_phi3_pair():
+    import torch
+    from transformers import Phi3Config, Phi3ForCausalLM
+
+    hf_cfg = Phi3Config(
+        vocab_size=97, hidden_size=32, intermediate_size=88,
+        num_hidden_layers=3, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=64, rms_norm_eps=1e-5,
+        tie_word_embeddings=False, attention_dropout=0.0, resid_pdrop=0.0,
+        embd_pdrop=0.0, sliding_window=3, attn_implementation="eager",
+        pad_token_id=0, bos_token_id=1, eos_token_id=2,
+    )
+    torch.manual_seed(0)
+    hf_model = Phi3ForCausalLM(hf_cfg).eval()
+    cfg = convert.config_from_hf(hf_cfg.to_dict())
+    # Phi-3's deltas from llama: fused projections (split at convert) and
+    # the sliding window.
+    assert cfg.sliding_window == 3 and not cfg.qkv_bias
+    cfg = cfg.__class__(**{**cfg.__dict__, "dtype": "float32"})
+    sd = convert.torch_state_dict_to_numpy(hf_model.state_dict())
+    params = convert.convert_state_dict(sd, cfg)
+    return hf_model, cfg, params
+
+
 @pytest.mark.parametrize(
     "maker",
     [_hf_gpt2_pair, _hf_llama_pair, _hf_opt_pair, _hf_qwen2_pair,
-     _hf_gemma_pair],
-    ids=["gpt2", "llama", "opt", "qwen2", "gemma"],
+     _hf_gemma_pair, _hf_phi3_pair],
+    ids=["gpt2", "llama", "opt", "qwen2", "gemma", "phi3"],
 )
 def test_golden_parity_vs_transformers(maker):
     import torch
@@ -173,3 +197,20 @@ def test_golden_parity_vs_transformers(maker):
 def test_config_from_hf_rejects_unknown():
     with pytest.raises(ValueError):
         convert.config_from_hf({"model_type": "mamba"})
+
+
+def test_config_from_hf_phi3_rejects_longrope():
+    base = dict(
+        model_type="phi3", vocab_size=100, hidden_size=32,
+        intermediate_size=88, num_hidden_layers=2, num_attention_heads=4,
+        num_key_value_heads=2, max_position_embeddings=4096,
+        sliding_window=2047,
+    )
+    assert convert.config_from_hf(base).sliding_window == 2047
+    with pytest.raises(ValueError, match="rope_scaling"):
+        convert.config_from_hf(
+            {**base, "rope_scaling": {"type": "longrope",
+                                      "short_factor": [1.0]}}
+        )
+    with pytest.raises(ValueError, match="partial_rotary"):
+        convert.config_from_hf({**base, "partial_rotary_factor": 0.5})
