@@ -206,6 +206,16 @@ class RuntimeConfig:
     # a dry pool back-pressures admission.  None = contiguous per-slot KV.
     paged_pages: int | None = None
     page_size: int = 64
+    # Speculative decoding (runtime/speculative.py).  With spec_decode=True
+    # on a single-device full-precision engine, generate_text transparently
+    # routes greedy requests through the speculative loop (results are
+    # bit-identical by construction — the draft only changes speed); the
+    # draft is the engine's own blocks weight-only quantized to
+    # spec_draft_quantize bits (self-speculation).  temperature > 0 and
+    # mesh engines fall back to the plain decode loop.
+    spec_decode: bool = False
+    spec_k: int = 4
+    spec_draft_quantize: int = 4
 
 
 @dataclass(frozen=True)

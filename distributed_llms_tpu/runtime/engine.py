@@ -111,6 +111,26 @@ class InferenceEngine:
                 cfg_, b, s, dtype=kv_dtype
             )
         self._timer = profiling.StepTimer("engine.generate")
+        if rt.spec_decode:
+            if parallel is not None:
+                raise ValueError(
+                    "runtime.spec_decode is single-device (speculative "
+                    "decoding drives the model forward directly); unset it "
+                    "on mesh engines"
+                )
+            if cfg.ragged_decode:
+                # speculative_generate_tokens rejects ragged_decode (the
+                # prefix-read kernel cannot serve its masks); surface the
+                # conflict at construction, not on the first request.
+                raise ValueError(
+                    "runtime.spec_decode is incompatible with "
+                    "model.ragged_decode; unset one"
+                )
+            # Self-speculation: the draft is this engine's own blocks
+            # quantized.  attach_draft raises on already-quantized params
+            # (serve_quantized stores) — there the operator must attach an
+            # explicit draft; surface that, don't half-configure.
+            self.attach_draft(quantize_bits=rt.spec_draft_quantize)
         # Session store: caches persist across turns; with kv_host_spill only
         # the most recent max_resident_sessions stay in device memory.
         from .session import SessionManager
@@ -220,6 +240,24 @@ class InferenceEngine:
         prompt_arr, lens, n_real = self._encode_rows(prompts, batch=None)
         n_new = self.rt.max_decode_steps if max_new_tokens is None else max_new_tokens
         gen_lib.check_sequence_budget(prompt_arr.shape[1], n_new, self.rt, self.cfg)
+        limit = min(self.rt.max_seq_len, self.cfg.max_seq_len)
+        if (
+            self.rt.spec_decode
+            and self.rt.temperature == 0.0
+            and self.parallel is None
+            and getattr(self, "draft_params", None) is not None
+            and n_new >= 1
+            # The verify pass overwrites up to k+1 slots past the budget;
+            # near the sequence cap the plain loop still fits — fall through
+            # there (transparent means never erroring where plain succeeds).
+            and prompt_arr.shape[1] + self.rt.spec_k + 1 + n_new <= limit
+        ):
+            # Transparent routing: greedy speculative output is bit-identical
+            # to the plain loop's, so callers (cluster workers, CLI) get the
+            # speedup without an API change.
+            return self._speculative_result(
+                prompt_arr, lens, n_real, n_new, self.rt.spec_k
+            )
         rng = jax.random.key(seed if seed is not None else self.rt.seed)
 
         profile_ctx = (
@@ -490,12 +528,15 @@ class InferenceEngine:
 
     def generate_text_speculative(
         self, prompts: list[str], max_new_tokens: int | None = None,
-        k: int = 4,
+        k: int = 4, seed: int | None = None,
     ) -> GenerationResult:
-        """Greedy generation through the speculative decode loop — emits
-        exactly ``generate_text``'s tokens (temperature 0), faster whenever
-        the attached draft's acceptance covers its cost.  Single-device
-        engines only (the loop drives models.model.forward directly)."""
+        """Generation through the speculative decode loop — at temperature 0
+        emits exactly ``generate_text``'s tokens; at temperature > 0 draws
+        an exact sample from the same warped target distribution (rejection
+        sampling — per-seed tokens differ from generate_text's because the
+        RNG stream differs, the distribution does not).  Faster whenever the
+        attached draft's acceptance covers its cost.  Single-device engines
+        only (the loop drives models.model.forward directly)."""
         if getattr(self, "draft_params", None) is None:
             raise ValueError("no draft attached; call attach_draft(...) first")
         if self.parallel is not None:
@@ -503,34 +544,54 @@ class InferenceEngine:
                 "speculative decoding is single-device for now (mesh engines "
                 "serve via generate_text / continuous_batcher)"
             )
-        if self.rt.temperature != 0.0:
-            raise ValueError(
-                "speculative decoding is greedy-only; set runtime.temperature=0"
-            )
-        from .speculative import speculative_generate_tokens
-
-        tok = self.tokenizer
         prompt_arr, lens, n_real = self._encode_rows(prompts, batch=None)
         n_new = self.rt.max_decode_steps if max_new_tokens is None else max_new_tokens
         gen_lib.check_sequence_budget(
             prompt_arr.shape[1] + k + 1, n_new, self.rt, self.cfg
         )
+        return self._speculative_result(prompt_arr, lens, n_real, n_new, k, seed)
+
+    def _speculative_result(
+        self, prompt_arr, lens, n_real: int, n_new: int, k: int,
+        seed: int | None = None,
+    ) -> GenerationResult:
+        """Shared tail of generate_text (spec_decode routing) and
+        generate_text_speculative: inputs are pre-encoded and budget-checked.
+        Mirrors the plain path's observability (profile trace,
+        generate_seconds, memory stats) — flipping spec_decode on must not
+        flatline a latency dashboard."""
+        from .speculative import speculative_generate_tokens
+
+        tok = self.tokenizer
+        rng = (
+            jax.random.key(seed if seed is not None else self.rt.seed)
+            if self.rt.temperature > 0.0 else None
+        )
+        profile_ctx = (
+            profiling.trace(self.rt.profile_dir)
+            if self.rt.profile_dir
+            else contextlib.nullcontext()
+        )
         t0 = time.perf_counter()
-        with self._timer.step(tokens=n_real * n_new):
+        with profile_ctx, self._timer.step(tokens=n_real * n_new):
             out, stats = speculative_generate_tokens(
                 self.params, self.cfg, self.draft_params, self.draft_cfg,
                 jnp.asarray(prompt_arr), jnp.asarray(lens),
                 k=k, max_new_tokens=n_new,
                 eos_id=tok.eos_id, pad_id=tok.pad_id, return_stats=True,
+                temperature=self.rt.temperature, top_k=self.rt.top_k,
+                top_p=self.rt.top_p, rng=rng,
             )
             out = _to_host(out)[:n_real]
         dt = time.perf_counter() - t0
+        profiling.record_memory_stats()
         drafted = max(int(stats["drafted"]), 1)
         METRICS.inc("engine.generated_tokens", int(out.shape[0] * out.shape[1]))
+        METRICS.observe("engine.generate_seconds", dt)
         METRICS.observe("engine.spec_acceptance",
                         int(stats["accepted"]) / drafted)
         return GenerationResult(
             text=[tok.decode(row) for row in out], tokens=out,
-            prompt_tokens=int(lens[:n_real].sum()),
+            prompt_tokens=int(np.asarray(lens)[:n_real].sum()),
             generated_tokens=int(out.shape[0] * out.shape[1]), seconds=dt,
         )

@@ -39,6 +39,19 @@ def _mask_top_p(logits: jax.Array, p: float) -> jax.Array:
     return jnp.where(logits < cutoff, -jnp.inf, logits)
 
 
+def warp_logits(
+    logits: jax.Array, temperature: float, top_k: int = 0, top_p: float = 1.0
+) -> jax.Array:
+    """Apply the temperature/top-k/top-p warp and return the warped logits
+    (masked entries at -inf).  ``softmax(warp_logits(...))`` is the exact
+    distribution :func:`sample` draws from — speculative rejection sampling
+    (runtime/speculative.py) needs that distribution, not just a draw.
+    Requires temperature > 0 (greedy has no distribution to expose)."""
+    logits = logits / temperature
+    logits = _mask_top_k(logits, top_k)
+    return _mask_top_p(logits, top_p)
+
+
 def sample(
     rng: jax.Array,
     logits: jax.Array,  # [B, V] float32
@@ -53,10 +66,9 @@ def sample(
     """
     if temperature == 0.0:
         return greedy(logits)
-    logits = logits / temperature
-    logits = _mask_top_k(logits, top_k)
-    logits = _mask_top_p(logits, top_p)
-    return jax.random.categorical(rng, logits, axis=-1).astype(jnp.int32)
+    return jax.random.categorical(
+        rng, warp_logits(logits, temperature, top_k, top_p), axis=-1
+    ).astype(jnp.int32)
 
 
 def sampler_from_config(rt: RuntimeConfig):

@@ -9,10 +9,20 @@ better shaped for than single-token decode.  The reference framework has no
 speculative path at all (its inference is one placeholder matmul per worker,
 src/worker/node.py:24-32) — this is a beyond-parity serving feature.
 
-Greedy-only and EXACT: at temperature 0 the emitted tokens are identical to
+EXACT at temperature 0: the emitted tokens are identical to
 ``generate.generate_tokens``'s, for ANY draft model and any k — the draft
 only affects speed.  (tests/runtime/test_speculative.py pins this with a
 deliberately different draft model.)
+
+DISTRIBUTION-PRESERVING at temperature > 0 (speculative sampling,
+Leviathan et al. 2023 / Chen et al. 2023): draft token d_j ~ q_j is
+accepted iff u_j < p_j(d_j)/q_j(d_j); on the first rejection the
+correction is drawn from normalize(max(p - q, 0)); after k acceptances
+the bonus draws from p_{k+1} directly (the unified residual below: q is
+zero-extended, so max(p - 0, 0) IS p).  The emitted sequence is an exact
+sample from the target's warped (temperature/top-k/top-p) distribution —
+the theorem, pinned empirically by tests/runtime/test_speculative.py's
+residual-distribution test.  p and q are both post-warp distributions.
 
 TPU-first formulation — the whole loop is one jitted ``lax.while_loop``
 with static shapes:
@@ -42,6 +52,7 @@ import jax.numpy as jnp
 
 from ..core.config import ModelConfig
 from ..models import model as model_lib
+from . import sampling
 
 
 def _prefill(params, cfg, prompt, prompt_lens, max_len):
@@ -60,7 +71,7 @@ def _prefill(params, cfg, prompt, prompt_lens, max_len):
     jax.jit,
     static_argnames=(
         "target_cfg", "draft_cfg", "k", "max_new_tokens", "eos_id", "pad_id",
-        "return_stats",
+        "return_stats", "temperature", "top_k", "top_p",
     ),
 )
 def speculative_generate_tokens(
@@ -75,10 +86,19 @@ def speculative_generate_tokens(
     eos_id: int = -1,         # -1 => never stops early
     pad_id: int = 0,
     return_stats: bool = False,
+    temperature: float = 0.0,  # 0 => greedy (bit-exact); > 0 => speculative
+    #                            sampling (distribution-preserving)
+    top_k: int = 0,
+    top_p: float = 1.0,
+    rng: jax.Array | None = None,  # required when temperature > 0
 ) -> jax.Array | tuple[jax.Array, dict[str, jax.Array]]:
-    """Greedy speculative decode.  Returns new tokens [B, max_new_tokens]
-    (positions after a row's EOS hold pad_id) — bit-identical to
-    ``generate_tokens(..., temperature=0.0)`` on the target alone.
+    """Speculative decode.  Returns new tokens [B, max_new_tokens]
+    (positions after a row's EOS hold pad_id).  temperature == 0: greedy,
+    bit-identical to ``generate_tokens(..., temperature=0.0)`` on the
+    target alone.  temperature > 0: rejection sampling — an exact sample
+    from the target's warped distribution (see module docstring); the RNG
+    stream differs from generate_tokens', so per-seed tokens differ while
+    the distribution does not.
 
     With ``return_stats``: also ``{"rounds": scalar, "drafted": scalar,
     "accepted": scalar}`` summed over the batch — mean accepted/drafted is
@@ -89,6 +109,9 @@ def speculative_generate_tokens(
         raise ValueError(f"k must be >= 1, got {k}")
     if max_new_tokens < 1:
         raise ValueError(f"max_new_tokens must be >= 1, got {max_new_tokens}")
+    sampled = temperature > 0.0
+    if sampled and rng is None:
+        raise ValueError("temperature > 0 requires an rng key")
     for cfg, who in ((target_cfg, "target"), (draft_cfg, "draft")):
         if cfg.ragged_decode:
             # The ragged kernel reads each row's full slot prefix — including
@@ -130,7 +153,12 @@ def speculative_generate_tokens(
         gen = jnp.logical_and(slots[None, :] >= t, slots[None, :] <= hi[:, None])
         return jnp.logical_or(prompt_valid, gen)[:, None, None, :]
 
-    tok0 = jnp.argmax(tgt_logits0, axis=-1).astype(jnp.int32)
+    if sampled:
+        rng, k0 = jax.random.split(rng)
+        tok0 = sampling.sample(k0, tgt_logits0, temperature, top_k, top_p)
+    else:
+        rng = jax.random.key(0)  # uniform carry shape; never consumed
+        tok0 = jnp.argmax(tgt_logits0, axis=-1).astype(jnp.int32)
     out0 = jnp.full((b, max_new_tokens + k + 1), pad_id, jnp.int32)
     out0 = out0.at[:, 0].set(tok0)
     e0 = jnp.ones((b,), jnp.int32)           # tokens emitted so far
@@ -138,15 +166,20 @@ def speculative_generate_tokens(
     stats0 = jnp.zeros((3,), jnp.int32)      # rounds, drafted, accepted
 
     def cond(carry):
-        _, _, _, e, _, done, _ = carry
+        _, _, _, e, _, done, _, _ = carry
         return jnp.any(jnp.logical_and(~done, e < max_new_tokens))
 
     def body(carry):
-        tgt_cache, drf_cache, out, e, y, done, stats = carry
+        tgt_cache, drf_cache, out, e, y, done, stats, rng = carry
+        rng, kd, ku, kc = jax.random.split(rng, 4)
 
-        # --- draft: k single-token greedy steps (batched, per-row index).
-        def draft_step(dc, j):
+        # --- draft: k single-token steps (batched, per-row index).  When
+        # sampling, each step also emits its full post-warp distribution
+        # q_j — the rejection test needs q_j(d_j) and the residual needs
+        # the whole vector.
+        def draft_step(dc, inputs):
             drf_cache, cur = dc
+            j, kj = inputs
             idx = t + e - 1 + j
             logits, drf_cache = model_lib.forward(
                 draft_params, draft_cfg, cur[:, None],
@@ -154,13 +187,26 @@ def speculative_generate_tokens(
                 cache=drf_cache, cache_index=idx, attn_mask=gen_mask(e, j),
                 **drf_win,
             )
-            nxt = jnp.argmax(logits[:, 0], axis=-1).astype(jnp.int32)
-            return (drf_cache, nxt), nxt
+            step_logits = logits[:, 0]
+            if sampled:
+                warped = sampling.warp_logits(
+                    step_logits, temperature, top_k, top_p
+                )
+                nxt = jax.random.categorical(kj, warped, axis=-1).astype(
+                    jnp.int32
+                )
+                q = jax.nn.softmax(warped, axis=-1)          # [B, V]
+            else:
+                nxt = jnp.argmax(step_logits, axis=-1).astype(jnp.int32)
+                q = jnp.zeros((b, 0), step_logits.dtype)     # unused
+            return (drf_cache, nxt), (nxt, q)
 
-        (drf_cache, _), drafts = jax.lax.scan(
-            draft_step, (drf_cache, y), jnp.arange(k, dtype=jnp.int32)
+        (drf_cache, _), (drafts, qs) = jax.lax.scan(
+            draft_step, (drf_cache, y),
+            (jnp.arange(k, dtype=jnp.int32), jax.random.split(kd, k)),
         )
-        drafts = drafts.T  # [B, k]: d_1..d_k
+        drafts = drafts.T            # [B, k]: d_1..d_k
+        qs = jnp.moveaxis(qs, 0, 1)  # [B, k, V] (V == 0 when greedy)
 
         # --- verify: ONE target forward over [y, d_1..d_k] (k+1 tokens).
         vtoks = jnp.concatenate([y[:, None], drafts], axis=1)  # [B, k+1]
@@ -174,19 +220,59 @@ def speculative_generate_tokens(
             cache=tgt_cache, cache_index=t + e - 1, attn_mask=vmask,
             **tgt_win,
         )
-        greedy = jnp.argmax(vlogits, axis=-1).astype(jnp.int32)  # [B, k+1]
-        # g_{j+1} = greedy[:, j] is the target's token AFTER consuming
-        # position j of the verify block.
-
-        # Longest agreeing prefix: a = #leading j with d_j == g_j.
-        agree = drafts == greedy[:, :k]                      # [B, k]
-        lead = jnp.cumprod(agree.astype(jnp.int32), axis=1)  # [B, k]
-        a = jnp.sum(lead, axis=1)                            # [B] in 0..k
-        # Committed candidates: accepted drafts then the bonus/correction.
+        # Logits after consuming position j of the verify block predict
+        # emitted index e+j.
         j_ar = jnp.arange(k + 1, dtype=jnp.int32)
-        cand = jnp.where(j_ar[None, :] < a[:, None],
-                         jnp.concatenate([drafts, drafts[:, -1:]], axis=1),
-                         greedy)                             # [B, k+1]
+        if sampled:
+            ps = jax.nn.softmax(
+                sampling.warp_logits(vlogits, temperature, top_k, top_p),
+                axis=-1,
+            )  # [B, k+1, V]
+            # Rejection test: accept d_j iff u_j < p_j(d_j)/q_j(d_j)
+            # (u in [0,1) makes min(1, ratio) implicit).
+            p_at = jnp.take_along_axis(
+                ps[:, :k], drafts[..., None], axis=-1
+            )[..., 0]                                        # [B, k]
+            q_at = jnp.take_along_axis(
+                qs, drafts[..., None], axis=-1
+            )[..., 0]                                        # [B, k]
+            u = jax.random.uniform(ku, (b, k))
+            accept = u * jnp.maximum(q_at, 1e-20) < p_at
+            lead = jnp.cumprod(accept.astype(jnp.int32), axis=1)
+            a = jnp.sum(lead, axis=1)                        # [B] in 0..k
+            # Unified residual: zero-extend q so position k's "residual"
+            # is p_{k+1} itself (the bonus draw).
+            q_ext = jnp.concatenate(
+                [qs, jnp.zeros_like(ps[:, :1])], axis=1
+            )                                                # [B, k+1, V]
+            p_a = jnp.take_along_axis(ps, a[:, None, None], axis=1)[:, 0]
+            q_a = jnp.take_along_axis(q_ext, a[:, None, None], axis=1)[:, 0]
+            resid = jnp.maximum(p_a - q_a, 0.0)
+            norm = jnp.sum(resid, axis=-1, keepdims=True)
+            # Numerical guard: p == q on the whole support leaves an empty
+            # residual; fall back to p (any sample from it is valid there).
+            resid = jnp.where(norm > 1e-9, resid / jnp.maximum(norm, 1e-9), p_a)
+            corr = jax.random.categorical(
+                kc,
+                jnp.where(resid > 0, jnp.log(jnp.maximum(resid, 1e-30)),
+                          -jnp.inf),
+                axis=-1,
+            ).astype(jnp.int32)                              # [B]
+            cand = jnp.where(
+                j_ar[None, :] < a[:, None],
+                jnp.concatenate([drafts, drafts[:, -1:]], axis=1),
+                corr[:, None],
+            )                                                # [B, k+1]
+        else:
+            greedy = jnp.argmax(vlogits, axis=-1).astype(jnp.int32)
+            # Longest agreeing prefix: a = #leading j with d_j == g_j.
+            agree = drafts == greedy[:, :k]                  # [B, k]
+            lead = jnp.cumprod(agree.astype(jnp.int32), axis=1)
+            a = jnp.sum(lead, axis=1)                        # [B] in 0..k
+            # Committed candidates: accepted drafts, then bonus/correction.
+            cand = jnp.where(j_ar[None, :] < a[:, None],
+                             jnp.concatenate([drafts, drafts[:, -1:]], axis=1),
+                             greedy)                         # [B, k+1]
 
         m = a + 1                                            # tokens to commit
         if eos_id >= 0:
@@ -251,10 +337,10 @@ def speculative_generate_tokens(
         # Committed drafts this round: all m tokens when a clamp (EOS/budget)
         # cut the round short of its bonus token, else the a accepted drafts.
         stats = stats.at[2].add(jnp.sum(jnp.minimum(a, m)))
-        return tgt_cache, drf_cache, out, e, y, done, stats
+        return tgt_cache, drf_cache, out, e, y, done, stats, rng
 
-    carry = (tgt_cache, drf_cache, out0, e0, tok0, done0, stats0)
-    *_, out, _, _, _, stats = jax.lax.while_loop(cond, body, carry)
+    carry = (tgt_cache, drf_cache, out0, e0, tok0, done0, stats0, rng)
+    *_, out, _, _, _, stats, _ = jax.lax.while_loop(cond, body, carry)
     toks = out[:, :max_new_tokens]
     if return_stats:
         return toks, {"rounds": stats[0], "drafted": stats[1],
