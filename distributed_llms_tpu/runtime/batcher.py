@@ -191,6 +191,149 @@ def admit_row(
     return (cache, *_replicated(pm, tok, row_valid))
 
 
+@partial(jax.jit, static_argnames=("cfg",), donate_argnames=("cache",))
+def admit_row_kv(
+    params: Any,
+    cfg: ModelConfig,
+    cache: Any,  # shared KVCache (the DRAFT's, in speculative mode)
+    slot: jax.Array,  # scalar int32
+    prompt: jax.Array,  # [Tp] int32 right-padded FULL prompt (prefix+suffix)
+    plen: jax.Array,  # scalar int32 true length
+) -> Any:
+    """KV-only admission: prefill one row and splice it into the shared
+    cache, sampling nothing.  Speculative batching uses it to seed the
+    DRAFT model's cache for a newly admitted request (prefix caching only
+    stores target KV, so the draft prefills the full prompt)."""
+    del plen  # the transient prefill writes all Tp slots; masks gate reads
+    _, row_cache = _prefill_row(
+        model_lib.forward, params, cfg, cache.k.dtype, cache.k.shape[-3],
+        prompt,
+    )
+    ax = _batch_axis(cache.k.ndim)
+
+    def splice(full, row):
+        start = [0] * full.ndim
+        start[ax] = slot
+        return jax.lax.dynamic_update_slice(
+            full, row.astype(full.dtype), tuple(start)
+        )
+
+    return KVCache(k=splice(cache.k, row_cache.k),
+                   v=splice(cache.v, row_cache.v))
+
+
+@partial(
+    jax.jit,
+    static_argnames=("cfg", "draft_cfg", "k", "eos_id", "pad_id"),
+    donate_argnames=("cache", "draft_cache"),
+)
+def spec_chunk(
+    params: Any,
+    cfg: ModelConfig,
+    draft_params: Any,
+    draft_cfg: ModelConfig,
+    cache: Any,        # target shared KVCache
+    draft_cache: Any,  # draft shared KVCache (same slot layout)
+    last_tok: jax.Array,   # [B] int32
+    real_lens: jax.Array,  # [B] int32
+    valid: jax.Array,      # [B, S] bool
+    active: jax.Array,     # [B] bool
+    budget: jax.Array,     # [B] int32
+    k: int,
+    eos_id: int = -1,
+    pad_id: int = 0,
+) -> tuple:
+    """ONE speculative round over the batch (greedy): draft k tokens per
+    row against the draft cache, verify all of them in one (k+1)-token
+    target forward, commit each row's agreeing prefix + bonus/correction.
+    Tokens are bit-identical to decode_chunk's greedy output — acceptance
+    only changes how many arrive per round.
+
+    Returns (toks [B, k+1] pad-masked, m [B] committed counts, cache',
+    draft_cache', last_tok', real_lens', valid', active', budget').
+
+    Layout: contiguous (slot == position) exactly like decode_chunk; the
+    rollback/backfill arguments mirror runtime/speculative.py with the
+    frontier convention shifted to the batcher's (a token's KV is written
+    by the forward that consumes it, at slot == its position)."""
+    s = cache.k.shape[-3]
+    slots = jnp.arange(s, dtype=jnp.int32)
+
+    def row_mask(hi):  # [B] inclusive frontier -> [B, 1, 1, S]
+        own = jnp.logical_and(slots[None, :] >= real_lens[:, None],
+                              slots[None, :] <= hi[:, None])
+        return jnp.logical_or(valid, own)[:, None, None, :]
+
+    # --- draft: k single-token greedy steps against the draft cache.
+    def draft_step(dc, j):
+        draft_cache, cur = dc
+        idx = real_lens + j
+        logits, draft_cache = model_lib.forward(
+            draft_params, draft_cfg, cur[:, None], positions=idx[:, None],
+            cache=draft_cache, cache_index=idx, attn_mask=row_mask(idx),
+        )
+        nxt = jnp.argmax(logits[:, 0], axis=-1).astype(jnp.int32)
+        return (draft_cache, nxt), nxt
+
+    (draft_cache, _), drafts = jax.lax.scan(
+        draft_step, (draft_cache, last_tok), jnp.arange(k, dtype=jnp.int32)
+    )
+    drafts = drafts.T  # [B, k]
+
+    # --- verify: one (k+1)-token target forward.
+    vtoks = jnp.concatenate([last_tok[:, None], drafts], axis=1)
+    voff = jnp.arange(k + 1, dtype=jnp.int32)
+    vmask = jnp.concatenate(
+        [row_mask(real_lens + q) for q in range(k + 1)], axis=2
+    )  # [B, 1, k+1, S]
+    vlogits, cache = model_lib.forward(
+        params, cfg, vtoks,
+        positions=real_lens[:, None] + voff[None, :],
+        cache=cache, cache_index=real_lens, attn_mask=vmask,
+    )
+    greedy = jnp.argmax(vlogits, axis=-1).astype(jnp.int32)  # [B, k+1]
+    # Shared accept/commit bookkeeping (runtime/speculative.py — the ONE
+    # definition; only the frontier convention differs between the loops).
+    from .speculative import backfill_coords, greedy_accept_commit
+
+    cand, m, has_eos, _ = greedy_accept_commit(
+        drafts, greedy, active, budget, eos_id, k
+    )
+    j_ar = jnp.arange(k + 1, dtype=jnp.int32)
+
+    # Target KVs at slots real_lens .. real_lens+m-1 hold
+    # [last_tok, c_1..c_{m-1}] — all committed; slot real_lens+m (holding
+    # d_m's KV when the round mismatched there) stays invalid and is
+    # overwritten when the next round consumes the true c_m.
+    committed = jnp.logical_and(
+        slots[None, :] >= real_lens[:, None],
+        slots[None, :] <= (real_lens + m - 1)[:, None],
+    )
+    valid = valid | (committed & (m > 0)[:, None])
+
+    toks = jnp.where(j_ar[None, :] < m[:, None], cand, jnp.int32(pad_id))
+    new_last = jnp.take_along_axis(
+        cand, jnp.maximum(m - 1, 0)[:, None], axis=1
+    )[:, 0]
+    last_tok = jnp.where(m > 0, new_last, last_tok)
+    real_lens = real_lens + m
+    budget = budget - m
+    active = active & ~has_eos & (budget > 0)
+
+    # Draft backfill: only a fully accepted round (m == k+1) leaves the
+    # draft missing c_k's KV one slot below the new frontier
+    # (speculative.backfill_coords has the full rationale).
+    bf_idx, bf_tok = backfill_coords(cand, m, frontier=real_lens)
+    bf_own = slots[None, :] == bf_idx[:, None]
+    bf_mask = jnp.logical_or(valid, bf_own)[:, None, None, :]
+    _, draft_cache = model_lib.forward(
+        draft_params, draft_cfg, bf_tok[:, None], positions=bf_idx[:, None],
+        cache=draft_cache, cache_index=bf_idx, attn_mask=bf_mask,
+    )
+    return (toks, m, cache, draft_cache, last_tok, real_lens, valid, active,
+            budget)
+
+
 @partial(
     jax.jit,
     static_argnames=("cfg", "temperature", "top_k", "top_p", "pm"),
@@ -473,6 +616,14 @@ class ContinuousBatcher:
         #   the pool can be far smaller than batch_slots * max_len; a full
         #   pool back-pressures admission instead of OOMing.
         page_size: int = 64,
+        # Speculative batching (greedy only): every scheduling round drafts
+        # spec_k tokens per row with the draft model and verifies them in
+        # ONE target forward — tokens stay bit-identical to the plain
+        # batcher; acceptance only changes how many arrive per round.
+        # Single-device contiguous mode (no mesh, no paging).
+        draft_params: Any = None,
+        draft_cfg: ModelConfig | None = None,
+        spec_k: int = 4,
     ) -> None:
         if max_len > cfg.max_seq_len:
             raise ValueError(
@@ -513,6 +664,29 @@ class ContinuousBatcher:
                     f"batch_slots {batch_slots} must divide over the mesh "
                     f"'data' axis ({dp})"
                 )
+        self.speculative = draft_params is not None
+        if self.speculative:
+            if draft_cfg is None:
+                raise ValueError("draft_params needs draft_cfg")
+            if parallel is not None or paged_pages is not None:
+                raise ValueError(
+                    "speculative batching is single-device contiguous mode "
+                    "(no mesh, no paged KV)"
+                )
+            if temperature != 0.0:
+                raise ValueError(
+                    "speculative batching is greedy-only; set temperature=0"
+                )
+            if draft_cfg.vocab_size != cfg.vocab_size:
+                raise ValueError(
+                    f"draft vocab {draft_cfg.vocab_size} != target vocab "
+                    f"{cfg.vocab_size}"
+                )
+            if spec_k < 1:
+                raise ValueError(f"spec_k must be >= 1, got {spec_k}")
+        self.draft_params = draft_params
+        self.draft_cfg = draft_cfg
+        self.spec_k = spec_k
         self.pm = parallel
         self.cfg = cfg
         # Decode-chunk variant of the config: ragged decode attention (row b
@@ -542,6 +716,14 @@ class ContinuousBatcher:
         self.sampling = dict(temperature=temperature, top_k=top_k, top_p=top_p)
         self.eos_id = eos_id
         self.pad_id = pad_id
+        # Speculative mode reserves k+1 HEADROOM cache slots past max_len:
+        # a near-capacity row's verify forward writes up to k+1 slots
+        # beyond its frontier, and dynamic_update_slice CLAMPS an
+        # overflowing start — without headroom the last committed slot's KV
+        # would be silently overwritten with misaligned values (admission
+        # capacity checks still enforce max_len; the extra slots are never
+        # valid, never committed, only overwritten).
+        cache_len = max_len + (spec_k + 1 if self.speculative else 0)
         if parallel is not None:
             # Mesh-sharded shared cache: 'data' on the batch axis, 'model'
             # on KV heads.  An explicit kv_dtype must not be silently
@@ -574,7 +756,12 @@ class ContinuousBatcher:
             )
         else:
             self.cache = model_lib.init_cache(
-                cfg, batch_slots, max_len,
+                cfg, batch_slots, cache_len,
+                dtype=jnp.dtype(kv_dtype) if kv_dtype else None,
+            )
+        if self.speculative:
+            self.draft_cache = model_lib.init_cache(
+                draft_cfg, batch_slots, cache_len,
                 dtype=jnp.dtype(kv_dtype) if kv_dtype else None,
             )
         self.page_size = page_size
@@ -593,7 +780,12 @@ class ContinuousBatcher:
         # what keeps a multi-process mesh in lockstep.
         self.last_tok = np.zeros((batch_slots,), np.int32)
         self.real_lens = np.zeros((batch_slots,), np.int32)
-        self.valid = np.zeros((batch_slots, max_len), bool)
+        # Sized to the CACHE width (speculative mode pads k+1 headroom slots
+        # past max_len; admission row_valid vectors come back cache-sized).
+        # Paged mode keeps per-row logical width (the cache is a page pool).
+        self.valid = np.zeros(
+            (batch_slots, max_len if self.paged else cache_len), bool
+        )
         self.active = np.zeros((batch_slots,), bool)
         self.budget = np.zeros((batch_slots,), np.int32)
         self.rows = [_RowState() for _ in range(batch_slots)]
@@ -618,7 +810,14 @@ class ContinuousBatcher:
             raise ValueError(
                 f"prefix ({len(ids)} tokens) does not fit slot capacity {self.s}"
             )
-        row_cache = model_lib.init_cache(self.cfg, 1, self.s, dtype=self.cache.k.dtype)
+        # Contiguous mode: CACHE width, not self.s — speculative mode pads
+        # headroom slots and the admission splice needs shape-matched rows.
+        # Paged mode keeps logical width (the pool's shape[-3] is the page
+        # size, and its admission scatters by pages, not a splice).
+        width = self.s if self.paged else self.cache.k.shape[-3]
+        row_cache = model_lib.init_cache(
+            self.cfg, 1, width, dtype=self.cache.k.dtype
+        )
         positions = jnp.arange(len(ids), dtype=jnp.int32)[None, :]
         _, row_cache = _fwd(self.pm)(
             self.params, self.cfg, jnp.asarray([ids], jnp.int32),
@@ -723,6 +922,19 @@ class ContinuousBatcher:
                     jnp.asarray(prompt), jnp.int32(len(req.ids)),
                     self._split_rng(), pm=self.pm, **self.sampling,
                 )
+            if self.speculative:
+                # Seed the DRAFT cache for this row: full prompt (prefix
+                # caching stores only target KV, so the draft prefills
+                # prefix + suffix; bucketed for compile reuse).
+                full_ids = (pfx.ids if pfx else []) + req.ids
+                td = min(_bucket(len(full_ids)), self.s)
+                dprompt = np.full((td,), self.pad_id, np.int32)
+                dprompt[: len(full_ids)] = full_ids
+                self.draft_cache = admit_row_kv(
+                    self.draft_params, self.draft_cfg, self.draft_cache,
+                    jnp.int32(i), jnp.asarray(dprompt),
+                    jnp.int32(len(full_ids)),
+                )
             tok = int(tok)  # replicated scalar — identical on every process
             self.last_tok[i] = tok
             self.real_lens[i] = total_len
@@ -740,12 +952,20 @@ class ContinuousBatcher:
                 self.active[i] = False
             METRICS.inc("batcher.admitted")
 
-    def _collect(self, toks: np.ndarray, was_active: np.ndarray) -> None:
+    def _collect(
+        self, toks: np.ndarray, was_active: np.ndarray,
+        counts: np.ndarray | None = None,
+    ) -> None:
         for i in range(self.b):
             row = self.rows[i]
             if row.rid is None or not was_active[i]:
                 continue
-            for t in toks[i]:
+            # Speculative rounds emit a VARIABLE count per row; columns past
+            # counts[i] are padding, not tokens (a legit pad-id token inside
+            # the count still collects).  decode_chunk's fixed-step output
+            # keeps the remaining-guarded full sweep.
+            row_toks = toks[i] if counts is None else toks[i][: counts[i]]
+            for t in row_toks:
                 if row.remaining <= 0:
                     break
                 t = int(t)
@@ -784,15 +1004,26 @@ class ContinuousBatcher:
                 if not self.queue and all(r.rid is None for r in self.rows):
                     break
                 continue
-            toks, self.cache, last_tok, real_lens, valid, active, budget = \
-                decode_chunk(
-                    self.params, self.cfg_decode, self.cache, self.last_tok,
+            counts = None
+            if self.speculative:
+                (toks, m, self.cache, self.draft_cache, last_tok, real_lens,
+                 valid, active, budget) = spec_chunk(
+                    self.params, self.cfg, self.draft_params, self.draft_cfg,
+                    self.cache, self.draft_cache, self.last_tok,
                     self.real_lens, self.valid, self.active, self.budget,
-                    self._split_rng(), self.chunk_steps,
-                    eos_id=self.eos_id, pad_id=self.pad_id, pm=self.pm,
-                    tables=jnp.asarray(self.tables) if self.paged else None,
-                    **self.sampling,
+                    k=self.spec_k, eos_id=self.eos_id, pad_id=self.pad_id,
                 )
+                counts = np.asarray(m)
+            else:
+                toks, self.cache, last_tok, real_lens, valid, active, budget = \
+                    decode_chunk(
+                        self.params, self.cfg_decode, self.cache, self.last_tok,
+                        self.real_lens, self.valid, self.active, self.budget,
+                        self._split_rng(), self.chunk_steps,
+                        eos_id=self.eos_id, pad_id=self.pad_id, pm=self.pm,
+                        tables=jnp.asarray(self.tables) if self.paged else None,
+                        **self.sampling,
+                    )
             # Back to host numpy mirrors (replicated outputs — every
             # process reads identical values).  np.array, not asarray:
             # device views are read-only and admission writes into these.
@@ -801,5 +1032,5 @@ class ContinuousBatcher:
             self.valid = np.array(valid)
             self.active = np.array(active)
             self.budget = np.array(budget)
-            self._collect(np.asarray(toks), was_active)
+            self._collect(np.asarray(toks), was_active, counts=counts)
         return dict(self.results)

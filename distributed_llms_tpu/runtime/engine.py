@@ -126,6 +126,9 @@ class InferenceEngine:
                     "runtime.spec_decode is incompatible with "
                     "model.ragged_decode; unset one"
                 )
+            if rt.spec_k < 1:
+                # Fail at construction, not on the first routed request.
+                raise ValueError(f"runtime.spec_k must be >= 1, got {rt.spec_k}")
             # Self-speculation: the draft is this engine's own blocks
             # quantized.  attach_draft raises on already-quantized params
             # (serve_quantized stores) — there the operator must attach an
@@ -419,6 +422,8 @@ class InferenceEngine:
         self, batch_slots: int = 8, max_len: int | None = None,
         chunk_steps: int = 8, paged_pages: int | None = None,
         page_size: int | None = None,
+        speculative: bool | None = None,  # None -> rt.spec_decode; needs an
+        #   attached draft + greedy + single-device contiguous mode
     ):
         """A ContinuousBatcher over this engine's model: requests admit into
         an in-flight decode batch as rows free up (runtime/batcher.py) —
@@ -469,9 +474,32 @@ class InferenceEngine:
             # default 8 on a data=16 mesh).
             dp = self.parallel.mesh.shape.get("data", 1)
             batch_slots = -(-batch_slots // dp) * dp
+        if speculative is None:
+            # Config-driven default mirrors generate_text's routing: only
+            # when every precondition holds (never erroring where the plain
+            # batcher works).
+            speculative = (
+                self.rt.spec_decode
+                and self.rt.temperature == 0.0
+                and self.parallel is None
+                and paged_pages is None
+                and getattr(self, "draft_params", None) is not None
+            )
+        spec_kwargs = {}
+        if speculative:
+            if getattr(self, "draft_params", None) is None:
+                raise ValueError(
+                    "speculative batching needs a draft: call "
+                    "attach_draft(...) first"
+                )
+            spec_kwargs = dict(
+                draft_params=self.draft_params, draft_cfg=self.draft_cfg,
+                spec_k=self.rt.spec_k,
+            )
         tok = self.tokenizer
         return ContinuousBatcher(
             self.cfg, self.params, tokenizer=tok,
+            **spec_kwargs,
             batch_slots=batch_slots,
             max_len=min(max_len or self.rt.max_seq_len, self.cfg.max_seq_len),
             chunk_steps=chunk_steps,

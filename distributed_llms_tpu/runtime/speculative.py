@@ -55,6 +55,76 @@ from ..models import model as model_lib
 from . import sampling
 
 
+def greedy_accept_commit(
+    drafts: jax.Array,   # [B, k] draft tokens d_1..d_k
+    greedy: jax.Array,   # [B, k+1] target greedy tokens g_1..g_{k+1}
+    live: jax.Array,     # [B] bool — rows that may commit this round
+    budget: jax.Array,   # [B] int32 — tokens each row may still emit
+    eos_id: int,
+    k: int,
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Greedy acceptance + commit bookkeeping — the SINGLE definition shared
+    by the standalone loop and the batcher's spec_chunk (their only
+    difference is the cache frontier convention, which stays at the call
+    sites).  Returns (cand [B, k+1], m [B], has_eos [B], a [B]): commit
+    cand[:m] per row; m accounts for EOS truncation, the budget clamp, and
+    dead rows; a is the raw accepted-draft count (for acceptance stats)."""
+    agree = drafts == greedy[:, :k]
+    lead = jnp.cumprod(agree.astype(jnp.int32), axis=1)
+    a = jnp.sum(lead, axis=1)                            # [B] in 0..k
+    j_ar = jnp.arange(k + 1, dtype=jnp.int32)
+    # Accepted drafts then the bonus/correction (greedy[j] at j == a).
+    cand = jnp.where(j_ar[None, :] < a[:, None],
+                     jnp.concatenate([drafts, drafts[:, -1:]], axis=1),
+                     greedy)                             # [B, k+1]
+    m, has_eos = commit_clamp(cand, a, live, budget, eos_id, k)
+    return cand, m, has_eos, a
+
+
+def commit_clamp(
+    cand: jax.Array,   # [B, k+1] committed candidates
+    a: jax.Array,      # [B] accepted-draft counts
+    live: jax.Array,   # [B] bool
+    budget: jax.Array, # [B] int32
+    eos_id: int,
+    k: int,
+) -> tuple[jax.Array, jax.Array]:
+    """The commit count: a+1 candidates, truncated at the first committed
+    EOS (inclusive), clamped to the row's budget, zero for dead rows.
+    Shared by the greedy and rejection-sampling paths."""
+    j_ar = jnp.arange(k + 1, dtype=jnp.int32)
+    m = a + 1
+    b = cand.shape[0]
+    if eos_id >= 0:
+        is_eos = jnp.logical_and(cand == eos_id, j_ar[None, :] < m[:, None])
+        eos_pos = jnp.argmax(is_eos, axis=1)
+        has_eos = jnp.any(is_eos, axis=1)
+        m = jnp.where(has_eos, jnp.minimum(m, eos_pos + 1), m)
+    else:
+        has_eos = jnp.zeros((b,), bool)
+    m = jnp.minimum(m, budget)
+    m = jnp.where(live, m, 0)
+    return m, has_eos
+
+
+def backfill_coords(
+    cand: jax.Array,      # [B, k+1] committed candidates
+    m: jax.Array,         # [B] committed counts
+    frontier: jax.Array,  # [B] the slot the NEXT round's first feed writes
+) -> tuple[jax.Array, jax.Array]:
+    """Draft-backfill coordinates (shared by both spec loops): after a
+    fully accepted round the draft never consumed the last accepted draft,
+    leaving a KV hole one slot below the new frontier.  Rounds with
+    2 <= m <= k rewrite an already-correct slot with the same token
+    (harmless); m < 2 redirects to the frontier slot, which the next
+    round's first feed overwrites before any query reads it."""
+    bf_idx = jnp.where(m >= 2, frontier - 1, frontier)
+    bf_tok = jnp.take_along_axis(
+        cand, jnp.maximum(m - 2, 0)[:, None], axis=1
+    )[:, 0]
+    return bf_idx, bf_tok
+
+
 def _prefill(params, cfg, prompt, prompt_lens, max_len):
     b, t = prompt.shape
     cache = model_lib.init_cache(cfg, b, max_len)
@@ -263,29 +333,14 @@ def speculative_generate_tokens(
                 jnp.concatenate([drafts, drafts[:, -1:]], axis=1),
                 corr[:, None],
             )                                                # [B, k+1]
+            budget = max_new_tokens - e                      # pre-commit
+            m, has_eos = commit_clamp(cand, a, ~done, budget, eos_id, k)
         else:
-            greedy = jnp.argmax(vlogits, axis=-1).astype(jnp.int32)
-            # Longest agreeing prefix: a = #leading j with d_j == g_j.
-            agree = drafts == greedy[:, :k]                  # [B, k]
-            lead = jnp.cumprod(agree.astype(jnp.int32), axis=1)
-            a = jnp.sum(lead, axis=1)                        # [B] in 0..k
-            # Committed candidates: accepted drafts, then bonus/correction.
-            cand = jnp.where(j_ar[None, :] < a[:, None],
-                             jnp.concatenate([drafts, drafts[:, -1:]], axis=1),
-                             greedy)                         # [B, k+1]
-
-        m = a + 1                                            # tokens to commit
-        if eos_id >= 0:
-            # Truncate at the first committed EOS (inclusive).
-            is_eos = jnp.logical_and(cand == eos_id, j_ar[None, :] < m[:, None])
-            eos_pos = jnp.argmax(is_eos, axis=1)             # first True, else 0
-            has_eos = jnp.any(is_eos, axis=1)
-            m = jnp.where(has_eos, jnp.minimum(m, eos_pos + 1), m)
-        else:
-            has_eos = jnp.zeros((b,), bool)
-        budget = max_new_tokens - e                         # pre-commit
-        m = jnp.minimum(m, budget)                          # budget clamp
-        m = jnp.where(done, 0, m)
+            greedy_toks = jnp.argmax(vlogits, axis=-1).astype(jnp.int32)
+            budget = max_new_tokens - e                      # pre-commit
+            cand, m, has_eos, a = greedy_accept_commit(
+                drafts, greedy_toks, ~done, budget, eos_id, k
+            )
 
         # Scatter the committed tokens into the (padded-wide) out buffer.
         valid = j_ar[None, :] < m[:, None]                   # [B, k+1]
@@ -305,15 +360,9 @@ def speculative_generate_tokens(
 
         # --- draft backfill: after a FULLY accepted round (m == k+1) the
         # draft proposed d_k but never consumed it, leaving a zero-KV hole
-        # at slot t+e-2 that the next round's masks would expose (and
-        # silently wreck acceptance from then on).  One discarded-logits
-        # draft step writes it.  Rounds with 2 <= m <= k rewrite an
-        # already-correct slot with the same token (harmless); m < 2
-        # redirects to the frontier slot, which the next round's first
-        # draft feed overwrites before any query reads it.
-        bf_idx = jnp.where(m >= 2, t + e - 2, t + e - 1)
-        bf_tok = jnp.take_along_axis(
-            cand, jnp.maximum(m - 2, 0)[:, None], axis=1)[:, 0]
+        # one slot below the new frontier t+e-1 (backfill_coords has the
+        # full rationale; a hole silently wrecks acceptance from then on).
+        bf_idx, bf_tok = backfill_coords(cand, m, frontier=t + e - 1)
         bf_gen = jnp.logical_and(slots[None, :] >= t,
                                  slots[None, :] <= bf_idx[:, None])
         bf_mask = jnp.logical_or(prompt_valid, bf_gen)[:, None, None, :]

@@ -1,0 +1,163 @@
+"""Speculative continuous batching (runtime/batcher.py spec_chunk).
+
+Invariant: with ANY draft, the speculative batcher's greedy results are
+bit-identical to the plain batcher's (which are pinned against solo
+decodes by test_batcher.py) — acceptance only changes how many tokens land
+per scheduling round.  Exercises mixed budgets, EOS mid-round, slot reuse,
+prefix caching (draft prefills the full prompt), and the draft backfill
+after fully accepted rounds (self-draft).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_llms_tpu.models import model as model_lib, presets
+from distributed_llms_tpu.runtime.batcher import ContinuousBatcher
+
+
+@pytest.fixture(scope="module")
+def models():
+    cfg = presets.get_preset("llama-tiny", vocab_size=512)
+    params = model_lib.init_params(jax.random.key(0), cfg)
+    dcfg = presets.get_preset("llama-tiny", vocab_size=512, num_layers=2)
+    dparams = model_lib.init_params(jax.random.key(99), dcfg)  # unrelated
+    return cfg, params, dcfg, dparams
+
+
+def _run(cfg, params, reqs, eos_id=-1, spec=None, spec_k=3):
+    b = ContinuousBatcher(
+        cfg, params, batch_slots=2, max_len=64, chunk_steps=4, eos_id=eos_id,
+        **(dict(draft_params=spec[1], draft_cfg=spec[0], spec_k=spec_k)
+           if spec else {}),
+    )
+    rids = [b.submit(ids, max_new_tokens=n) for ids, n in reqs]
+    return b, rids, b.run()
+
+
+def test_spec_batcher_matches_plain(models):
+    cfg, params, dcfg, dparams = models
+    reqs = [([7, 1, 9, 4, 2], 9), ([4, 4, 4], 5), ([11, 12], 12), ([42], 7),
+            ([3, 1], 1)]
+    _, rp, plain = _run(cfg, params, reqs)
+    _, rs, spec = _run(cfg, params, reqs, spec=(dcfg, dparams))
+    for a, b in zip(rp, rs):
+        assert plain[a] == spec[b], (a, plain[a], spec[b])
+
+
+def test_spec_batcher_self_draft_matches_plain(models):
+    """Self-draft: every round fully accepts, hammering the draft-backfill
+    slot math round after round."""
+    cfg, params, _, _ = models
+    reqs = [([7, 1, 9], 13), ([5, 5], 11)]
+    _, rp, plain = _run(cfg, params, reqs)
+    _, rs, spec = _run(cfg, params, reqs, spec=(cfg, params), spec_k=4)
+    for a, b in zip(rp, rs):
+        assert plain[a] == spec[b]
+
+
+def test_spec_batcher_eos_and_slot_reuse(models):
+    cfg, params, dcfg, dparams = models
+    # Find an EOS id that actually occurs: run once free, grab a token.
+    probe_b, probe_r, probe = _run(cfg, params, [([7, 1, 9], 8)])
+    eos_id = probe[probe_r[0]][3]
+    reqs = [([7, 1, 9], 8), ([4, 4, 4], 6), ([11, 12], 9), ([2, 8], 7)]
+    _, rp, plain = _run(cfg, params, reqs, eos_id=eos_id)
+    _, rs, spec = _run(cfg, params, reqs, eos_id=eos_id,
+                       spec=(dcfg, dparams))
+    for a, b in zip(rp, rs):
+        assert plain[a] == spec[b]
+
+
+def test_spec_batcher_prefix_caching(models):
+    """Prefix-cached requests: the draft prefills prefix+suffix itself
+    (register_prefix stores target KV only); results must still match the
+    plain batcher's prefix path exactly."""
+    cfg, params, dcfg, dparams = models
+
+    def run(spec):
+        b = ContinuousBatcher(
+            cfg, params, batch_slots=2, max_len=64, chunk_steps=4,
+            **(dict(draft_params=dparams, draft_cfg=dcfg, spec_k=3)
+               if spec else {}),
+        )
+        b.register_prefix("sys", [9, 8, 7, 6, 5])
+        rids = [b.submit([1, 2], max_new_tokens=7, prefix="sys"),
+                b.submit([3], max_new_tokens=5, prefix="sys"),
+                b.submit([4, 4, 4], max_new_tokens=6)]
+        return rids, b.run()
+
+    rp, plain = run(False)
+    rs, spec = run(True)
+    for a, b2 in zip(rp, rs):
+        assert plain[a] == spec[b2]
+
+
+def test_spec_batcher_near_capacity(models):
+    """REGRESSION (r4 review): a request filling its slot exactly
+    (prompt + max_new_tokens == max_len) makes the last verify write k+1
+    slots past the frontier — without headroom, dynamic_update_slice CLAMPS
+    the start and silently corrupts the last committed slot's KV.  The
+    padded cache must keep tokens bit-identical to the plain batcher."""
+    cfg, params, dcfg, dparams = models
+    max_len = 32
+    prompt = [7, 1, 9, 4, 2, 8, 3, 5]          # 8 tokens
+    reqs = [(prompt, max_len - len(prompt))]   # fills the slot exactly
+
+    def run(spec):
+        b = ContinuousBatcher(
+            cfg, params, batch_slots=1, max_len=max_len, chunk_steps=4,
+            **(dict(draft_params=dparams, draft_cfg=dcfg, spec_k=4)
+               if spec else {}),
+        )
+        rids = [b.submit(ids, max_new_tokens=n) for ids, n in reqs]
+        return rids, b.run()
+
+    rp, plain = run(False)
+    rs, spec = run(True)
+    assert len(plain[rp[0]]) == max_len - len(prompt)
+    assert plain[rp[0]] == spec[rs[0]]
+
+
+def test_spec_batcher_guards(models):
+    cfg, params, dcfg, dparams = models
+    with pytest.raises(ValueError, match="draft_cfg"):
+        ContinuousBatcher(cfg, params, max_len=64, draft_params=dparams)
+    with pytest.raises(ValueError, match="greedy-only"):
+        ContinuousBatcher(cfg, params, max_len=64, draft_params=dparams,
+                          draft_cfg=dcfg, temperature=0.5)
+    with pytest.raises(ValueError, match="single-device"):
+        ContinuousBatcher(cfg, params, draft_params=dparams, draft_cfg=dcfg,
+                          paged_pages=8, page_size=16, max_len=64)
+    with pytest.raises(ValueError, match="vocab"):
+        bad = presets.get_preset("llama-tiny", vocab_size=97)
+        ContinuousBatcher(cfg, params, max_len=64,
+                          draft_params=model_lib.init_params(
+                              jax.random.key(1), bad), draft_cfg=bad)
+
+
+def test_engine_spec_batcher_wiring():
+    """RuntimeConfig(spec_decode=True): continuous_batcher() defaults to
+    speculative mode with the engine's attached self-draft, and its results
+    match the plain batcher."""
+    from distributed_llms_tpu.core.config import RuntimeConfig
+    from distributed_llms_tpu.runtime.engine import InferenceEngine
+
+    rt = RuntimeConfig(max_decode_steps=8, max_seq_len=64, spec_decode=True,
+                       spec_k=3)
+    eng = InferenceEngine.from_preset("llama-tiny", rt, vocab_size=300,
+                                      max_seq_len=64)
+    b = eng.continuous_batcher(batch_slots=2, max_len=48)
+    assert b.speculative
+    rids = [b.submit("hello", max_new_tokens=6),
+            b.submit("cat", max_new_tokens=4)]
+    res = b.run()
+    plain = eng.continuous_batcher(batch_slots=2, max_len=48,
+                                   speculative=False)
+    assert not plain.speculative
+    rp = [plain.submit("hello", max_new_tokens=6),
+          plain.submit("cat", max_new_tokens=4)]
+    resp = plain.run()
+    for a, c in zip(rids, rp):
+        assert res[a] == resp[c]
