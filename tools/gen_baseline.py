@@ -71,7 +71,8 @@ def generate(ladder_path: str) -> str:
     listed = [str(e["config"]) for e in bench.LADDER] + [
         # Aux rows run_ladder appends after the decode configs.
         "serving-latency", "continuous-batching", "paged-batching",
-        "ragged-decode-8k",
+        "ragged-decode-8k", "quant-matmul-bw", "spec-decode",
+        "spec-decode-7b-int8",
         "prefill-flash-2048", "prefill-flash-8192", "hop-latency",
     ]
     extras = [c for c in rows if c not in listed]
