@@ -266,17 +266,23 @@ def speculative_generate_tokens(
                     jnp.int32
                 )
                 q = jax.nn.softmax(warped, axis=-1)          # [B, V]
-            else:
-                nxt = jnp.argmax(step_logits, axis=-1).astype(jnp.int32)
-                q = jnp.zeros((b, 0), step_logits.dtype)     # unused
-            return (drf_cache, nxt), (nxt, q)
+                return (drf_cache, nxt), (nxt, q)
+            # Greedy emits only the token — no zero-sized q placeholder
+            # through the scan (0-element carries inside scan-in-while_loop
+            # are exactly the shape XLA:CPU handles worst).
+            nxt = jnp.argmax(step_logits, axis=-1).astype(jnp.int32)
+            return (drf_cache, nxt), nxt
 
-        (drf_cache, _), (drafts, qs) = jax.lax.scan(
+        (drf_cache, _), draft_ys = jax.lax.scan(
             draft_step, (drf_cache, y),
             (jnp.arange(k, dtype=jnp.int32), jax.random.split(kd, k)),
         )
-        drafts = drafts.T            # [B, k]: d_1..d_k
-        qs = jnp.moveaxis(qs, 0, 1)  # [B, k, V] (V == 0 when greedy)
+        if sampled:
+            drafts, qs = draft_ys
+            qs = jnp.moveaxis(qs, 0, 1)  # [B, k, V]
+        else:
+            drafts, qs = draft_ys, None
+        drafts = drafts.T                # [B, k]: d_1..d_k
 
         # --- verify: ONE target forward over [y, d_1..d_k] (k+1 tokens).
         vtoks = jnp.concatenate([y[:, None], drafts], axis=1)  # [B, k+1]

@@ -196,6 +196,12 @@ def test_ragged_batch_windowed_decode_matches_solo():
         np.testing.assert_array_equal(batch[i], solo[0])
 
 
+@pytest.mark.skipif(
+    __import__("os").environ.get("DLT_RUN_ISOLATED") != "1",
+    reason="speculative while_loop compiles segfault XLA:CPU in long-lived "
+           "processes; exercised by tests/runtime/test_isolated.py in a "
+           "fresh process (see test_speculative.py fragile_xla_cpu)",
+)
 def test_ragged_windowed_speculative_matches_generate():
     """Same regression through the speculative loop (shares the layout)."""
     from distributed_llms_tpu.runtime import generate as gen_lib

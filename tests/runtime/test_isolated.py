@@ -1,0 +1,44 @@
+"""Fresh-process runner for tests XLA:CPU cannot compile reliably in a
+long-lived process.
+
+The speculative while_loop programs (two model scans inlined into one
+loop) nondeterministically SEGFAULT the XLA:CPU compiler when compiled
+after ~150 other tests have run in the same process — 5/5 full-suite runs
+on 2026-07-31 crashed there, on five different members of the family
+(int4-draft, engine-level, lax.map-batched) and at three different stages
+(backend_compile_and_load, persistent-cache serialize, deserialize) —
+while every fresh-process run passes.  The whole speculative test family
+is therefore marked skip-unless-DLT_RUN_ISOLATED in its home files
+(module-level pytestmark) and executed here in ONE fresh subprocess —
+full coverage, crash domain isolated, and a real failure in those tests
+still fails the suite loudly through this runner.
+"""
+
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+ISOLATED = [
+    "tests/runtime/test_speculative.py",
+    "tests/runtime/test_spec_batcher.py",
+    # Every OTHER test that compiles a speculative while_loop program —
+    # grep for speculative_generate_tokens when adding tests outside the
+    # two files above.
+    "tests/models/test_sliding_window.py::"
+    "test_ragged_windowed_speculative_matches_generate",
+]
+
+
+def test_fragile_xla_cpu_tests_in_fresh_process():
+    r = subprocess.run(
+        [sys.executable, "-m", "pytest", "-q", "-p", "no:cacheprovider",
+         *ISOLATED],
+        env={**os.environ, "DLT_RUN_ISOLATED": "1"},
+        capture_output=True, text=True, timeout=1800, cwd=REPO,
+    )
+    assert r.returncode == 0, (
+        f"isolated fragile tests failed (rc={r.returncode}):\n"
+        f"{r.stdout[-3000:]}\n{r.stderr[-2000:]}"
+    )

@@ -8,6 +8,7 @@ path hard; draft == target exercises full acceptance (a == k every round).
 """
 
 import dataclasses
+import os
 
 import jax
 import jax.numpy as jnp
@@ -17,6 +18,23 @@ import pytest
 from distributed_llms_tpu.models import model as model_lib, presets
 from distributed_llms_tpu.runtime import generate as gen_lib
 from distributed_llms_tpu.runtime.speculative import speculative_generate_tokens
+
+# XLA:CPU nondeterministically SEGFAULTS compiling the speculative
+# while_loop programs (two model scans inlined into one loop) — but only in
+# a process that has already run ~150+ other tests (5/5 full-suite runs
+# crashed on 2026-07-31, on five different members of the family —
+# int4-draft, engine-level, lax.map-batched — and at three different
+# stages: backend_compile_and_load, persistent-cache serialize, and
+# deserialize; every fresh-process run passes).  The ENTIRE speculative
+# test family therefore runs in a FRESH subprocess via test_isolated.py
+# and is skipped in the main process.  This is an XLA:CPU compiler
+# robustness issue, not a product bug: TPU uses a different compiler.
+fragile_xla_cpu = pytest.mark.skipif(
+    os.environ.get("DLT_RUN_ISOLATED") != "1",
+    reason="speculative while_loop compiles segfault XLA:CPU in long-lived "
+           "processes; exercised by test_isolated.py in a fresh process",
+)
+pytestmark = fragile_xla_cpu
 
 
 @pytest.fixture(scope="module")
