@@ -404,6 +404,10 @@ def config_from_hf(hf_config: Mapping[str, Any]) -> ModelConfig:
                 "Qwen2 with use_sliding_window=True is not supported "
                 "(global attention only)"
             )
+        if hf_config.get("rope_scaling"):
+            raise ValueError(
+                "qwen2 rope_scaling is not supported (plain RoPE only)"
+            )
         return ModelConfig(
             family="llama",
             qkv_bias=True,
@@ -426,6 +430,10 @@ def config_from_hf(hf_config: Mapping[str, Any]) -> ModelConfig:
         # embeddings.  Gemma-2 (model_type "gemma2": logit softcapping,
         # alternating local attention) is a different architecture —
         # rejected by falling through to the ValueError below.
+        if hf_config.get("rope_scaling"):
+            raise ValueError(
+                "gemma rope_scaling is not supported (plain RoPE only)"
+            )
         act = hf_config.get("hidden_activation") or hf_config.get("hidden_act")
         if act not in (None, "gelu_pytorch_tanh"):
             # HF honors an explicit exact-erf "gelu" here; reject rather
@@ -461,6 +469,10 @@ def config_from_hf(hf_config: Mapping[str, Any]) -> ModelConfig:
         # v0.2+ releases set sliding_window null (global attention) — both
         # map cleanly.  window >= max_position_embeddings degenerates to
         # global causal; keep None there so the mask stays the cheap one.
+        if hf_config.get("rope_scaling"):
+            raise ValueError(
+                "mistral rope_scaling is not supported (plain RoPE only)"
+            )
         window = hf_config.get("sliding_window")
         max_len = hf_config.get("max_position_embeddings", 32768)
         if window is not None and window >= max_len:
@@ -518,8 +530,29 @@ def config_from_hf(hf_config: Mapping[str, Any]) -> ModelConfig:
             tie_embeddings=hf_config.get("tie_word_embeddings", False),
         )
     if model_type in ("llama", "mixtral") or "llama" in arch or "mixtral" in arch:
+        rs = hf_config.get("rope_scaling") or {}
+        rope_kw = {}
+        if rs:
+            # Llama-3.1/3.2's "llama3" frequency rescale is implemented
+            # (models/layers.rope_frequencies); any other scheme (linear,
+            # dynamic NTK, yarn) would silently serve wrong positions.
+            rtype = rs.get("rope_type") or rs.get("type")
+            if rtype != "llama3":
+                raise ValueError(
+                    f"unsupported rope_scaling type {rtype!r} "
+                    "(llama3 only)"
+                )
+            rope_kw = dict(
+                rope_scaling_factor=float(rs["factor"]),
+                rope_low_freq_factor=float(rs.get("low_freq_factor", 1.0)),
+                rope_high_freq_factor=float(rs.get("high_freq_factor", 4.0)),
+                rope_original_max_len=int(
+                    rs.get("original_max_position_embeddings", 8192)
+                ),
+            )
         return ModelConfig(
             family="llama",
+            **rope_kw,
             # Community fine-tunes sometimes enable projection biases on the
             # llama architecture; converting them without the bias leaves
             # would be silently wrong logits.

@@ -48,6 +48,16 @@ class ModelConfig:
     head_dim: int | None = None  # default hidden_size // num_heads
     max_seq_len: int = 1024
     rope_theta: float = 10000.0
+    # Llama-3.1-style rope scaling ("rope_type": "llama3"): piecewise
+    # frequency rescale that stretches low-frequency (long-wavelength)
+    # components by `factor` while keeping high-frequency ones, with a
+    # smooth ramp between — how 3.1/3.2 extend 8k-trained RoPE to 128k.
+    # factor == 1.0 disables (plain RoPE).  Other HF rope_type values
+    # (linear, dynamic, yarn, longrope) are rejected at convert.
+    rope_scaling_factor: float = 1.0
+    rope_low_freq_factor: float = 1.0
+    rope_high_freq_factor: float = 4.0
+    rope_original_max_len: int = 8192
     norm_eps: float = 1e-5
     tie_embeddings: bool = True
     dtype: str = "bfloat16"

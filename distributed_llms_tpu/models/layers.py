@@ -48,21 +48,43 @@ def rms_norm(x: jax.Array, scale: jax.Array, eps: float) -> jax.Array:
 # Rotary position embeddings (Llama family)
 # ---------------------------------------------------------------------------
 
-def rope_frequencies(head_dim: int, theta: float) -> jax.Array:
-    """Inverse frequencies, shape [head_dim // 2], float32."""
+def rope_frequencies(
+    head_dim: int, theta: float,
+    scaling: tuple[float, float, float, int] | None = None,
+) -> jax.Array:
+    """Inverse frequencies, shape [head_dim // 2], float32.
+
+    ``scaling`` = (factor, low_freq_factor, high_freq_factor,
+    original_max_len) applies Llama-3.1's piecewise rescale (HF
+    _compute_llama3_parameters): wavelengths beyond
+    original_max_len/low_freq_factor divide by ``factor`` (stretched for
+    long context), wavelengths under original_max_len/high_freq_factor
+    keep their frequency, and the band between interpolates smoothly.
+    """
     exponent = jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim
-    return 1.0 / (theta**exponent)
+    inv_freq = 1.0 / (theta**exponent)
+    if scaling is not None:
+        factor, low, high, old_len = scaling
+        wavelen = 2.0 * jnp.pi / inv_freq
+        smooth = (old_len / wavelen - low) / (high - low)
+        smooth = jnp.clip(smooth, 0.0, 1.0)  # 0 = fully scaled, 1 = kept
+        inv_freq = (1.0 - smooth) * inv_freq / factor + smooth * inv_freq
+    return inv_freq
 
 
-def apply_rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
+def apply_rope(
+    x: jax.Array, positions: jax.Array, theta: float,
+    scaling: tuple[float, float, float, int] | None = None,
+) -> jax.Array:
     """Rotate half-pairs.  x: [B, T, H, D]; positions: [B, T] int32.
 
     Uses the HF/Llama convention: the head dim is split into two halves
     (x1 = x[..., :D/2], x2 = x[..., D/2:]) rotated jointly — matches the
-    checkpoint layout our converter targets.
+    checkpoint layout our converter targets.  ``scaling`` is the Llama-3.1
+    frequency rescale (see rope_frequencies).
     """
     half = x.shape[-1] // 2
-    freqs = rope_frequencies(x.shape[-1], theta)  # [half]
+    freqs = rope_frequencies(x.shape[-1], theta, scaling)  # [half]
     angles = positions[..., None].astype(jnp.float32) * freqs  # [B, T, half]
     cos = jnp.cos(angles)[:, :, None, :]  # [B, T, 1, half]
     sin = jnp.sin(angles)[:, :, None, :]

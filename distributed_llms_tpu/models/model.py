@@ -97,8 +97,13 @@ def _attention(
 ) -> tuple[jax.Array, tuple[jax.Array, jax.Array] | None]:
     q, k, v = layers.qkv_project(x, p, cfg)
     if use_rope:
-        q = layers.apply_rope(q, positions, cfg.rope_theta)
-        k = layers.apply_rope(k, positions, cfg.rope_theta)
+        rope_scale = (
+            (cfg.rope_scaling_factor, cfg.rope_low_freq_factor,
+             cfg.rope_high_freq_factor, cfg.rope_original_max_len)
+            if cfg.rope_scaling_factor != 1.0 else None  # Llama-3.1 rescale
+        )
+        q = layers.apply_rope(q, positions, cfg.rope_theta, rope_scale)
+        k = layers.apply_rope(k, positions, cfg.rope_theta, rope_scale)
 
     if kv_tables is not None:
         if layer_cache is None or getattr(cache_index, "ndim", 0) != 1 or x.shape[1] != 1:

@@ -46,6 +46,13 @@ PRESETS: dict[str, ModelConfig] = {
         num_layers=32, num_heads=32, num_kv_heads=8, max_seq_len=8192,
         rope_theta=500000.0, norm_eps=1e-5, tie_embeddings=False,
     ),
+    "llama-3.1-8b": ModelConfig(
+        family="llama", vocab_size=128256, hidden_size=4096, intermediate_size=14336,
+        num_layers=32, num_heads=32, num_kv_heads=8, max_seq_len=131072,
+        rope_theta=500000.0, norm_eps=1e-5, tie_embeddings=False,
+        rope_scaling_factor=8.0, rope_low_freq_factor=1.0,
+        rope_high_freq_factor=4.0, rope_original_max_len=8192,
+    ),
     "llama-3-70b": ModelConfig(
         family="llama", vocab_size=128256, hidden_size=8192, intermediate_size=28672,
         num_layers=80, num_heads=64, num_kv_heads=8, max_seq_len=8192,
@@ -114,6 +121,7 @@ HF_REPOS: dict[str, str] = {
     "llama-2-7b": "meta-llama/Llama-2-7b-hf",
     "llama-2-13b": "meta-llama/Llama-2-13b-hf",
     "llama-3-8b": "meta-llama/Meta-Llama-3-8B",
+    "llama-3.1-8b": "meta-llama/Llama-3.1-8B",
     "llama-3-70b": "meta-llama/Meta-Llama-3-70B",
     "qwen2-7b": "Qwen/Qwen2-7B",
     "gemma-7b": "google/gemma-7b",

@@ -38,9 +38,10 @@ jax.config.update("jax_platforms", "cpu")
 # dropped it in the same change: prefix-restored caches would also cross
 # heterogeneous runner CPU generations — the exact machine-feature
 # mismatch XLA's loader warns may SIGILL); this knob exists for local
-# iteration on a single box at the operator's own risk.
+# iteration on a single box at the operator's own risk.  (One knob only:
+# to disable, unset DLT_TEST_CACHE_DIR.)
 _cache_dir = os.environ.get("DLT_TEST_CACHE_DIR")
-if _cache_dir and os.environ.get("DLT_TEST_NO_CACHE") != "1":
+if _cache_dir:
     jax.config.update("jax_compilation_cache_dir", _cache_dir)
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
     jax.config.update("jax_persistent_cache_enable_xla_caches", "none")

@@ -153,6 +153,33 @@ def _hf_gemma_pair():
     return hf_model, cfg, params
 
 
+def _hf_llama31_pair():
+    """Llama-3.1-style rope_scaling (rope_type llama3): the tiny
+    original_max_position_embeddings forces several frequencies into the
+    scaled and smoothed bands, so the piecewise rescale is live."""
+    import torch
+    from transformers import LlamaConfig, LlamaForCausalLM
+
+    hf_cfg = LlamaConfig(
+        vocab_size=97, hidden_size=32, intermediate_size=88,
+        num_hidden_layers=3, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=64, rms_norm_eps=1e-5,
+        tie_word_embeddings=False, attention_dropout=0.0,
+        rope_theta=10000.0,
+        rope_scaling={"rope_type": "llama3", "factor": 8.0,
+                      "low_freq_factor": 1.0, "high_freq_factor": 4.0,
+                      "original_max_position_embeddings": 16},
+    )
+    torch.manual_seed(0)
+    hf_model = LlamaForCausalLM(hf_cfg).eval()
+    cfg = convert.config_from_hf(hf_cfg.to_dict())
+    assert cfg.rope_scaling_factor == 8.0 and cfg.rope_original_max_len == 16
+    cfg = cfg.__class__(**{**cfg.__dict__, "dtype": "float32"})
+    sd = convert.torch_state_dict_to_numpy(hf_model.state_dict())
+    params = convert.convert_state_dict(sd, cfg)
+    return hf_model, cfg, params
+
+
 def _hf_phi3_pair():
     import torch
     from transformers import Phi3Config, Phi3ForCausalLM
@@ -180,8 +207,8 @@ def _hf_phi3_pair():
 @pytest.mark.parametrize(
     "maker",
     [_hf_gpt2_pair, _hf_llama_pair, _hf_opt_pair, _hf_qwen2_pair,
-     _hf_gemma_pair, _hf_phi3_pair],
-    ids=["gpt2", "llama", "opt", "qwen2", "gemma", "phi3"],
+     _hf_gemma_pair, _hf_phi3_pair, _hf_llama31_pair],
+    ids=["gpt2", "llama", "opt", "qwen2", "gemma", "phi3", "llama31"],
 )
 def test_golden_parity_vs_transformers(maker):
     import torch
@@ -197,6 +224,25 @@ def test_golden_parity_vs_transformers(maker):
 def test_config_from_hf_rejects_unknown():
     with pytest.raises(ValueError):
         convert.config_from_hf({"model_type": "mamba"})
+
+
+def test_config_from_hf_rejects_non_llama3_rope_scaling():
+    base = dict(
+        model_type="llama", vocab_size=100, hidden_size=32,
+        intermediate_size=88, num_hidden_layers=2, num_attention_heads=4,
+        num_key_value_heads=2, max_position_embeddings=4096,
+    )
+    for rtype in ("linear", "dynamic", "yarn"):
+        with pytest.raises(ValueError, match="rope_scaling"):
+            convert.config_from_hf(
+                {**base, "rope_scaling": {"rope_type": rtype, "factor": 2.0}}
+            )
+    for mt in ("mistral", "qwen2", "gemma"):
+        with pytest.raises(ValueError, match="rope_scaling"):
+            convert.config_from_hf(
+                {**base, "model_type": mt,
+                 "rope_scaling": {"rope_type": "yarn", "factor": 2.0}}
+            )
 
 
 def test_config_from_hf_phi3_rejects_longrope():
