@@ -630,6 +630,67 @@ def _measure_speculative(
     return out
 
 
+def _measure_spec_batching(
+    preset: str = "tinyllama-1.1b", dtype: str = "bfloat16",
+    target_quant: str = "int8", slots: int = 4, requests: int = 12,
+    k: int = 4,
+) -> dict:
+    """Speculative vs plain continuous batching on mixed-length traffic:
+    same requests, same (quantized) target, same scheduler — the spec
+    variant drafts with the int4 self-draft and verifies k+1 tokens per
+    target forward.  Results are asserted bit-identical; only rounds-per-
+    token changes.  Quantized target so target and draft share the same
+    on-device-generated base weights (cf. the spec-decode rows)."""
+    import numpy as np
+
+    from distributed_llms_tpu.runtime.batcher import ContinuousBatcher
+
+    cfg, tparams = _build_params(preset, dtype, target_quant)
+    _, dparams = _build_params(preset, dtype, "int4")
+    rng = np.random.RandomState(0)
+    lens = rng.randint(8, 65, size=requests)
+    budgets = rng.choice([8, 8, 12, 16, 16, 24, 32], size=requests).astype(
+        np.int64
+    )
+    prompts = [rng.randint(1, cfg.vocab_size, size=n).tolist() for n in lens]
+    total_new = int(budgets.sum())
+
+    def run(spec: bool):
+        b = ContinuousBatcher(
+            cfg, tparams, batch_slots=slots, max_len=128, chunk_steps=8,
+            **(dict(draft_params=dparams, draft_cfg=cfg, spec_k=k)
+               if spec else {}),
+        )
+        rids = [b.submit(p, max_new_tokens=int(n))
+                for p, n in zip(prompts, budgets)]
+        t0 = time.perf_counter()
+        res = b.run()
+        return time.perf_counter() - t0, [res[r] for r in rids]
+
+    # Warm compiles outside the timed runs.
+    run(False)
+    run(True)
+    t_plain, out_plain = run(False)
+    t_spec, out_spec = run(True)
+    exact = out_plain == out_spec
+    out = {
+        "preset": preset,
+        "quant": target_quant,
+        "draft": "self-int4",
+        "k": k,
+        "slots": slots,
+        "requests": requests,
+        "platform": jax.devices()[0].platform,
+        "exact_vs_plain": bool(exact),
+        "tok_per_s_plain": round(total_new / t_plain, 2),
+        "tok_per_s_spec": round(total_new / t_spec, 2),
+        "speedup": round(t_plain / t_spec, 3),
+    }
+    if not exact:
+        out["note"] = "EXACTNESS FAILED: speculative batcher != plain"
+    return out
+
+
 def _measure_ragged_decode(
     preset: str = "tinyllama-1.1b", dtype: str = "bfloat16",
     max_len: int = 8192, slots: int = 8, iters: int = 5,
@@ -1095,7 +1156,7 @@ def run_ladder(args, degraded: str | None) -> list[dict]:
             "serving-latency", "continuous-batching", "paged-batching",
             "ragged-decode-8k", "quant-matmul-bw", "prefill-flash-2048",
             "prefill-flash-8192", "hop-latency", "spec-decode",
-            "spec-decode-7b-int8",
+            "spec-decode-7b-int8", "spec-batching",
         }
         unknown = only - known
         if unknown:  # a typo must not masquerade as a clean zero-row run
@@ -1226,6 +1287,7 @@ def run_ladder(args, degraded: str | None) -> list[dict]:
                 iters=args.iters)),
             ("spec-decode-7b-int8", lambda: _measure_speculative(
                 "llama-2-7b", dtype, target_quant="int8", iters=args.iters)),
+            ("spec-batching", lambda: _measure_spec_batching(dtype=dtype)),
         ]
         aux += [
             (f"prefill-flash-{seq}", functools.partial(

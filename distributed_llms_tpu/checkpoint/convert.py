@@ -542,10 +542,22 @@ def config_from_hf(hf_config: Mapping[str, Any]) -> ModelConfig:
                     f"unsupported rope_scaling type {rtype!r} "
                     "(llama3 only)"
                 )
+            if "factor" not in rs:
+                raise ValueError("llama3 rope_scaling needs a 'factor'")
+            low = float(rs.get("low_freq_factor", 1.0))
+            high = float(rs.get("high_freq_factor", 4.0))
+            if high <= low:
+                # The smooth band divides by (high - low): equal factors
+                # would serve NaN frequencies, inverted ones a reversed
+                # ramp.  HF merely warns here; reject loudly instead.
+                raise ValueError(
+                    f"llama3 rope_scaling needs high_freq_factor ({high}) "
+                    f"> low_freq_factor ({low})"
+                )
             rope_kw = dict(
                 rope_scaling_factor=float(rs["factor"]),
-                rope_low_freq_factor=float(rs.get("low_freq_factor", 1.0)),
-                rope_high_freq_factor=float(rs.get("high_freq_factor", 4.0)),
+                rope_low_freq_factor=low,
+                rope_high_freq_factor=high,
                 rope_original_max_len=int(
                     rs.get("original_max_position_embeddings", 8192)
                 ),

@@ -243,6 +243,18 @@ def test_config_from_hf_rejects_non_llama3_rope_scaling():
                 {**base, "model_type": mt,
                  "rope_scaling": {"rope_type": "yarn", "factor": 2.0}}
             )
+    # Malformed llama3 blocks fail loudly too — a zero-width smooth band
+    # would serve NaN frequencies, a missing factor a bare KeyError.
+    with pytest.raises(ValueError, match="factor"):
+        convert.config_from_hf(
+            {**base, "rope_scaling": {"rope_type": "llama3"}}
+        )
+    with pytest.raises(ValueError, match="high_freq_factor"):
+        convert.config_from_hf(
+            {**base, "rope_scaling": {"rope_type": "llama3", "factor": 8.0,
+                                      "low_freq_factor": 2.0,
+                                      "high_freq_factor": 2.0}}
+        )
 
 
 def test_config_from_hf_phi3_rejects_longrope():

@@ -72,7 +72,7 @@ def generate(ladder_path: str) -> str:
         # Aux rows run_ladder appends after the decode configs.
         "serving-latency", "continuous-batching", "paged-batching",
         "ragged-decode-8k", "quant-matmul-bw", "spec-decode",
-        "spec-decode-7b-int8",
+        "spec-decode-7b-int8", "spec-batching",
         "prefill-flash-2048", "prefill-flash-8192", "hop-latency",
     ]
     extras = [c for c in rows if c not in listed]
