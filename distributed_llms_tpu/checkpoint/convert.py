@@ -323,10 +323,61 @@ def convert_opt(sd: StateDict, cfg: ModelConfig) -> dict[str, Any]:
     )
 
 
+def convert_neox(sd: StateDict, cfg: ModelConfig) -> dict[str, Any]:
+    """GPT-NeoX/Pythia: fused ``query_key_value`` is INTERLEAVED PER HEAD —
+    torch weight [(H*3*HD), D] reshapes to (H, 3, HD, D) with q/k/v adjacent
+    within each head (GPTNeoXAttention), unlike Phi-3's q|k|v block layout.
+    nn.Linear weights are [out, in] -> transpose."""
+    sd = _strip_prefix(sd, ("gpt_neox.",))
+    D, H, HD = cfg.hidden_size, cfg.num_heads, cfg.head_dim_
+    L = cfg.num_layers
+    if cfg.num_kv_heads != H:
+        raise ValueError("neox is multi-head only (num_kv_heads == num_heads)")
+
+    def qkv_w(w, which):  # [(H*3*HD), D] -> [D, H, HD]
+        return np.asarray(w).reshape(H, 3, HD, D)[:, which].transpose(2, 0, 1)
+
+    def qkv_b(b, which):  # [(H*3*HD)] -> [H, HD]
+        return np.asarray(b).reshape(H, 3, HD)[:, which]
+
+    params = {
+        "embed": {"wte": np.asarray(sd["embed_in.weight"])},
+        "final_norm": {
+            "scale": np.asarray(sd["final_layer_norm.weight"]),
+            "bias": np.asarray(sd["final_layer_norm.bias"]),
+        },
+        "blocks": {
+            "ln1": {"scale": _stack(sd, "layers.{i}.input_layernorm.weight", L, lambda w: w),
+                    "bias": _stack(sd, "layers.{i}.input_layernorm.bias", L, lambda w: w)},
+            "ln2": {"scale": _stack(sd, "layers.{i}.post_attention_layernorm.weight", L, lambda w: w),
+                    "bias": _stack(sd, "layers.{i}.post_attention_layernorm.bias", L, lambda w: w)},
+            "attn": {
+                "wq": _stack(sd, "layers.{i}.attention.query_key_value.weight", L, lambda w: qkv_w(w, 0)),
+                "wk": _stack(sd, "layers.{i}.attention.query_key_value.weight", L, lambda w: qkv_w(w, 1)),
+                "wv": _stack(sd, "layers.{i}.attention.query_key_value.weight", L, lambda w: qkv_w(w, 2)),
+                "bq": _stack(sd, "layers.{i}.attention.query_key_value.bias", L, lambda b: qkv_b(b, 0)),
+                "bk": _stack(sd, "layers.{i}.attention.query_key_value.bias", L, lambda b: qkv_b(b, 1)),
+                "bv": _stack(sd, "layers.{i}.attention.query_key_value.bias", L, lambda b: qkv_b(b, 2)),
+                "wo": _stack(sd, "layers.{i}.attention.dense.weight", L, lambda w: w.T.reshape(H, HD, D)),
+                "bo": _stack(sd, "layers.{i}.attention.dense.bias", L, lambda b: b),
+            },
+            "mlp": {
+                "w_in": _stack(sd, "layers.{i}.mlp.dense_h_to_4h.weight", L, lambda w: w.T),
+                "b_in": _stack(sd, "layers.{i}.mlp.dense_h_to_4h.bias", L, lambda b: b),
+                "w_out": _stack(sd, "layers.{i}.mlp.dense_4h_to_h.weight", L, lambda w: w.T),
+                "b_out": _stack(sd, "layers.{i}.mlp.dense_4h_to_h.bias", L, lambda b: b),
+            },
+        },
+        "lm_head": {"w": np.asarray(sd["embed_out.weight"]).T},
+    }
+    return params
+
+
 CONVERTERS: dict[str, Callable[[StateDict, ModelConfig], dict[str, Any]]] = {
     "gpt2": convert_gpt2,
     "opt": convert_opt,
     "llama": convert_llama,
+    "neox": convert_neox,
 }
 
 
@@ -343,11 +394,19 @@ def convert_state_dict(sd: StateDict, cfg: ModelConfig, dtype: Any = None) -> di
     return jax.tree.map(lambda x: jnp.asarray(x, dtype=target), tree)
 
 
-def _opt_activation(name: str) -> str:
-    table = {"relu": "relu", "gelu": "gelu_exact", "gelu_new": "gelu"}
+def _gelu_relu_activation(name: str, what: str) -> str:
+    """Map HF activation names onto layers.mlp_gelu's (HF 'gelu' is the
+    exact erf form; 'gelu_new'/'gelu_fast' the tanh approximation) — shared
+    by the OPT and NeoX config branches so the alias table lives once."""
+    table = {"relu": "relu", "gelu": "gelu_exact", "gelu_new": "gelu",
+             "gelu_fast": "gelu"}
     if name not in table:
-        raise ValueError(f"unsupported OPT activation_function {name!r}")
+        raise ValueError(f"unsupported {what} {name!r}")
     return table[name]
+
+
+def _opt_activation(name: str) -> str:
+    return _gelu_relu_activation(name, "OPT activation_function")
 
 
 def config_from_hf(hf_config: Mapping[str, Any]) -> ModelConfig:
@@ -493,6 +552,40 @@ def config_from_hf(hf_config: Mapping[str, Any]) -> ModelConfig:
             rope_theta=hf_config.get("rope_theta", 10000.0),
             norm_eps=hf_config.get("rms_norm_eps", 1e-5),
             tie_embeddings=hf_config.get("tie_word_embeddings", False),
+        )
+    if model_type == "gpt_neox" or "gptneoxfor" in arch:
+        # GPT-NeoX / Pythia: LayerNorm + partial rotary + parallel residual
+        # (its own block flavour and converter — models.model.neox_block,
+        # convert_neox).
+        if hf_config.get("tie_word_embeddings", False):
+            # init_params/unembed treat neox as untied (embed_out); a tied
+            # checkpoint would carry a dead lm_head tensor in HBM.
+            raise ValueError("tied-embedding gpt_neox is not supported")
+        if hf_config.get("rope_scaling"):
+            raise ValueError(
+                "gpt_neox rope_scaling is not supported (plain rotary only)"
+            )
+        if hf_config.get("attention_bias", True) is False:
+            raise ValueError("gpt_neox without attention biases unsupported")
+        return ModelConfig(
+            family="neox",
+            vocab_size=hf_config["vocab_size"],
+            hidden_size=hf_config["hidden_size"],
+            intermediate_size=hf_config["intermediate_size"],
+            num_layers=hf_config["num_hidden_layers"],
+            num_heads=hf_config["num_attention_heads"],
+            num_kv_heads=hf_config["num_attention_heads"],
+            max_seq_len=hf_config.get("max_position_embeddings", 2048),
+            rope_theta=float(hf_config.get("rotary_emb_base", 10000)),
+            rotary_pct=float(hf_config.get("rotary_pct", 1.0)),
+            parallel_residual=bool(
+                hf_config.get("use_parallel_residual", True)
+            ),
+            norm_eps=hf_config.get("layer_norm_eps", 1e-5),
+            tie_embeddings=False,
+            activation=_gelu_relu_activation(
+                hf_config.get("hidden_act", "gelu"), "neox hidden_act"
+            ),
         )
     if model_type == "phi3" or "phi3for" in arch:
         # Phi-3 = llama layout with fused qkv/gate_up projections (split at

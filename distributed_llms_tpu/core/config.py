@@ -38,7 +38,7 @@ class ModelConfig:
     (run_master.py:17, facebook/opt-125m).
     """
 
-    family: str = "gpt2"  # "gpt2" | "opt" | "llama"
+    family: str = "gpt2"  # "gpt2" | "opt" | "llama" | "neox"
     vocab_size: int = 50257
     hidden_size: int = 768
     intermediate_size: int = 3072
@@ -58,6 +58,12 @@ class ModelConfig:
     rope_low_freq_factor: float = 1.0
     rope_high_freq_factor: float = 4.0
     rope_original_max_len: int = 8192
+    # GPT-NeoX/Pythia: rotate only the first rotary_pct of each head's dims
+    # (partial rotary); the rest pass through position-free.
+    rotary_pct: float = 1.0
+    # GPT-NeoX/Pythia parallel residual: x + attn(ln1 x) + mlp(ln2 x)
+    # (HF use_parallel_residual; False = sequential pre-LN like GPT-2).
+    parallel_residual: bool = False
     norm_eps: float = 1e-5
     tie_embeddings: bool = True
     dtype: str = "bfloat16"
@@ -113,6 +119,18 @@ class ModelConfig:
             # moe_swiglu hardcodes silu (Mixtral); accepting another
             # activation here would silently ignore it.
             raise ValueError("MoE blocks support gate_act='silu' only")
+        if not 0.0 < self.rotary_pct <= 1.0:
+            raise ValueError(
+                f"rotary_pct must be in (0, 1], got {self.rotary_pct}"
+            )
+        if self.rotary_pct < 1.0:
+            rot = int(self.head_dim_ * self.rotary_pct)
+            if rot < 2 or rot % 2:
+                raise ValueError(
+                    f"rotary_pct {self.rotary_pct} of head_dim "
+                    f"{self.head_dim_} gives {rot} rotary dims; need an "
+                    "even count >= 2"
+                )
         if self.sliding_window is not None:
             if self.sliding_window < 1:
                 raise ValueError(

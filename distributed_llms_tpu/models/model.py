@@ -102,8 +102,22 @@ def _attention(
              cfg.rope_high_freq_factor, cfg.rope_original_max_len)
             if cfg.rope_scaling_factor != 1.0 else None  # Llama-3.1 rescale
         )
-        q = layers.apply_rope(q, positions, cfg.rope_theta, rope_scale)
-        k = layers.apply_rope(k, positions, cfg.rope_theta, rope_scale)
+        if cfg.rotary_pct < 1.0:
+            # Partial rotary (GPT-NeoX/Pythia): only the first rotary_pct
+            # of each head's dims rotate; the rest are position-free.
+            rot = int(cfg.head_dim_ * cfg.rotary_pct)
+
+            def _rope(t):
+                return jnp.concatenate(
+                    [layers.apply_rope(t[..., :rot], positions,
+                                       cfg.rope_theta, rope_scale),
+                     t[..., rot:]], axis=-1,
+                )
+
+            q, k = _rope(q), _rope(k)
+        else:
+            q = layers.apply_rope(q, positions, cfg.rope_theta, rope_scale)
+            k = layers.apply_rope(k, positions, cfg.rope_theta, rope_scale)
 
     if kv_tables is not None:
         if layer_cache is None or getattr(cache_index, "ndim", 0) != 1 or x.shape[1] != 1:
@@ -367,7 +381,23 @@ def llama_block(x, p, cfg, positions, layer_cache, cache_index, attn_mask=None, 
     return x, new_cache, jnp.float32(0.0)
 
 
-BLOCK_FNS = {"gpt2": gpt2_block, "opt": gpt2_block, "llama": llama_block}
+def neox_block(x, p, cfg, positions, layer_cache, cache_index, attn_mask=None, std_layout=False, kv_tables=None, key_positions=None):
+    """GPT-NeoX/Pythia: LayerNorm + (partial) rotary + optionally PARALLEL
+    residual — out = x + attn(ln1 x) + mlp(ln2 x), both norms reading the
+    SAME input (HF use_parallel_residual, the NeoX default); sequential
+    pre-LN otherwise.  -> (x, new_cache, aux)."""
+    h = layers.layer_norm(x, p["ln1"]["scale"], p["ln1"]["bias"], cfg.norm_eps)
+    attn_out, new_cache = _attention(h, p["attn"], cfg, positions, layer_cache, cache_index, use_rope=True, attn_mask=attn_mask, std_layout=std_layout, kv_tables=kv_tables, key_positions=key_positions)
+    if cfg.parallel_residual:
+        h2 = layers.layer_norm(x, p["ln2"]["scale"], p["ln2"]["bias"], cfg.norm_eps)
+        return x + attn_out + layers.mlp_gelu(h2, p["mlp"], cfg.activation), new_cache, jnp.float32(0.0)
+    x = x + attn_out
+    h2 = layers.layer_norm(x, p["ln2"]["scale"], p["ln2"]["bias"], cfg.norm_eps)
+    return x + layers.mlp_gelu(h2, p["mlp"], cfg.activation), new_cache, jnp.float32(0.0)
+
+
+BLOCK_FNS = {"gpt2": gpt2_block, "opt": gpt2_block, "llama": llama_block,
+             "neox": neox_block}
 
 
 def run_blocks(
@@ -436,7 +466,7 @@ def embed(params: Params, cfg: ModelConfig, tokens: jax.Array, positions: jax.Ar
 
 
 def unembed(params: Params, cfg: ModelConfig, x: jax.Array) -> jax.Array:
-    if cfg.family in ("gpt2", "opt"):
+    if cfg.family in ("gpt2", "opt", "neox"):
         x = layers.layer_norm(x, params["final_norm"]["scale"], params["final_norm"]["bias"], cfg.norm_eps)
     else:
         x = layers.rms_norm(x, params["final_norm"]["scale"], cfg.norm_eps)
@@ -512,9 +542,10 @@ def init_params(rng: jax.Array, cfg: ModelConfig, dtype: Any = None) -> Params:
         "embed": {"wte": dense(next(keys), (cfg.vocab_size, D), D)},
         "final_norm": {"scale": jnp.ones((D,), dtype)},
     }
-    if cfg.family in ("gpt2", "opt"):
-        pos_rows = cfg.max_seq_len + (2 if cfg.family == "opt" else 0)
-        params["embed"]["wpe"] = dense(next(keys), (pos_rows, D), D)
+    if cfg.family in ("gpt2", "opt", "neox"):
+        if cfg.family != "neox":  # neox uses rotary, not a position table
+            pos_rows = cfg.max_seq_len + (2 if cfg.family == "opt" else 0)
+            params["embed"]["wpe"] = dense(next(keys), (pos_rows, D), D)
         params["final_norm"]["bias"] = jnp.zeros((D,), dtype)
         params["blocks"] = {
             "ln1": {"scale": jnp.ones((L, D), dtype), "bias": jnp.zeros((L, D), dtype)},
@@ -571,6 +602,8 @@ def init_params(rng: jax.Array, cfg: ModelConfig, dtype: Any = None) -> Params:
         raise ValueError(f"unknown family {cfg.family!r}")
     if cfg.num_experts > 0 and cfg.family != "llama":
         raise ValueError("MoE (num_experts > 0) is supported for the llama family")
+    if cfg.family == "neox" and cfg.tie_embeddings:
+        raise ValueError("neox checkpoints untie embeddings (embed_out)")
     if not cfg.tie_embeddings:
         params["lm_head"] = {"w": dense(next(keys), (D, cfg.vocab_size), D)}
     return params

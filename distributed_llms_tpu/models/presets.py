@@ -82,6 +82,13 @@ PRESETS: dict[str, ModelConfig] = {
         max_seq_len=32768, rope_theta=10000.0, norm_eps=1e-5,
         tie_embeddings=False,
     ),
+    "pythia-6.9b": ModelConfig(
+        family="neox", vocab_size=50432, hidden_size=4096,
+        intermediate_size=16384, num_layers=32, num_heads=32,
+        num_kv_heads=32, max_seq_len=2048, rope_theta=10000.0,
+        rotary_pct=0.25, parallel_residual=True, norm_eps=1e-5,
+        tie_embeddings=False, activation="gelu_exact",
+    ),
     "mixtral-8x7b": ModelConfig(
         family="llama", vocab_size=32000, hidden_size=4096, intermediate_size=14336,
         num_layers=32, num_heads=32, num_kv_heads=8, max_seq_len=32768,
@@ -105,6 +112,12 @@ PRESETS: dict[str, ModelConfig] = {
         num_layers=4, num_heads=4, num_kv_heads=4, max_seq_len=128,
         tie_embeddings=True, dtype="float32", activation="relu",
     ),
+    "neox-tiny": ModelConfig(
+        family="neox", vocab_size=256, hidden_size=64, intermediate_size=176,
+        num_layers=3, num_heads=4, num_kv_heads=4, max_seq_len=128,
+        rotary_pct=0.25, parallel_residual=True, tie_embeddings=False,
+        dtype="float32", activation="gelu_exact",
+    ),
     "llama-tiny": ModelConfig(
         family="llama", vocab_size=256, hidden_size=64, intermediate_size=176,
         num_layers=4, num_heads=4, num_kv_heads=2, max_seq_len=128,
@@ -127,6 +140,7 @@ HF_REPOS: dict[str, str] = {
     "gemma-7b": "google/gemma-7b",
     "mistral-7b": "mistralai/Mistral-7B-v0.1",
     "phi-3-mini-4k": "microsoft/Phi-3-mini-4k-instruct",
+    "pythia-6.9b": "EleutherAI/pythia-6.9b",
 }
 
 

@@ -53,15 +53,16 @@ def param_specs(cfg: ModelConfig, mesh: Mesh) -> Params:
         "wv": P(pipe, None, kv_ax, None),
         "wo": P(pipe, q_ax, None, None),
     }
-    if cfg.qkv_bias or cfg.family in ("gpt2", "opt"):
-        # q/k/v biases shard with their head axes (gpt2/opt always carry
-        # them; llama only in the Qwen2-style qkv_bias layout).
+    if cfg.qkv_bias or cfg.family in ("gpt2", "opt", "neox"):
+        # q/k/v biases shard with their head axes (gpt2/opt/neox always
+        # carry them; llama only in the Qwen2-style qkv_bias layout).
         attn.update(
             bq=P(pipe, q_ax, None), bk=P(pipe, kv_ax, None),
             bv=P(pipe, kv_ax, None),
         )
-    if cfg.family in ("gpt2", "opt"):
-        specs["embed"]["wpe"] = P(None, None)
+    if cfg.family in ("gpt2", "opt", "neox"):
+        if cfg.family != "neox":  # neox is rotary — no position table
+            specs["embed"]["wpe"] = P(None, None)
         specs["final_norm"]["bias"] = P(None)
         attn["bo"] = P(pipe, None)
         mlp = {

@@ -11,7 +11,7 @@ from distributed_llms_tpu.models import model, presets
 from distributed_llms_tpu.checkpoint import convert
 
 
-@pytest.mark.parametrize("name", ["gpt2-tiny", "llama-tiny", "opt-tiny"])
+@pytest.mark.parametrize("name", ["gpt2-tiny", "llama-tiny", "opt-tiny", "neox-tiny"])
 def test_forward_shapes(name):
     cfg = presets.get_preset(name)
     params = model.init_params(jax.random.key(0), cfg)
@@ -22,7 +22,7 @@ def test_forward_shapes(name):
     assert cache is None
 
 
-@pytest.mark.parametrize("name", ["gpt2-tiny", "llama-tiny", "opt-tiny"])
+@pytest.mark.parametrize("name", ["gpt2-tiny", "llama-tiny", "opt-tiny", "neox-tiny"])
 def test_kv_cache_matches_full_forward(name):
     cfg = presets.get_preset(name)
     params = model.init_params(jax.random.key(0), cfg)
@@ -204,11 +204,42 @@ def _hf_phi3_pair():
     return hf_model, cfg, params
 
 
+def _hf_neox_pair(parallel=True):
+    """GPT-NeoX/Pythia: interleaved fused qkv, PARTIAL rotary (pct 0.25 of
+    head_dim 16 = 4 rotated dims), parallel residual (the NeoX default)."""
+    import torch
+    from transformers import GPTNeoXConfig, GPTNeoXForCausalLM
+
+    hf_cfg = GPTNeoXConfig(
+        vocab_size=97, hidden_size=64, intermediate_size=176,
+        num_hidden_layers=3, num_attention_heads=4,
+        max_position_embeddings=64, rotary_pct=0.25,
+        use_parallel_residual=parallel, hidden_act="gelu",
+        layer_norm_eps=1e-5, tie_word_embeddings=False,
+        attention_dropout=0.0, hidden_dropout=0.0,
+    )
+    torch.manual_seed(0)
+    hf_model = GPTNeoXForCausalLM(hf_cfg).eval()
+    cfg = convert.config_from_hf(hf_cfg.to_dict())
+    assert cfg.family == "neox" and cfg.rotary_pct == 0.25
+    assert cfg.parallel_residual is parallel
+    cfg = cfg.__class__(**{**cfg.__dict__, "dtype": "float32"})
+    sd = convert.torch_state_dict_to_numpy(hf_model.state_dict())
+    params = convert.convert_state_dict(sd, cfg)
+    return hf_model, cfg, params
+
+
+def _hf_neox_seq_pair():
+    return _hf_neox_pair(parallel=False)
+
+
 @pytest.mark.parametrize(
     "maker",
     [_hf_gpt2_pair, _hf_llama_pair, _hf_opt_pair, _hf_qwen2_pair,
-     _hf_gemma_pair, _hf_phi3_pair, _hf_llama31_pair],
-    ids=["gpt2", "llama", "opt", "qwen2", "gemma", "phi3", "llama31"],
+     _hf_gemma_pair, _hf_phi3_pair, _hf_llama31_pair, _hf_neox_pair,
+     _hf_neox_seq_pair],
+    ids=["gpt2", "llama", "opt", "qwen2", "gemma", "phi3", "llama31",
+         "neox", "neox-seq"],
 )
 def test_golden_parity_vs_transformers(maker):
     import torch
@@ -224,6 +255,16 @@ def test_golden_parity_vs_transformers(maker):
 def test_config_from_hf_rejects_unknown():
     with pytest.raises(ValueError):
         convert.config_from_hf({"model_type": "mamba"})
+
+
+def test_config_from_hf_neox_rejects_tied_embeddings():
+    with pytest.raises(ValueError, match="tied"):
+        convert.config_from_hf(dict(
+            model_type="gpt_neox", vocab_size=100, hidden_size=64,
+            intermediate_size=176, num_hidden_layers=2,
+            num_attention_heads=4, max_position_embeddings=64,
+            tie_word_embeddings=True,
+        ))
 
 
 def test_config_from_hf_rejects_non_llama3_rope_scaling():
