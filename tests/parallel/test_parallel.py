@@ -108,6 +108,30 @@ def test_dp_pp_tp_generation_matches_single_device(gpt2, devices8):
     assert np.asarray(ref).tolist() == np.asarray(out).tolist()
 
 
+def test_neox_blocks_shard_and_generate(devices8):
+    """GPT-NeoX layout (parallel residual + partial rotary + biasful
+    LayerNorm blocks, no position table): dp x pp x tp generation matches
+    the single device exactly."""
+    from distributed_llms_tpu.models import presets
+
+    cfg = presets.get_preset("neox-tiny", vocab_size=512, num_layers=4)
+    params = model.init_params(jax.random.key(5), cfg)
+    assert "wpe" not in params["embed"]
+    rows = [[7, 1, 9], [4, 4, 4, 4], [100, 3, 5, 2], [9, 8]]
+    arr, lens = pad_batch(rows, pad_id=0)
+    ref = gen_lib.generate_tokens(
+        params, cfg, jnp.asarray(arr), jnp.asarray(lens), jax.random.key(0),
+        max_new_tokens=4,
+    )
+    pm = make_parallel_model(cfg, MeshConfig(data=2, pipe=2, model=2), num_microbatches=2)
+    sharded = pm.shard_params(params)
+    out = gen_lib.generate_tokens(
+        sharded, cfg, jnp.asarray(arr), jnp.asarray(lens), jax.random.key(0),
+        max_new_tokens=4, forward_fn=pm.as_forward_fn(), make_cache=pm.as_make_cache(),
+    )
+    assert np.asarray(ref).tolist() == np.asarray(out).tolist()
+
+
 def test_qkv_bias_blocks_shard_and_generate(devices8):
     """Qwen2-style llama blocks (cfg.qkv_bias): the bias leaves shard with
     their head axes over 'model' and dp x pp x tp generation matches the
