@@ -112,12 +112,13 @@ class InferenceEngine:
             )
         self._timer = profiling.StepTimer("engine.generate")
         if rt.spec_decode:
-            if parallel is not None:
-                raise ValueError(
-                    "runtime.spec_decode is single-device (speculative "
-                    "decoding drives the model forward directly); unset it "
-                    "on mesh engines"
-                )
+            # CONFIG-DRIVEN knob policy (same as runtime.paged_pages on a
+            # mesh engine): one shared cluster config with spec_decode on
+            # must never brick a worker whose engine can't self-speculate —
+            # mesh engines and quantized-store engines DEGRADE to plain
+            # serving with a loud warning (an explicit
+            # attach_draft(draft_cfg, draft_params) still works on the
+            # latter).  Genuinely malformed configs still raise.
             if cfg.ragged_decode:
                 # speculative_generate_tokens rejects ragged_decode (the
                 # prefix-read kernel cannot serve its masks); surface the
@@ -129,11 +130,22 @@ class InferenceEngine:
             if rt.spec_k < 1:
                 # Fail at construction, not on the first routed request.
                 raise ValueError(f"runtime.spec_k must be >= 1, got {rt.spec_k}")
-            # Self-speculation: the draft is this engine's own blocks
-            # quantized.  attach_draft raises on already-quantized params
-            # (serve_quantized stores) — there the operator must attach an
-            # explicit draft; surface that, don't half-configure.
-            self.attach_draft(quantize_bits=rt.spec_draft_quantize)
+            if parallel is not None:
+                log.warning(
+                    "runtime.spec_decode is single-device; this mesh engine "
+                    "serves PLAIN (generate_text / continuous_batcher keep "
+                    "working, just without speculation)"
+                )
+            elif self._serves_quantized():
+                log.warning(
+                    "spec_decode requested but the engine serves quantized "
+                    "weights; serving PLAIN (no self-draft to quantize). "
+                    "Attach an explicit draft for speculative serving."
+                )
+            else:
+                # Self-speculation: the draft is this engine's own blocks
+                # weight-only quantized.
+                self.attach_draft(quantize_bits=rt.spec_draft_quantize)
         # Session store: caches persist across turns; with kv_host_spill only
         # the most recent max_resident_sessions stay in device memory.
         from .session import SessionManager
@@ -512,6 +524,18 @@ class InferenceEngine:
 
     # -- speculative decoding (runtime/speculative.py): greedy-exact ------
 
+    def _serves_quantized(self) -> bool:
+        """Whether the decoder-block weights are resident as QuantizedTensor
+        leaves (serve_quantized stores) — such params cannot be re-quantized
+        into a self-draft."""
+        from ..checkpoint.quantize import QuantizedTensor
+
+        leaves = jax.tree_util.tree_leaves(
+            self.params.get("blocks", {}),
+            is_leaf=lambda x: isinstance(x, QuantizedTensor),
+        )
+        return any(isinstance(x, QuantizedTensor) for x in leaves)
+
     def attach_draft(
         self, draft_cfg: Any = None, draft_params: Any = None,
         quantize_bits: int | None = None,
@@ -528,13 +552,9 @@ class InferenceEngine:
         if quantize_bits is not None:
             if draft_cfg is not None or draft_params is not None:
                 raise ValueError("pass draft_cfg/draft_params OR quantize_bits")
-            from ..checkpoint.quantize import QuantizedTensor, quantize_tree
+            from ..checkpoint.quantize import quantize_tree
 
-            leaves = jax.tree_util.tree_leaves(
-                self.params.get("blocks", {}),
-                is_leaf=lambda x: isinstance(x, QuantizedTensor),
-            )
-            if any(isinstance(x, QuantizedTensor) for x in leaves):
+            if self._serves_quantized():
                 raise ValueError(
                     "engine already serves quantized weights; build the "
                     "draft explicitly (attach_draft(draft_cfg, draft_params))"

@@ -314,7 +314,33 @@ def test_config_driven_spec_routing():
     assert got2.text == want2.text
 
 
-def test_spec_decode_config_rejects_mesh():
+def test_spec_decode_quantized_engine_degrades_plain():
+    """A shared cluster config with spec_decode=True must not brick workers
+    serving quantized stores: the engine warns, skips the self-draft, and
+    generate_text serves plain."""
+    from distributed_llms_tpu.checkpoint import quantize as quant_lib
+    from distributed_llms_tpu.core.config import RuntimeConfig
+    from distributed_llms_tpu.runtime.engine import InferenceEngine
+
+    cfg = presets.get_preset("llama-tiny", vocab_size=300)
+    params = model_lib.init_params(jax.random.key(0), cfg)
+    qparams = {**params,
+               "blocks": quant_lib.quantize_tree(params["blocks"], bits=8)}
+    rt = RuntimeConfig(max_decode_steps=6, max_seq_len=64, spec_decode=True)
+    eng = InferenceEngine(cfg, rt, qparams)  # must NOT raise
+    assert getattr(eng, "draft_params", None) is None
+    plain = InferenceEngine(
+        cfg, RuntimeConfig(max_decode_steps=6, max_seq_len=64), qparams
+    )
+    got = eng.generate_text(["hello"], max_new_tokens=6)
+    want = plain.generate_text(["hello"], max_new_tokens=6)
+    assert got.text == want.text
+
+
+def test_spec_decode_config_mesh_degrades_plain():
+    """Shared-config policy: spec_decode on a MESH engine degrades to plain
+    serving with a warning (same convention as runtime.paged_pages there),
+    never bricking the worker at construction."""
     from distributed_llms_tpu.core.config import MeshConfig, RuntimeConfig
     from distributed_llms_tpu.parallel.api import make_parallel_model
     from distributed_llms_tpu.runtime.engine import InferenceEngine
@@ -323,9 +349,12 @@ def test_spec_decode_config_rejects_mesh():
     pm = make_parallel_model(cfg, MeshConfig(data=2),
                              devices=jax.devices()[:2])
     params = model_lib.init_params(jax.random.key(0), cfg)
-    with pytest.raises(ValueError, match="single-device"):
-        InferenceEngine(cfg, RuntimeConfig(spec_decode=True), params,
-                        parallel=pm)
+    eng = InferenceEngine(cfg, RuntimeConfig(spec_decode=True, max_seq_len=64,
+                                             max_decode_steps=6),
+                          params, parallel=pm)  # must NOT raise
+    assert getattr(eng, "draft_params", None) is None
+    res = eng.generate_text(["hi", "yo"], max_new_tokens=4)
+    assert len(res.text) == 2
 
 
 def test_rejects_bad_args(pair):
