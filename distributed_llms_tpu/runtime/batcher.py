@@ -578,6 +578,7 @@ class _RowState:
     #                     tokens from post-deactivation padding)
     pages: list[int] = field(default_factory=list)  # paged mode: the pool
     #                     pages this row owns (freed on completion)
+    streamed: int = 0  # tokens already delivered to run()'s on_tokens
 
 
 class ContinuousBatcher:
@@ -794,6 +795,7 @@ class ContinuousBatcher:
         self.prefixes: dict[str, _Prefix] = {}
         self._rng = jax.random.key(seed)
         self._next_rid = 0
+        self._on_tokens = None  # set per run() call (streaming callback)
 
     # -- prefix caching ------------------------------------------------------
 
@@ -950,6 +952,13 @@ class ContinuousBatcher:
             log.debug("admitted request %d into slot %d", req.rid, i)
             if req.max_new_tokens == 1 or tok == self.eos_id:
                 self.active[i] = False
+            if self._on_tokens is not None:
+                # Stream the admission token; completion (done=True) is
+                # always announced by _collect's publish sweep.  State
+                # advances BEFORE the callback so a raising callback can
+                # never cause a re-delivery on a later run().
+                self.rows[i].streamed = 1
+                self._on_tokens(req.rid, [tok], False)
             METRICS.inc("batcher.admitted")
 
     def _collect(
@@ -983,14 +992,45 @@ class ContinuousBatcher:
                     cut = row.emitted.index(self.eos_id) + 1
                     row.emitted = row.emitted[:cut]
                 self.results[row.rid] = row.emitted
+                rid, final = row.rid, row.emitted[row.streamed:]
                 if row.pages:  # paged: return the row's pool pages
                     self.free_pages.extend(row.pages)
                     self.tables[i] = 0
                 self.rows[i] = _RowState()
                 METRICS.inc("batcher.completed")
+                if self._on_tokens is not None:
+                    # Final delivery: whatever landed since the last stream
+                    # (possibly nothing), with done=True exactly once.  Row
+                    # state is already reset, so a raising callback cannot
+                    # cause a duplicate done on a later run().
+                    self._on_tokens(rid, final, True)
+        if self._on_tokens is not None:
+            # Still-active rows stream this chunk's new tokens (streamed
+            # advances before the callback — same raise-safety).
+            for i in range(self.b):
+                row = self.rows[i]
+                if row.rid is not None and len(row.emitted) > row.streamed:
+                    new = row.emitted[row.streamed:]
+                    row.streamed = len(row.emitted)
+                    self._on_tokens(row.rid, new, False)
 
-    def run(self) -> dict[int, list[int]]:
-        """Drive until every submitted request has a result."""
+    def run(self, on_tokens=None) -> dict[int, list[int]]:
+        """Drive until every submitted request has a result.
+
+        ``on_tokens(rid, new_tokens, done)`` streams incrementally: called
+        with each request's newly committed token ids as scheduling chunks
+        complete (admission token first, then per-chunk), and exactly once
+        with ``done=True`` carrying any final tokens — the concatenation of
+        all deliveries for a rid equals its entry in the returned dict.
+        Exceptions from the callback propagate (and abort the run).
+        """
+        self._on_tokens = on_tokens
+        try:
+            return self._run_loop()
+        finally:
+            self._on_tokens = None
+
+    def _run_loop(self) -> dict[int, list[int]]:
         # Publish any 1-token requests finished by admission alone.
         while self.queue or bool(self.active.any()) or any(
             r.rid is not None for r in self.rows

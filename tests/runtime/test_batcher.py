@@ -252,3 +252,71 @@ def test_engine_integration(tiny):
     pipe_eng = InferenceEngine(cfg, RuntimeConfig(), params, parallel=pm_pipe)
     with pytest.raises(ValueError, match="data/tensor-parallel"):
         pipe_eng.continuous_batcher()
+
+
+def test_streaming_deliveries_reassemble_results(tiny):
+    """run(on_tokens=...): per-rid concatenation of streamed chunks equals
+    the returned result, with exactly one done=True as the LAST delivery —
+    across mixed budgets, EOS stops, and slot reuse."""
+    cfg, params = tiny
+    b = ContinuousBatcher(cfg, params, batch_slots=2, max_len=64,
+                          chunk_steps=4)
+    reqs = [([7, 1, 9, 4, 2], 9), ([4, 4, 4], 1), ([11, 12], 12), ([42], 5)]
+    rids = [b.submit(ids, max_new_tokens=n) for ids, n in reqs]
+    streamed: dict[int, list[int]] = {r: [] for r in rids}
+    done_flags: dict[int, list[bool]] = {r: [] for r in rids}
+
+    def on_tokens(rid, new, done):
+        assert not done_flags[rid] or not done_flags[rid][-1], \
+            f"delivery after done for rid {rid}"
+        streamed[rid].extend(new)
+        done_flags[rid].append(done)
+
+    res = b.run(on_tokens=on_tokens)
+    for r in rids:
+        assert streamed[r] == res[r], (r, streamed[r], res[r])
+        assert done_flags[r].count(True) == 1 and done_flags[r][-1]
+    # A later run() without a callback must not stream to the stale one.
+    before = {r: list(v) for r, v in streamed.items()}
+    rid2 = b.submit([9, 9], max_new_tokens=3)
+    res2 = b.run()
+    assert streamed == before and rid2 in res2
+
+
+def test_streaming_callback_exception_no_duplicate_done(tiny):
+    """A raising callback aborts the run, but state advances BEFORE each
+    delivery: a later run() never re-delivers tokens or a second done."""
+    cfg, params = tiny
+    b = ContinuousBatcher(cfg, params, batch_slots=2, max_len=64,
+                          chunk_steps=4)
+    rids = [b.submit([7, 1, 9], max_new_tokens=6),
+            b.submit([4, 4], max_new_tokens=6)]
+    seen: list[tuple[int, tuple[int, ...], bool]] = []
+
+    class Boom(RuntimeError):
+        pass
+
+    def raising(rid, new, done):
+        seen.append((rid, tuple(new), done))
+        if done:
+            raise Boom()
+
+    import pytest as _pytest
+    with _pytest.raises(Boom):
+        b.run(on_tokens=raising)
+    collect = {r: [] for r in rids}
+    dones = {r: 0 for r in rids}
+    res = b.run(on_tokens=lambda rid, new, done: (
+        collect[rid].extend(new), dones.__setitem__(rid, dones[rid] + bool(done))
+    ))
+    # Reassemble: pre-crash deliveries + post-crash deliveries == result.
+    full = {r: [] for r in rids}
+    total_dones = {r: 0 for r in rids}
+    for rid, new, done in seen:
+        full[rid].extend(new)
+        total_dones[rid] += bool(done)
+    for r in rids:
+        full[r].extend(collect[r])
+        total_dones[r] += dones[r]
+        assert full[r] == res[r], (r, full[r], res[r])
+        assert total_dones[r] == 1, (r, total_dones[r])

@@ -171,3 +171,27 @@ def test_engine_spec_batcher_wiring():
     resp = plain.run()
     for a, c in zip(rids, rp):
         assert res[a] == resp[c]
+
+
+def test_spec_streaming_matches_plain_stream(models):
+    """Speculative streaming: chunk boundaries differ (k+1-token rounds),
+    but the reassembled streams are bit-identical to the plain batcher's
+    results and done fires exactly once per request."""
+    cfg, params, dcfg, dparams = models
+    reqs = [([7, 1, 9], 8), ([4, 4], 5), ([11, 12, 13], 10)]
+    _, rp, plain = _run(cfg, params, reqs)
+    b = ContinuousBatcher(cfg, params, batch_slots=2, max_len=64,
+                          chunk_steps=4, draft_params=dparams,
+                          draft_cfg=dcfg, spec_k=3)
+    rids = [b.submit(ids, max_new_tokens=n) for ids, n in reqs]
+    streamed = {r: [] for r in rids}
+    dones = {r: 0 for r in rids}
+
+    def cb(rid, new, done):
+        streamed[rid].extend(new)
+        dones[rid] += bool(done)
+
+    res = b.run(on_tokens=cb)
+    for a, r in zip(rp, rids):
+        assert streamed[r] == res[r] == plain[a]
+        assert dones[r] == 1
