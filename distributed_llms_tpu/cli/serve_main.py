@@ -1,0 +1,112 @@
+"""Standalone HTTP serving entry point.
+
+``python -m distributed_llms_tpu.cli.serve_main --store ./store_7b --port 8000``
+boots an InferenceEngine from a shard store, wraps its continuous batcher in
+the OpenAI-style HTTP gateway (runtime/server.py), and serves until SIGTERM/
+SIGINT.  This is the single-process serving front door; the cluster path
+(cli/coordinator_main.py --serve) remains the multi-worker one.
+
+The reference has no serving entry point at all — its user interface is the
+master REPL (run_master.py:28-42).
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import os
+import signal
+
+from ..core.config import load_config
+from ..core.observability import get_logger
+from ..runtime.engine import InferenceEngine
+from ..runtime.server import InferenceServer
+
+log = get_logger("serve_main")
+
+
+def build_server(args) -> InferenceServer:
+    cfg = load_config(args.config, args.override)
+    rt = cfg.runtime
+    if args.store:
+        mesh_cfg = cfg.mesh if cfg.mesh.num_devices > 1 else None
+        engine = InferenceEngine.from_store(args.store, rt=rt, mesh_cfg=mesh_cfg)
+        default_name = os.path.basename(os.path.normpath(args.store))
+    elif args.preset:
+        # Random-weight smoke serving (no checkpoint needed): exercises the
+        # full HTTP/batcher/decode path with a byte-level tokenizer.  Tiny
+        # presets (vocab 256) cannot hold the byte tokenizer's specials
+        # (259 ids) — widen to a lane-aligned 512.
+        from ..models.presets import get_preset
+        from ..runtime.tokenizer import ByteTokenizer
+
+        overrides = (
+            {"vocab_size": 512}
+            if get_preset(args.preset).vocab_size < ByteTokenizer.vocab_size
+            else {}
+        )
+        engine = InferenceEngine.from_preset(args.preset, rt=rt, **overrides)
+        default_name = args.preset
+    else:
+        raise SystemExit("one of --store or --preset is required")
+    batcher = engine.continuous_batcher(
+        batch_slots=args.slots,
+        max_len=args.max_len,
+        chunk_steps=args.chunk_steps,
+    )
+    return InferenceServer(
+        batcher,
+        model_name=args.model_name or default_name,
+        host=args.host,
+        port=args.port,
+        max_pending=args.max_pending,
+    )
+
+
+async def _serve(args) -> None:
+    server = build_server(args)
+    stop = asyncio.Event()
+    loop = asyncio.get_running_loop()
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        loop.add_signal_handler(sig, stop.set)
+    host, port = await server.start()
+    log.info("ready on http://%s:%s (Ctrl-C to stop)", host, port)
+    await stop.wait()
+    log.info("shutting down...")
+    await server.stop()
+
+
+def main(argv=None) -> None:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--store", default=None, help="shard store directory")
+    ap.add_argument("--preset", default=None,
+                    help="serve a random-weight preset (smoke testing)")
+    ap.add_argument("--config", default=None, help="JSON/YAML config file")
+    ap.add_argument("--override", action="append", default=[],
+                    help="dotted config override, e.g. runtime.temperature=0.7")
+    ap.add_argument("--host", default="0.0.0.0")
+    ap.add_argument("--port", type=int, default=8000)
+    ap.add_argument("--model-name", default=None,
+                    help="name reported by /v1/models (default: store/preset)")
+    ap.add_argument("--slots", type=int, default=8,
+                    help="continuous-batching row slots")
+    ap.add_argument("--max-len", type=int, default=None,
+                    help="per-row cache length (default: runtime.max_seq_len)")
+    ap.add_argument("--chunk-steps", type=int, default=8,
+                    help="decode steps per scheduling chunk")
+    ap.add_argument("--max-pending", type=int, default=256,
+                    help="in-flight request cap before 429s")
+    ap.add_argument("--platform", default=None,
+                    help="force a jax platform (e.g. cpu) — the axon TPU "
+                         "plugin ignores JAX_PLATFORMS, so this sets "
+                         "jax.config before backend init")
+    args = ap.parse_args(argv)
+    if args.platform:
+        import jax
+
+        jax.config.update("jax_platforms", args.platform)
+    asyncio.run(_serve(args))
+
+
+if __name__ == "__main__":
+    main()

@@ -829,6 +829,16 @@ class ContinuousBatcher:
 
     # -- submission --------------------------------------------------------
 
+    @property
+    def next_rid(self) -> int:
+        """The rid the next ``submit`` call will return.  Serving front-ends
+        register their delivery state under this id BEFORE submitting:
+        once ``submit`` appends to the queue, an engine thread already
+        inside ``run()`` may admit the request and fire ``on_tokens``
+        immediately — registering afterwards would race it.  Only valid
+        when all submissions happen on one thread."""
+        return self._next_rid
+
     def submit(
         self, prompt: str | list[int], max_new_tokens: int = 32,
         prefix: str | None = None,
@@ -857,6 +867,46 @@ class ContinuousBatcher:
         self._next_rid += 1
         self.queue.append(_Request(rid, ids, max_new_tokens, prefix=prefix))
         return rid
+
+    def cancel_row(self, rid: int) -> bool:
+        """Cancel a submitted request (serving front-ends: client went away,
+        or a stop sequence hit mid-row).  A queued request is dropped; an
+        admitted row is deactivated and its slot freed for the next
+        admission.  Either way ``results[rid]`` records whatever tokens had
+        been committed (possibly none) and NO ``done=True`` callback fires
+        for the rid — the canceller initiated this and already knows.
+
+        Thread contract: call from ``run()``'s ``on_tokens`` callback
+        (which executes between device chunks, on the thread driving
+        ``run``) or while ``run()`` is not executing.  On a multi-process
+        mesh every process must cancel the same rid in the same scheduling
+        round, or the host scheduling mirrors diverge.
+
+        Returns True if the rid was found queued or resident."""
+        # Scan a snapshot: a serving front-end may append to the live deque
+        # from its own thread mid-scan (deque ops are GIL-atomic; live
+        # iteration is not), then remove by identity (also atomic).
+        for req in list(self.queue):
+            if req.rid == rid:
+                self.queue.remove(req)
+                self.results[rid] = []
+                METRICS.inc("batcher.cancelled")
+                return True
+        for i in range(self.b):
+            row = self.rows[i]
+            if row.rid == rid:
+                if self.eos_id >= 0 and self.eos_id in row.emitted:
+                    row.emitted = row.emitted[: row.emitted.index(self.eos_id) + 1]
+                self.results[rid] = row.emitted
+                if row.pages:
+                    self.free_pages.extend(row.pages)
+                    self.tables[i] = 0
+                self.rows[i] = _RowState()
+                self.active[i] = False
+                self.budget[i] = 0
+                METRICS.inc("batcher.cancelled")
+                return True
+        return False
 
     # -- scheduling loop ---------------------------------------------------
 

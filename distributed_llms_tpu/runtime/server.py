@@ -1,0 +1,626 @@
+"""HTTP serving gateway: an OpenAI-style REST front door over the
+continuous batcher.
+
+The reference's only user interface is the master's blocking REPL
+(run_master.py:28-42); a serving/REST layer is future scope in its plan
+(plan.md:225-233) and never existed.  This module is that layer, built for
+how a TPU actually serves:
+
+- the asyncio event loop owns every connection and all request bookkeeping;
+- ONE engine thread owns the ``ContinuousBatcher`` and therefore the device
+  — a single dispatch thread keeps XLA dispatch uncontended and makes the
+  batcher's host scheduling mirrors single-writer by construction;
+- requests cross from loop to engine through the batcher's FIFO queue
+  (``submit`` is loop-side: deque append is the only shared mutation);
+  token deliveries cross back via ``loop.call_soon_threadsafe`` from the
+  batcher's ``on_tokens`` streaming callback;
+- client disconnects and stop-sequence hits cancel lazily: the loop flags
+  the rid, the engine's next chunk-boundary delivery observes the flag and
+  frees the row (``ContinuousBatcher.cancel_row``), so an abandoned request
+  costs at most one scheduling chunk.
+
+Endpoints:
+
+- ``POST /v1/completions``       OpenAI text-completion shape (+ ``prefix``
+  extension naming a registered KV prefix); ``stream: true`` serves SSE.
+- ``POST /v1/chat/completions``  chat shape via the tokenizer's own chat
+  template (model-correct control tokens) or a plain-text fallback.
+- ``GET /v1/models``, ``GET /healthz``, ``GET /metrics`` (Prometheus).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import threading
+import time
+import uuid
+
+from ..core.observability import METRICS, get_logger
+
+log = get_logger("server")
+
+_MAX_REQUEST_LINE = 8192
+_MAX_HEADERS = 100
+_MAX_BODY = 8 * 1024 * 1024
+_REASONS = {
+    200: "OK", 400: "Bad Request", 404: "Not Found",
+    405: "Method Not Allowed", 413: "Payload Too Large",
+    429: "Too Many Requests", 431: "Request Header Fields Too Large",
+    500: "Internal Server Error",
+}
+
+
+class _Mailbox:
+    """Per-request delivery queue, filled by the engine thread via
+    call_soon_threadsafe, drained by the owning handler coroutine.
+    ``finished`` flips once generation concluded (done seen / stop acked)
+    so the disconnect path knows whether a cancel flag is still needed."""
+
+    __slots__ = ("queue", "finished")
+
+    def __init__(self) -> None:
+        self.queue: asyncio.Queue = asyncio.Queue()
+        self.finished = False
+
+
+class BadRequest(ValueError):
+    pass
+
+
+def _field(req: dict, name: str, default, kind, *, minimum=None):
+    v = req.get(name, default)
+    if kind is int and isinstance(v, bool):  # bool passes isinstance(int)
+        raise BadRequest(f"{name!r} must be an integer")
+    if not isinstance(v, kind):
+        raise BadRequest(f"{name!r} must be {kind.__name__}")
+    if minimum is not None and v < minimum:
+        raise BadRequest(f"{name!r} must be >= {minimum}")
+    return v
+
+
+def _stop_list(req: dict) -> list[str]:
+    stop = req.get("stop")
+    if stop is None:
+        return []
+    if isinstance(stop, str):
+        stop = [stop]
+    if (
+        not isinstance(stop, list)
+        or len(stop) > 4
+        or not all(isinstance(s, str) and s for s in stop)
+    ):
+        raise BadRequest("'stop' must be a non-empty string or up to 4 of them")
+    return stop
+
+
+class InferenceServer:
+    """Serve a ContinuousBatcher over HTTP.  See module docstring."""
+
+    def __init__(
+        self,
+        batcher,
+        model_name: str = "dlt-model",
+        host: str = "0.0.0.0",
+        port: int = 8000,
+        max_pending: int = 256,
+    ) -> None:
+        if batcher.tokenizer is None:
+            raise ValueError(
+                "InferenceServer needs a batcher with a tokenizer "
+                "(the completion API speaks text)"
+            )
+        self.batcher = batcher
+        self.model_name = model_name
+        self.host = host
+        self.port = port
+        self.max_pending = max_pending
+        self._requests: dict[int, _Mailbox] = {}
+        self._cancelled: set[int] = set()  # loop writes, engine consumes
+        self._work = threading.Event()
+        self._stopping = False
+        self._server: asyncio.base_events.Server | None = None
+        self._engine: threading.Thread | None = None
+        self._loop: asyncio.AbstractEventLoop | None = None
+        self._conns: set[asyncio.StreamWriter] = set()
+
+    # -- lifecycle ---------------------------------------------------------
+
+    async def start(self) -> tuple[str, int]:
+        self._loop = asyncio.get_running_loop()
+        self._server = await asyncio.start_server(self._handle, self.host, self.port)
+        self._engine = threading.Thread(
+            target=self._engine_loop, name="dlt-serve-engine", daemon=True
+        )
+        self._engine.start()
+        addr = self._server.sockets[0].getsockname()
+        log.info(
+            "serving %s on http://%s:%s/v1/completions",
+            self.model_name, addr[0], addr[1],
+        )
+        return addr[0], addr[1]
+
+    @property
+    def bound_port(self) -> int:
+        assert self._server is not None
+        return self._server.sockets[0].getsockname()[1]
+
+    async def stop(self) -> None:
+        """Cancel in-flight rows, stop the engine thread, close sockets."""
+        self._stopping = True
+        for rid in list(self._requests):
+            self._cancelled.add(rid)
+        self._work.set()
+        if self._engine is not None:
+            # Every active row delivers each chunk, so the cancel flags
+            # drain run() within one chunk; join must not block the loop.
+            await asyncio.to_thread(self._engine.join, 60.0)
+        if self._server is not None:
+            self._server.close()
+            for w in list(self._conns):
+                w.close()
+            await self._server.wait_closed()
+
+    # -- engine thread -----------------------------------------------------
+
+    def _pending(self) -> bool:
+        b = self.batcher
+        return bool(b.queue) or any(r.rid is not None for r in b.rows)
+
+    def _engine_loop(self) -> None:
+        while True:
+            self._work.wait()
+            self._work.clear()
+            if self._stopping:
+                return
+            if not self._pending():
+                continue
+            try:
+                self.batcher.run(on_tokens=self._deliver)
+            except Exception:
+                log.exception("batcher.run failed; failing in-flight requests")
+                for rid in list(self._requests):
+                    self.batcher.cancel_row(rid)
+                    self._notify(rid, [], True, err="internal engine error")
+                self._cancelled.clear()
+            # run() accumulated per-rid results we already streamed; drop
+            # them so a long-lived server's memory stays flat.
+            self.batcher.results.clear()
+
+    def _deliver(self, rid: int, toks: list[int], done: bool) -> None:
+        # Engine thread, between device chunks: the one safe point to act
+        # on loop-side cancel flags.
+        if rid in self._cancelled:
+            self._cancelled.discard(rid)
+            if not done:
+                self.batcher.cancel_row(rid)
+            self._notify(rid, toks, True)
+            return
+        self._notify(rid, toks, done)
+
+    def _notify(self, rid: int, toks: list[int], done: bool, err: str | None = None):
+        mbox = self._requests.get(rid)
+        if mbox is not None and self._loop is not None:
+            self._loop.call_soon_threadsafe(
+                mbox.queue.put_nowait, (list(toks), done, err)
+            )
+
+    # -- HTTP plumbing -----------------------------------------------------
+
+    async def _handle(
+        self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
+    ) -> None:
+        self._conns.add(writer)
+        try:
+            try:
+                # Deadline covers the parse phase only: generation itself
+                # may legitimately exceed any fixed request timeout.
+                async with asyncio.timeout(30.0):
+                    method, path, body = await self._read_request(writer, reader)
+            except _Responded:
+                return
+            await self._route(writer, method, path, body)
+        except (asyncio.TimeoutError, ConnectionError, OSError, ValueError,
+                EOFError):  # IncompleteReadError: client hung up mid-body
+            pass
+        finally:
+            self._conns.discard(writer)
+            writer.close()
+
+    async def _read_request(self, writer, reader) -> tuple[str, str, bytes]:
+        line = await reader.readline()
+        if len(line) > _MAX_REQUEST_LINE:
+            await self._plain(writer, 431, "request line too long")
+            raise _Responded
+        parts = line.decode("latin-1", "replace").split()
+        if len(parts) < 2:
+            await self._plain(writer, 400, "bad request")
+            raise _Responded
+        method, path = parts[0], parts[1]
+        content_len = 0
+        for _ in range(_MAX_HEADERS):
+            h = await reader.readline()
+            if h in (b"\r\n", b"\n", b""):
+                break
+            name, _, value = h.decode("latin-1", "replace").partition(":")
+            if name.strip().lower() == "content-length":
+                try:
+                    content_len = int(value.strip())
+                except ValueError:
+                    await self._plain(writer, 400, "bad content-length")
+                    raise _Responded
+        else:
+            await self._plain(writer, 431, "too many headers")
+            raise _Responded
+        if content_len > _MAX_BODY:
+            await self._plain(writer, 413, "body too large")
+            raise _Responded
+        body = await reader.readexactly(content_len) if content_len else b""
+        return method, path, body
+
+    async def _route(self, writer, method: str, path: str, body: bytes) -> None:
+        if method == "GET" and path == "/healthz":
+            await self._plain(writer, 200, "ok\n")
+        elif method == "GET" and path == "/metrics":
+            await self._respond(
+                writer, 200, "text/plain; version=0.0.4; charset=utf-8",
+                METRICS.prometheus_text().encode(),
+            )
+        elif method == "GET" and path == "/v1/models":
+            await self._json(writer, 200, {
+                "object": "list",
+                "data": [{
+                    "id": self.model_name, "object": "model",
+                    "owned_by": "distributed-llms-tpu",
+                }],
+            })
+        elif method == "POST" and path in ("/v1/completions", "/v1/chat/completions"):
+            try:
+                req = json.loads(body or b"{}")
+                if not isinstance(req, dict):
+                    raise BadRequest("request body must be a JSON object")
+                await self._completions(writer, req, chat="chat" in path)
+            except (BadRequest, json.JSONDecodeError) as e:
+                await self._json(writer, 400, _err_body(str(e)))
+        elif method not in ("GET", "POST"):
+            await self._plain(writer, 405, "method not allowed")
+        else:
+            await self._plain(writer, 404, "not found")
+
+    # -- the completion core ----------------------------------------------
+
+    def _parse_prompt(self, req: dict, chat: bool) -> tuple[list[int], str]:
+        tok = self.batcher.tokenizer
+        if chat:
+            messages = req.get("messages")
+            if (
+                not isinstance(messages, list) or not messages
+                or not all(
+                    isinstance(m, dict)
+                    and isinstance(m.get("role"), str)
+                    and isinstance(m.get("content"), str)
+                    for m in messages
+                )
+            ):
+                raise BadRequest(
+                    "'messages' must be a non-empty list of "
+                    "{role, content} objects"
+                )
+            text = tok.apply_chat_template(messages)
+            return tok.encode(text), text
+        prompt = req.get("prompt")
+        if isinstance(prompt, str) and prompt:
+            return tok.encode(prompt), prompt
+        if (
+            isinstance(prompt, list) and prompt
+            and all(isinstance(t, int) and not isinstance(t, bool) for t in prompt)
+        ):
+            return list(prompt), ""
+        raise BadRequest("'prompt' must be a non-empty string or token-id list")
+
+    def _check_sampling(self, req: dict) -> None:
+        """Per-request sampling knobs must match the server's engine config
+        until per-row sampling lands; reject silently-different results."""
+        cfg = self.batcher.sampling
+        for name, have in (
+            ("temperature", cfg["temperature"]),
+            ("top_p", cfg["top_p"]),
+        ):
+            want = req.get(name)
+            if want is None:
+                continue
+            if not isinstance(want, (int, float)) or isinstance(want, bool):
+                raise BadRequest(f"{name!r} must be a number")
+            if abs(float(want) - float(have)) > 1e-6:
+                raise BadRequest(
+                    f"this server samples with {name}={have} (fixed at "
+                    f"engine build); per-request {name} is not supported"
+                )
+        want_k = req.get("top_k")
+        if want_k is not None and want_k != cfg["top_k"]:
+            raise BadRequest(
+                f"this server samples with top_k={cfg['top_k']} (fixed at "
+                "engine build); per-request top_k is not supported"
+            )
+        if req.get("n", 1) != 1:
+            raise BadRequest("only n=1 is supported")
+
+    async def _completions(self, writer, req: dict, chat: bool) -> None:
+        prompt_ids, _ = self._parse_prompt(req, chat)
+        max_tokens = _field(
+            req, "max_completion_tokens" if chat else "max_tokens",
+            req.get("max_tokens", 16), int, minimum=1,
+        )
+        stream = bool(req.get("stream", False))
+        stop = _stop_list(req)
+        prefix = req.get("prefix")
+        self._check_sampling(req)
+        if len(self._requests) >= self.max_pending:
+            await self._json(writer, 429, _err_body("server request queue is full"))
+            return
+        if self._stopping:
+            await self._json(writer, 500, _err_body("server is shutting down"))
+            return
+        # Register the mailbox BEFORE submit: the engine thread may already
+        # be inside run() and can admit + deliver the moment the request
+        # hits the queue — a mailbox registered after submit would miss
+        # those deliveries (and hang forever on a 1-chunk completion).
+        # All submissions happen on this loop thread, so next_rid is ours.
+        rid = self.batcher.next_rid
+        mbox = _Mailbox()
+        self._requests[rid] = mbox
+        try:
+            got = self.batcher.submit(
+                prompt_ids, max_new_tokens=max_tokens, prefix=prefix
+            )
+            assert got == rid
+        except (ValueError, KeyError) as e:
+            self._requests.pop(rid, None)
+            await self._json(writer, 400, _err_body(str(e)))
+            return
+        self._work.set()
+        METRICS.inc("server.requests")
+        oid = ("chatcmpl-" if chat else "cmpl-") + uuid.uuid4().hex[:24]
+        created = int(time.time())
+        try:
+            if stream:
+                await self._serve_stream(
+                    writer, mbox, rid, stop, chat, oid, created
+                )
+            else:
+                await self._serve_blocking(
+                    writer, mbox, rid, stop, chat, oid, created, len(prompt_ids)
+                )
+        except (ConnectionError, OSError, asyncio.TimeoutError):
+            # Client went away.  Flag the rid only if the row is still
+            # generating — the engine consumes the flag at its next
+            # delivery; a flag for an already-finished rid would sit in
+            # the set forever (rids are never reused).
+            if not mbox.finished:
+                self._cancelled.add(rid)
+            METRICS.inc("server.disconnects")
+        finally:
+            if mbox.finished:
+                # Drop any stop-flag the engine never got to consume (the
+                # row finished naturally in the same delivery).
+                self._cancelled.discard(rid)
+            self._requests.pop(rid, None)
+
+    async def _collect_until_done(self, mbox, rid, stop, need_text=True):
+        """Drain the mailbox; yield (text_so_far, ids_so_far, done, err).
+        ``err`` is "stopped" when a stop sequence truncated the text (the
+        rid is then flagged for engine-side cancel, and the generator keeps
+        draining until the cancel ack so the row is verifiably freed).
+        Token accounting lives HERE, not in ``batcher.results`` — the
+        engine thread clears that dict between runs, so readers on the
+        loop thread would race it.  ``need_text=False`` (blocking handler,
+        no stop strings) skips the per-delivery decode and yields
+        ``text=None`` until the final delivery — per-delivery full decodes
+        are O(n^2) over a generation and all on the loop thread."""
+        tok = self.batcher.tokenizer
+        ids: list[int] = []
+        stopped_at: int | None = None
+        scanned = 0  # chars already known stop-free
+        hold = max((len(s) for s in stop), default=1) - 1
+        while True:
+            toks, done, err = await mbox.queue.get()
+            if err is not None:
+                mbox.finished = True
+                yield "", ids, True, err
+                return
+            if stopped_at is None:
+                # Past the stop cut, later deliveries (the cancel-ack chunk)
+                # are not part of the response — don't bill them.
+                ids.extend(toks)
+                text = tok.decode(ids) if (need_text or stop or done) else None
+                hit = -1
+                if text is not None and stop:
+                    # Only the unscanned tail can hit, minus a lookbehind
+                    # for stops spanning a delivery boundary.
+                    start = max(0, scanned - hold)
+                    hit = min(
+                        (i for i in (text.find(s, start) for s in stop) if i >= 0),
+                        default=-1,
+                    )
+                    scanned = len(text)
+                if hit >= 0:
+                    stopped_at = hit
+                    text = text[:hit]
+                    if not done:
+                        # Flag for the engine; its next delivery for this
+                        # rid (one chunk away at most — an active row
+                        # streams every chunk) is the done ack.
+                        self._cancelled.add(rid)
+                if done:
+                    mbox.finished = True
+                yield text, ids, done, "stopped" if stopped_at is not None and done else None
+                if done:
+                    return
+            elif done:
+                # Cancel ack after a stop hit: no new text (None marks the
+                # truncated text already delivered as authoritative).
+                mbox.finished = True
+                yield None, ids, True, "stopped"
+                return
+
+    async def _serve_blocking(
+        self, writer, mbox, rid, stop, chat, oid, created, n_prompt
+    ) -> None:
+        text = ""
+        ids: list[int] = []
+        reason = "length"
+        async for t, ids, done, err in self._collect_until_done(
+            mbox, rid, stop, need_text=bool(stop)
+        ):
+            if err == "stopped":
+                if t is not None:
+                    text = t
+                reason = "stop"
+                break
+            if err is not None:
+                await self._json(writer, 500, _err_body(err))
+                return
+            text = t
+            if done:
+                break
+        if reason != "stop" and self.batcher.eos_id >= 0 and (
+            ids and ids[-1] == self.batcher.eos_id
+        ):
+            reason = "stop"
+        choice = (
+            {"index": 0, "message": {"role": "assistant", "content": text},
+             "finish_reason": reason}
+            if chat else
+            {"index": 0, "text": text, "logprobs": None, "finish_reason": reason}
+        )
+        await self._json(writer, 200, {
+            "id": oid,
+            "object": "chat.completion" if chat else "text_completion",
+            "created": created,
+            "model": self.model_name,
+            "choices": [choice],
+            "usage": {
+                "prompt_tokens": n_prompt,
+                "completion_tokens": len(ids),
+                "total_tokens": n_prompt + len(ids),
+            },
+        })
+
+    async def _serve_stream(
+        self, writer, mbox, rid, stop, chat, oid, created
+    ) -> None:
+        writer.write(
+            b"HTTP/1.1 200 OK\r\n"
+            b"Content-Type: text/event-stream\r\n"
+            b"Cache-Control: no-cache\r\n"
+            b"Connection: close\r\n\r\n"
+        )
+        await writer.drain()
+
+        sent = 0
+        reason = "length"
+        stop_hold = max((len(s) for s in stop), default=1) - 1
+
+        def chunk(delta: str, finish: str | None) -> bytes:
+            choice = (
+                {"index": 0, "delta": ({"content": delta} if delta else {}),
+                 "finish_reason": finish}
+                if chat else
+                {"index": 0, "text": delta, "logprobs": None,
+                 "finish_reason": finish}
+            )
+            payload = {
+                "id": oid,
+                "object": "chat.completion.chunk" if chat else "text_completion",
+                "created": created,
+                "model": self.model_name,
+                "choices": [choice],
+            }
+            return b"data: " + json.dumps(payload).encode() + b"\n\n"
+
+        if chat:
+            # OpenAI stream fidelity: the first chunk announces the role.
+            writer.write(
+                b"data: " + json.dumps({
+                    "id": oid, "object": "chat.completion.chunk",
+                    "created": created, "model": self.model_name,
+                    "choices": [{"index": 0,
+                                 "delta": {"role": "assistant"},
+                                 "finish_reason": None}],
+                }).encode() + b"\n\n"
+            )
+            await writer.drain()
+        stopped = False
+        last_text = None  # survives the cancel-ack yield (text=None)
+        async for text, ids, done, err in self._collect_until_done(mbox, rid, stop):
+            if err == "stopped":
+                stopped = True
+            elif err is not None:
+                writer.write(
+                    b"data: " + json.dumps(_err_body(err)).encode() + b"\n\n"
+                )
+                break
+            if text is not None:
+                last_text = text
+            else:
+                text = last_text
+            if text is None:
+                delta = ""
+            else:
+                # Streamed deltas cannot be retracted, so hold back text
+                # that may still change: (a) a trailing U+FFFD — usually a
+                # partially-decoded multi-byte sequence whose chars CHANGE
+                # once the continuation tokens arrive; (b) a tail that
+                # could become the head of a stop sequence spanning a
+                # delivery boundary (the blocking path would truncate it).
+                if done:
+                    emit_src = text
+                else:
+                    emit_src = text.rstrip("�")
+                    if stop_hold:
+                        emit_src = emit_src[: max(sent, len(emit_src) - stop_hold)]
+                delta = emit_src[sent:]
+                sent = max(sent, len(emit_src))
+            if delta and not done:
+                writer.write(chunk(delta, None))
+                await writer.drain()
+            if done:
+                if stopped or (
+                    self.batcher.eos_id >= 0 and ids
+                    and ids[-1] == self.batcher.eos_id
+                ):
+                    reason = "stop"
+                writer.write(chunk(delta, reason))
+                break
+        writer.write(b"data: [DONE]\n\n")
+        await writer.drain()
+
+    # -- response helpers --------------------------------------------------
+
+    async def _plain(self, writer, code: int, body: str) -> None:
+        await self._respond(writer, code, "text/plain", body.encode())
+
+    async def _json(self, writer, code: int, obj: dict) -> None:
+        await self._respond(
+            writer, code, "application/json", (json.dumps(obj) + "\n").encode()
+        )
+
+    async def _respond(self, writer, code: int, ctype: str, payload: bytes) -> None:
+        writer.write(
+            (
+                f"HTTP/1.1 {code} {_REASONS.get(code, '')}\r\n"
+                f"Content-Type: {ctype}\r\n"
+                f"Content-Length: {len(payload)}\r\n"
+                "Connection: close\r\n\r\n"
+            ).encode()
+            + payload
+        )
+        await writer.drain()
+
+
+class _Responded(Exception):
+    """Internal: the parse phase already wrote an error response."""
+
+
+def _err_body(msg: str) -> dict:
+    return {"error": {"message": msg, "type": "invalid_request_error"}}

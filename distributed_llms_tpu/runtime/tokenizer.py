@@ -13,6 +13,13 @@ from __future__ import annotations
 import numpy as np
 
 
+def _plain_chat_template(messages: list[dict]) -> str:
+    """Model-agnostic fallback chat layout: ``role: content`` lines plus a
+    trailing assistant cue.  Used when no model template is available."""
+    lines = [f"{m['role']}: {m['content']}" for m in messages]
+    return "\n".join(lines) + "\nassistant:"
+
+
 class ByteTokenizer:
     """Byte-level tokenizer: ids 0..255 are raw bytes; specials follow.
     Deterministic, offline, round-trips any UTF-8 text."""
@@ -35,6 +42,11 @@ class ByteTokenizer:
     def decode(self, ids) -> str:
         data = bytes(i for i in ids if 0 <= int(i) < 256)
         return data.decode("utf-8", errors="replace")
+
+    def apply_chat_template(self, messages: list[dict]) -> str:
+        """Plain-text fallback template (no model-specific control tokens
+        exist at the byte level)."""
+        return _plain_chat_template(messages)
 
 
 class HFTokenizer:
@@ -61,6 +73,16 @@ class HFTokenizer:
 
     def decode(self, ids) -> str:
         return self._tok.decode([int(i) for i in ids], skip_special_tokens=True)
+
+    def apply_chat_template(self, messages: list[dict]) -> str:
+        """The model's own chat template when it ships one (Llama/Mistral/
+        Qwen/... control-token formats differ; the tokenizer files are the
+        source of truth), else the plain-text fallback."""
+        if getattr(self._tok, "chat_template", None):
+            return self._tok.apply_chat_template(
+                messages, tokenize=False, add_generation_prompt=True
+            )
+        return _plain_chat_template(messages)
 
 
 def get_tokenizer(name_or_path: str | None):
