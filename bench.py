@@ -1128,9 +1128,23 @@ def _measure_quant_matmul_bw(
 
 
 def _merge_rows(prior: list[dict], fresh: list[dict]) -> list[dict]:
-    """Replace prior rows by config name (prior order kept), append new."""
+    """Replace prior rows by config name (prior order kept), append new.
+
+    A fresh SKIP never clobbers a prior MEASURED row: a tunnel death
+    mid-measurement is caught and recorded as a skip, and round 4 lost its
+    only measured 3-int8 number exactly that way — the artifact of record
+    must keep the last real measurement (with its original stamp) and
+    carry the failed refresh as ``refresh_skipped`` instead."""
     by_cfg = {str(r.get("config")): r for r in fresh}
-    merged = [by_cfg.pop(str(r.get("config")), r) for r in prior]
+    merged = []
+    for r in prior:
+        f = by_cfg.pop(str(r.get("config")), None)
+        if f is None:
+            merged.append(r)
+        elif "skipped" in f and "skipped" not in r:
+            merged.append(r | {"refresh_skipped": f["skipped"]})
+        else:
+            merged.append(f)
     merged.extend(by_cfg.values())
     return merged
 
@@ -1236,6 +1250,7 @@ def run_ladder(args, degraded: str | None) -> list[dict]:
                 "preset": entry["preset"],
                 "skipped": f"{type(exc).__name__}: "
                            f"{(str(exc).splitlines() or ['?'])[0][:200]}",
+                "error": True,  # exception, not a doesn't-fit skip
             })
         rows.append(row)
         print(f"#   -> {row}", file=sys.stderr)
@@ -1311,6 +1326,7 @@ def run_ladder(args, degraded: str | None) -> list[dict]:
                 f"{type(exc).__name__}: "
                 f"{(str(exc).splitlines() or ['?'])[0][:200]}"
             )
+            row["error"] = True
         rows.append(row)
         print(f"# {name}: {row}", file=sys.stderr)
         emit()
@@ -1469,6 +1485,14 @@ def main() -> None:
             result["degraded"] = degraded
     watchdog_done.set()
     print(json.dumps(result))
+    if args.ladder and args.rows:
+        attempted = [r for r in rows if "config" in r]
+        if attempted and all(r.get("error") for r in attempted):
+            # Every requested row died on an exception (tunnel wedge, OOM):
+            # tell the runbook to retry rather than reading rc 0 as "row
+            # recorded".  The artifact keeps prior measured rows either way
+            # (_merge_rows).
+            raise SystemExit(4)
 
 
 if __name__ == "__main__":

@@ -92,13 +92,21 @@ def _replicated(pm, *xs):
     return out if len(out) > 1 else out[0]
 
 
-def _sample_first(logits, last_idx, rng, temperature, top_k, top_p):
+def _sample_first(logits, last_idx, rng, temperature, top_k, top_p,
+                  temp_req=None, topp_req=None):
     """Sample the admitted row's first token from the last real position's
-    logits — the one sampling tail shared by every admission path."""
+    logits — the one sampling tail shared by every admission path.
+    ``temp_req``/``topp_req`` (traced scalars) override the static knobs
+    for per-request sampling without a recompile per value."""
     next_logits = jnp.take_along_axis(
         logits, jnp.maximum(last_idx - 1, 0)[None, None, None], axis=1
     )[:, 0]
-    return sampling.sample(rng, next_logits, temperature, top_k, top_p)[0]
+    if temp_req is None:
+        return sampling.sample(rng, next_logits, temperature, top_k, top_p)[0]
+    return sampling.sample_rows(
+        rng, next_logits, jnp.reshape(temp_req, (1,)), top_k,
+        jnp.reshape(topp_req, (1,)),
+    )[0]
 
 
 def _prefill_row(fwd, params, cfg, cache_dtype, s, prompt):
@@ -137,12 +145,13 @@ def _prefill_row_with_prefix(fwd, params, cfg, prefix_k, prefix_v, prefix_len,
 
 def _finish_admission(
     cache, slot, row_cache, logits, last_idx, rng, temperature, top_k, top_p,
-    total_len,
+    total_len, temp_req=None, topp_req=None,
 ):
     """Shared admission tail (plain and prefix-cached paths): sample the
     first token from the last real position's logits, splice the prefilled
     row into the shared cache, report the row's valid slots."""
-    tok = _sample_first(logits, last_idx, rng, temperature, top_k, top_p)
+    tok = _sample_first(logits, last_idx, rng, temperature, top_k, top_p,
+                        temp_req, topp_req)
     ax = _batch_axis(cache.k.ndim)
 
     def splice(full, row):
@@ -175,6 +184,8 @@ def admit_row(
     top_k: int = 0,
     top_p: float = 1.0,
     pm: Any = None,  # ParallelModel — GSPMD dp/tp mesh batching
+    temp_req: jax.Array | None = None,  # traced per-request overrides
+    topp_req: jax.Array | None = None,
 ) -> tuple[Any, jax.Array, jax.Array]:
     """Prefill one request into batch row ``slot``.  Returns
     (cache', first_token, row_valid [S]) — real_lens/budget bookkeeping is
@@ -186,7 +197,7 @@ def admit_row(
     )
     cache, tok, row_valid = _finish_admission(
         cache, slot, row_cache, logits, plen, rng, temperature, top_k, top_p,
-        total_len=plen,
+        total_len=plen, temp_req=temp_req, topp_req=topp_req,
     )
     return (cache, *_replicated(pm, tok, row_valid))
 
@@ -354,6 +365,8 @@ def admit_row_with_prefix(
     top_k: int = 0,
     top_p: float = 1.0,
     pm: Any = None,  # ParallelModel — GSPMD dp/tp mesh batching
+    temp_req: jax.Array | None = None,  # traced per-request overrides
+    topp_req: jax.Array | None = None,
 ) -> tuple[Any, jax.Array, jax.Array]:
     """Prefix-cached admission: the shared prefix's KV (computed ONCE by
     ``register_prefix``) seeds the row; only the request's suffix prefills —
@@ -364,7 +377,7 @@ def admit_row_with_prefix(
     )
     cache, tok, row_valid = _finish_admission(
         cache, slot, row_cache, logits, clen, rng, temperature, top_k, top_p,
-        total_len=prefix_len + clen,
+        total_len=prefix_len + clen, temp_req=temp_req, topp_req=topp_req,
     )
     return (cache, *_replicated(pm, tok, row_valid))
 
@@ -379,7 +392,7 @@ def _paged_pool(cfg: ModelConfig, num_pages: int, page_size: int, dtype=None):
 
 
 def _paged_splice(cache, page_list, row_cache, logits, last_idx, rng,
-                  temperature, top_k, top_p):
+                  temperature, top_k, top_p, temp_req=None, topp_req=None):
     """Admission tail for the paged pool: sample the first token, then
     scatter the contiguous transient row cache into the row's pages.
     ``page_list`` [P] is padded with the reserved scratch page 0 past the
@@ -387,7 +400,8 @@ def _paged_splice(cache, page_list, row_cache, logits, last_idx, rng,
     writes land in the scratch page, whose contents no LIVE row ever reads
     (freed rows' clamped decode reads do touch it, but their outputs are
     masked to pad)."""
-    tok = _sample_first(logits, last_idx, rng, temperature, top_k, top_p)
+    tok = _sample_first(logits, last_idx, rng, temperature, top_k, top_p,
+                        temp_req, topp_req)
     p = page_list.shape[0]
     blk = cache.k.shape[2]
 
@@ -418,6 +432,8 @@ def admit_row_paged(
     temperature: float = 0.0,
     top_k: int = 0,
     top_p: float = 1.0,
+    temp_req: jax.Array | None = None,  # traced per-request overrides
+    topp_req: jax.Array | None = None,
 ) -> tuple[Any, jax.Array]:
     """Paged admission: dense causal prefill on a transient contiguous row
     cache, then scatter its pages into the pool.  Returns (cache', tok)."""
@@ -427,7 +443,7 @@ def admit_row_paged(
     )
     return _paged_splice(
         cache, page_list, row_cache, logits, plen, rng, temperature, top_k,
-        top_p,
+        top_p, temp_req, topp_req,
     )
 
 
@@ -450,6 +466,8 @@ def admit_row_with_prefix_paged(
     temperature: float = 0.0,
     top_k: int = 0,
     top_p: float = 1.0,
+    temp_req: jax.Array | None = None,  # traced per-request overrides
+    topp_req: jax.Array | None = None,
 ) -> tuple[Any, jax.Array]:
     """Prefix-cached paged admission: the prefix KV seeds the transient row
     cache, only the suffix prefills, then the pages scatter into the pool."""
@@ -458,7 +476,7 @@ def admit_row_with_prefix_paged(
     )
     return _paged_splice(
         cache, page_list, row_cache, logits, clen, rng, temperature, top_k,
-        top_p,
+        top_p, temp_req, topp_req,
     )
 
 
@@ -488,9 +506,13 @@ def decode_chunk(
     pad_id: int = 0,
     pm: Any = None,  # ParallelModel — GSPMD dp/tp mesh batching
     tables: jax.Array | None = None,  # [B, P] page table — cache is a pool
+    temp_row: jax.Array | None = None,  # [B] traced per-row temperature
+    topp_row: jax.Array | None = None,  # [B] traced per-row top-p
 ) -> tuple[jax.Array, Any, jax.Array, jax.Array, jax.Array, jax.Array, jax.Array]:
     """K decode steps with per-row positions.  Returns
-    (toks [B, K], cache', last_tok', real_lens', valid', active', budget')."""
+    (toks [B, K], cache', last_tok', real_lens', valid', active', budget').
+    ``temp_row``/``topp_row`` switch sampling to the per-row path
+    (sampling.sample_rows) — per-request sampling in one shared batch."""
     if tables is None:
         s = cache.k.shape[-3]
         slots = jnp.arange(s, dtype=jnp.int32)
@@ -525,7 +547,13 @@ def decode_chunk(
                 active[:, None] & (slots[None, :] == real_lens[:, None])
             )
         real_lens = real_lens + active.astype(jnp.int32)
-        tok = sampling.sample(rng_step, logits, temperature, top_k, top_p)
+        if temp_row is None:
+            tok = sampling.sample(rng_step, logits, temperature, top_k, top_p)
+        else:
+            tok = sampling.sample_rows(
+                rng_step, logits, temp_row, top_k,
+                1.0 if topp_row is None else topp_row,
+            )
         budget = budget - active.astype(jnp.int32)
         if eos_id >= 0:
             active = active & (tok != eos_id)
@@ -560,6 +588,8 @@ class _Request:
     ids: list[int]  # suffix ids when prefix is set, else the full prompt
     max_new_tokens: int
     prefix: str | None = None
+    temperature: float | None = None  # None -> the batcher's config
+    top_p: float | None = None
 
 
 @dataclass
@@ -789,6 +819,11 @@ class ContinuousBatcher:
         )
         self.active = np.zeros((batch_slots,), bool)
         self.budget = np.zeros((batch_slots,), np.int32)
+        # Per-row sampling mirrors: rows admitted with explicit per-request
+        # knobs diverge from the batcher config; decode chunks switch to
+        # the traced per-row sampling path only while such a row is live.
+        self.temp_row = np.full((batch_slots,), temperature, np.float32)
+        self.topp_row = np.full((batch_slots,), top_p, np.float32)
         self.rows = [_RowState() for _ in range(batch_slots)]
         self.queue: deque[_Request] = deque()
         self.results: dict[int, list[int]] = {}
@@ -841,8 +876,13 @@ class ContinuousBatcher:
 
     def submit(
         self, prompt: str | list[int], max_new_tokens: int = 32,
-        prefix: str | None = None,
+        prefix: str | None = None, temperature: float | None = None,
+        top_p: float | None = None,
     ) -> int:
+        """Queue a request.  ``temperature``/``top_p`` override the
+        batcher's sampling config FOR THIS REQUEST (serving front-ends:
+        per-request sampling in a shared batch); ``top_k`` stays
+        batcher-wide (static under jit).  None keeps the config value."""
         ids = (
             self.tokenizer.encode(prompt)
             if isinstance(prompt, str)
@@ -853,6 +893,19 @@ class ContinuousBatcher:
             raise ValueError("empty prompt")
         if max_new_tokens < 1:
             raise ValueError(f"max_new_tokens must be >= 1, got {max_new_tokens}")
+        if temperature is not None:
+            import math
+
+            if not (math.isfinite(temperature) and temperature >= 0.0):
+                raise ValueError(f"temperature must be >= 0, got {temperature}")
+            if self.speculative and temperature > 0.0:
+                raise ValueError(
+                    "speculative batching is greedy-exact; per-request "
+                    "temperature > 0 is not supported (build a plain "
+                    "batcher for sampled serving)"
+                )
+        if top_p is not None and not 0.0 < top_p <= 1.0:
+            raise ValueError(f"top_p must be in (0, 1], got {top_p}")
         pfx_len = 0
         if prefix is not None:
             if prefix not in self.prefixes:
@@ -865,7 +918,10 @@ class ContinuousBatcher:
             )
         rid = self._next_rid
         self._next_rid += 1
-        self.queue.append(_Request(rid, ids, max_new_tokens, prefix=prefix))
+        self.queue.append(_Request(
+            rid, ids, max_new_tokens, prefix=prefix,
+            temperature=temperature, top_p=top_p,
+        ))
         return rid
 
     def cancel_row(self, rid: int) -> bool:
@@ -946,19 +1002,31 @@ class ContinuousBatcher:
             tp = min(_bucket(len(req.ids)), self.s - pfx_len)
             prompt = np.full((tp,), self.pad_id, np.int32)
             prompt[: len(req.ids)] = req.ids
+            # Per-request sampling: traced scalar overrides (no recompile
+            # per value) only when the request diverges from the config.
+            req_t = (self.sampling["temperature"] if req.temperature is None
+                     else float(req.temperature))
+            req_p = (self.sampling["top_p"] if req.top_p is None
+                     else float(req.top_p))
+            custom = (req_t != self.sampling["temperature"]
+                      or req_p != self.sampling["top_p"])
+            extra = (
+                dict(temp_req=jnp.float32(req_t), topp_req=jnp.float32(req_p))
+                if custom else {}
+            )
             if self.paged and pfx is not None:
                 self.cache, tok = admit_row_with_prefix_paged(
                     self.params, self.cfg, self.cache, jnp.asarray(page_list),
                     pfx.k, pfx.v, jnp.int32(pfx_len),
                     jnp.asarray(prompt), jnp.int32(len(req.ids)),
-                    self._split_rng(), **self.sampling,
+                    self._split_rng(), **self.sampling, **extra,
                 )
                 row_valid = np.arange(self.s) < total_len
             elif self.paged:
                 self.cache, tok = admit_row_paged(
                     self.params, self.cfg, self.cache, jnp.asarray(page_list),
                     jnp.asarray(prompt), jnp.int32(len(req.ids)),
-                    self._split_rng(), **self.sampling,
+                    self._split_rng(), **self.sampling, **extra,
                 )
                 row_valid = np.arange(self.s) < total_len
             elif pfx is not None:
@@ -966,13 +1034,13 @@ class ContinuousBatcher:
                     self.params, self.cfg, self.cache, jnp.int32(i),
                     pfx.k, pfx.v, jnp.int32(pfx_len),
                     jnp.asarray(prompt), jnp.int32(len(req.ids)),
-                    self._split_rng(), pm=self.pm, **self.sampling,
+                    self._split_rng(), pm=self.pm, **self.sampling, **extra,
                 )
             else:
                 self.cache, tok, row_valid = admit_row(
                     self.params, self.cfg, self.cache, jnp.int32(i),
                     jnp.asarray(prompt), jnp.int32(len(req.ids)),
-                    self._split_rng(), pm=self.pm, **self.sampling,
+                    self._split_rng(), pm=self.pm, **self.sampling, **extra,
                 )
             if self.speculative:
                 # Seed the DRAFT cache for this row: full prompt (prefix
@@ -989,6 +1057,8 @@ class ContinuousBatcher:
                 )
             tok = int(tok)  # replicated scalar — identical on every process
             self.last_tok[i] = tok
+            self.temp_row[i] = req_t
+            self.topp_row[i] = req_p
             self.real_lens[i] = total_len
             self.valid[i] = np.asarray(row_valid)
             self.active[i] = True
@@ -1105,6 +1175,22 @@ class ContinuousBatcher:
                 )
                 counts = np.asarray(m)
             else:
+                # Per-row sampling path only while a custom-sampled row is
+                # live: the all-default batch keeps the static program
+                # (greedy compiles to a bare argmax — no per-step vocab
+                # sort paid for traffic that never asked for sampling).
+                rows_live = self.active & (
+                    (self.temp_row != self.sampling["temperature"])
+                    | (self.topp_row != self.sampling["top_p"])
+                )
+                per_row = {}
+                if bool(rows_live.any()):
+                    per_row["temp_row"] = jnp.asarray(self.temp_row)
+                    if not bool((self.topp_row[self.active] == 1.0).all()):
+                        # All-1.0 top_p skips the per-step [B, V] sort+
+                        # softmax+cumsum mask entirely (sample_rows takes
+                        # the static keep-everything path).
+                        per_row["topp_row"] = jnp.asarray(self.topp_row)
                 toks, self.cache, last_tok, real_lens, valid, active, budget = \
                     decode_chunk(
                         self.params, self.cfg_decode, self.cache, self.last_tok,
@@ -1112,7 +1198,7 @@ class ContinuousBatcher:
                         self._split_rng(), self.chunk_steps,
                         eos_id=self.eos_id, pad_id=self.pad_id, pm=self.pm,
                         tables=jnp.asarray(self.tables) if self.paged else None,
-                        **self.sampling,
+                        **self.sampling, **per_row,
                     )
             # Back to host numpy mirrors (replicated outputs — every
             # process reads identical values).  np.array, not asarray:

@@ -71,6 +71,47 @@ def sample(
     ).astype(jnp.int32)
 
 
+def _mask_top_p_rows(logits: jax.Array, p: jax.Array) -> jax.Array:
+    """Top-p mask with a TRACED per-row ``p`` [B] (same math as
+    :func:`_mask_top_p`, which specializes on a static scalar)."""
+    p = jnp.broadcast_to(p, logits.shape[:1])[:, None]
+    sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
+    probs = jax.nn.softmax(sorted_logits, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    keep_sorted = cum - probs < p
+    cutoff = jnp.min(
+        jnp.where(keep_sorted, sorted_logits, jnp.inf), axis=-1, keepdims=True
+    )
+    return jnp.where(logits < cutoff, -jnp.inf, logits)
+
+
+def sample_rows(
+    rng: jax.Array,
+    logits: jax.Array,       # [B, V] float32
+    temperature: jax.Array,  # [B] — 0 means greedy for that row
+    top_k: int = 0,
+    top_p: jax.Array | float = 1.0,  # [B] or scalar, traced
+) -> jax.Array:
+    """Per-row sampling: each batch row draws with its OWN temperature and
+    top-p — continuous-batching serving mixes per-request sampling configs
+    in one decode step without recompiling (the knobs are traced inputs,
+    not static).  ``top_k`` stays static and shared: ``lax.top_k`` needs a
+    compile-time k.  Rows with temperature == 0 take the greedy token
+    (identical to :func:`greedy`); the warp order matches :func:`sample`,
+    so a uniform batch draws the same tokens as the static path under the
+    same rng."""
+    temperature = jnp.asarray(temperature, logits.dtype)
+    safe_t = jnp.where(temperature > 0, temperature, 1.0)[:, None]
+    warped = logits / safe_t
+    warped = _mask_top_k(warped, top_k)
+    if not (isinstance(top_p, (int, float)) and float(top_p) >= 1.0):
+        # Static keep-everything fast path: the [B, V] sort+softmax+cumsum
+        # is pure waste when no row asked for top-p.
+        warped = _mask_top_p_rows(warped, jnp.asarray(top_p, logits.dtype))
+    drawn = jax.random.categorical(rng, warped, axis=-1).astype(jnp.int32)
+    return jnp.where(temperature > 0, drawn, greedy(logits))
+
+
 def sampler_from_config(rt: RuntimeConfig):
     """Bind the static sampling knobs from a RuntimeConfig."""
 

@@ -318,32 +318,42 @@ class InferenceServer:
             return list(prompt), ""
         raise BadRequest("'prompt' must be a non-empty string or token-id list")
 
-    def _check_sampling(self, req: dict) -> None:
-        """Per-request sampling knobs must match the server's engine config
-        until per-row sampling lands; reject silently-different results."""
-        cfg = self.batcher.sampling
-        for name, have in (
-            ("temperature", cfg["temperature"]),
-            ("top_p", cfg["top_p"]),
-        ):
+    def _parse_sampling(self, req: dict) -> tuple[float | None, float | None]:
+        """Per-request temperature/top_p ride the batcher's per-row
+        sampling path; top_k stays engine-wide (static under jit)."""
+        import math
+
+        out = []
+        for name in ("temperature", "top_p"):
             want = req.get(name)
             if want is None:
+                out.append(None)
                 continue
             if not isinstance(want, (int, float)) or isinstance(want, bool):
                 raise BadRequest(f"{name!r} must be a number")
-            if abs(float(want) - float(have)) > 1e-6:
+            want = float(want)
+            if not math.isfinite(want):  # json.loads accepts Infinity/NaN
+                raise BadRequest(f"{name!r} must be finite")
+            if name == "temperature" and not 0.0 <= want:
+                raise BadRequest("'temperature' must be >= 0")
+            if name == "top_p" and not 0.0 < want <= 1.0:
+                raise BadRequest("'top_p' must be in (0, 1]")
+            if name == "temperature" and want > 0 and self.batcher.speculative:
                 raise BadRequest(
-                    f"this server samples with {name}={have} (fixed at "
-                    f"engine build); per-request {name} is not supported"
+                    "this server runs speculative (greedy-exact) decoding; "
+                    "temperature > 0 is not supported"
                 )
+            out.append(want)
         want_k = req.get("top_k")
-        if want_k is not None and want_k != cfg["top_k"]:
+        if want_k is not None and want_k != self.batcher.sampling["top_k"]:
             raise BadRequest(
-                f"this server samples with top_k={cfg['top_k']} (fixed at "
-                "engine build); per-request top_k is not supported"
+                f"this server samples with top_k="
+                f"{self.batcher.sampling['top_k']} (fixed at engine build); "
+                "per-request top_k is not supported"
             )
         if req.get("n", 1) != 1:
             raise BadRequest("only n=1 is supported")
+        return out[0], out[1]
 
     async def _completions(self, writer, req: dict, chat: bool) -> None:
         prompt_ids, _ = self._parse_prompt(req, chat)
@@ -354,7 +364,7 @@ class InferenceServer:
         stream = bool(req.get("stream", False))
         stop = _stop_list(req)
         prefix = req.get("prefix")
-        self._check_sampling(req)
+        temperature, top_p = self._parse_sampling(req)
         if len(self._requests) >= self.max_pending:
             await self._json(writer, 429, _err_body("server request queue is full"))
             return
@@ -371,7 +381,8 @@ class InferenceServer:
         self._requests[rid] = mbox
         try:
             got = self.batcher.submit(
-                prompt_ids, max_new_tokens=max_tokens, prefix=prefix
+                prompt_ids, max_new_tokens=max_tokens, prefix=prefix,
+                temperature=temperature, top_p=top_p,
             )
             assert got == rid
         except (ValueError, KeyError) as e:

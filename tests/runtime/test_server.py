@@ -288,7 +288,9 @@ def test_bad_requests_rejected(tiny):
             ({"prompt": "x", "max_tokens": 0}, 400),
             ({"prompt": "x", "max_tokens": True}, 400),     # bool is not int
             ({"prompt": "x", "n": 2}, 400),
-            ({"prompt": "x", "temperature": 0.9}, 400),     # engine is greedy
+            ({"prompt": "x", "temperature": -0.1}, 400),
+            ({"prompt": "x", "top_p": 0.0}, 400),
+            ({"prompt": "x", "top_k": 7}, 400),             # top_k is engine-wide
             ({"prompt": "x", "stop": ["a", "b", "c", "d", "e"]}, 400),
             ({"prompt": "x" * 500, "max_tokens": 8}, 400),  # exceeds max_len
             ({"prompt": "x", "prefix": "nope"}, 400),       # unknown prefix
@@ -306,10 +308,11 @@ def test_bad_requests_rejected(tiny):
         status = int((await reader.readline()).split()[1])
         assert status == 400
         writer.close()
-        # Temperature equal to the engine's is accepted.
+        # Per-request sampling rides the batcher's per-row path.
         status, _ = await _request(
             host, port, "POST", "/v1/completions",
-            {"prompt": "ok", "max_tokens": 2, "temperature": 0.0},
+            {"prompt": "ok", "max_tokens": 2, "temperature": 0.9,
+             "top_p": 0.95},
         )
         assert status == 200
 
