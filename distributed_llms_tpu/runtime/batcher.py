@@ -102,11 +102,17 @@ def _sample_first(logits, last_idx, rng, temperature, top_k, top_p,
         logits, jnp.maximum(last_idx - 1, 0)[None, None, None], axis=1
     )[:, 0]
     if temp_req is None:
-        return sampling.sample(rng, next_logits, temperature, top_k, top_p)[0]
-    return sampling.sample_rows(
-        rng, next_logits, jnp.reshape(temp_req, (1,)), top_k,
-        jnp.reshape(topp_req, (1,)),
-    )[0]
+        tok = sampling.sample(rng, next_logits, temperature, top_k, top_p)[0]
+    else:
+        tok = sampling.sample_rows(
+            rng, next_logits, jnp.reshape(temp_req, (1,)), top_k,
+            jnp.reshape(topp_req, (1,)),
+        )[0]
+    # Chosen-token logprob under the RAW model distribution (the OpenAI
+    # logprobs contract) — one [V] log-softmax, trivial next to the
+    # prefill that produced the logits.
+    lp = jax.nn.log_softmax(next_logits[0].astype(jnp.float32))[tok]
+    return tok, lp
 
 
 def _prefill_row(fwd, params, cfg, cache_dtype, s, prompt):
@@ -150,8 +156,8 @@ def _finish_admission(
     """Shared admission tail (plain and prefix-cached paths): sample the
     first token from the last real position's logits, splice the prefilled
     row into the shared cache, report the row's valid slots."""
-    tok = _sample_first(logits, last_idx, rng, temperature, top_k, top_p,
-                        temp_req, topp_req)
+    tok, lp = _sample_first(logits, last_idx, rng, temperature, top_k, top_p,
+                            temp_req, topp_req)
     ax = _batch_axis(cache.k.ndim)
 
     def splice(full, row):
@@ -164,7 +170,7 @@ def _finish_admission(
     cache = KVCache(k=splice(cache.k, row_cache.k), v=splice(cache.v, row_cache.v))
     s = cache.k.shape[-3]
     row_valid = jnp.arange(s, dtype=jnp.int32) < total_len
-    return cache, tok, row_valid
+    return cache, tok, row_valid, lp
 
 
 @partial(
@@ -186,20 +192,20 @@ def admit_row(
     pm: Any = None,  # ParallelModel — GSPMD dp/tp mesh batching
     temp_req: jax.Array | None = None,  # traced per-request overrides
     topp_req: jax.Array | None = None,
-) -> tuple[Any, jax.Array, jax.Array]:
+) -> tuple[Any, jax.Array, jax.Array, jax.Array]:
     """Prefill one request into batch row ``slot``.  Returns
-    (cache', first_token, row_valid [S]) — real_lens/budget bookkeeping is
-    the caller's.  The transient row cache is deliberately NOT
+    (cache', first_token, row_valid [S], first_token_logprob) —
+    real_lens/budget bookkeeping is the caller's.  The transient row cache is deliberately NOT
     mesh-constrained: batch 1 can't shard over 'data'; XLA places it (TP
     still shards the matmuls via the weights)."""
     logits, row_cache = _prefill_row(
         _fwd(pm), params, cfg, cache.k.dtype, cache.k.shape[-3], prompt
     )
-    cache, tok, row_valid = _finish_admission(
+    cache, tok, row_valid, lp = _finish_admission(
         cache, slot, row_cache, logits, plen, rng, temperature, top_k, top_p,
         total_len=plen, temp_req=temp_req, topp_req=topp_req,
     )
-    return (cache, *_replicated(pm, tok, row_valid))
+    return (cache, *_replicated(pm, tok, row_valid, lp))
 
 
 @partial(jax.jit, static_argnames=("cfg",), donate_argnames=("cache",))
@@ -367,19 +373,19 @@ def admit_row_with_prefix(
     pm: Any = None,  # ParallelModel — GSPMD dp/tp mesh batching
     temp_req: jax.Array | None = None,  # traced per-request overrides
     topp_req: jax.Array | None = None,
-) -> tuple[Any, jax.Array, jax.Array]:
+) -> tuple[Any, jax.Array, jax.Array, jax.Array]:
     """Prefix-cached admission: the shared prefix's KV (computed ONCE by
     ``register_prefix``) seeds the row; only the request's suffix prefills —
     session-style continuation math (runtime/session.py) for one row.
-    Returns (cache', first_token, row_valid)."""
+    Returns (cache', first_token, row_valid, first_token_logprob)."""
     logits, row_cache = _prefill_row_with_prefix(
         _fwd(pm), params, cfg, prefix_k, prefix_v, prefix_len, chunk
     )
-    cache, tok, row_valid = _finish_admission(
+    cache, tok, row_valid, lp = _finish_admission(
         cache, slot, row_cache, logits, clen, rng, temperature, top_k, top_p,
         total_len=prefix_len + clen, temp_req=temp_req, topp_req=topp_req,
     )
-    return (cache, *_replicated(pm, tok, row_valid))
+    return (cache, *_replicated(pm, tok, row_valid, lp))
 
 
 def _paged_pool(cfg: ModelConfig, num_pages: int, page_size: int, dtype=None):
@@ -400,8 +406,8 @@ def _paged_splice(cache, page_list, row_cache, logits, last_idx, rng,
     writes land in the scratch page, whose contents no LIVE row ever reads
     (freed rows' clamped decode reads do touch it, but their outputs are
     masked to pad)."""
-    tok = _sample_first(logits, last_idx, rng, temperature, top_k, top_p,
-                        temp_req, topp_req)
+    tok, lp = _sample_first(logits, last_idx, rng, temperature, top_k, top_p,
+                            temp_req, topp_req)
     p = page_list.shape[0]
     blk = cache.k.shape[2]
 
@@ -413,7 +419,7 @@ def _paged_splice(cache, page_list, row_cache, logits, last_idx, rng,
     cache = KVCache(
         k=splice(cache.k, row_cache.k), v=splice(cache.v, row_cache.v)
     )
-    return cache, tok
+    return cache, tok, lp
 
 
 @partial(
@@ -434,9 +440,10 @@ def admit_row_paged(
     top_p: float = 1.0,
     temp_req: jax.Array | None = None,  # traced per-request overrides
     topp_req: jax.Array | None = None,
-) -> tuple[Any, jax.Array]:
+) -> tuple[Any, jax.Array, jax.Array]:
     """Paged admission: dense causal prefill on a transient contiguous row
-    cache, then scatter its pages into the pool.  Returns (cache', tok)."""
+    cache, then scatter its pages into the pool.
+    Returns (cache', tok, logprob)."""
     logits, row_cache = _prefill_row(
         _fwd(None), params, cfg, cache.k.dtype,
         page_list.shape[0] * cache.k.shape[2], prompt,
@@ -468,9 +475,10 @@ def admit_row_with_prefix_paged(
     top_p: float = 1.0,
     temp_req: jax.Array | None = None,  # traced per-request overrides
     topp_req: jax.Array | None = None,
-) -> tuple[Any, jax.Array]:
+) -> tuple[Any, jax.Array, jax.Array]:
     """Prefix-cached paged admission: the prefix KV seeds the transient row
-    cache, only the suffix prefills, then the pages scatter into the pool."""
+    cache, only the suffix prefills, then the pages scatter into the pool.
+    Returns (cache', tok, logprob)."""
     logits, row_cache = _prefill_row_with_prefix(
         _fwd(None), params, cfg, prefix_k, prefix_v, prefix_len, chunk
     )
@@ -508,11 +516,13 @@ def decode_chunk(
     tables: jax.Array | None = None,  # [B, P] page table — cache is a pool
     temp_row: jax.Array | None = None,  # [B] traced per-row temperature
     topp_row: jax.Array | None = None,  # [B] traced per-row top-p
-) -> tuple[jax.Array, Any, jax.Array, jax.Array, jax.Array, jax.Array, jax.Array]:
+) -> tuple[jax.Array, Any, jax.Array, jax.Array, jax.Array, jax.Array,
+           jax.Array, jax.Array]:
     """K decode steps with per-row positions.  Returns
-    (toks [B, K], cache', last_tok', real_lens', valid', active', budget').
-    ``temp_row``/``topp_row`` switch sampling to the per-row path
-    (sampling.sample_rows) — per-request sampling in one shared batch."""
+    (toks [B, K], cache', last_tok', real_lens', valid', active', budget',
+    logprobs [B, K]).  ``temp_row``/``topp_row`` switch sampling to the
+    per-row path (sampling.sample_rows) — per-request sampling in one
+    shared batch."""
     if tables is None:
         s = cache.k.shape[-3]
         slots = jnp.arange(s, dtype=jnp.int32)
@@ -561,18 +571,24 @@ def decode_chunk(
         out = jnp.where(
             carry[4], tok, jnp.int32(pad_id)
         )  # mask with PRE-step active
+        # Chosen-token logprob under the raw distribution (serving's
+        # OpenAI logprobs field) — one log-softmax reduction per step.
+        lp = jnp.take_along_axis(
+            jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1),
+            tok[:, None], axis=-1,
+        )[:, 0]
+        lp = jnp.where(carry[4], lp, 0.0)
         last_tok = jnp.where(carry[4], tok, last_tok)
-        return (cache, last_tok, real_lens, valid, active, budget), out
+        return (cache, last_tok, real_lens, valid, active, budget), (out, lp)
 
     rngs = jax.random.split(rng, chunk_steps)
     carry0 = (cache, last_tok, real_lens, valid, active, budget)
-    (cache, last_tok, real_lens, valid, active, budget), toks = jax.lax.scan(
-        step, carry0, rngs
+    (cache, last_tok, real_lens, valid, active, budget), (toks, lps) = \
+        jax.lax.scan(step, carry0, rngs)
+    toks, lps, last_tok, real_lens, valid, active, budget = _replicated(
+        pm, toks.T, lps.T, last_tok, real_lens, valid, active, budget
     )
-    toks, last_tok, real_lens, valid, active, budget = _replicated(
-        pm, toks.T, last_tok, real_lens, valid, active, budget
-    )
-    return toks, cache, last_tok, real_lens, valid, active, budget
+    return toks, cache, last_tok, real_lens, valid, active, budget, lps
 
 
 def _bucket(n: int, floor: int = 8) -> int:
@@ -603,6 +619,9 @@ class _Prefix:
 class _RowState:
     rid: int | None = None
     emitted: list[int] = field(default_factory=list)
+    lps: list[float] = field(default_factory=list)  # per-token logprobs
+    #                     (raw distribution), aligned with emitted; empty
+    #                     in speculative mode (verify logits not retained)
     remaining: int = 0  # decode tokens this row may still emit (host mirror
     #                     of the device budget — distinguishes real pad-id
     #                     tokens from post-deactivation padding)
@@ -827,6 +846,9 @@ class ContinuousBatcher:
         self.rows = [_RowState() for _ in range(batch_slots)]
         self.queue: deque[_Request] = deque()
         self.results: dict[int, list[int]] = {}
+        # Per-token logprobs of each finished request (None in speculative
+        # mode); same lifecycle as ``results``.
+        self.result_logprobs: dict[int, list[float] | None] = {}
         self.prefixes: dict[str, _Prefix] = {}
         self._rng = jax.random.key(seed)
         self._next_rid = 0
@@ -946,14 +968,20 @@ class ContinuousBatcher:
             if req.rid == rid:
                 self.queue.remove(req)
                 self.results[rid] = []
+                self.result_logprobs[rid] = None if self.speculative else []
                 METRICS.inc("batcher.cancelled")
                 return True
         for i in range(self.b):
             row = self.rows[i]
             if row.rid == rid:
                 if self.eos_id >= 0 and self.eos_id in row.emitted:
-                    row.emitted = row.emitted[: row.emitted.index(self.eos_id) + 1]
+                    cut = row.emitted.index(self.eos_id) + 1
+                    row.emitted = row.emitted[:cut]
+                    row.lps = row.lps[:cut]
                 self.results[rid] = row.emitted
+                self.result_logprobs[rid] = (
+                    None if self.speculative else row.lps
+                )
                 if row.pages:
                     self.free_pages.extend(row.pages)
                     self.tables[i] = 0
@@ -1015,7 +1043,7 @@ class ContinuousBatcher:
                 if custom else {}
             )
             if self.paged and pfx is not None:
-                self.cache, tok = admit_row_with_prefix_paged(
+                self.cache, tok, lp = admit_row_with_prefix_paged(
                     self.params, self.cfg, self.cache, jnp.asarray(page_list),
                     pfx.k, pfx.v, jnp.int32(pfx_len),
                     jnp.asarray(prompt), jnp.int32(len(req.ids)),
@@ -1023,21 +1051,21 @@ class ContinuousBatcher:
                 )
                 row_valid = np.arange(self.s) < total_len
             elif self.paged:
-                self.cache, tok = admit_row_paged(
+                self.cache, tok, lp = admit_row_paged(
                     self.params, self.cfg, self.cache, jnp.asarray(page_list),
                     jnp.asarray(prompt), jnp.int32(len(req.ids)),
                     self._split_rng(), **self.sampling, **extra,
                 )
                 row_valid = np.arange(self.s) < total_len
             elif pfx is not None:
-                self.cache, tok, row_valid = admit_row_with_prefix(
+                self.cache, tok, row_valid, lp = admit_row_with_prefix(
                     self.params, self.cfg, self.cache, jnp.int32(i),
                     pfx.k, pfx.v, jnp.int32(pfx_len),
                     jnp.asarray(prompt), jnp.int32(len(req.ids)),
                     self._split_rng(), pm=self.pm, **self.sampling, **extra,
                 )
             else:
-                self.cache, tok, row_valid = admit_row(
+                self.cache, tok, row_valid, lp = admit_row(
                     self.params, self.cfg, self.cache, jnp.int32(i),
                     jnp.asarray(prompt), jnp.int32(len(req.ids)),
                     self._split_rng(), pm=self.pm, **self.sampling, **extra,
@@ -1067,6 +1095,7 @@ class ContinuousBatcher:
             self.budget[i] = req.max_new_tokens - 1
             self.rows[i] = _RowState(
                 rid=req.rid, emitted=[tok],
+                lps=[] if self.speculative else [float(lp)],
                 remaining=req.max_new_tokens - 1, pages=pages,
             )
             log.debug("admitted request %d into slot %d", req.rid, i)
@@ -1078,12 +1107,13 @@ class ContinuousBatcher:
                 # advances BEFORE the callback so a raising callback can
                 # never cause a re-delivery on a later run().
                 self.rows[i].streamed = 1
-                self._on_tokens(req.rid, [tok], False)
+                self._on_tokens(req.rid, [tok], False,
+                                None if self.speculative else [float(lp)])
             METRICS.inc("batcher.admitted")
 
     def _collect(
         self, toks: np.ndarray, was_active: np.ndarray,
-        counts: np.ndarray | None = None,
+        counts: np.ndarray | None = None, lps: np.ndarray | None = None,
     ) -> None:
         for i in range(self.b):
             row = self.rows[i]
@@ -1094,11 +1124,13 @@ class ContinuousBatcher:
             # the count still collects).  decode_chunk's fixed-step output
             # keeps the remaining-guarded full sweep.
             row_toks = toks[i] if counts is None else toks[i][: counts[i]]
-            for t in row_toks:
+            for j, t in enumerate(row_toks):
                 if row.remaining <= 0:
                     break
                 t = int(t)
                 row.emitted.append(t)
+                if lps is not None:
+                    row.lps.append(float(lps[i][j]))
                 row.remaining -= 1
                 if t == self.eos_id:
                     break
@@ -1111,11 +1143,18 @@ class ContinuousBatcher:
                 if self.eos_id >= 0 and self.eos_id in row.emitted:
                     cut = row.emitted.index(self.eos_id) + 1
                     row.emitted = row.emitted[:cut]
+                    row.lps = row.lps[:cut]
                 self.results[row.rid] = row.emitted
+                self.result_logprobs[row.rid] = (
+                    None if self.speculative else row.lps
+                )
                 rid, final = row.rid, row.emitted[row.streamed:]
                 if row.pages:  # paged: return the row's pool pages
                     self.free_pages.extend(row.pages)
                     self.tables[i] = 0
+                final_lps = (
+                    None if self.speculative else row.lps[row.streamed:]
+                )
                 self.rows[i] = _RowState()
                 METRICS.inc("batcher.completed")
                 if self._on_tokens is not None:
@@ -1123,7 +1162,7 @@ class ContinuousBatcher:
                     # (possibly nothing), with done=True exactly once.  Row
                     # state is already reset, so a raising callback cannot
                     # cause a duplicate done on a later run().
-                    self._on_tokens(rid, final, True)
+                    self._on_tokens(rid, final, True, final_lps)
         if self._on_tokens is not None:
             # Still-active rows stream this chunk's new tokens (streamed
             # advances before the callback — same raise-safety).
@@ -1131,17 +1170,24 @@ class ContinuousBatcher:
                 row = self.rows[i]
                 if row.rid is not None and len(row.emitted) > row.streamed:
                     new = row.emitted[row.streamed:]
+                    new_lps = (
+                        None if self.speculative
+                        else row.lps[row.streamed:]
+                    )
                     row.streamed = len(row.emitted)
-                    self._on_tokens(row.rid, new, False)
+                    self._on_tokens(row.rid, new, False, new_lps)
 
     def run(self, on_tokens=None) -> dict[int, list[int]]:
         """Drive until every submitted request has a result.
 
-        ``on_tokens(rid, new_tokens, done)`` streams incrementally: called
-        with each request's newly committed token ids as scheduling chunks
-        complete (admission token first, then per-chunk), and exactly once
-        with ``done=True`` carrying any final tokens — the concatenation of
-        all deliveries for a rid equals its entry in the returned dict.
+        ``on_tokens(rid, new_tokens, done, logprobs)`` streams
+        incrementally: called with each request's newly committed token ids
+        as scheduling chunks complete (admission token first, then
+        per-chunk), and exactly once with ``done=True`` carrying any final
+        tokens — the concatenation of all deliveries for a rid equals its
+        entry in the returned dict.  ``logprobs`` aligns 1:1 with
+        ``new_tokens`` (raw-distribution chosen-token logprobs; None in
+        speculative mode, whose verify pass does not retain them).
         Exceptions from the callback propagate (and abort the run).
         """
         self._on_tokens = on_tokens
@@ -1191,7 +1237,8 @@ class ContinuousBatcher:
                         # softmax+cumsum mask entirely (sample_rows takes
                         # the static keep-everything path).
                         per_row["topp_row"] = jnp.asarray(self.topp_row)
-                toks, self.cache, last_tok, real_lens, valid, active, budget = \
+                (toks, self.cache, last_tok, real_lens, valid, active,
+                 budget, chunk_lps) = \
                     decode_chunk(
                         self.params, self.cfg_decode, self.cache, self.last_tok,
                         self.real_lens, self.valid, self.active, self.budget,
@@ -1208,5 +1255,7 @@ class ContinuousBatcher:
             self.valid = np.array(valid)
             self.active = np.array(active)
             self.budget = np.array(budget)
-            self._collect(np.asarray(toks), was_active, counts=counts)
+            self._collect(np.asarray(toks), was_active, counts=counts,
+                          lps=None if counts is not None
+                          else np.asarray(chunk_lps))
         return dict(self.results)

@@ -186,23 +186,27 @@ class InferenceServer:
             # run() accumulated per-rid results we already streamed; drop
             # them so a long-lived server's memory stays flat.
             self.batcher.results.clear()
+            self.batcher.result_logprobs.clear()
 
-    def _deliver(self, rid: int, toks: list[int], done: bool) -> None:
+    def _deliver(self, rid: int, toks: list[int], done: bool,
+                 lps: list[float] | None = None) -> None:
         # Engine thread, between device chunks: the one safe point to act
         # on loop-side cancel flags.
         if rid in self._cancelled:
             self._cancelled.discard(rid)
             if not done:
                 self.batcher.cancel_row(rid)
-            self._notify(rid, toks, True)
+            self._notify(rid, toks, True, lps=lps)
             return
-        self._notify(rid, toks, done)
+        self._notify(rid, toks, done, lps=lps)
 
-    def _notify(self, rid: int, toks: list[int], done: bool, err: str | None = None):
+    def _notify(self, rid: int, toks: list[int], done: bool,
+                err: str | None = None, lps: list[float] | None = None):
         mbox = self._requests.get(rid)
         if mbox is not None and self._loop is not None:
             self._loop.call_soon_threadsafe(
-                mbox.queue.put_nowait, (list(toks), done, err)
+                mbox.queue.put_nowait,
+                (list(toks), done, err, list(lps) if lps else None),
             )
 
     # -- HTTP plumbing -----------------------------------------------------
@@ -365,6 +369,23 @@ class InferenceServer:
         stop = _stop_list(req)
         prefix = req.get("prefix")
         temperature, top_p = self._parse_sampling(req)
+        lp_req = req.get("logprobs")
+        if lp_req is None or lp_req is False:
+            want_lp = False
+        elif lp_req is True or (isinstance(lp_req, int)
+                                and not isinstance(lp_req, bool)
+                                and lp_req == 0):
+            want_lp = True
+        else:
+            raise BadRequest(
+                "'logprobs' top-alternatives are not supported; pass true "
+                "(or 0) for chosen-token logprobs"
+            )
+        if want_lp and self.batcher.speculative:
+            raise BadRequest(
+                "this server runs speculative decoding, whose verify pass "
+                "does not retain logprobs"
+            )
         if len(self._requests) >= self.max_pending:
             await self._json(writer, 429, _err_body("server request queue is full"))
             return
@@ -396,11 +417,12 @@ class InferenceServer:
         try:
             if stream:
                 await self._serve_stream(
-                    writer, mbox, rid, stop, chat, oid, created
+                    writer, mbox, rid, stop, chat, oid, created, want_lp
                 )
             else:
                 await self._serve_blocking(
-                    writer, mbox, rid, stop, chat, oid, created, len(prompt_ids)
+                    writer, mbox, rid, stop, chat, oid, created,
+                    len(prompt_ids), want_lp
                 )
         except (ConnectionError, OSError, asyncio.TimeoutError):
             # Client went away.  Flag the rid only if the row is still
@@ -430,19 +452,22 @@ class InferenceServer:
         are O(n^2) over a generation and all on the loop thread."""
         tok = self.batcher.tokenizer
         ids: list[int] = []
+        lps: list[float] = []
         stopped_at: int | None = None
         scanned = 0  # chars already known stop-free
         hold = max((len(s) for s in stop), default=1) - 1
         while True:
-            toks, done, err = await mbox.queue.get()
+            toks, done, err, new_lps = await mbox.queue.get()
             if err is not None:
                 mbox.finished = True
-                yield "", ids, True, err
+                yield "", ids, lps, True, err
                 return
             if stopped_at is None:
                 # Past the stop cut, later deliveries (the cancel-ack chunk)
                 # are not part of the response — don't bill them.
                 ids.extend(toks)
+                if new_lps is not None:
+                    lps.extend(new_lps)
                 text = tok.decode(ids) if (need_text or stop or done) else None
                 hit = -1
                 if text is not None and stop:
@@ -457,6 +482,18 @@ class InferenceServer:
                 if hit >= 0:
                     stopped_at = hit
                     text = text[:hit]
+                    # Align the token-level view with the truncated text:
+                    # keep only the tokens whose decode fits within the
+                    # cut, so logprobs/usage agree with the returned text.
+                    # (Streaming may have shipped a few pre-cut logprob
+                    # entries already — deltas can't be retracted; the
+                    # blocking response is exact.)
+                    keep = 0
+                    while (keep < len(ids)
+                           and len(tok.decode(ids[: keep + 1])) <= hit):
+                        keep += 1
+                    del ids[keep:]
+                    del lps[keep:]
                     if not done:
                         # Flag for the engine; its next delivery for this
                         # rid (one chunk away at most — an active row
@@ -464,23 +501,27 @@ class InferenceServer:
                         self._cancelled.add(rid)
                 if done:
                     mbox.finished = True
-                yield text, ids, done, "stopped" if stopped_at is not None and done else None
+                yield text, ids, lps, done, (
+                    "stopped" if stopped_at is not None and done else None
+                )
                 if done:
                     return
             elif done:
                 # Cancel ack after a stop hit: no new text (None marks the
                 # truncated text already delivered as authoritative).
                 mbox.finished = True
-                yield None, ids, True, "stopped"
+                yield None, ids, lps, True, "stopped"
                 return
 
     async def _serve_blocking(
-        self, writer, mbox, rid, stop, chat, oid, created, n_prompt
+        self, writer, mbox, rid, stop, chat, oid, created, n_prompt,
+        want_lp=False,
     ) -> None:
         text = ""
         ids: list[int] = []
+        lps: list[float] = []
         reason = "length"
-        async for t, ids, done, err in self._collect_until_done(
+        async for t, ids, lps, done, err in self._collect_until_done(
             mbox, rid, stop, need_text=bool(stop)
         ):
             if err == "stopped":
@@ -504,6 +545,10 @@ class InferenceServer:
             if chat else
             {"index": 0, "text": text, "logprobs": None, "finish_reason": reason}
         )
+        if want_lp:
+            choice["logprobs"] = _lp_field(
+                self.batcher.tokenizer, ids, lps, chat
+            )
         await self._json(writer, 200, {
             "id": oid,
             "object": "chat.completion" if chat else "text_completion",
@@ -518,7 +563,7 @@ class InferenceServer:
         })
 
     async def _serve_stream(
-        self, writer, mbox, rid, stop, chat, oid, created
+        self, writer, mbox, rid, stop, chat, oid, created, want_lp=False
     ) -> None:
         writer.write(
             b"HTTP/1.1 200 OK\r\n"
@@ -529,10 +574,12 @@ class InferenceServer:
         await writer.drain()
 
         sent = 0
+        lp_sent = 0
         reason = "length"
         stop_hold = max((len(s) for s in stop), default=1) - 1
 
-        def chunk(delta: str, finish: str | None) -> bytes:
+        def chunk(delta: str, finish: str | None,
+                  lp_items: tuple | None = None) -> bytes:
             choice = (
                 {"index": 0, "delta": ({"content": delta} if delta else {}),
                  "finish_reason": finish}
@@ -540,6 +587,10 @@ class InferenceServer:
                 {"index": 0, "text": delta, "logprobs": None,
                  "finish_reason": finish}
             )
+            if lp_items is not None:
+                choice["logprobs"] = _lp_field(
+                    self.batcher.tokenizer, lp_items[0], lp_items[1], chat
+                )
             payload = {
                 "id": oid,
                 "object": "chat.completion.chunk" if chat else "text_completion",
@@ -563,7 +614,7 @@ class InferenceServer:
             await writer.drain()
         stopped = False
         last_text = None  # survives the cancel-ack yield (text=None)
-        async for text, ids, done, err in self._collect_until_done(mbox, rid, stop):
+        async for text, ids, lps, done, err in self._collect_until_done(mbox, rid, stop):
             if err == "stopped":
                 stopped = True
             elif err is not None:
@@ -592,8 +643,15 @@ class InferenceServer:
                         emit_src = emit_src[: max(sent, len(emit_src) - stop_hold)]
                 delta = emit_src[sent:]
                 sent = max(sent, len(emit_src))
+            def lp_slice():
+                nonlocal lp_sent
+                if not want_lp:
+                    return None
+                items = (ids[lp_sent:len(lps)], lps[lp_sent:])
+                lp_sent = len(lps)
+                return items
             if delta and not done:
-                writer.write(chunk(delta, None))
+                writer.write(chunk(delta, None, lp_slice()))
                 await writer.drain()
             if done:
                 if stopped or (
@@ -601,7 +659,7 @@ class InferenceServer:
                     and ids[-1] == self.batcher.eos_id
                 ):
                     reason = "stop"
-                writer.write(chunk(delta, reason))
+                writer.write(chunk(delta, reason, lp_slice()))
                 break
         writer.write(b"data: [DONE]\n\n")
         await writer.drain()
@@ -635,3 +693,17 @@ class _Responded(Exception):
 
 def _err_body(msg: str) -> dict:
     return {"error": {"message": msg, "type": "invalid_request_error"}}
+
+
+def _lp_field(tok, ids: list[int], lps: list[float], chat: bool) -> dict:
+    """OpenAI logprobs shapes: completions carries parallel arrays, chat
+    carries per-token objects.  ``ids``/``lps`` align 1:1 (the batcher
+    emits them together); tokens render as their individual decode."""
+    pieces = [tok.decode([i]) for i in ids[: len(lps)]]
+    lps = [round(v, 6) for v in lps]
+    if chat:
+        return {"content": [
+            {"token": p, "logprob": v} for p, v in zip(pieces, lps)
+        ]}
+    return {"tokens": pieces, "token_logprobs": lps,
+            "top_logprobs": None, "text_offset": None}

@@ -105,7 +105,7 @@ def test_late_submission_joins_inflight_batch(tiny):
     # Drive a couple of chunks manually, then inject a new request.
     b._admit_pending()
     was = b.active.copy()
-    toks, b.cache, last_tok, real_lens, valid, active, budget = (
+    toks, b.cache, last_tok, real_lens, valid, active, budget, _lps = (
         __import__(
             "distributed_llms_tpu.runtime.batcher", fromlist=["decode_chunk"]
         ).decode_chunk(
@@ -266,7 +266,7 @@ def test_streaming_deliveries_reassemble_results(tiny):
     streamed: dict[int, list[int]] = {r: [] for r in rids}
     done_flags: dict[int, list[bool]] = {r: [] for r in rids}
 
-    def on_tokens(rid, new, done):
+    def on_tokens(rid, new, done, lps):
         assert not done_flags[rid] or not done_flags[rid][-1], \
             f"delivery after done for rid {rid}"
         streamed[rid].extend(new)
@@ -296,7 +296,7 @@ def test_streaming_callback_exception_no_duplicate_done(tiny):
     class Boom(RuntimeError):
         pass
 
-    def raising(rid, new, done):
+    def raising(rid, new, done, lps):
         seen.append((rid, tuple(new), done))
         if done:
             raise Boom()
@@ -306,7 +306,7 @@ def test_streaming_callback_exception_no_duplicate_done(tiny):
         b.run(on_tokens=raising)
     collect = {r: [] for r in rids}
     dones = {r: 0 for r in rids}
-    res = b.run(on_tokens=lambda rid, new, done: (
+    res = b.run(on_tokens=lambda rid, new, done, lps: (
         collect[rid].extend(new), dones.__setitem__(rid, dones[rid] + bool(done))
     ))
     # Reassemble: pre-crash deliveries + post-crash deliveries == result.

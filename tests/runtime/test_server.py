@@ -361,3 +361,46 @@ def test_token_id_prompt_and_prefix(tiny):
         assert json.loads(body)["choices"][0]["text"] == want
 
     run_with_server(b, fn)
+
+
+def test_logprobs_blocking_and_stream(tiny):
+    async def fn(host, port, srv):
+        status, body = await _request(
+            host, port, "POST", "/v1/completions",
+            {"prompt": "lp please", "max_tokens": 6, "logprobs": True},
+        )
+        assert status == 200
+        out = json.loads(body)
+        lp = out["choices"][0]["logprobs"]
+        assert len(lp["tokens"]) == len(lp["token_logprobs"]) == 6
+        assert all(v <= 1e-6 for v in lp["token_logprobs"])
+        # Streaming: per-chunk logprob slices reassemble the same list.
+        status, events = await _sse_events(
+            host, port, "/v1/completions",
+            {"prompt": "lp please", "max_tokens": 6, "logprobs": 0,
+             "stream": True},
+        )
+        assert status == 200
+        got = []
+        for e in events[:-1]:
+            f = e["choices"][0].get("logprobs")
+            if f:
+                got.extend(f["token_logprobs"])
+        assert got == lp["token_logprobs"]
+        # Chat shape.
+        status, body = await _request(
+            host, port, "POST", "/v1/chat/completions",
+            {"messages": [{"role": "user", "content": "x"}],
+             "max_tokens": 3, "logprobs": True},
+        )
+        assert status == 200
+        content = json.loads(body)["choices"][0]["logprobs"]["content"]
+        assert len(content) == 3 and all("logprob" in c for c in content)
+        # Top-alternative counts are not supported.
+        status, _ = await _request(
+            host, port, "POST", "/v1/completions",
+            {"prompt": "x", "logprobs": 3},
+        )
+        assert status == 400
+
+    run_with_server(make_batcher(tiny), fn)

@@ -187,7 +187,7 @@ def test_spec_streaming_matches_plain_stream(models):
     streamed = {r: [] for r in rids}
     dones = {r: 0 for r in rids}
 
-    def cb(rid, new, done):
+    def cb(rid, new, done, lps):
         streamed[rid].extend(new)
         dones[rid] += bool(done)
 
