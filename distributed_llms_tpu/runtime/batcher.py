@@ -516,19 +516,28 @@ def decode_chunk(
     tables: jax.Array | None = None,  # [B, P] page table — cache is a pool
     temp_row: jax.Array | None = None,  # [B] traced per-row temperature
     topp_row: jax.Array | None = None,  # [B] traced per-row top-p
+    counts: jax.Array | None = None,  # [B, V] int32 output-token histogram
+    pres_row: jax.Array | None = None,  # [B] traced presence penalties
+    freq_row: jax.Array | None = None,  # [B] traced frequency penalties
 ) -> tuple[jax.Array, Any, jax.Array, jax.Array, jax.Array, jax.Array,
-           jax.Array, jax.Array]:
+           jax.Array, jax.Array, jax.Array | None]:
     """K decode steps with per-row positions.  Returns
     (toks [B, K], cache', last_tok', real_lens', valid', active', budget',
-    logprobs [B, K]).  ``temp_row``/``topp_row`` switch sampling to the
-    per-row path (sampling.sample_rows) — per-request sampling in one
-    shared batch."""
+    logprobs [B, K], counts').  ``temp_row``/``topp_row`` switch sampling
+    to the per-row path (sampling.sample_rows) — per-request sampling in
+    one shared batch.  ``counts``+``pres_row``+``freq_row`` engage OpenAI
+    presence/frequency penalties: logits adjust by
+    ``- freq*count - pres*(count > 0)`` per row BEFORE sampling, and the
+    histogram tracks every emitted token (rows with zero penalties read
+    garbage counts harmlessly — the adjustment multiplies to zero).
+    Logprobs stay RAW-distribution (pre-penalty), matching the logprobs
+    contract elsewhere."""
     if tables is None:
         s = cache.k.shape[-3]
         slots = jnp.arange(s, dtype=jnp.int32)
 
     def step(carry, rng_step):
-        cache, last_tok, real_lens, valid, active, budget = carry
+        cache, last_tok, real_lens, valid, active, budget, cnts = carry
         # One batched forward with PER-ROW write slots (models.model accepts
         # a [B] cache_index: only the KV write scatters; all matmuls stay
         # batched).  Paged mode: the page table routes each row's read and
@@ -557,13 +566,26 @@ def decode_chunk(
                 active[:, None] & (slots[None, :] == real_lens[:, None])
             )
         real_lens = real_lens + active.astype(jnp.int32)
+        if cnts is not None:
+            sample_from = (
+                logits
+                - freq_row[:, None] * cnts.astype(logits.dtype)
+                - pres_row[:, None] * (cnts > 0).astype(logits.dtype)
+            )
+        else:
+            sample_from = logits
         if temp_row is None:
-            tok = sampling.sample(rng_step, logits, temperature, top_k, top_p)
+            tok = sampling.sample(rng_step, sample_from, temperature, top_k,
+                                  top_p)
         else:
             tok = sampling.sample_rows(
-                rng_step, logits, temp_row, top_k,
+                rng_step, sample_from, temp_row, top_k,
                 1.0 if topp_row is None else topp_row,
             )
+        if cnts is not None:
+            cnts = cnts.at[
+                jnp.arange(cnts.shape[0]), tok
+            ].add(active.astype(jnp.int32))
         budget = budget - active.astype(jnp.int32)
         if eos_id >= 0:
             active = active & (tok != eos_id)
@@ -579,16 +601,29 @@ def decode_chunk(
         )[:, 0]
         lp = jnp.where(carry[4], lp, 0.0)
         last_tok = jnp.where(carry[4], tok, last_tok)
-        return (cache, last_tok, real_lens, valid, active, budget), (out, lp)
+        return (
+            (cache, last_tok, real_lens, valid, active, budget, cnts),
+            (out, lp),
+        )
 
     rngs = jax.random.split(rng, chunk_steps)
-    carry0 = (cache, last_tok, real_lens, valid, active, budget)
-    (cache, last_tok, real_lens, valid, active, budget), (toks, lps) = \
-        jax.lax.scan(step, carry0, rngs)
+    carry0 = (cache, last_tok, real_lens, valid, active, budget, counts)
+    ((cache, last_tok, real_lens, valid, active, budget, counts),
+     (toks, lps)) = jax.lax.scan(step, carry0, rngs)
     toks, lps, last_tok, real_lens, valid, active, budget = _replicated(
         pm, toks.T, lps.T, last_tok, real_lens, valid, active, budget
     )
-    return toks, cache, last_tok, real_lens, valid, active, budget, lps
+    return (toks, cache, last_tok, real_lens, valid, active, budget, lps,
+            counts)
+
+
+@partial(jax.jit, donate_argnames=("counts",))
+def _reset_count_row(counts, slot, tok):
+    """Zero one row of the output-token histogram and count the admission
+    token — a penalized request's penalties see exactly its own output."""
+    v = counts.shape[1]
+    row = jnp.zeros((v,), jnp.int32).at[tok].set(1)
+    return counts.at[slot].set(row)
 
 
 def _bucket(n: int, floor: int = 8) -> int:
@@ -606,6 +641,8 @@ class _Request:
     prefix: str | None = None
     temperature: float | None = None  # None -> the batcher's config
     top_p: float | None = None
+    presence_penalty: float = 0.0   # OpenAI-style, applied to output tokens
+    frequency_penalty: float = 0.0
 
 
 @dataclass
@@ -843,6 +880,12 @@ class ContinuousBatcher:
         # the traced per-row sampling path only while such a row is live.
         self.temp_row = np.full((batch_slots,), temperature, np.float32)
         self.topp_row = np.full((batch_slots,), top_p, np.float32)
+        self.pres_row = np.zeros((batch_slots,), np.float32)
+        self.freq_row = np.zeros((batch_slots,), np.float32)
+        # Output-token histogram [B, V], allocated on the first penalized
+        # admission (1 MB at 32k vocab — but zero cost for servers that
+        # never see a penalty).
+        self.tok_counts: jax.Array | None = None
         self.rows = [_RowState() for _ in range(batch_slots)]
         self.queue: deque[_Request] = deque()
         self.results: dict[int, list[int]] = {}
@@ -899,12 +942,16 @@ class ContinuousBatcher:
     def submit(
         self, prompt: str | list[int], max_new_tokens: int = 32,
         prefix: str | None = None, temperature: float | None = None,
-        top_p: float | None = None,
+        top_p: float | None = None, presence_penalty: float = 0.0,
+        frequency_penalty: float = 0.0,
     ) -> int:
         """Queue a request.  ``temperature``/``top_p`` override the
         batcher's sampling config FOR THIS REQUEST (serving front-ends:
         per-request sampling in a shared batch); ``top_k`` stays
-        batcher-wide (static under jit).  None keeps the config value."""
+        batcher-wide (static under jit).  None keeps the config value.
+        ``presence_penalty``/``frequency_penalty`` (OpenAI semantics,
+        [-2, 2]) adjust logits against this request's own output tokens
+        before sampling."""
         ids = (
             self.tokenizer.encode(prompt)
             if isinstance(prompt, str)
@@ -928,6 +975,21 @@ class ContinuousBatcher:
                 )
         if top_p is not None and not 0.0 < top_p <= 1.0:
             raise ValueError(f"top_p must be in (0, 1], got {top_p}")
+        for name, pen in (("presence_penalty", presence_penalty),
+                          ("frequency_penalty", frequency_penalty)):
+            if not -2.0 <= pen <= 2.0:  # also rejects NaN/inf
+                raise ValueError(f"{name} must be in [-2, 2], got {pen}")
+        if (presence_penalty or frequency_penalty):
+            if self.speculative:
+                raise ValueError(
+                    "speculative batching is greedy-exact; penalties are "
+                    "not supported"
+                )
+            if self.pm is not None:
+                raise ValueError(
+                    "presence/frequency penalties are single-device for "
+                    "now (the output histogram is not mesh-sharded)"
+                )
         pfx_len = 0
         if prefix is not None:
             if prefix not in self.prefixes:
@@ -943,6 +1005,8 @@ class ContinuousBatcher:
         self.queue.append(_Request(
             rid, ids, max_new_tokens, prefix=prefix,
             temperature=temperature, top_p=top_p,
+            presence_penalty=float(presence_penalty),
+            frequency_penalty=float(frequency_penalty),
         ))
         return rid
 
@@ -1087,6 +1151,16 @@ class ContinuousBatcher:
             self.last_tok[i] = tok
             self.temp_row[i] = req_t
             self.topp_row[i] = req_p
+            self.pres_row[i] = req.presence_penalty
+            self.freq_row[i] = req.frequency_penalty
+            if req.presence_penalty or req.frequency_penalty:
+                if self.tok_counts is None:
+                    self.tok_counts = jnp.zeros(
+                        (self.b, self.cfg.vocab_size), jnp.int32
+                    )
+                self.tok_counts = _reset_count_row(
+                    self.tok_counts, jnp.int32(i), jnp.int32(tok)
+                )
             self.real_lens[i] = total_len
             self.valid[i] = np.asarray(row_valid)
             self.active[i] = True
@@ -1211,6 +1285,7 @@ class ContinuousBatcher:
                     break
                 continue
             counts = None
+            counts_out = None  # decode_chunk's histogram (plain branch only)
             if self.speculative:
                 (toks, m, self.cache, self.draft_cache, last_tok, real_lens,
                  valid, active, budget) = spec_chunk(
@@ -1237,8 +1312,15 @@ class ContinuousBatcher:
                         # softmax+cumsum mask entirely (sample_rows takes
                         # the static keep-everything path).
                         per_row["topp_row"] = jnp.asarray(self.topp_row)
+                pen_live = self.active & (
+                    (self.pres_row != 0.0) | (self.freq_row != 0.0)
+                )
+                if bool(pen_live.any()):
+                    per_row["counts"] = self.tok_counts
+                    per_row["pres_row"] = jnp.asarray(self.pres_row)
+                    per_row["freq_row"] = jnp.asarray(self.freq_row)
                 (toks, self.cache, last_tok, real_lens, valid, active,
-                 budget, chunk_lps) = \
+                 budget, chunk_lps, counts_out) = \
                     decode_chunk(
                         self.params, self.cfg_decode, self.cache, self.last_tok,
                         self.real_lens, self.valid, self.active, self.budget,
@@ -1255,6 +1337,8 @@ class ContinuousBatcher:
             self.valid = np.array(valid)
             self.active = np.array(active)
             self.budget = np.array(budget)
+            if counts is None and counts_out is not None:
+                self.tok_counts = counts_out
             self._collect(np.asarray(toks), was_active, counts=counts,
                           lps=None if counts is not None
                           else np.asarray(chunk_lps))

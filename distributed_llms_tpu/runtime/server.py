@@ -322,9 +322,11 @@ class InferenceServer:
             return list(prompt), ""
         raise BadRequest("'prompt' must be a non-empty string or token-id list")
 
-    def _parse_sampling(self, req: dict) -> tuple[float | None, float | None]:
+    def _parse_sampling(self, req: dict):
         """Per-request temperature/top_p ride the batcher's per-row
-        sampling path; top_k stays engine-wide (static under jit)."""
+        sampling path; presence/frequency penalties adjust against the
+        request's own output histogram; top_k stays engine-wide (static
+        under jit).  Returns (temperature, top_p, presence, frequency)."""
         import math
 
         out = []
@@ -348,6 +350,17 @@ class InferenceServer:
                     "temperature > 0 is not supported"
                 )
             out.append(want)
+        for name in ("presence_penalty", "frequency_penalty"):
+            pen = req.get(name)
+            if pen is None:
+                out.append(0.0)
+                continue
+            if not isinstance(pen, (int, float)) or isinstance(pen, bool):
+                raise BadRequest(f"{name!r} must be a number")
+            # Range and engine-capability policy live in submit() — its
+            # ValueError becomes a 400 at the call site; duplicating the
+            # checks here would just drift.
+            out.append(float(pen))
         want_k = req.get("top_k")
         if want_k is not None and want_k != self.batcher.sampling["top_k"]:
             raise BadRequest(
@@ -357,7 +370,7 @@ class InferenceServer:
             )
         if req.get("n", 1) != 1:
             raise BadRequest("only n=1 is supported")
-        return out[0], out[1]
+        return out[0], out[1], out[2], out[3]
 
     async def _completions(self, writer, req: dict, chat: bool) -> None:
         prompt_ids, _ = self._parse_prompt(req, chat)
@@ -368,7 +381,7 @@ class InferenceServer:
         stream = bool(req.get("stream", False))
         stop = _stop_list(req)
         prefix = req.get("prefix")
-        temperature, top_p = self._parse_sampling(req)
+        temperature, top_p, pres_pen, freq_pen = self._parse_sampling(req)
         lp_req = req.get("logprobs")
         if lp_req is None or lp_req is False:
             want_lp = False
@@ -404,6 +417,7 @@ class InferenceServer:
             got = self.batcher.submit(
                 prompt_ids, max_new_tokens=max_tokens, prefix=prefix,
                 temperature=temperature, top_p=top_p,
+                presence_penalty=pres_pen, frequency_penalty=freq_pen,
             )
             assert got == rid
         except (ValueError, KeyError) as e:

@@ -105,7 +105,7 @@ def test_late_submission_joins_inflight_batch(tiny):
     # Drive a couple of chunks manually, then inject a new request.
     b._admit_pending()
     was = b.active.copy()
-    toks, b.cache, last_tok, real_lens, valid, active, budget, _lps = (
+    toks, b.cache, last_tok, real_lens, valid, active, budget, *_aux = (
         __import__(
             "distributed_llms_tpu.runtime.batcher", fromlist=["decode_chunk"]
         ).decode_chunk(

@@ -170,3 +170,48 @@ def test_speculative_logprobs_are_none(tiny):
 
     b.run(on_tokens=cb)
     assert b.result_logprobs[rid] is None
+
+
+def test_penalties_break_repetition_and_preserve_neighbors(tiny):
+    """A frequency/presence-penalized greedy row must diverge from the
+    unpenalized greedy run once repetition appears, while an unpenalized
+    greedy neighbor in the same batch stays bit-exact with its solo run."""
+    ids, n = [7, 1, 9], 20
+    plain_b = make(tiny)
+    plain_rid = plain_b.submit(ids, max_new_tokens=n)
+    plain = plain_b.run()[plain_rid]
+    # Random tiny models loop hard; the premise of the test is repetition.
+    assert len(set(plain)) < len(plain)
+
+    other_ids, other_n = [4, 4, 4, 4], 9
+    solo_b = make(tiny)
+    solo_rid = solo_b.submit(other_ids, max_new_tokens=other_n)
+    solo = solo_b.run()[solo_rid]
+
+    b = make(tiny)
+    rid_pen = b.submit(ids, max_new_tokens=n, presence_penalty=1.5,
+                       frequency_penalty=1.5)
+    rid_other = b.submit(other_ids, max_new_tokens=other_n)
+    res = b.run()
+    assert res[rid_pen] != plain          # penalties changed the argmax path
+    assert res[rid_other] == solo         # neighbor untouched
+    # Explicit zero penalties are the identity.
+    z = make(tiny)
+    rid_z = z.submit(ids, max_new_tokens=n, presence_penalty=0.0,
+                     frequency_penalty=0.0)
+    assert z.run()[rid_z] == plain
+
+
+def test_penalty_validation(tiny):
+    b = make(tiny)
+    with pytest.raises(ValueError, match="presence_penalty"):
+        b.submit([1, 2], max_new_tokens=4, presence_penalty=2.5)
+    with pytest.raises(ValueError, match="frequency_penalty"):
+        b.submit([1, 2], max_new_tokens=4, frequency_penalty=float("nan"))
+    cfg, params = tiny
+    spec = ContinuousBatcher(
+        cfg, params, batch_slots=2, max_len=64, chunk_steps=4,
+        draft_params=params, draft_cfg=cfg, spec_k=2,
+    )
+    with pytest.raises(ValueError, match="penalties"):
+        spec.submit([1, 2], max_new_tokens=4, frequency_penalty=1.0)
