@@ -368,8 +368,6 @@ class InferenceServer:
                 f"{self.batcher.sampling['top_k']} (fixed at engine build); "
                 "per-request top_k is not supported"
             )
-        if req.get("n", 1) != 1:
-            raise BadRequest("only n=1 is supported")
         return out[0], out[1], out[2], out[3]
 
     async def _completions(self, writer, req: dict, chat: bool) -> None:
@@ -399,31 +397,45 @@ class InferenceServer:
                 "this server runs speculative decoding, whose verify pass "
                 "does not retain logprobs"
             )
-        if len(self._requests) >= self.max_pending:
+        n = _field(req, "n", 1, int, minimum=1)
+        if n > 8:
+            raise BadRequest("'n' must be <= 8")
+        if len(self._requests) + n > self.max_pending:
             await self._json(writer, 429, _err_body("server request queue is full"))
             return
         if self._stopping:
             await self._json(writer, 500, _err_body("server is shutting down"))
             return
-        # Register the mailbox BEFORE submit: the engine thread may already
-        # be inside run() and can admit + deliver the moment the request
-        # hits the queue — a mailbox registered after submit would miss
-        # those deliveries (and hang forever on a 1-chunk completion).
-        # All submissions happen on this loop thread, so next_rid is ours.
-        rid = self.batcher.next_rid
-        mbox = _Mailbox()
-        self._requests[rid] = mbox
-        try:
-            got = self.batcher.submit(
-                prompt_ids, max_new_tokens=max_tokens, prefix=prefix,
-                temperature=temperature, top_p=top_p,
-                presence_penalty=pres_pen, frequency_penalty=freq_pen,
-            )
-            assert got == rid
-        except (ValueError, KeyError) as e:
-            self._requests.pop(rid, None)
-            await self._json(writer, 400, _err_body(str(e)))
-            return
+        # One batcher request per choice.  Register each mailbox BEFORE its
+        # submit: the engine thread may already be inside run() and can
+        # admit + deliver the moment the request hits the queue — a mailbox
+        # registered after submit would miss those deliveries (and hang
+        # forever on a 1-chunk completion).  All submissions happen on this
+        # loop thread, so next_rid is ours.
+        subs: list[tuple[int, int, _Mailbox]] = []  # (choice index, rid, mbox)
+        for idx in range(n):
+            rid = self.batcher.next_rid
+            mbox = _Mailbox()
+            self._requests[rid] = mbox
+            try:
+                got = self.batcher.submit(
+                    prompt_ids, max_new_tokens=max_tokens, prefix=prefix,
+                    temperature=temperature, top_p=top_p,
+                    presence_penalty=pres_pen, frequency_penalty=freq_pen,
+                )
+                assert got == rid
+            except (ValueError, KeyError) as e:
+                self._requests.pop(rid, None)
+                for _, r, _m in subs:
+                    # Already-queued siblings die too — via the cancel
+                    # flag, NOT cancel_row: the engine thread may be mid-
+                    # run() and owns the batcher state.
+                    self._cancelled.add(r)
+                    self._requests.pop(r, None)
+                self._work.set()  # let an idle engine drain the flags
+                await self._json(writer, 400, _err_body(str(e)))
+                return
+            subs.append((idx, rid, mbox))
         self._work.set()
         METRICS.inc("server.requests")
         oid = ("chatcmpl-" if chat else "cmpl-") + uuid.uuid4().hex[:24]
@@ -431,27 +443,29 @@ class InferenceServer:
         try:
             if stream:
                 await self._serve_stream(
-                    writer, mbox, rid, stop, chat, oid, created, want_lp
+                    writer, subs, stop, chat, oid, created, want_lp
                 )
             else:
                 await self._serve_blocking(
-                    writer, mbox, rid, stop, chat, oid, created,
+                    writer, subs, stop, chat, oid, created,
                     len(prompt_ids), want_lp
                 )
         except (ConnectionError, OSError, asyncio.TimeoutError):
-            # Client went away.  Flag the rid only if the row is still
-            # generating — the engine consumes the flag at its next
-            # delivery; a flag for an already-finished rid would sit in
-            # the set forever (rids are never reused).
-            if not mbox.finished:
-                self._cancelled.add(rid)
+            # Client went away.  Flag only rows still generating — the
+            # engine consumes the flag at its next delivery; a flag for an
+            # already-finished rid would sit in the set forever (rids are
+            # never reused).
+            for _, rid, mbox in subs:
+                if not mbox.finished:
+                    self._cancelled.add(rid)
             METRICS.inc("server.disconnects")
         finally:
-            if mbox.finished:
-                # Drop any stop-flag the engine never got to consume (the
-                # row finished naturally in the same delivery).
-                self._cancelled.discard(rid)
-            self._requests.pop(rid, None)
+            for _, rid, mbox in subs:
+                if mbox.finished:
+                    # Drop any stop-flag the engine never got to consume
+                    # (the row finished naturally in the same delivery).
+                    self._cancelled.discard(rid)
+                self._requests.pop(rid, None)
 
     async def _collect_until_done(self, mbox, rid, stop, need_text=True):
         """Drain the mailbox; yield (text_so_far, ids_so_far, done, err).
@@ -527,10 +541,9 @@ class InferenceServer:
                 yield None, ids, lps, True, "stopped"
                 return
 
-    async def _serve_blocking(
-        self, writer, mbox, rid, stop, chat, oid, created, n_prompt,
-        want_lp=False,
-    ) -> None:
+    async def _gather_choice(self, mbox, rid, stop):
+        """Drain one choice to completion.  Returns
+        (text, ids, lps, finish_reason, fatal_err)."""
         text = ""
         ids: list[int] = []
         lps: list[float] = []
@@ -544,8 +557,7 @@ class InferenceServer:
                 reason = "stop"
                 break
             if err is not None:
-                await self._json(writer, 500, _err_body(err))
-                return
+                return text, ids, lps, reason, err
             text = t
             if done:
                 break
@@ -553,40 +565,55 @@ class InferenceServer:
             ids and ids[-1] == self.batcher.eos_id
         ):
             reason = "stop"
-        choice = (
-            {"index": 0, "message": {"role": "assistant", "content": text},
-             "finish_reason": reason}
-            if chat else
-            {"index": 0, "text": text, "logprobs": None, "finish_reason": reason}
-        )
-        if want_lp:
-            choice["logprobs"] = _lp_field(
-                self.batcher.tokenizer, ids, lps, chat
+        return text, ids, lps, reason, None
+
+    async def _serve_blocking(
+        self, writer, subs, stop, chat, oid, created, n_prompt,
+        want_lp=False,
+    ) -> None:
+        outs = await asyncio.gather(*[
+            self._gather_choice(mbox, rid, stop) for _, rid, mbox in subs
+        ])
+        fatal = next((e for *_x, e in outs if e is not None), None)
+        if fatal is not None:
+            await self._json(writer, 500, _err_body(fatal))
+            return
+        choices = []
+        total_completion = 0
+        for (idx, _rid, _mbox), (text, ids, lps, reason, _e) in zip(subs, outs):
+            choice = (
+                {"index": idx,
+                 "message": {"role": "assistant", "content": text},
+                 "finish_reason": reason}
+                if chat else
+                {"index": idx, "text": text, "logprobs": None,
+                 "finish_reason": reason}
             )
+            if want_lp:
+                choice["logprobs"] = _lp_field(
+                    self.batcher.tokenizer, ids, lps, chat
+                )
+            choices.append(choice)
+            total_completion += len(ids)
         await self._json(writer, 200, {
             "id": oid,
             "object": "chat.completion" if chat else "text_completion",
             "created": created,
             "model": self.model_name,
-            "choices": [choice],
+            "choices": choices,
             "usage": {
                 "prompt_tokens": n_prompt,
-                "completion_tokens": len(ids),
-                "total_tokens": n_prompt + len(ids),
+                "completion_tokens": total_completion,
+                "total_tokens": n_prompt + total_completion,
             },
         })
 
-    async def _serve_stream(
-        self, writer, mbox, rid, stop, chat, oid, created, want_lp=False
+    async def _stream_choice(
+        self, writer, mbox, rid, index, stop, chat, oid, created, want_lp
     ) -> None:
-        writer.write(
-            b"HTTP/1.1 200 OK\r\n"
-            b"Content-Type: text/event-stream\r\n"
-            b"Cache-Control: no-cache\r\n"
-            b"Connection: close\r\n\r\n"
-        )
-        await writer.drain()
-
+        """Stream one choice's SSE chunks (its `index` tags every chunk);
+        n>1 choices interleave on the same connection, each driven by its
+        own task."""
         sent = 0
         lp_sent = 0
         reason = "length"
@@ -595,10 +622,10 @@ class InferenceServer:
         def chunk(delta: str, finish: str | None,
                   lp_items: tuple | None = None) -> bytes:
             choice = (
-                {"index": 0, "delta": ({"content": delta} if delta else {}),
+                {"index": index, "delta": ({"content": delta} if delta else {}),
                  "finish_reason": finish}
                 if chat else
-                {"index": 0, "text": delta, "logprobs": None,
+                {"index": index, "text": delta, "logprobs": None,
                  "finish_reason": finish}
             )
             if lp_items is not None:
@@ -620,7 +647,7 @@ class InferenceServer:
                 b"data: " + json.dumps({
                     "id": oid, "object": "chat.completion.chunk",
                     "created": created, "model": self.model_name,
-                    "choices": [{"index": 0,
+                    "choices": [{"index": index,
                                  "delta": {"role": "assistant"},
                                  "finish_reason": None}],
                 }).encode() + b"\n\n"
@@ -652,7 +679,7 @@ class InferenceServer:
                 if done:
                     emit_src = text
                 else:
-                    emit_src = text.rstrip("�")
+                    emit_src = text.rstrip("\ufffd")
                     if stop_hold:
                         emit_src = emit_src[: max(sent, len(emit_src) - stop_hold)]
                 delta = emit_src[sent:]
@@ -674,7 +701,30 @@ class InferenceServer:
                 ):
                     reason = "stop"
                 writer.write(chunk(delta, reason, lp_slice()))
+                await writer.drain()
                 break
+
+    async def _serve_stream(
+        self, writer, subs, stop, chat, oid, created, want_lp=False
+    ) -> None:
+        writer.write(
+            b"HTTP/1.1 200 OK\r\n"
+            b"Content-Type: text/event-stream\r\n"
+            b"Cache-Control: no-cache\r\n"
+            b"Connection: close\r\n\r\n"
+        )
+        await writer.drain()
+        # One task per choice; chunks interleave, each tagged with its
+        # choice index.  return_exceptions so one dead socket lets every
+        # sibling finish its drain before the disconnect propagates.
+        results = await asyncio.gather(*[
+            self._stream_choice(writer, mbox, rid, idx, stop, chat, oid,
+                                created, want_lp)
+            for idx, rid, mbox in subs
+        ], return_exceptions=True)
+        for r in results:
+            if isinstance(r, BaseException):
+                raise r
         writer.write(b"data: [DONE]\n\n")
         await writer.drain()
 

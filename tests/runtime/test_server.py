@@ -404,3 +404,47 @@ def test_logprobs_blocking_and_stream(tiny):
         assert status == 400
 
     run_with_server(make_batcher(tiny), fn)
+
+
+def test_n_choices_blocking_and_stream(tiny):
+    want = expected_text(tiny, "multi", 5)
+
+    async def fn(host, port, srv):
+        # Greedy n=3: all choices identical to the solo run, indices 0..2.
+        status, body = await _request(
+            host, port, "POST", "/v1/completions",
+            {"prompt": "multi", "max_tokens": 5, "n": 3},
+        )
+        assert status == 200
+        out = json.loads(body)
+        assert [c["index"] for c in out["choices"]] == [0, 1, 2]
+        assert all(c["text"] == want for c in out["choices"])
+        assert out["usage"]["completion_tokens"] == 15
+        # Streaming n=2: chunks carry per-choice indices; each choice's
+        # concatenation equals the solo text, one finish per choice.
+        status, events = await _sse_events(
+            host, port, "/v1/completions",
+            {"prompt": "multi", "max_tokens": 5, "n": 2, "stream": True},
+        )
+        assert status == 200
+        texts = {0: "", 1: ""}
+        finals = {0: 0, 1: 0}
+        for e in events[:-1]:
+            c = e["choices"][0]
+            texts[c["index"]] += c["text"]
+            finals[c["index"]] += c["finish_reason"] is not None
+        assert texts == {0: want, 1: want}
+        assert finals == {0: 1, 1: 1}
+        # Validation.
+        status, _ = await _request(
+            host, port, "POST", "/v1/completions",
+            {"prompt": "x", "n": 9},
+        )
+        assert status == 400
+        status, _ = await _request(
+            host, port, "POST", "/v1/completions",
+            {"prompt": "x", "n": 0},
+        )
+        assert status == 400
+
+    run_with_server(make_batcher(tiny), fn)
