@@ -63,29 +63,36 @@ class MetricsServer:
         self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
     ) -> None:
         self._conns.add(writer)
+
+        async def read_phase() -> tuple[str, str] | None:
+            line = await reader.readline()
+            if len(line) > _MAX_REQUEST_LINE:
+                await self._respond(writer, 414, "text/plain", "request line too long")
+                return None
+            parts = line.decode("latin-1", "replace").split()
+            if len(parts) < 2:
+                await self._respond(writer, 400, "text/plain", "bad request")
+                return None
+            # Drain headers (we never need them; the count cap plus the
+            # outer deadline keep this bounded).
+            for _ in range(100):
+                h = await reader.readline()
+                if h in (b"\r\n", b"\n", b""):
+                    break
+            else:
+                await self._respond(writer, 431, "text/plain", "too many headers")
+                return None
+            return parts[0], parts[1]
+
         try:
             # One deadline for the whole read phase: an idle or trickling
             # client can hold a connection (and therefore wait_closed at
-            # shutdown) for at most this long.
-            async with asyncio.timeout(10.0):
-                line = await reader.readline()
-                if len(line) > _MAX_REQUEST_LINE:
-                    await self._respond(writer, 414, "text/plain", "request line too long")
-                    return
-                parts = line.decode("latin-1", "replace").split()
-                if len(parts) < 2:
-                    await self._respond(writer, 400, "text/plain", "bad request")
-                    return
-                method, path = parts[0], parts[1]
-                # Drain headers (we never need them; the count cap plus the
-                # outer deadline keep this bounded).
-                for _ in range(100):
-                    h = await reader.readline()
-                    if h in (b"\r\n", b"\n", b""):
-                        break
-                else:
-                    await self._respond(writer, 431, "text/plain", "too many headers")
-                    return
+            # shutdown) for at most this long.  (wait_for, not
+            # asyncio.timeout: pyproject allows Python 3.10.)
+            parsed = await asyncio.wait_for(read_phase(), 10.0)
+            if parsed is None:
+                return
+            method, path = parsed
             if method != "GET":
                 await self._respond(writer, 405, "text/plain", "method not allowed")
             elif path == "/metrics":

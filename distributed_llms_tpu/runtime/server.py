@@ -47,7 +47,7 @@ _REASONS = {
     200: "OK", 400: "Bad Request", 404: "Not Found",
     405: "Method Not Allowed", 413: "Payload Too Large",
     429: "Too Many Requests", 431: "Request Header Fields Too Large",
-    500: "Internal Server Error",
+    500: "Internal Server Error", 501: "Not Implemented",
 }
 
 
@@ -172,6 +172,14 @@ class InferenceServer:
             self._work.wait()
             self._work.clear()
             if self._stopping:
+                # Drain before exiting: a request submitted between the
+                # last run and stop() (engine idle, _work set by both) is
+                # in the batcher queue but will never run — without this
+                # its handler coroutine blocks forever on its mailbox.
+                for rid in list(self._requests):
+                    self.batcher.cancel_row(rid)
+                    self._cancelled.discard(rid)
+                    self._notify(rid, [], True, err="server is shutting down")
                 return
             if not self._pending():
                 continue
@@ -181,8 +189,11 @@ class InferenceServer:
                 log.exception("batcher.run failed; failing in-flight requests")
                 for rid in list(self._requests):
                     self.batcher.cancel_row(rid)
+                    # Discard only rids we handled — a blanket clear()
+                    # could drop a disconnect flag the loop thread added
+                    # concurrently for a request not in this snapshot.
+                    self._cancelled.discard(rid)
                     self._notify(rid, [], True, err="internal engine error")
-                self._cancelled.clear()
             # run() accumulated per-rid results we already streamed; drop
             # them so a long-lived server's memory stays flat.
             self.batcher.results.clear()
@@ -219,8 +230,10 @@ class InferenceServer:
             try:
                 # Deadline covers the parse phase only: generation itself
                 # may legitimately exceed any fixed request timeout.
-                async with asyncio.timeout(30.0):
-                    method, path, body = await self._read_request(writer, reader)
+                # (wait_for, not asyncio.timeout: pyproject allows 3.10.)
+                method, path, body = await asyncio.wait_for(
+                    self._read_request(writer, reader), 30.0
+                )
             except _Responded:
                 return
             await self._route(writer, method, path, body)
@@ -247,12 +260,19 @@ class InferenceServer:
             if h in (b"\r\n", b"\n", b""):
                 break
             name, _, value = h.decode("latin-1", "replace").partition(":")
-            if name.strip().lower() == "content-length":
+            hname = name.strip().lower()
+            if hname == "content-length":
                 try:
                     content_len = int(value.strip())
                 except ValueError:
                     await self._plain(writer, 400, "bad content-length")
                     raise _Responded
+            elif hname == "transfer-encoding":
+                # Only Content-Length bodies are read; a chunked POST would
+                # otherwise parse as empty and fail with a misleading
+                # "'prompt' missing" 400.
+                await self._plain(writer, 501, "chunked bodies not supported")
+                raise _Responded
         else:
             await self._plain(writer, 431, "too many headers")
             raise _Responded
@@ -392,11 +412,6 @@ class InferenceServer:
                 "'logprobs' top-alternatives are not supported; pass true "
                 "(or 0) for chosen-token logprobs"
             )
-        if want_lp and self.batcher.speculative:
-            raise BadRequest(
-                "this server runs speculative decoding, whose verify pass "
-                "does not retain logprobs"
-            )
         n = _field(req, "n", 1, int, minimum=1)
         if n > 8:
             raise BadRequest("'n' must be <= 8")
@@ -451,20 +466,20 @@ class InferenceServer:
                     len(prompt_ids), want_lp
                 )
         except (ConnectionError, OSError, asyncio.TimeoutError):
-            # Client went away.  Flag only rows still generating — the
-            # engine consumes the flag at its next delivery; a flag for an
-            # already-finished rid would sit in the set forever (rids are
-            # never reused).
-            for _, rid, mbox in subs:
-                if not mbox.finished:
-                    self._cancelled.add(rid)
             METRICS.inc("server.disconnects")
         finally:
+            # Runs on EVERY exit (normal, disconnect, or an unexpected
+            # exception from the serve path): rows still generating get
+            # cancel-flagged — the engine consumes the flag at its next
+            # delivery; only unfinished rids are flagged because rids are
+            # never reused and a stale flag would sit in the set forever.
             for _, rid, mbox in subs:
                 if mbox.finished:
                     # Drop any stop-flag the engine never got to consume
                     # (the row finished naturally in the same delivery).
                     self._cancelled.discard(rid)
+                else:
+                    self._cancelled.add(rid)
                 self._requests.pop(rid, None)
 
     async def _collect_until_done(self, mbox, rid, stop, need_text=True):
@@ -609,11 +624,21 @@ class InferenceServer:
         })
 
     async def _stream_choice(
-        self, writer, mbox, rid, index, stop, chat, oid, created, want_lp
+        self, writer, wlock, mbox, rid, index, stop, chat, oid, created,
+        want_lp
     ) -> None:
         """Stream one choice's SSE chunks (its `index` tags every chunk);
         n>1 choices interleave on the same connection, each driven by its
-        own task."""
+        own task.  ``wlock`` serializes write+drain across sibling tasks:
+        StreamWriter.drain is not reentrant (FlowControlMixin asserts a
+        single waiter), so two choices draining concurrently under write
+        backpressure would raise AssertionError."""
+
+        async def emit(data: bytes) -> None:
+            async with wlock:
+                writer.write(data)
+                await writer.drain()
+
         sent = 0
         lp_sent = 0
         reason = "length"
@@ -643,7 +668,7 @@ class InferenceServer:
 
         if chat:
             # OpenAI stream fidelity: the first chunk announces the role.
-            writer.write(
+            await emit(
                 b"data: " + json.dumps({
                     "id": oid, "object": "chat.completion.chunk",
                     "created": created, "model": self.model_name,
@@ -652,14 +677,13 @@ class InferenceServer:
                                  "finish_reason": None}],
                 }).encode() + b"\n\n"
             )
-            await writer.drain()
         stopped = False
         last_text = None  # survives the cancel-ack yield (text=None)
         async for text, ids, lps, done, err in self._collect_until_done(mbox, rid, stop):
             if err == "stopped":
                 stopped = True
             elif err is not None:
-                writer.write(
+                await emit(
                     b"data: " + json.dumps(_err_body(err)).encode() + b"\n\n"
                 )
                 break
@@ -692,16 +716,14 @@ class InferenceServer:
                 lp_sent = len(lps)
                 return items
             if delta and not done:
-                writer.write(chunk(delta, None, lp_slice()))
-                await writer.drain()
+                await emit(chunk(delta, None, lp_slice()))
             if done:
                 if stopped or (
                     self.batcher.eos_id >= 0 and ids
                     and ids[-1] == self.batcher.eos_id
                 ):
                     reason = "stop"
-                writer.write(chunk(delta, reason, lp_slice()))
-                await writer.drain()
+                await emit(chunk(delta, reason, lp_slice()))
                 break
 
     async def _serve_stream(
@@ -715,11 +737,14 @@ class InferenceServer:
         )
         await writer.drain()
         # One task per choice; chunks interleave, each tagged with its
-        # choice index.  return_exceptions so one dead socket lets every
-        # sibling finish its drain before the disconnect propagates.
+        # choice index, writes serialized by a shared per-connection lock
+        # (drain is not reentrant).  return_exceptions so one dead socket
+        # lets every sibling finish its drain before the disconnect
+        # propagates.
+        wlock = asyncio.Lock()
         results = await asyncio.gather(*[
-            self._stream_choice(writer, mbox, rid, idx, stop, chat, oid,
-                                created, want_lp)
+            self._stream_choice(writer, wlock, mbox, rid, idx, stop, chat,
+                                oid, created, want_lp)
             for idx, rid, mbox in subs
         ], return_exceptions=True)
         for r in results:

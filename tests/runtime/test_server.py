@@ -287,7 +287,8 @@ def test_bad_requests_rejected(tiny):
             ({"prompt": ""}, 400),
             ({"prompt": "x", "max_tokens": 0}, 400),
             ({"prompt": "x", "max_tokens": True}, 400),     # bool is not int
-            ({"prompt": "x", "n": 2}, 400),
+            ({"prompt": "x", "n": 9}, 400),              # n capped at 8
+            ({"prompt": "x", "n": 0}, 400),
             ({"prompt": "x", "temperature": -0.1}, 400),
             ({"prompt": "x", "top_p": 0.0}, 400),
             ({"prompt": "x", "top_k": 7}, 400),             # top_k is engine-wide
@@ -315,6 +316,45 @@ def test_bad_requests_rejected(tiny):
              "top_p": 0.95},
         )
         assert status == 200
+
+    run_with_server(make_batcher(tiny), fn)
+
+
+def test_chunked_body_rejected(tiny):
+    async def fn(host, port, srv):
+        # Only Content-Length bodies are read; chunked must fail loudly
+        # (501), not as a misleading "'prompt' missing" 400.
+        reader, writer = await asyncio.open_connection(host, port)
+        writer.write(
+            b"POST /v1/completions HTTP/1.1\r\nHost: t\r\n"
+            b"Transfer-Encoding: chunked\r\n\r\n"
+        )
+        await writer.drain()
+        status = int((await reader.readline()).split()[1])
+        assert status == 501
+        writer.close()
+
+    run_with_server(make_batcher(tiny), fn)
+
+
+def test_shutdown_drains_pending_request(tiny):
+    from distributed_llms_tpu.runtime.server import _Mailbox
+
+    async def fn(host, port, srv):
+        # Emulate the shutdown race: a request lands in the batcher queue
+        # just as stop() flips _stopping (so the stop()-time cancel sweep
+        # missed it).  The engine's stopping path must fail it — without
+        # the drain its mailbox would never be notified and the handler
+        # would hang forever.
+        rid = srv.batcher.next_rid
+        mbox = _Mailbox()
+        srv._requests[rid] = mbox
+        assert srv.batcher.submit("hi", max_new_tokens=4) == rid
+        srv._stopping = True
+        srv._work.set()
+        toks, done, err, _lps = await asyncio.wait_for(mbox.queue.get(), 10)
+        assert done and err == "server is shutting down"
+        srv._requests.pop(rid, None)
 
     run_with_server(make_batcher(tiny), fn)
 
