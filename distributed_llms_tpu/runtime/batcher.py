@@ -259,6 +259,9 @@ def spec_chunk(
     k: int,
     eos_id: int = -1,
     pad_id: int = 0,
+    counts: jax.Array | None = None,  # [B, V] int32 output-token histogram
+    pres_row: jax.Array | None = None,  # [B] traced presence penalties
+    freq_row: jax.Array | None = None,  # [B] traced frequency penalties
 ) -> tuple:
     """ONE speculative round over the batch (greedy): draft k tokens per
     row against the draft cache, verify all of them in one (k+1)-token
@@ -266,8 +269,23 @@ def spec_chunk(
     Tokens are bit-identical to decode_chunk's greedy output — acceptance
     only changes how many arrive per round.
 
-    Returns (toks [B, k+1] pad-masked, m [B] committed counts, cache',
-    draft_cache', last_tok', real_lens', valid', active', budget').
+    Returns (toks [B, k+1] pad-masked, m [B] committed counts, lps
+    [B, k+1] chosen-token logprobs, cache', draft_cache', last_tok',
+    real_lens', valid', active', budget', counts').  ``lps[b, j]`` is the
+    TARGET's raw-distribution log-softmax of the committed token
+    ``toks[b, j]`` — the verify forward already computes full logits for
+    every position, so serving logprobs costs one log-softmax + gather per
+    round.
+
+    Presence/frequency penalties (``counts``+``pres_row``+``freq_row``)
+    stay bit-exact vs the penalized plain batcher: verify position j's
+    context is [last_tok, d_1..d_j], so its penalty histogram is the base
+    counts plus the one-hots of d_1..d_j — and within the accepted lead
+    (the only region where greedy[j] can commit) those drafts ARE the
+    committed tokens, making the adjusted argmax identical to the
+    sequential penalized decode's.  Draft steps penalize with the same
+    evolving histogram so acceptance tracks the penalized target.
+    Logprobs stay RAW-distribution (pre-penalty), matching decode_chunk.
 
     Layout: contiguous (slot == position) exactly like decode_chunk; the
     rollback/backfill arguments mirror runtime/speculative.py with the
@@ -275,6 +293,16 @@ def spec_chunk(
     by the forward that consumes it, at slot == its position)."""
     s = cache.k.shape[-3]
     slots = jnp.arange(s, dtype=jnp.int32)
+    penalized = counts is not None
+
+    def _pen(logits, cnt):  # [B(, T), V] logits, [B(, T), V] int32 counts
+        if not penalized:
+            return logits
+        extra = (1,) * (logits.ndim - 2)
+        f = freq_row.reshape(-1, *extra, 1)
+        p = pres_row.reshape(-1, *extra, 1)
+        return (logits - f * cnt.astype(logits.dtype)
+                - p * (cnt > 0).astype(logits.dtype))
 
     def row_mask(hi):  # [B] inclusive frontier -> [B, 1, 1, S]
         own = jnp.logical_and(slots[None, :] >= real_lens[:, None],
@@ -282,18 +310,24 @@ def spec_chunk(
         return jnp.logical_or(valid, own)[:, None, None, :]
 
     # --- draft: k single-token greedy steps against the draft cache.
+    # Penalized mode carries the evolving histogram (base + drafts so far)
+    # so the draft's greedy tracks the penalized target's.
     def draft_step(dc, j):
-        draft_cache, cur = dc
+        draft_cache, cur, cnt = dc
         idx = real_lens + j
         logits, draft_cache = model_lib.forward(
             draft_params, draft_cfg, cur[:, None], positions=idx[:, None],
             cache=draft_cache, cache_index=idx, attn_mask=row_mask(idx),
         )
-        nxt = jnp.argmax(logits[:, 0], axis=-1).astype(jnp.int32)
-        return (draft_cache, nxt), nxt
+        nxt = jnp.argmax(_pen(logits[:, 0], cnt), axis=-1).astype(jnp.int32)
+        if penalized:
+            cnt = cnt.at[jnp.arange(cnt.shape[0]), nxt].add(1)
+        return (draft_cache, nxt, cnt), nxt
 
-    (draft_cache, _), drafts = jax.lax.scan(
-        draft_step, (draft_cache, last_tok), jnp.arange(k, dtype=jnp.int32)
+    dcnt0 = counts if penalized else jnp.zeros((), jnp.int32)
+    (draft_cache, _, _), drafts = jax.lax.scan(
+        draft_step, (draft_cache, last_tok, dcnt0),
+        jnp.arange(k, dtype=jnp.int32),
     )
     drafts = drafts.T  # [B, k]
 
@@ -308,7 +342,19 @@ def spec_chunk(
         positions=real_lens[:, None] + voff[None, :],
         cache=cache, cache_index=real_lens, attn_mask=vmask,
     )
-    greedy = jnp.argmax(vlogits, axis=-1).astype(jnp.int32)  # [B, k+1]
+    if penalized:
+        # counts_j = base + one-hots of d_1..d_j (position j consumed
+        # [last_tok, d_1..d_j]; last_tok is already in the base histogram).
+        v = vlogits.shape[-1]
+        oneh = jax.nn.one_hot(drafts, v, dtype=jnp.int32)       # [B, k, V]
+        c = jnp.concatenate(
+            [jnp.zeros_like(oneh[:, :1]), jnp.cumsum(oneh, axis=1)], axis=1
+        )                                                       # [B, k+1, V]
+        greedy = jnp.argmax(
+            _pen(vlogits, counts[:, None, :] + c), axis=-1
+        ).astype(jnp.int32)
+    else:
+        greedy = jnp.argmax(vlogits, axis=-1).astype(jnp.int32)  # [B, k+1]
     # Shared accept/commit bookkeeping (runtime/speculative.py — the ONE
     # definition; only the frontier convention differs between the loops).
     from .speculative import backfill_coords, greedy_accept_commit
@@ -317,6 +363,14 @@ def spec_chunk(
         drafts, greedy, active, budget, eos_id, k
     )
     j_ar = jnp.arange(k + 1, dtype=jnp.int32)
+    # Chosen-token logprobs for the committed tokens (OpenAI logprobs
+    # contract): vlogits[:, j] predicts the token committed at offset j —
+    # for accepted drafts (j < a) cand[j] == greedy[j] by agreement, and
+    # the bonus/correction at j == a is greedy[j] itself.
+    lps = jnp.take_along_axis(
+        jax.nn.log_softmax(vlogits.astype(jnp.float32), axis=-1),
+        cand[..., None], axis=-1,
+    )[..., 0]  # [B, k+1]
 
     # Target KVs at slots real_lens .. real_lens+m-1 hold
     # [last_tok, c_1..c_{m-1}] — all committed; slot real_lens+m (holding
@@ -329,6 +383,13 @@ def spec_chunk(
     valid = valid | (committed & (m > 0)[:, None])
 
     toks = jnp.where(j_ar[None, :] < m[:, None], cand, jnp.int32(pad_id))
+    if penalized:
+        # Histogram update: every committed token (EOS included, matching
+        # decode_chunk's accounting).
+        commit_oneh = jax.nn.one_hot(
+            cand, counts.shape[1], dtype=jnp.int32
+        ) * (j_ar[None, :] < m[:, None])[..., None]
+        counts = counts + jnp.sum(commit_oneh, axis=1)
     new_last = jnp.take_along_axis(
         cand, jnp.maximum(m - 1, 0)[:, None], axis=1
     )[:, 0]
@@ -347,8 +408,8 @@ def spec_chunk(
         draft_params, draft_cfg, bf_tok[:, None], positions=bf_idx[:, None],
         cache=draft_cache, cache_index=bf_idx, attn_mask=bf_mask,
     )
-    return (toks, m, cache, draft_cache, last_tok, real_lens, valid, active,
-            budget)
+    return (toks, m, lps, cache, draft_cache, last_tok, real_lens, valid,
+            active, budget, counts if penalized else None)
 
 
 @partial(
@@ -613,6 +674,10 @@ def decode_chunk(
     toks, lps, last_tok, real_lens, valid, active, budget = _replicated(
         pm, toks.T, lps.T, last_tok, real_lens, valid, active, budget
     )
+    if counts is not None:
+        # The histogram is scheduling state too: replicated, so every host
+        # of a multi-process mesh applies identical penalty adjustments.
+        counts = _replicated(pm, counts)
     return (toks, cache, last_tok, real_lens, valid, active, budget, lps,
             counts)
 
@@ -657,8 +722,8 @@ class _RowState:
     rid: int | None = None
     emitted: list[int] = field(default_factory=list)
     lps: list[float] = field(default_factory=list)  # per-token logprobs
-    #                     (raw distribution), aligned with emitted; empty
-    #                     in speculative mode (verify logits not retained)
+    #                     (raw TARGET distribution), aligned with emitted —
+    #                     speculative mode gathers them from verify logits
     remaining: int = 0  # decode tokens this row may still emit (host mirror
     #                     of the device budget — distinguishes real pad-id
     #                     tokens from post-deactivation padding)
@@ -889,9 +954,9 @@ class ContinuousBatcher:
         self.rows = [_RowState() for _ in range(batch_slots)]
         self.queue: deque[_Request] = deque()
         self.results: dict[int, list[int]] = {}
-        # Per-token logprobs of each finished request (None in speculative
-        # mode); same lifecycle as ``results``.
-        self.result_logprobs: dict[int, list[float] | None] = {}
+        # Per-token logprobs of each finished request; same lifecycle as
+        # ``results`` (speculative mode gathers them from verify logits).
+        self.result_logprobs: dict[int, list[float]] = {}
         self.prefixes: dict[str, _Prefix] = {}
         self._rng = jax.random.key(seed)
         self._next_rid = 0
@@ -979,17 +1044,10 @@ class ContinuousBatcher:
                           ("frequency_penalty", frequency_penalty)):
             if not -2.0 <= pen <= 2.0:  # also rejects NaN/inf
                 raise ValueError(f"{name} must be in [-2, 2], got {pen}")
-        if (presence_penalty or frequency_penalty):
-            if self.speculative:
-                raise ValueError(
-                    "speculative batching is greedy-exact; penalties are "
-                    "not supported"
-                )
-            if self.pm is not None:
-                raise ValueError(
-                    "presence/frequency penalties are single-device for "
-                    "now (the output histogram is not mesh-sharded)"
-                )
+        # Presence/frequency penalties serve everywhere the batcher does:
+        # single-device, speculative, and GSPMD dp/tp meshes (the [B, V]
+        # histogram rides decode_chunk replicated, like the rest of the
+        # scheduling state).
         pfx_len = 0
         if prefix is not None:
             if prefix not in self.prefixes:
@@ -1032,7 +1090,7 @@ class ContinuousBatcher:
             if req.rid == rid:
                 self.queue.remove(req)
                 self.results[rid] = []
-                self.result_logprobs[rid] = None if self.speculative else []
+                self.result_logprobs[rid] = []
                 METRICS.inc("batcher.cancelled")
                 return True
         for i in range(self.b):
@@ -1043,9 +1101,7 @@ class ContinuousBatcher:
                     row.emitted = row.emitted[:cut]
                     row.lps = row.lps[:cut]
                 self.results[rid] = row.emitted
-                self.result_logprobs[rid] = (
-                    None if self.speculative else row.lps
-                )
+                self.result_logprobs[rid] = row.lps
                 if row.pages:
                     self.free_pages.extend(row.pages)
                     self.tables[i] = 0
@@ -1168,8 +1224,7 @@ class ContinuousBatcher:
             # budget-1 more from decode chunks.
             self.budget[i] = req.max_new_tokens - 1
             self.rows[i] = _RowState(
-                rid=req.rid, emitted=[tok],
-                lps=[] if self.speculative else [float(lp)],
+                rid=req.rid, emitted=[tok], lps=[float(lp)],
                 remaining=req.max_new_tokens - 1, pages=pages,
             )
             log.debug("admitted request %d into slot %d", req.rid, i)
@@ -1181,8 +1236,7 @@ class ContinuousBatcher:
                 # advances BEFORE the callback so a raising callback can
                 # never cause a re-delivery on a later run().
                 self.rows[i].streamed = 1
-                self._on_tokens(req.rid, [tok], False,
-                                None if self.speculative else [float(lp)])
+                self._on_tokens(req.rid, [tok], False, [float(lp)])
             METRICS.inc("batcher.admitted")
 
     def _collect(
@@ -1219,16 +1273,12 @@ class ContinuousBatcher:
                     row.emitted = row.emitted[:cut]
                     row.lps = row.lps[:cut]
                 self.results[row.rid] = row.emitted
-                self.result_logprobs[row.rid] = (
-                    None if self.speculative else row.lps
-                )
+                self.result_logprobs[row.rid] = row.lps
                 rid, final = row.rid, row.emitted[row.streamed:]
                 if row.pages:  # paged: return the row's pool pages
                     self.free_pages.extend(row.pages)
                     self.tables[i] = 0
-                final_lps = (
-                    None if self.speculative else row.lps[row.streamed:]
-                )
+                final_lps = row.lps[row.streamed:]
                 self.rows[i] = _RowState()
                 METRICS.inc("batcher.completed")
                 if self._on_tokens is not None:
@@ -1244,10 +1294,7 @@ class ContinuousBatcher:
                 row = self.rows[i]
                 if row.rid is not None and len(row.emitted) > row.streamed:
                     new = row.emitted[row.streamed:]
-                    new_lps = (
-                        None if self.speculative
-                        else row.lps[row.streamed:]
-                    )
+                    new_lps = row.lps[row.streamed:]
                     row.streamed = len(row.emitted)
                     self._on_tokens(row.rid, new, False, new_lps)
 
@@ -1260,8 +1307,9 @@ class ContinuousBatcher:
         per-chunk), and exactly once with ``done=True`` carrying any final
         tokens — the concatenation of all deliveries for a rid equals its
         entry in the returned dict.  ``logprobs`` aligns 1:1 with
-        ``new_tokens`` (raw-distribution chosen-token logprobs; None in
-        speculative mode, whose verify pass does not retain them).
+        ``new_tokens`` (raw-distribution chosen-token logprobs — in
+        speculative mode gathered from the verify pass's logits, identical
+        to the plain batcher's at temperature 0).
         Exceptions from the callback propagate (and abort the run).
         """
         self._on_tokens = on_tokens
@@ -1285,14 +1333,26 @@ class ContinuousBatcher:
                     break
                 continue
             counts = None
-            counts_out = None  # decode_chunk's histogram (plain branch only)
+            counts_out = None  # updated penalty histogram (either branch)
             if self.speculative:
-                (toks, m, self.cache, self.draft_cache, last_tok, real_lens,
-                 valid, active, budget) = spec_chunk(
+                # Penalized path only while a penalized row is live — the
+                # all-default batch keeps the smaller static program (same
+                # policy as the plain branch below).
+                per_spec = {}
+                pen_live = self.active & (
+                    (self.pres_row != 0.0) | (self.freq_row != 0.0)
+                )
+                if bool(pen_live.any()):
+                    per_spec["counts"] = self.tok_counts
+                    per_spec["pres_row"] = jnp.asarray(self.pres_row)
+                    per_spec["freq_row"] = jnp.asarray(self.freq_row)
+                (toks, m, chunk_lps, self.cache, self.draft_cache, last_tok,
+                 real_lens, valid, active, budget, counts_out) = spec_chunk(
                     self.params, self.cfg, self.draft_params, self.draft_cfg,
                     self.cache, self.draft_cache, self.last_tok,
                     self.real_lens, self.valid, self.active, self.budget,
                     k=self.spec_k, eos_id=self.eos_id, pad_id=self.pad_id,
+                    **per_spec,
                 )
                 counts = np.asarray(m)
             else:
@@ -1337,9 +1397,8 @@ class ContinuousBatcher:
             self.valid = np.array(valid)
             self.active = np.array(active)
             self.budget = np.array(budget)
-            if counts is None and counts_out is not None:
+            if counts_out is not None:
                 self.tok_counts = counts_out
             self._collect(np.asarray(toks), was_active, counts=counts,
-                          lps=None if counts is not None
-                          else np.asarray(chunk_lps))
+                          lps=np.asarray(chunk_lps))
         return dict(self.results)

@@ -96,6 +96,40 @@ def test_mesh_batcher_prefix_caching(tiny, devices8):
     assert res[rid] == solo(cfg, params, prefix + suffix, 8)
 
 
+@pytest.mark.skipif(
+    __import__("os").environ.get("DLT_RUN_ISOLATED") != "1",
+    reason="compile-heavy penalized mesh decode; runs fresh-process via "
+           "tests/runtime/test_isolated.py (XLA:CPU long-lived-process "
+           "compile fragility)",
+)
+def test_mesh_batcher_penalties_match_single_device(tiny, devices8):
+    """Per-request presence/frequency penalties on a dp x tp mesh: the
+    [B, V] output histogram rides decode_chunk replicated (scheduling
+    state), so the penalized row matches the single-device penalized
+    batcher token-for-token and its unpenalized neighbor stays solo-exact."""
+    cfg, params = tiny
+    ids, n = [7, 1, 9], 20
+    other = ([4, 4, 4, 4], 9)
+
+    ref = ContinuousBatcher(cfg, params, batch_slots=2, max_len=96,
+                            chunk_steps=4)
+    r_pen = ref.submit(ids, max_new_tokens=n, presence_penalty=1.5,
+                       frequency_penalty=1.5)
+    r_other = ref.submit(other[0], max_new_tokens=other[1])
+    ref_res = ref.run()
+
+    b = _mesh_batcher(
+        cfg, params, devices8, data=2, model=4,
+        batch_slots=2, max_len=96, chunk_steps=4,
+    )
+    m_pen = b.submit(ids, max_new_tokens=n, presence_penalty=1.5,
+                     frequency_penalty=1.5)
+    m_other = b.submit(other[0], max_new_tokens=other[1])
+    res = b.run()
+    assert res[m_pen] == ref_res[r_pen]
+    assert res[m_other] == ref_res[r_other]
+
+
 def test_mesh_batcher_rejects_pipe_and_seq(tiny, devices8):
     cfg, params = tiny
     pm = api_lib.make_parallel_model(cfg, MeshConfig(pipe=2, model=4))

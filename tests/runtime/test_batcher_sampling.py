@@ -157,19 +157,48 @@ def test_logprobs_aligned_deterministic_and_streamed(tiny):
     assert result_lps == {k: result_lps2[k] for k in result_lps}
 
 
-def test_speculative_logprobs_are_none(tiny):
+# The two speculative tests below compile spec_chunk programs (plain and
+# penalized); the suite's XLA:CPU crash budget is cumulative, so they run
+# fresh-process via tests/runtime/test_isolated.py like the rest of the
+# speculative family.
+_fragile_xla_cpu = pytest.mark.skipif(
+    __import__("os").environ.get("DLT_RUN_ISOLATED") != "1",
+    reason="compile-heavy speculative rounds; runs fresh-process via "
+           "tests/runtime/test_isolated.py (XLA:CPU long-lived-process "
+           "compile fragility)",
+)
+
+
+@_fragile_xla_cpu
+def test_speculative_logprobs_match_plain(tiny):
+    """Speculative mode gathers chosen-token logprobs from the verify
+    pass's logits; at temperature 0 they must match the plain batcher's
+    (same model, same tokens, same raw distribution — the verify forward
+    and the plain decode forward see identical committed context)."""
     cfg, params = tiny
+    reqs = [([1, 2, 3], 8), ([7, 1], 5)]
+    plain = make(tiny)
+    plain_rids = [plain.submit(ids, max_new_tokens=n) for ids, n in reqs]
+    plain_res = plain.run()
+
     b = ContinuousBatcher(
-        cfg, params, batch_slots=2, max_len=64, chunk_steps=4,
+        cfg, params, batch_slots=2, max_len=96, chunk_steps=4,
         draft_params=params, draft_cfg=cfg, spec_k=2,
     )
-    rid = b.submit([1, 2, 3], max_new_tokens=5)
+    rids = [b.submit(ids, max_new_tokens=n) for ids, n in reqs]
+    streamed_lps = {r: [] for r in rids}
 
-    def cb(r, new, done, lps):
-        assert lps is None
+    def cb(rid, new, done, lps):
+        assert lps is not None and len(lps) == len(new)
+        streamed_lps[rid].extend(lps)
 
-    b.run(on_tokens=cb)
-    assert b.result_logprobs[rid] is None
+    res = b.run(on_tokens=cb)
+    for pr, r in zip(plain_rids, rids):
+        assert res[r] == plain_res[pr]  # spec is greedy-exact
+        assert len(b.result_logprobs[r]) == len(res[r])
+        assert streamed_lps[r] == b.result_logprobs[r]
+        for a, c in zip(plain.result_logprobs[pr], b.result_logprobs[r]):
+            assert abs(a - c) < 5e-4, (a, c)
 
 
 def test_penalties_break_repetition_and_preserve_neighbors(tiny):
@@ -208,10 +237,36 @@ def test_penalty_validation(tiny):
         b.submit([1, 2], max_new_tokens=4, presence_penalty=2.5)
     with pytest.raises(ValueError, match="frequency_penalty"):
         b.submit([1, 2], max_new_tokens=4, frequency_penalty=float("nan"))
+
+
+@_fragile_xla_cpu
+def test_speculative_penalties_match_plain(tiny):
+    """Penalized speculative batching is bit-exact vs the penalized plain
+    batcher: verify position j's penalty histogram (base + drafts 1..j)
+    equals the sequential decode's committed-context histogram within the
+    accepted lead — so the adjusted argmax chain is identical.  An
+    unpenalized neighbor in the same spec batch stays exact too."""
     cfg, params = tiny
+    ids, n = [7, 1, 9], 20
+    other_ids, other_n = [4, 4, 4, 4], 9
+
+    plain = make(tiny)
+    p_pen = plain.submit(ids, max_new_tokens=n, presence_penalty=1.5,
+                         frequency_penalty=1.5)
+    p_other = plain.submit(other_ids, max_new_tokens=other_n)
+    p_res = plain.run()
+    # Premise: penalties actually changed the path (vs unpenalized run).
+    un = make(tiny)
+    u_rid = un.submit(ids, max_new_tokens=n)
+    assert p_res[p_pen] != un.run()[u_rid]
+
     spec = ContinuousBatcher(
-        cfg, params, batch_slots=2, max_len=64, chunk_steps=4,
+        cfg, params, batch_slots=2, max_len=96, chunk_steps=4,
         draft_params=params, draft_cfg=cfg, spec_k=2,
     )
-    with pytest.raises(ValueError, match="penalties"):
-        spec.submit([1, 2], max_new_tokens=4, frequency_penalty=1.0)
+    s_pen = spec.submit(ids, max_new_tokens=n, presence_penalty=1.5,
+                        frequency_penalty=1.5)
+    s_other = spec.submit(other_ids, max_new_tokens=other_n)
+    s_res = spec.run()
+    assert s_res[s_pen] == p_res[p_pen]
+    assert s_res[s_other] == p_res[p_other]
