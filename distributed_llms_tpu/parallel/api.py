@@ -251,19 +251,19 @@ class ParallelModel:
     # dataclass => stable hash => jit cache hits across calls) --------------
 
     def _guard_windowed_decode(self) -> None:
-        """Mesh decode of sliding-window models is unsupported: the decode
-        adapters do not thread the slot->position map the window mask needs
-        for the right-padded generate layout (models.model._attention
-        key_positions), so serving one here would silently widen the window
-        by each row's pad amount.  Training and no-cache forwards window
-        correctly (position space throughout) and stay available; serve
-        windowed models via a single-device engine or its continuous
-        batcher (contiguous layout: slot == position)."""
-        if self.cfg.sliding_window is not None:
+        """Sliding-window mesh decode: the GSPMD and pipelined adapters
+        thread the slot->position map the window mask needs for the
+        right-padded generate layout (models.model._attention
+        key_positions; pipeline_decode derives it per tick), so windowed
+        models serve on data/tensor/pipe meshes.  Only the seq-parallel
+        cached paths stay guarded: ring/Ulysses attention and the
+        two-region seq cache are causal-only and do not carry a window
+        bound — decoding there would silently attend past the window."""
+        if self.cfg.sliding_window is not None and self.seq_parallel:
             raise ValueError(
-                "mesh decode of sliding_window models is unsupported (the "
-                "decode adapters do not thread key_positions); serve via a "
-                "single-device engine or its continuous batcher"
+                "sequence-parallel decode of sliding_window models is "
+                "unsupported (ring/Ulysses attention is causal-only, no "
+                "window bound); use a data/model/pipe mesh"
             )
 
     def as_forward_fn(self):
@@ -295,12 +295,13 @@ class ParallelModel:
 
     def _forward_adapter(
         self, params, cfg, tokens, positions=None, cache=None,
-        cache_index=None, attn_mask=None,
+        cache_index=None, attn_mask=None, key_positions=None,
     ):
         del cfg  # self.cfg is authoritative
         return self.forward(
             params, tokens, positions=positions, cache=cache,
             cache_index=cache_index, attn_mask=attn_mask,
+            key_positions=key_positions,
         )
 
     def _make_cache_adapter(self, cfg, batch, max_len, prompt_len=None):
@@ -412,12 +413,19 @@ class ParallelModel:
         attn_mask: jax.Array | None = None,
         remat: bool = False,
         return_aux: bool = False,
+        key_positions: jax.Array | None = None,  # [B, S] slot->position map
+        #   (sliding-window decode under the right-padded generate layout)
     ) -> tuple[jax.Array, KVCache | None] | tuple[jax.Array, KVCache | None, jax.Array]:
         """Same contract as models.model.forward, but mesh-parallel.
         ``return_aux`` (MoE load-balance loss) flows through on the
         GSPMD paths; the pipeline/seq shard_map schedules return aux=0 —
         train MoE with data/model/expert axes."""
         cfg = self.cfg
+        if self.seq_parallel and key_positions is not None:
+            raise NotImplementedError(
+                "sequence-parallel paths do not thread key_positions "
+                "(ring/Ulysses are causal-only)"
+            )
         if self.seq_parallel and cache is not None:
             # Long-context *generation* (SURVEY §5.7): prompt KV sharded over
             # 'seq' (two-region cache from init_cache); single-token decode
@@ -464,7 +472,7 @@ class ParallelModel:
                 return model_lib.forward(
                     params, cfg, tokens, positions=positions, cache=cache,
                     cache_index=cache_index, remat=remat, attn_mask=attn_mask,
-                    return_aux=return_aux,
+                    return_aux=return_aux, key_positions=key_positions,
                 )
 
         b, t = tokens.shape
@@ -478,6 +486,7 @@ class ParallelModel:
             cache_k=cache.k if cache is not None else None,
             cache_v=cache.v if cache is not None else None,
             cache_index=cache_index, attn_mask=attn_mask, remat=remat,
+            key_positions=key_positions,
         )
         logits = model_lib.unembed(params, cfg, y)
         new = None if cache is None else KVCache(k=new_cache[0], v=new_cache[1])

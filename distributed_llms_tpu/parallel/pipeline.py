@@ -73,6 +73,8 @@ def pipeline_blocks(
     cache_index: jax.Array | None = None,  # scalar int32
     attn_mask: jax.Array | None = None,  # [B, 1, Tq, S]
     remat: bool = False,
+    key_positions: jax.Array | None = None,  # [B, S] slot->position map for
+    #   sliding-window models under the right-padded decode layout
 ) -> tuple[jax.Array, tuple[jax.Array, jax.Array] | None]:
     """Run the decoder blocks through the pipeline.  Returns ([B, T, D],
     updated staged caches or None)."""
@@ -87,9 +89,14 @@ def pipeline_blocks(
     mask_mb = (
         _split_mb(attn_mask, m) if use_mask else jnp.zeros((m, 1, 1, 1, 1), dtype=bool)
     )
+    use_kpos = key_positions is not None
+    kpos_mb = (
+        _split_mb(key_positions, m) if use_kpos
+        else jnp.zeros((m, 1, 1), dtype=jnp.int32)
+    )
     mb_size = x_mb.shape[1]
 
-    def body(staged_blocks, x_mb, pos_mb, cache_k, cache_v, mask_mb):
+    def body(staged_blocks, x_mb, pos_mb, cache_k, cache_v, mask_mb, kpos_mb):
         # Per-device views: leading 'pipe' axis has local size 1 -> squeeze.
         blocks = jax.tree.map(lambda a: a[0], staged_blocks)
         stage = jax.lax.axis_index("pipe")
@@ -115,6 +122,11 @@ def pipeline_blocks(
                 if use_mask
                 else None
             )
+            kpos = (
+                jax.lax.dynamic_index_in_dim(kpos_mb, mb_idx, keepdims=False)
+                if use_kpos
+                else None
+            )
 
             if use_cache:
                 row0 = mb_idx * mb_size
@@ -122,7 +134,7 @@ def pipeline_blocks(
                 cv_mb = jax.lax.dynamic_slice_in_dim(cv, row0, mb_size, axis=1)
                 y, (nk, nv), _ = model_lib.run_blocks(
                     x_in, blocks, cfg, pos, ck_mb, cv_mb, cache_index,
-                    remat=remat, attn_mask=amask,
+                    remat=remat, attn_mask=amask, key_positions=kpos,
                 )
                 nk = jnp.where(valid, nk, ck_mb)
                 nv = jnp.where(valid, nv, cv_mb)
@@ -167,6 +179,7 @@ def pipeline_blocks(
         P("pipe") if use_cache else P(),
         P("pipe") if use_cache else P(),
         P(),        # mask_mb
+        P(),        # kpos_mb
     )
     out_specs = (P("pipe"), P("pipe"), P("pipe")) if use_cache else (P("pipe"),)
 
@@ -181,7 +194,7 @@ def pipeline_blocks(
         staged_blocks, x_mb, pos_mb,
         cache_k if use_cache else jnp.zeros((num_stages, 1)),
         cache_v if use_cache else jnp.zeros((num_stages, 1)),
-        mask_mb,
+        mask_mb, kpos_mb,
     )
 
     if use_cache:
@@ -335,8 +348,19 @@ def pipeline_decode(
             row0 = m_idx * mb
             ck_mb = jax.lax.dynamic_slice_in_dim(ck, row0, mb, axis=1)
             cv_mb = jax.lax.dynamic_slice_in_dim(cv, row0, mb, axis=1)
+            # Sliding-window models: slot->position map under this layout
+            # (prompt slot s holds position s; generated slot t_base + i
+            # holds position len + i) — same formula as
+            # runtime.generate.window_key_positions, per microbatch.
+            kpos = None
+            if cfg.sliding_window is not None:
+                kpos = jnp.where(
+                    slots[None, :] < t_base, slots[None, :],
+                    plens_m[:, None] + (slots[None, :] - t_base),
+                )
             y, (nk, nv), _ = model_lib.run_blocks(
-                x_in, blocks, cfg, pos, ck_mb, cv_mb, t_base + j, attn_mask=mask
+                x_in, blocks, cfg, pos, ck_mb, cv_mb, t_base + j,
+                attn_mask=mask, key_positions=kpos,
             )
             nk = jnp.where(valid, nk, ck_mb)
             nv = jnp.where(valid, nv, cv_mb)
