@@ -63,6 +63,10 @@ def save_train_state(
     )
     marker = os.path.join(path, _TREEDEF_FILE)
     tmp = marker + ".tmp"
+    # pickle is safe here (unlike the reference's pickled network frames,
+    # src/network/protocol.py): this sidecar is a LOCAL file in the
+    # checkpoint directory we just wrote, read back only by restore() on
+    # the same trusted filesystem — never from the network.
     with open(tmp, "wb") as f:
         pickle.dump(treedef, f)
         f.flush()

@@ -70,9 +70,9 @@ def generate(ladder_path: str) -> str:
     ]
     listed = [str(e["config"]) for e in bench.LADDER] + [
         # Aux rows run_ladder appends after the decode configs.
-        "serving-latency", "continuous-batching", "paged-batching",
+        "serving-latency", "continuous-batching", "local-proc-batching",
         "ragged-decode-8k", "quant-matmul-bw", "spec-decode",
-        "spec-decode-7b-int8", "spec-batching",
+        "spec-decode-7b-int8", "spec-batching", "paged-batching",
         "prefill-flash-2048", "prefill-flash-8192", "hop-latency",
     ]
     extras = [c for c in rows if c not in listed]
