@@ -241,7 +241,8 @@ def admit_row_kv(
 
 @partial(
     jax.jit,
-    static_argnames=("cfg", "draft_cfg", "k", "eos_id", "pad_id"),
+    static_argnames=("cfg", "draft_cfg", "k", "eos_id", "pad_id",
+                     "temperature", "top_k", "top_p"),
     donate_argnames=("cache", "draft_cache"),
 )
 def spec_chunk(
@@ -262,12 +263,28 @@ def spec_chunk(
     counts: jax.Array | None = None,  # [B, V] int32 output-token histogram
     pres_row: jax.Array | None = None,  # [B] traced presence penalties
     freq_row: jax.Array | None = None,  # [B] traced frequency penalties
+    temperature: float = 0.0,  # 0 => greedy (bit-exact vs decode_chunk);
+    #   > 0 => speculative SAMPLING (distribution-preserving, engine-wide
+    #   warp — the same Leviathan/Chen rejection scheme as
+    #   runtime/speculative.py, one round per call instead of a while_loop)
+    top_k: int = 0,
+    top_p: float = 1.0,
+    rng: jax.Array | None = None,  # required when temperature > 0
 ) -> tuple:
-    """ONE speculative round over the batch (greedy): draft k tokens per
-    row against the draft cache, verify all of them in one (k+1)-token
-    target forward, commit each row's agreeing prefix + bonus/correction.
-    Tokens are bit-identical to decode_chunk's greedy output — acceptance
-    only changes how many arrive per round.
+    """ONE speculative round over the batch: draft k tokens per row
+    against the draft cache, verify all of them in one (k+1)-token target
+    forward, commit each row's accepted prefix + bonus/correction.
+    temperature == 0: greedy — tokens bit-identical to decode_chunk's
+    greedy output; acceptance only changes how many arrive per round.
+    temperature > 0: rejection sampling — draft token d_j ~ q_j accepts
+    iff u_j < p_j(d_j)/q_j(d_j), the first rejection draws from
+    normalize(max(p - q, 0)), full acceptance draws the bonus from
+    p_{k+1} (q zero-extended); the emitted sequence is an exact sample
+    from the target's warped distribution, same theorem and residual
+    construction as runtime/speculative.py's sampled loop (the RNG stream
+    differs from decode_chunk's, so per-seed tokens differ while the
+    distribution does not — pinned by the self-calibrated TV test in
+    tests/runtime/test_spec_batcher.py).
 
     Returns (toks [B, k+1] pad-masked, m [B] committed counts, lps
     [B, k+1] chosen-token logprobs, cache', draft_cache', last_tok',
@@ -294,6 +311,13 @@ def spec_chunk(
     s = cache.k.shape[-3]
     slots = jnp.arange(s, dtype=jnp.int32)
     penalized = counts is not None
+    sampled = temperature > 0.0
+    if sampled and rng is None:
+        raise ValueError("spec_chunk with temperature > 0 requires rng")
+    if sampled:
+        rng, kd, ku, kc = jax.random.split(rng, 4)
+    else:
+        kd = jax.random.key(0)  # uniform scan shape; never consumed
 
     def _pen(logits, cnt):  # [B(, T), V] logits, [B(, T), V] int32 counts
         if not penalized:
@@ -309,26 +333,45 @@ def spec_chunk(
                               slots[None, :] <= hi[:, None])
         return jnp.logical_or(valid, own)[:, None, None, :]
 
-    # --- draft: k single-token greedy steps against the draft cache.
-    # Penalized mode carries the evolving histogram (base + drafts so far)
-    # so the draft's greedy tracks the penalized target's.
-    def draft_step(dc, j):
+    # --- draft: k single-token steps against the draft cache.  Penalized
+    # mode carries the evolving histogram (base + drafts so far) so the
+    # draft tracks the penalized target; sampled mode also emits each
+    # step's full post-warp distribution q_j (the rejection test needs
+    # q_j(d_j) and the residual the whole vector).
+    def draft_step(dc, inputs):
         draft_cache, cur, cnt = dc
+        j, kj = inputs
         idx = real_lens + j
         logits, draft_cache = model_lib.forward(
             draft_params, draft_cfg, cur[:, None], positions=idx[:, None],
             cache=draft_cache, cache_index=idx, attn_mask=row_mask(idx),
         )
-        nxt = jnp.argmax(_pen(logits[:, 0], cnt), axis=-1).astype(jnp.int32)
+        step_logits = _pen(logits[:, 0], cnt)
+        if sampled:
+            warped = sampling.warp_logits(
+                step_logits, temperature, top_k, top_p
+            )
+            nxt = jax.random.categorical(kj, warped, axis=-1).astype(
+                jnp.int32
+            )
+            out = (nxt, jax.nn.softmax(warped, axis=-1))
+        else:
+            nxt = jnp.argmax(step_logits, axis=-1).astype(jnp.int32)
+            out = nxt
         if penalized:
             cnt = cnt.at[jnp.arange(cnt.shape[0]), nxt].add(1)
-        return (draft_cache, nxt, cnt), nxt
+        return (draft_cache, nxt, cnt), out
 
     dcnt0 = counts if penalized else jnp.zeros((), jnp.int32)
-    (draft_cache, _, _), drafts = jax.lax.scan(
+    (draft_cache, _, _), draft_ys = jax.lax.scan(
         draft_step, (draft_cache, last_tok, dcnt0),
-        jnp.arange(k, dtype=jnp.int32),
+        (jnp.arange(k, dtype=jnp.int32), jax.random.split(kd, k)),
     )
+    if sampled:
+        drafts, qs = draft_ys
+        qs = jnp.moveaxis(qs, 0, 1)  # [B, k, V]
+    else:
+        drafts, qs = draft_ys, None
     drafts = drafts.T  # [B, k]
 
     # --- verify: one (k+1)-token target forward.
@@ -350,23 +393,66 @@ def spec_chunk(
         c = jnp.concatenate(
             [jnp.zeros_like(oneh[:, :1]), jnp.cumsum(oneh, axis=1)], axis=1
         )                                                       # [B, k+1, V]
-        greedy = jnp.argmax(
-            _pen(vlogits, counts[:, None, :] + c), axis=-1
-        ).astype(jnp.int32)
+        pen_vlogits = _pen(vlogits, counts[:, None, :] + c)
     else:
-        greedy = jnp.argmax(vlogits, axis=-1).astype(jnp.int32)  # [B, k+1]
+        pen_vlogits = vlogits
     # Shared accept/commit bookkeeping (runtime/speculative.py — the ONE
     # definition; only the frontier convention differs between the loops).
-    from .speculative import backfill_coords, greedy_accept_commit
+    from .speculative import backfill_coords, commit_clamp, greedy_accept_commit
 
-    cand, m, has_eos, _ = greedy_accept_commit(
-        drafts, greedy, active, budget, eos_id, k
-    )
     j_ar = jnp.arange(k + 1, dtype=jnp.int32)
+    b = drafts.shape[0]
+    if sampled:
+        # Rejection sampling over the (penalized, warped) target vs draft
+        # distributions — identical math to speculative_generate_tokens'
+        # sampled branch; p and q share the same penalty basis per
+        # position so the theorem holds against the penalized target.
+        ps = jax.nn.softmax(
+            sampling.warp_logits(pen_vlogits, temperature, top_k, top_p),
+            axis=-1,
+        )  # [B, k+1, V]
+        p_at = jnp.take_along_axis(
+            ps[:, :k], drafts[..., None], axis=-1
+        )[..., 0]                                        # [B, k]
+        q_at = jnp.take_along_axis(qs, drafts[..., None], axis=-1)[..., 0]
+        u = jax.random.uniform(ku, (b, k))
+        accept = u * jnp.maximum(q_at, 1e-20) < p_at
+        lead = jnp.cumprod(accept.astype(jnp.int32), axis=1)
+        a = jnp.sum(lead, axis=1)                        # [B] in 0..k
+        # Unified residual: zero-extend q so position k's "residual" is
+        # p_{k+1} itself (the bonus draw).
+        q_ext = jnp.concatenate([qs, jnp.zeros_like(ps[:, :1])], axis=1)
+        p_a = jnp.take_along_axis(ps, a[:, None, None], axis=1)[:, 0]
+        q_a = jnp.take_along_axis(q_ext, a[:, None, None], axis=1)[:, 0]
+        resid = jnp.maximum(p_a - q_a, 0.0)
+        norm = jnp.sum(resid, axis=-1, keepdims=True)
+        # p == q on the whole support leaves an empty residual; fall back
+        # to p (any sample from it is valid there).
+        resid = jnp.where(norm > 1e-9, resid / jnp.maximum(norm, 1e-9), p_a)
+        corr = jax.random.categorical(
+            kc,
+            jnp.where(resid > 0, jnp.log(jnp.maximum(resid, 1e-30)),
+                      -jnp.inf),
+            axis=-1,
+        ).astype(jnp.int32)                              # [B]
+        cand = jnp.where(
+            j_ar[None, :] < a[:, None],
+            jnp.concatenate([drafts, drafts[:, -1:]], axis=1),
+            corr[:, None],
+        )                                                # [B, k+1]
+        m, has_eos = commit_clamp(cand, a, active, budget, eos_id, k)
+    else:
+        greedy = jnp.argmax(pen_vlogits, axis=-1).astype(jnp.int32)
+        cand, m, has_eos, _ = greedy_accept_commit(
+            drafts, greedy, active, budget, eos_id, k
+        )
     # Chosen-token logprobs for the committed tokens (OpenAI logprobs
-    # contract): vlogits[:, j] predicts the token committed at offset j —
-    # for accepted drafts (j < a) cand[j] == greedy[j] by agreement, and
-    # the bonus/correction at j == a is greedy[j] itself.
+    # contract): vlogits[:, j] predicts the token committed at offset j.
+    # Greedy: accepted drafts equal greedy[j] by agreement and the bonus
+    # at j == a IS greedy[j].  Sampled: accepted drafts are the sampled
+    # d_j and j == a holds the residual/bonus draw — either way the
+    # committed token's raw-distribution log-softmax under the TARGET at
+    # position j is the contract (decode_chunk reports the same basis).
     lps = jnp.take_along_axis(
         jax.nn.log_softmax(vlogits.astype(jnp.float32), axis=-1),
         cand[..., None], axis=-1,
@@ -768,11 +854,13 @@ class ContinuousBatcher:
         #   the pool can be far smaller than batch_slots * max_len; a full
         #   pool back-pressures admission instead of OOMing.
         page_size: int = 64,
-        # Speculative batching (greedy only): every scheduling round drafts
-        # spec_k tokens per row with the draft model and verifies them in
-        # ONE target forward — tokens stay bit-identical to the plain
-        # batcher; acceptance only changes how many arrive per round.
-        # Single-device contiguous mode (no mesh, no paging).
+        # Speculative batching: every scheduling round drafts spec_k
+        # tokens per row with the draft model and verifies them in ONE
+        # target forward.  temperature == 0: tokens stay bit-identical to
+        # the plain batcher (acceptance only changes how many arrive per
+        # round); engine-wide temperature > 0: distribution-preserving
+        # rejection sampling (spec_chunk docstring).  Single-device
+        # contiguous mode (no mesh, no paging).
         draft_params: Any = None,
         draft_cfg: ModelConfig | None = None,
         spec_k: int = 4,
@@ -825,10 +913,10 @@ class ContinuousBatcher:
                     "speculative batching is single-device contiguous mode "
                     "(no mesh, no paged KV)"
                 )
-            if temperature != 0.0:
-                raise ValueError(
-                    "speculative batching is greedy-only; set temperature=0"
-                )
+            # Engine-wide temperature/top_k/top_p compose with speculation
+            # (distribution-preserving rejection sampling in spec_chunk);
+            # only PER-REQUEST overrides are rejected (submit) — the
+            # rejection test warps p and q with one static config.
             if draft_cfg.vocab_size != cfg.vocab_size:
                 raise ValueError(
                     f"draft vocab {draft_cfg.vocab_size} != target vocab "
@@ -1032,14 +1120,22 @@ class ContinuousBatcher:
 
             if not (math.isfinite(temperature) and temperature >= 0.0):
                 raise ValueError(f"temperature must be >= 0, got {temperature}")
-            if self.speculative and temperature > 0.0:
+            if self.speculative and temperature != self.sampling["temperature"]:
                 raise ValueError(
-                    "speculative batching is greedy-exact; per-request "
-                    "temperature > 0 is not supported (build a plain "
-                    "batcher for sampled serving)"
+                    "speculative batching samples with the engine-wide "
+                    f"temperature ({self.sampling['temperature']}); "
+                    "per-request overrides are not supported (the rejection "
+                    "test warps target and draft with one static config)"
                 )
         if top_p is not None and not 0.0 < top_p <= 1.0:
             raise ValueError(f"top_p must be in (0, 1], got {top_p}")
+        if (top_p is not None and self.speculative
+                and top_p != self.sampling["top_p"]):
+            raise ValueError(
+                "speculative batching samples with the engine-wide top_p "
+                f"({self.sampling['top_p']}); per-request overrides are "
+                "not supported"
+            )
         for name, pen in (("presence_penalty", presence_penalty),
                           ("frequency_penalty", frequency_penalty)):
             if not -2.0 <= pen <= 2.0:  # also rejects NaN/inf
@@ -1346,13 +1442,17 @@ class ContinuousBatcher:
                     per_spec["counts"] = self.tok_counts
                     per_spec["pres_row"] = jnp.asarray(self.pres_row)
                     per_spec["freq_row"] = jnp.asarray(self.freq_row)
+                if self.sampling["temperature"] > 0.0:
+                    # Sampled rounds consume RNG; greedy rounds must not
+                    # (greedy spec stays bit-stable across configs).
+                    per_spec["rng"] = self._split_rng()
                 (toks, m, chunk_lps, self.cache, self.draft_cache, last_tok,
                  real_lens, valid, active, budget, counts_out) = spec_chunk(
                     self.params, self.cfg, self.draft_params, self.draft_cfg,
                     self.cache, self.draft_cache, self.last_tok,
                     self.real_lens, self.valid, self.active, self.budget,
                     k=self.spec_k, eos_id=self.eos_id, pad_id=self.pad_id,
-                    **per_spec,
+                    **self.sampling, **per_spec,
                 )
                 counts = np.asarray(m)
             else:

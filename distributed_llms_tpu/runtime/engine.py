@@ -489,7 +489,10 @@ class InferenceEngine:
         if speculative is None:
             # Config-driven default mirrors generate_text's routing: only
             # when every precondition holds (never erroring where the plain
-            # batcher works).
+            # batcher works).  temperature == 0 keeps the flip-on-spec
+            # bit-exactness contract; sampled speculation (distribution-
+            # preserving, different RNG stream) is available by passing
+            # speculative=True explicitly.
             speculative = (
                 self.rt.spec_decode
                 and self.rt.temperature == 0.0
@@ -522,7 +525,8 @@ class InferenceEngine:
             paged_pages=paged_pages, page_size=page_size,
         )
 
-    # -- speculative decoding (runtime/speculative.py): greedy-exact ------
+    # -- speculative decoding (runtime/speculative.py): greedy-exact at
+    # temperature 0, distribution-preserving sampling above it ----------
 
     def _serves_quantized(self) -> bool:
         """Whether the decoder-block weights are resident as QuantizedTensor

@@ -364,11 +364,9 @@ class InferenceServer:
                 raise BadRequest("'temperature' must be >= 0")
             if name == "top_p" and not 0.0 < want <= 1.0:
                 raise BadRequest("'top_p' must be in (0, 1]")
-            if name == "temperature" and want > 0 and self.batcher.speculative:
-                raise BadRequest(
-                    "this server runs speculative (greedy-exact) decoding; "
-                    "temperature > 0 is not supported"
-                )
+            # Speculative engines accept only values matching their
+            # engine-wide sampling config — submit() enforces it and its
+            # ValueError becomes a 400 at the call site.
             out.append(want)
         for name in ("presence_penalty", "frequency_penalty"):
             pen = req.get(name)

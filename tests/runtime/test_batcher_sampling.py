@@ -115,9 +115,12 @@ def test_speculative_rejects_per_request_sampling(tiny):
         cfg, params, batch_slots=2, max_len=64, chunk_steps=4,
         draft_params=params, draft_cfg=cfg, spec_k=2,
     )
-    with pytest.raises(ValueError, match="greedy-exact"):
+    # Engine-wide sampling composes with speculation (temperature set at
+    # construction); per-request overrides differing from the engine's
+    # config do not.
+    with pytest.raises(ValueError, match="engine-wide"):
         b.submit([1, 2, 3], max_new_tokens=4, temperature=0.7)
-    # Explicit temperature=0 is fine (it IS greedy).
+    # Explicit temperature=0 matches this engine's config (greedy).
     rid = b.submit([1, 2, 3], max_new_tokens=4, temperature=0.0)
     assert rid >= 0
 

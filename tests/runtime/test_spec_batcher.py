@@ -134,9 +134,6 @@ def test_spec_batcher_guards(models):
     cfg, params, dcfg, dparams = models
     with pytest.raises(ValueError, match="draft_cfg"):
         ContinuousBatcher(cfg, params, max_len=64, draft_params=dparams)
-    with pytest.raises(ValueError, match="greedy-only"):
-        ContinuousBatcher(cfg, params, max_len=64, draft_params=dparams,
-                          draft_cfg=dcfg, temperature=0.5)
     with pytest.raises(ValueError, match="single-device"):
         ContinuousBatcher(cfg, params, draft_params=dparams, draft_cfg=dcfg,
                           paged_pages=8, page_size=16, max_len=64)
@@ -145,6 +142,64 @@ def test_spec_batcher_guards(models):
         ContinuousBatcher(cfg, params, max_len=64,
                           draft_params=model_lib.init_params(
                               jax.random.key(1), bad), draft_cfg=bad)
+    # Engine-wide sampling composes with speculation; PER-REQUEST overrides
+    # don't (the rejection test warps p and q with one static config).
+    sb = ContinuousBatcher(cfg, params, max_len=64, draft_params=dparams,
+                          draft_cfg=dcfg, temperature=0.5)
+    with pytest.raises(ValueError, match="engine-wide"):
+        sb.submit([1, 2], max_new_tokens=4, temperature=0.9)
+    with pytest.raises(ValueError, match="engine-wide"):
+        sb.submit([1, 2], max_new_tokens=4, top_p=0.5)
+    # Values MATCHING the engine config are accepted (they are no-ops).
+    assert sb.submit([1, 2], max_new_tokens=4, temperature=0.5) >= 0
+
+
+def test_sampled_spec_batcher_distribution():
+    """Sampled speculative batching is distribution-preserving: over many
+    seeds, the joint empirical distribution of the first two tokens from a
+    temperature>0 spec batcher (unrelated draft, so rejection/residual
+    carries real weight) must match the plain sampled batcher's — measured
+    with the same self-calibrated total-variation test as the standalone
+    loop (tests/runtime/test_speculative.py).  Also pins per-seed
+    determinism."""
+    n_seeds = 800
+    cfg = presets.get_preset("llama-tiny", vocab_size=16, num_layers=1,
+                             num_heads=2, num_kv_heads=2, hidden_size=16,
+                             intermediate_size=44)
+    params = model_lib.init_params(jax.random.key(0), cfg)
+    dparams = model_lib.init_params(jax.random.key(77), cfg)  # unrelated
+    prompt = [7, 1, 9]
+
+    def run_one(seed, spec):
+        b = ContinuousBatcher(
+            cfg, params, batch_slots=1, max_len=16, chunk_steps=2,
+            temperature=0.9, seed=seed,
+            **(dict(draft_params=dparams, draft_cfg=cfg, spec_k=2)
+               if spec else {}),
+        )
+        rid = b.submit(prompt, max_new_tokens=2)
+        out = b.run()[rid]
+        assert len(out) == 2
+        return tuple(out)
+
+    spec = [run_one(s, True) for s in range(n_seeds)]
+    plain_a = [run_one(s + 10_000, False) for s in range(n_seeds)]
+    plain_b = [run_one(s + 20_000, False) for s in range(n_seeds)]
+    assert run_one(5, True) == spec[5]  # per-seed determinism
+
+    def joint_hist(arr):
+        h = np.zeros((16, 16))
+        for a_, b_ in arr:
+            h[a_, b_] += 1
+        return h / len(arr)
+
+    hs, hp_a, hp_b = joint_hist(spec), joint_hist(plain_a), joint_hist(plain_b)
+    null_tv = 0.5 * np.abs(hp_a - hp_b).sum()
+    test_tv = 0.5 * np.abs(hs - hp_a).sum()
+    assert test_tv < 1.5 * null_tv + 0.04, (
+        f"TV {test_tv:.3f} vs same-distribution null {null_tv:.3f} — "
+        "sampled speculative batching diverges from the target distribution"
+    )
 
 
 def test_engine_spec_batcher_wiring():
@@ -171,6 +226,62 @@ def test_engine_spec_batcher_wiring():
     resp = plain.run()
     for a, c in zip(rids, rp):
         assert res[a] == resp[c]
+
+
+def test_sampled_spec_server_roundtrip(models):
+    """The HTTP gateway serves a SAMPLED speculative engine: requests with
+    temperature matching the engine config get 200 + logprobs; overrides
+    differing from it get a clean 400 (submit's engine-wide policy)."""
+    import asyncio
+    import json
+
+    from distributed_llms_tpu.runtime.server import InferenceServer
+    from distributed_llms_tpu.runtime.tokenizer import ByteTokenizer
+
+    cfg, params, dcfg, dparams = models
+    tok = ByteTokenizer()
+    b = ContinuousBatcher(
+        cfg, params, tokenizer=tok, eos_id=tok.eos_id, pad_id=tok.pad_id,
+        batch_slots=2, max_len=96, chunk_steps=4, temperature=0.8,
+        draft_params=dparams, draft_cfg=dcfg, spec_k=2,
+    )
+
+    async def post(host, port, body):
+        reader, writer = await asyncio.open_connection(host, port)
+        payload = json.dumps(body).encode()
+        writer.write(
+            f"POST /v1/completions HTTP/1.1\r\nHost: t\r\n"
+            f"Content-Length: {len(payload)}\r\n\r\n".encode() + payload
+        )
+        await writer.drain()
+        status = int((await reader.readline()).split()[1])
+        while (await reader.readline()) not in (b"\r\n", b"\n", b""):
+            pass
+        data = await reader.read()
+        writer.close()
+        return status, data
+
+    async def fn():
+        srv = InferenceServer(b, model_name="t", host="127.0.0.1", port=0)
+        host, port = await srv.start()
+        try:
+            st, data = await post(host, port, {
+                "prompt": "hi", "max_tokens": 6, "temperature": 0.8,
+                "logprobs": True,
+            })
+            assert st == 200, (st, data)
+            out = json.loads(data)
+            lp = out["choices"][0]["logprobs"]
+            assert len(lp["token_logprobs"]) == len(lp["tokens"]) > 0
+            assert all(v <= 1e-6 for v in lp["token_logprobs"])
+            st2, data2 = await post(host, port, {
+                "prompt": "hi", "max_tokens": 4, "temperature": 0.1,
+            })
+            assert st2 == 400 and b"engine-wide" in data2, (st2, data2)
+        finally:
+            await srv.stop()
+
+    asyncio.run(fn())
 
 
 def test_spec_streaming_matches_plain_stream(models):
