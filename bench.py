@@ -1447,7 +1447,11 @@ def run_ladder(args, degraded: str | None) -> list[dict]:
         try:
             row.update(fn())
             row["measured_on"] = _stamp()
-            if degraded is not None:
+            # local-proc-batching pins its workers to CPU BY DESIGN (its
+            # subject is the cluster path's own overhead) — a run-wide
+            # "accelerator-unavailable" marker would mislabel its native
+            # measurement as a fallback.
+            if degraded is not None and name != "local-proc-batching":
                 row["degraded"] = degraded
         except _RowSkip as skip:
             row.update({"preset": srv["preset"], "skipped": str(skip)})

@@ -41,6 +41,10 @@ def _result_cell(row: dict) -> str:
         ("speedup", "speedup"),
         ("flash_ms", "flash ms"), ("dot_ms", "dot ms"),
         ("p50_us", "p50 µs"), ("p95_us", "p95 µs"),
+        ("tok_per_s_end_to_end", "end-to-end tok/s"),
+        ("tok_per_s_in_engine", "in-engine tok/s"),
+        ("cluster_overhead_pct", "cluster overhead %"),
+        ("rtt_1tok_p50_ms", "1-tok RTT p50 ms"),
     ):
         if row.get(k) is not None:
             v = row[k]
