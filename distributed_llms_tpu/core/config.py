@@ -99,11 +99,13 @@ class ModelConfig:
     ragged_decode: bool = False
     # Sliding-window attention (Mistral): query at position p attends keys in
     # (p - window, p].  None = global causal.  Enforced via masks on the dot
-    # paths; the flash kernel falls back to dot (no windowed fast path yet),
-    # and the ragged/paged decode kernels + seq-parallel impls reject it
-    # (they read the full cache prefix by construction).  The KV cache keeps
-    # max_seq_len slots (no rolling buffer yet) — masking is what bounds the
-    # attention span, not cache size.
+    # paths; the flash and ragged-decode kernels carry the window natively
+    # (flash skips out-of-window tiles without DMAing them; ragged decode
+    # reads only each row's window span).  The paged decode kernel and the
+    # seq-parallel impls reject it (full-prefix / global-causal by
+    # construction).  The KV cache keeps max_seq_len slots (no rolling
+    # buffer yet) — masking is what bounds the attention span, not cache
+    # size.
     sliding_window: int | None = None
 
     def __post_init__(self):
@@ -144,13 +146,10 @@ class ModelConfig:
                     "sliding_window is not supported with ring/ulysses "
                     "sequence parallelism (global causal attention only)"
                 )
-            if self.ragged_decode:
-                # The ragged decode kernel reads the whole cache prefix
-                # [0, cache_index[b]] — it cannot honor a window lower bound.
-                raise ValueError(
-                    "sliding_window is incompatible with ragged_decode "
-                    "(the prefix-read kernel cannot mask the pre-window span)"
-                )
+            # ragged_decode composes: the kernel takes a window bound and
+            # reads only [length - window, length) per row — exact for the
+            # contract layout (slot == position), which is the same layout
+            # the ragged contract already demands.
     # MoE (expert parallelism); num_experts == 0 -> dense MLP.
     num_experts: int = 0
     num_experts_per_token: int = 2

@@ -154,16 +154,19 @@ def _attention(
         cfg.attn_impl == "flash"
         and attn_mask is None
         and layer_cache is None
-        and cfg.sliding_window is None  # no windowed fast path; dot masks it
     ):
         # Self-attention over the input block (training / no-cache eval).
+        # Sliding-window models ride the kernel's window band (positions
+        # space, layers.and_window semantics): out-of-window tiles are
+        # skipped without even a DMA, so windowed prefill work scales with
+        # the window instead of the sequence.
         from ..ops import flash
 
         out = flash.flash_attention(
             q, k, v,
             q_positions=None if std_layout else positions,
             k_positions=None if std_layout else positions,
-            causal=True,
+            causal=True, window=cfg.sliding_window,
         )
         return layers.out_project(out, p), None
 
@@ -215,13 +218,16 @@ def _attention(
                 # cache (lengths = cache_index + 1 includes the slot just
                 # written above).  cfg.ragged_decode is the caller's promise
                 # that attn_mask IS that prefix mask (core/config.py).
+                # Sliding-window models pass the window through: the kernel
+                # reads only [length - window, length) per row — exact
+                # because the ragged contract layout is slot == position.
                 from ..ops import decode_attn
 
                 # ck/cv go in at the CACHE's dtype — the kernel casts per
                 # block in VMEM, so a kv_dtype != compute dtype never costs
                 # a full-width HBM copy of the cache.
                 out = decode_attn.ragged_decode_attention(
-                    q, ck, cv, cache_index + 1,
+                    q, ck, cv, cache_index + 1, window=cfg.sliding_window,
                 )
                 return layers.out_project(out, p), (ck, cv)
         else:
@@ -231,17 +237,25 @@ def _attention(
             s = ck.shape[1]
             k_positions = jnp.broadcast_to(jnp.arange(s, dtype=jnp.int32), (x.shape[0], s))
             k_valid = k_positions < (cache_index + x.shape[1])
-            if cfg.attn_impl == "flash" and x.shape[1] > 1 and cfg.sliding_window is None:
+            if (cfg.attn_impl == "flash" and x.shape[1] > 1
+                    and (cfg.sliding_window is None or key_positions is None)):
                 # Prefill into a (longer, padded) cache: the flash kernel
                 # masks the unwritten tail instead of computing a dense
                 # [Tq, max_len] score matrix.  Single-token decode stays on
                 # the dense path (the kernel targets block-sized Tq).
+                # Windowed models ride the kernel's window band here too —
+                # the kernel's single k_positions vector drives causality
+                # AND the window, which is exact precisely when slot ==
+                # position for written slots (attn_mask is None and no
+                # key_positions map => the ungapped prefill layout); gapped
+                # layouts supply key_positions and take the dense window
+                # path below.
                 from ..ops import flash
 
                 out = flash.flash_attention(
                     q, ck.astype(q.dtype), cv.astype(q.dtype),
                     q_positions=positions, k_positions=k_positions,
-                    k_valid=k_valid, causal=True,
+                    k_valid=k_valid, causal=True, window=cfg.sliding_window,
                 )
                 return layers.out_project(out, p), (ck, cv)
             # Causality/validity compare SLOT indices (the write frontier);

@@ -24,7 +24,10 @@ attends to cache slots ``[0, lengths[b])`` (its valid prefix INCLUDING the
 slot its own token was just written to — lengths = cache_index + 1).
 ``models.model._attention`` routes here when ``cfg.ragged_decode`` is set
 (the ContinuousBatcher sets it; the flag is the caller's assertion that its
-mask is this prefix mask).
+mask is this prefix mask).  Sliding-window models pass ``window``: the
+read narrows to ``[lengths[b] - window, lengths[b])`` — exact because the
+contract layout is slot == position, so the slot band IS the position
+window — and per-step HBM traffic drops from O(length) to O(window).
 
 No reference counterpart: the reference's compute was a placeholder matmul
 (src/worker/node.py:24-32) with no KV cache at all.
@@ -62,10 +65,19 @@ def _kernel(
     num_k_blocks: int,
     kvh: int,
     gp: int,
+    window: int | None = None,  # row b reads [length - window, length)
+    #   instead of [0, length) — exact under the contract layout
+    #   (slot == position), where the query sits at position length - 1
 ):
     bi, ji = pl.program_id(0), pl.program_id(1)
     length = lengths_ref[bi]
     last_needed = jax.lax.div(jnp.maximum(length - 1, 0), block_k)
+    if window is None:
+        first_needed = 0
+    else:
+        first_needed = jax.lax.div(
+            jnp.maximum(length - window, 0), block_k
+        )
 
     @pl.when(ji == 0)
     def _init():
@@ -73,7 +85,7 @@ def _kernel(
         m_ref[...] = jnp.full_like(m_ref, _NEG_INF)
         l_ref[...] = jnp.zeros_like(l_ref)
 
-    @pl.when(ji <= last_needed)
+    @pl.when(jnp.logical_and(ji <= last_needed, ji >= first_needed))
     def _block():
         key_pos = ji * block_k + jax.lax.broadcasted_iota(
             jnp.int32, (gp, block_k), dimension=1
@@ -95,7 +107,12 @@ def _kernel(
                 )
                 * scale
             )  # [Gp, bk] f32
-            s = jnp.where(key_pos < length, s, _NEG_INF)
+            keep = key_pos < length
+            if window is not None:
+                # layers.and_window in slot space: keys in
+                # [length - window, length) == positions (p - window, p].
+                keep = jnp.logical_and(keep, key_pos >= length - window)
+            s = jnp.where(keep, s, _NEG_INF)
             m_prev = m_ref[r0:r1, 0]
             l_prev = l_ref[r0:r1, 0]
             m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1))
@@ -125,7 +142,7 @@ def _kernel_paged(lengths_ref, tables_ref, *rest, **kw):
     return _kernel(lengths_ref, *rest, **kw)
 
 
-def _dense_reference(q, k, v, lengths):
+def _dense_reference(q, k, v, lengths, window=None):
     """Masked dot-product prefix attention — the numerics the kernel must
     match and the fallback for untileable shapes / non-kernel modes.
     Mirrors layers.dot_product_attention exactly (f32 score accumulation,
@@ -138,7 +155,10 @@ def _dense_reference(q, k, v, lengths):
     g = h // k.shape[2]
     kf = layers.repeat_kv(k.astype(q.dtype), g)
     vf = layers.repeat_kv(v.astype(q.dtype), g)
-    mask = jnp.arange(s, dtype=jnp.int32)[None, :] < lengths[:, None]  # [B,S]
+    slots = jnp.arange(s, dtype=jnp.int32)
+    mask = slots[None, :] < lengths[:, None]  # [B, S]
+    if window is not None:
+        mask = jnp.logical_and(mask, slots[None, :] >= lengths[:, None] - window)
     return layers.dot_product_attention(q, kf, vf, mask[:, None, None, :])
 
 
@@ -157,6 +177,10 @@ def ragged_decode_attention(
     v: jax.Array,  # [B, S, KVH, D]
     lengths: jax.Array,  # [B] int32 — row b attends slots [0, lengths[b])
     block_k: int = 256,
+    window: int | None = None,  # sliding window: row b attends only
+    #   [lengths[b] - window, lengths[b]) — the index maps clamp the DMA
+    #   walk into that band, so windowed long-context decode reads
+    #   O(window) KV bytes per row instead of O(length)
 ) -> jax.Array:
     """Returns [B, 1, H, D] in q.dtype.  Inference-only (no VJP)."""
     mode = _mode()
@@ -178,7 +202,7 @@ def ragged_decode_attention(
     )
     tileable = bk is not None and d % 128 == 0
     if mode == "fallback" or not tileable:
-        return _dense_reference(q, k, v, lengths)
+        return _dense_reference(q, k, v, lengths, window)
 
     gp = _round_up(g, 8)  # sublane-pad the per-kv-head query group
     # [B, KVH, G, D]: head ordering h = kv*g + i matches repeat_kv /
@@ -194,12 +218,18 @@ def ragged_decode_attention(
 
     def kv_index(bi, ji, lengths_ref):
         last = jax.lax.div(jnp.maximum(lengths_ref[bi] - 1, 0), bk)
-        return (bi, jnp.minimum(ji, last), 0, 0)
+        kk = jnp.minimum(ji, last)
+        if window is not None:
+            first = jax.lax.div(
+                jnp.maximum(lengths_ref[bi] - window, 0), bk
+            )
+            kk = jnp.maximum(kk, first)
+        return (bi, kk, 0, 0)
 
     out = pl.pallas_call(
         functools.partial(
             _kernel, scale=d**-0.5, block_k=bk, num_k_blocks=nk,
-            kvh=kvh, gp=gp,
+            kvh=kvh, gp=gp, window=window,
         ),
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=1,

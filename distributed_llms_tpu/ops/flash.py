@@ -107,6 +107,7 @@ def _kernel_static(
     num_k_blocks: int,
     block_q: int,
     block_k: int,
+    window: int | None = None,  # sliding window: keys in (row - window, row]
 ):
     qi = pl.program_id(2)
     ki = pl.program_id(3)
@@ -119,25 +120,35 @@ def _kernel_static(
 
     q_start = qi * block_q
     k_start = ki * block_k
-    # Tile classes: fully visible (strictly below the diagonal band), diagonal
-    # (crosses row==col), dead (above the diagonal; index maps clamp its K/V
-    # fetch so it costs nothing).
+    # Tile classes: fully visible (every (row, col) pair inside the causal —
+    # and, when windowed, the window — band), boundary (crosses the diagonal
+    # or the window's lower edge: iota-masked), dead (fully outside; index
+    # maps clamp its K/V fetch so it costs no DMA and no MXU work).
     visible = k_start + block_k - 1 <= q_start
-    diagonal = jnp.logical_and(
-        k_start + block_k - 1 > q_start, k_start <= q_start + block_q - 1
-    )
+    dead = k_start > q_start + block_q - 1  # above the diagonal
+    if window is not None:
+        # Fully visible additionally needs every col > every row - window;
+        # fully below the window's lower edge is dead.
+        visible = jnp.logical_and(
+            visible, k_start > q_start + block_q - 1 - window
+        )
+        dead = jnp.logical_or(dead, k_start + block_k - 1 <= q_start - window)
+    boundary = jnp.logical_not(jnp.logical_or(visible, dead))
 
     @pl.when(visible)
     def _full():
         s = _scores(q_ref[0], k_ref[0], scale)
         _accumulate(s, v_ref[0], acc_ref, m_ref, l_ref)
 
-    @pl.when(diagonal)
-    def _diag():
+    @pl.when(boundary)
+    def _edge():
         s = _scores(q_ref[0], k_ref[0], scale)
         rows = q_start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
         cols = k_start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
-        s = jnp.where(cols <= rows, s, _NEG_INF)
+        keep = cols <= rows
+        if window is not None:
+            keep = jnp.logical_and(keep, cols > rows - window)
+        s = jnp.where(keep, s, _NEG_INF)
         _accumulate(s, v_ref[0], acc_ref, m_ref, l_ref)
 
     @pl.when(ki == num_k_blocks - 1)
@@ -164,6 +175,7 @@ def _kernel_dynamic(
     causal: bool,
     scale: float,
     num_k_blocks: int,
+    window: int | None = None,  # sliding window in POSITION space
 ):
     ki = pl.program_id(3)
 
@@ -179,6 +191,9 @@ def _kernel_dynamic(
     mask = (kv != 0)[None, :]  # [1, bk]
     if causal:
         mask = jnp.logical_and(mask, kp[None, :] <= qp[:, None])  # [bq, bk]
+    if window is not None:
+        # layers.and_window semantics: keys at positions (p - window, p].
+        mask = jnp.logical_and(mask, kp[None, :] > qp[:, None] - window)
     mask = jnp.broadcast_to(mask, (qp.shape[0], kp.shape[0]))
 
     @pl.when(jnp.any(mask))
@@ -205,8 +220,8 @@ def _pad_to(x: jax.Array, axis: int, mult: int, value) -> jax.Array:
     return jnp.pad(x, widths, constant_values=value)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(6, 7, 8, 9))
-def _flash(q, k, v, q_positions, k_positions, k_valid, causal, block_q, block_k, interpret):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(6, 7, 8, 9, 10))
+def _flash(q, k, v, q_positions, k_positions, k_valid, causal, block_q, block_k, interpret, window):
     # Inside shard_map (e.g. the Ulysses body) the inputs carry varying
     # manual axes (vma); the output must declare the same set.
     vma = frozenset().union(
@@ -217,7 +232,7 @@ def _flash(q, k, v, q_positions, k_positions, k_valid, causal, block_q, block_k,
         # internal dynamic_slices; run the numerically-identical dense
         # reference there.  Real TPU lowering takes the kernel.
         return _dense_reference(
-            q, k, v, q_positions, k_positions, k_valid, causal
+            q, k, v, q_positions, k_positions, k_valid, causal, window
         )
     b, tq, h, d = q.shape
     s = k.shape[1]
@@ -260,16 +275,23 @@ def _flash(q, k, v, q_positions, k_positions, k_valid, causal, block_q, block_k,
     )
 
     if static_causal:
-        # Clamp dead (above-diagonal) tiles' K/V fetches to the diagonal tile:
-        # repeated index => the pipeline issues no new DMA for skipped tiles.
+        # Clamp dead tiles' K/V fetches into the live band (above the
+        # diagonal, and — when windowed — below the window's lower edge):
+        # repeated index => the pipeline issues no new DMA for skipped
+        # tiles, so a windowed prefill's work scales with the window, not
+        # the sequence.
         def kv_index(bi, hi, qi, ki):
             last_needed = jax.lax.div(qi * bq + bq - 1, bk)
-            return (bi * kvh + hi // g, jnp.minimum(ki, last_needed), 0)
+            kk = jnp.minimum(ki, last_needed)
+            if window is not None:
+                first_col = jnp.maximum(qi * bq - (window - 1), 0)
+                kk = jnp.maximum(kk, jax.lax.div(first_col, bk))
+            return (bi * kvh + hi // g, kk, 0)
 
         out = pl.pallas_call(
             functools.partial(
                 _kernel_static, scale=scale, num_k_blocks=nk,
-                block_q=bq, block_k=bk,
+                block_q=bq, block_k=bk, window=window,
             ),
             grid=grid,
             in_specs=[
@@ -312,7 +334,8 @@ def _flash(q, k, v, q_positions, k_positions, k_valid, causal, block_q, block_k,
         kval = _pad_to(kval, 1, bk, 0)
         out = pl.pallas_call(
             functools.partial(
-                _kernel_dynamic, causal=causal, scale=scale, num_k_blocks=nk
+                _kernel_dynamic, causal=causal, scale=scale, num_k_blocks=nk,
+                window=window,
             ),
             grid=grid,
             in_specs=[
@@ -341,7 +364,8 @@ def _flash(q, k, v, q_positions, k_positions, k_valid, causal, block_q, block_k,
 # Autodiff: dense-recompute backward (flash-checkpoint style)
 # ---------------------------------------------------------------------------
 
-def _dense_reference(q, k, v, q_positions, k_positions, k_valid, causal):
+def _dense_reference(q, k, v, q_positions, k_positions, k_valid, causal,
+                     window=None):
     """Same math and masking semantics as the kernel, in plain XLA ops — the
     VJP target for the backward pass."""
     b, tq, h, d = q.shape
@@ -367,24 +391,30 @@ def _dense_reference(q, k, v, q_positions, k_positions, k_valid, causal):
     )
     if k_valid is not None:
         mask = jnp.logical_and(mask, k_valid[:, None, None, :])
+    if window is not None:
+        # layers.and_window semantics: keys at positions (p - window, p].
+        mask = jnp.logical_and(
+            mask, kp[:, None, None, :] > qp[:, None, :, None] - window
+        )
     logits = jnp.where(mask, logits, _NEG_INF)
     probs = jax.nn.softmax(logits, axis=-1)
     out = jnp.einsum("bhqk,bkhd->bqhd", probs.astype(v.dtype), v)
     return out.astype(q.dtype)
 
 
-def _flash_fwd(q, k, v, q_positions, k_positions, k_valid, causal, block_q, block_k, interpret):
+def _flash_fwd(q, k, v, q_positions, k_positions, k_valid, causal, block_q, block_k, interpret, window):
     out = _flash(
-        q, k, v, q_positions, k_positions, k_valid, causal, block_q, block_k, interpret
+        q, k, v, q_positions, k_positions, k_valid, causal, block_q, block_k,
+        interpret, window,
     )
     return out, (q, k, v, q_positions, k_positions, k_valid)
 
 
-def _flash_bwd(causal, block_q, block_k, interpret, res, g):
+def _flash_bwd(causal, block_q, block_k, interpret, window, res, g):
     q, k, v, q_positions, k_positions, k_valid = res
     _, vjp = jax.vjp(
         lambda q_, k_, v_: _dense_reference(
-            q_, k_, v_, q_positions, k_positions, k_valid, causal
+            q_, k_, v_, q_positions, k_positions, k_valid, causal, window
         ),
         q, k, v,
     )
@@ -398,7 +428,7 @@ _flash.defvjp(_flash_fwd, _flash_bwd)
 
 @functools.partial(
     jax.jit,
-    static_argnames=("causal", "block_q", "block_k", "interpret"),
+    static_argnames=("causal", "block_q", "block_k", "interpret", "window"),
 )
 def flash_attention(
     q: jax.Array,  # [B, Tq, H, D]
@@ -411,13 +441,23 @@ def flash_attention(
     block_q: int = 1024,
     block_k: int = 1024,
     interpret: bool | None = None,
+    window: int | None = None,  # sliding window (layers.and_window
+    #   semantics: keys at positions (p - window, p]); static.  The
+    #   static-causal path skips — and never DMAs — tiles fully outside
+    #   the window band, so windowed prefill work scales with the window.
 ) -> jax.Array:
     """Fused attention.  Matches ``layers.dot_product_attention`` with mask
-    ``(k_pos <= q_pos if causal) & k_valid`` but never materializes the
-    [Tq, S] score matrix in the forward.  Differentiable (dense-recompute
-    backward).  Returns [B, Tq, H, D] in q.dtype."""
+    ``(k_pos <= q_pos if causal) & k_valid [& window band]`` but never
+    materializes the [Tq, S] score matrix in the forward.  Differentiable
+    (dense-recompute backward).  Returns [B, Tq, H, D] in q.dtype."""
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
+    if window is not None:
+        if not causal:
+            raise ValueError("window requires causal attention")
+        if window < 1:
+            raise ValueError(f"window must be >= 1, got {window}")
     return _flash(
-        q, k, v, q_positions, k_positions, k_valid, causal, block_q, block_k, interpret
+        q, k, v, q_positions, k_positions, k_valid, causal, block_q, block_k,
+        interpret, window,
     )

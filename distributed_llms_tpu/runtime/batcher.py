@@ -939,13 +939,13 @@ class ContinuousBatcher:
 
         from ..ops import decode_attn
 
-        # (Sliding-window models keep the masked dense path: the ragged
-        # kernel reads the full prefix and cannot honor the window — the
-        # window is AND-ed into the batcher's masks by models._attention.)
+        # (Sliding-window models ride the ragged kernel too: it takes the
+        # window bound and reads only [length - window, length) per row —
+        # slot == position in this contiguous layout, so the slot-space
+        # band equals the position-space window exactly.)
         self.cfg_decode = (
             dataclasses.replace(cfg, ragged_decode=True)
             if parallel is None and decode_attn._mode() != "fallback"
-            and cfg.sliding_window is None
             else cfg
         )
         self.params = params

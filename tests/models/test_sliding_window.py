@@ -85,9 +85,14 @@ def test_kv_cache_matches_full_forward_windowed():
                                    np.asarray(step[:, 0]), rtol=1e-3, atol=1e-3)
 
 
-def test_flash_impl_falls_back_to_windowed_dot():
-    """attn_impl='flash' on a windowed model must take the masked dot path
-    (the flash kernel has no windowed fast path) and match it exactly."""
+@pytest.mark.fragile_xla_cpu
+def test_flash_impl_matches_windowed_dot():
+    """attn_impl='flash' on a windowed model rides the kernel's window
+    band (ops/flash.py window=) for no-cache forwards AND cached prefill,
+    matching the masked dot path exactly; the windowed generate loop stays
+    token-identical too (decode steps keep the dense path)."""
+    from distributed_llms_tpu.runtime import generate as gen_lib
+
     cfg = _windowed_tiny(window=3)
     cfg_flash = dataclasses.replace(cfg, attn_impl="flash")
     params = model.init_params(jax.random.key(0), cfg)
@@ -97,6 +102,17 @@ def test_flash_impl_falls_back_to_windowed_dot():
     b, _ = model.forward(params, cfg_flash, toks)
     np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5,
                                atol=1e-5)
+    # Ragged generate: windowed flash prefill into the padded cache must
+    # emit the same tokens as the dot path (window crossed mid-decode).
+    prompt = jnp.asarray([[7, 1, 9, 0, 0, 0], [4] * 6], jnp.int32)
+    lens = jnp.asarray([3, 6], jnp.int32)
+    ref = gen_lib.generate_tokens(
+        params, cfg, prompt, lens, jax.random.key(2), max_new_tokens=8,
+    )
+    out = gen_lib.generate_tokens(
+        params, cfg_flash, prompt, lens, jax.random.key(2), max_new_tokens=8,
+    )
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
 
 
 def test_golden_parity_vs_transformers_mistral():
@@ -142,8 +158,11 @@ def test_config_from_hf_mistral_window_mapping():
 def test_invalid_window_combos_rejected():
     with pytest.raises(ValueError, match="ring"):
         presets.get_preset("llama-tiny", sliding_window=4, attn_impl="ring")
-    with pytest.raises(ValueError, match="ragged"):
-        presets.get_preset("llama-tiny", sliding_window=4, ragged_decode=True)
+    # ragged_decode + window COMPOSES since the kernel carries the window
+    # band (ops/decode_attn.py) — only seq-parallel impls still reject.
+    cfg = presets.get_preset("llama-tiny", sliding_window=4,
+                             ragged_decode=True)
+    assert cfg.sliding_window == 4 and cfg.ragged_decode
     with pytest.raises(ValueError, match="sliding_window must be"):
         ModelConfig(family="llama", sliding_window=0)
 
@@ -157,7 +176,9 @@ def test_batcher_serves_windowed_model_exactly():
     cfg = presets.get_preset("llama-tiny", vocab_size=512, sliding_window=5)
     params = model.init_params(jax.random.key(0), cfg)
     b = ContinuousBatcher(cfg, params, batch_slots=2, max_len=64, chunk_steps=4)
-    assert b.cfg_decode.ragged_decode is False  # prefix kernel refused
+    # Off-TPU default is the dense fallback; under kernel/interpret modes
+    # windowed models now ride the ragged kernel's window band (exactness
+    # under interpret is pinned by tests/ops/test_decode_attn.py).
     reqs = [([7, 1, 9, 4, 2, 8, 3], 8), ([4, 4, 4], 6), ([11, 12], 10)]
     rids = [b.submit(ids, max_new_tokens=n) for ids, n in reqs]
     res = b.run()
@@ -196,12 +217,7 @@ def test_ragged_batch_windowed_decode_matches_solo():
         np.testing.assert_array_equal(batch[i], solo[0])
 
 
-@pytest.mark.skipif(
-    __import__("os").environ.get("DLT_RUN_ISOLATED") != "1",
-    reason="speculative while_loop compiles segfault XLA:CPU in long-lived "
-           "processes; exercised by tests/runtime/test_isolated.py in a "
-           "fresh process (see test_speculative.py fragile_xla_cpu)",
-)
+@pytest.mark.fragile_xla_cpu
 def test_ragged_windowed_speculative_matches_generate():
     """Same regression through the speculative loop (shares the layout)."""
     from distributed_llms_tpu.runtime import generate as gen_lib
@@ -249,19 +265,9 @@ def test_windowed_ragged_session_matches_solo():
         solo.end_session(ssid)
 
 
-# The two mesh-decode tests below compile big pipelined/GSPMD programs;
-# XLA:CPU's crash budget in a long-lived suite process is cumulative
-# (tests/runtime/test_isolated.py docstring), so they run there in a
-# fresh subprocess instead of the main process.
-_fragile_xla_cpu = pytest.mark.skipif(
-    __import__("os").environ.get("DLT_RUN_ISOLATED") != "1",
-    reason="compile-heavy mesh decode; runs fresh-process via "
-           "tests/runtime/test_isolated.py (XLA:CPU long-lived-process "
-           "compile fragility)",
-)
-
-
-@_fragile_xla_cpu
+# The two mesh-decode tests below compile big pipelined/GSPMD programs —
+# fresh-process via tests/runtime/test_isolated.py (shared marker).
+@pytest.mark.fragile_xla_cpu
 def test_mesh_windowed_decode_matches_single_device():
     """Mesh decode of sliding-window models threads key_positions through
     the adapters (parallel/api.py), so a ragged batch on a dp x tp mesh
@@ -302,7 +308,7 @@ def test_mesh_windowed_decode_matches_single_device():
     assert jnp.isfinite(loss)
 
 
-@_fragile_xla_cpu
+@pytest.mark.fragile_xla_cpu
 def test_pipelined_windowed_decode_matches_single_device():
     """The pipelined paths derive the slot->position map too: per-token
     schedule (pipeline_blocks) and the fused wavefront (pipeline_decode)

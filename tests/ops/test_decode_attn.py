@@ -42,6 +42,36 @@ def test_kernel_matches_dense_reference(monkeypatch, b, s, h, kvh, d, lengths):
     )
 
 
+# Round-5 windowed tests run fresh-process via test_isolated.py (shared
+# marker — tests/conftest.py).
+@pytest.mark.fragile_xla_cpu
+@pytest.mark.parametrize(
+    "b,s,h,kvh,d,lengths,window",
+    [
+        (4, 256, 8, 8, 128, [1, 100, 256, 17], 5),    # tiny window, mixed
+        (2, 512, 8, 2, 128, [512, 300], 256),         # window == block size
+        (1, 1024, 16, 8, 128, [769], 130),            # band crosses blocks
+        (2, 256, 4, 4, 128, [200, 9], 1024),          # window > depth: no-op
+        (2, 384, 4, 4, 128, [384, 130], 3),           # window inside one blk
+    ],
+)
+def test_windowed_kernel_matches_dense(monkeypatch, b, s, h, kvh, d,
+                                       lengths, window):
+    """Sliding-window band: the kernel reads only [length - window,
+    length) per row (first/last block clamps + in-block mask) and must
+    match the dense windowed reference bit-for-tolerance."""
+    monkeypatch.setenv("DLT_RAGGED_DECODE", "interpret")
+    q = _rand(0, (b, 1, h, d))
+    k = _rand(1, (b, s, kvh, d))
+    v = _rand(2, (b, s, kvh, d))
+    ln = jnp.asarray(lengths, jnp.int32)
+    got = decode_attn.ragged_decode_attention(q, k, v, ln, window=window)
+    want = decode_attn._dense_reference(q, k, v, ln, window=window)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), rtol=2e-5, atol=2e-5
+    )
+
+
 def test_block_stepping_keeps_kernel_at_384(monkeypatch):
     """Cache width 384 (a 128-multiple but not a 256-multiple) must step the
     K block down to 128 and stay on the kernel — not silently serve the
@@ -162,6 +192,45 @@ def test_batcher_exact_tokens_with_ragged_decode(monkeypatch):
     b = ContinuousBatcher(cfg, params, batch_slots=2, max_len=128, chunk_steps=4)
     assert b.cfg_decode.ragged_decode
     reqs = [([7, 1, 9], 6), ([4, 4, 4, 4, 4], 9), ([11, 12], 3)]
+    rids = [b.submit(ids, max_new_tokens=n) for ids, n in reqs]
+    res = b.run()
+    assert calls, "ragged decode attention did not run"
+    for rid, (ids, n) in zip(rids, reqs):
+        solo = gen_lib.generate_tokens(
+            params, cfg, jnp.asarray([ids], jnp.int32),
+            jnp.asarray([len(ids)], jnp.int32), jax.random.key(9),
+            max_new_tokens=n,
+        )
+        assert res[rid] == np.asarray(solo)[0].tolist(), f"req {rid} diverged"
+
+
+@pytest.mark.fragile_xla_cpu
+def test_batcher_windowed_ragged_matches_solo(monkeypatch):
+    """Sliding-window model through the batcher's ragged kernel path
+    (interpret): mixed budgets crossing the window boundary must match the
+    solo dense-windowed decode token-for-token — the kernel's slot-space
+    band equals the dense path's position-space window exactly under the
+    contiguous layout."""
+    from distributed_llms_tpu.models import model as model_lib, presets
+    from distributed_llms_tpu.runtime import generate as gen_lib
+    from distributed_llms_tpu.runtime.batcher import ContinuousBatcher
+
+    monkeypatch.setenv("DLT_RAGGED_DECODE", "interpret")
+    calls = []
+    orig = decode_attn.pl.pallas_call
+    monkeypatch.setattr(
+        decode_attn.pl, "pallas_call",
+        lambda *a, **kw: calls.append(1) or orig(*a, **kw),
+    )
+    cfg = presets.get_preset(
+        "llama-tiny", vocab_size=512, hidden_size=256, num_heads=2,
+        num_kv_heads=2, sliding_window=5,  # head_dim 128 — kernel-tileable
+    )
+    params = model_lib.init_params(jax.random.key(0), cfg)
+    b = ContinuousBatcher(cfg, params, batch_slots=2, max_len=128,
+                          chunk_steps=4)
+    assert b.cfg_decode.ragged_decode and b.cfg_decode.sliding_window == 5
+    reqs = [([7, 1, 9, 4, 2, 8, 3], 9), ([4, 4, 4], 7), ([11, 12], 12)]
     rids = [b.submit(ids, max_new_tokens=n) for ids, n in reqs]
     res = b.run()
     assert calls, "ragged decode attention did not run"

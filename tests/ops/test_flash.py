@@ -99,6 +99,76 @@ def test_model_forward_flash_matches_dot(family):
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-4)
 
 
+# Round-5 windowed-kernel tests: compile-heavy, so they run fresh-process
+# via tests/runtime/test_isolated.py (shared marker — tests/conftest.py).
+@pytest.mark.fragile_xla_cpu
+@pytest.mark.parametrize("window", [1, 3, 37, 200])
+def test_windowed_static_matches_dense(window):
+    """Static-causal path with a sliding window: every tile class (fully
+    visible, boundary on the diagonal, boundary on the window's lower
+    edge, dead above, dead below) vs the dense windowed mask.  t=200 with
+    16/128 tiles crosses all of them; window >= t degenerates to plain
+    causal."""
+    q, k, v = _qkv(t=200, seed=7)
+    b, t = q.shape[:2]
+    pos = jnp.broadcast_to(jnp.arange(t, dtype=jnp.int32), (b, t))
+    ref = _dense(q, k, v, layers.causal_mask(pos, pos, window=window))
+    out = flash_attention(q, k, v, block_q=16, block_k=128, window=window)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+
+@pytest.mark.fragile_xla_cpu
+def test_windowed_dynamic_matches_dense():
+    """Dynamic path (explicit positions + validity) with a window: padded
+    cache prefill where only the first T slots are valid."""
+    t, s, window = 23, 64, 5
+    q, k, v = _qkv(t=t, s=s, seed=8)
+    b = q.shape[0]
+    pos = jnp.broadcast_to(jnp.arange(t, dtype=jnp.int32), (b, t))
+    kpos = jnp.broadcast_to(jnp.arange(s, dtype=jnp.int32), (b, s))
+    k_valid = kpos < t
+    ref = _dense(q, k, v, layers.causal_mask(pos, kpos, k_valid,
+                                             window=window))
+    out = flash_attention(
+        q, k, v, q_positions=pos, k_positions=kpos, k_valid=k_valid,
+        block_q=16, block_k=128, window=window,
+    )
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+
+def test_windowed_validation():
+    q, k, v = _qkv(seed=9)
+    with pytest.raises(ValueError, match="causal"):
+        flash_attention(q, k, v, causal=False, window=3)
+    with pytest.raises(ValueError, match="window"):
+        flash_attention(q, k, v, window=0)
+
+
+@pytest.mark.fragile_xla_cpu
+def test_windowed_grad_matches_dot():
+    """Gradients through the windowed flash forward (dense-recompute
+    backward must carry the window) vs the windowed dot path."""
+    import dataclasses
+
+    cfg = ModelConfig(
+        family="llama", vocab_size=64, hidden_size=32, intermediate_size=64,
+        num_layers=2, num_heads=4, num_kv_heads=2, max_seq_len=32,
+        dtype="float32", attn_impl="flash", sliding_window=3,
+    )
+    cfg_dot = dataclasses.replace(cfg, attn_impl="dot")
+    params = model_lib.init_params(jax.random.key(0), cfg)
+    toks = jax.random.randint(jax.random.key(1), (2, 9), 0, 64, dtype=jnp.int32)
+
+    def loss(p, c):
+        lg, _ = model_lib.forward(p, c, toks)
+        return jnp.mean(lg**2)
+
+    g1 = jax.grad(lambda p: loss(p, cfg))(params)
+    g2 = jax.grad(lambda p: loss(p, cfg_dot))(params)
+    for a, b in zip(jax.tree.leaves(g1), jax.tree.leaves(g2)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
+
+
 def test_grad_through_flash_matches_dot():
     import dataclasses
 

@@ -12,6 +12,7 @@ Run via tools/tpu_runbook.sh; standalone: `python tools/kernel_parity.py`.
 """
 from __future__ import annotations
 
+import functools
 import os
 import sys
 
@@ -79,6 +80,19 @@ def flash_parity() -> None:
         want = _dense_reference(q, kk, v, None, None, None, True)
         check(f"flash causal B{b} T{t} S{s} H{h}/{kvh}", got, want,
               rtol=3e-2, atol=3e-2)
+    # Sliding-window band (Mistral/Phi-3 prefill): dead-tile clamping +
+    # boundary iota masks on both edges must survive Mosaic lowering.
+    ks = jax.random.split(jax.random.fold_in(key, 9), 3)
+    b, t, h, kvh, d, win = 1, 2048, 8, 4, 128, 512
+    q = jax.random.normal(ks[0], (b, t, h, d), jnp.bfloat16)
+    kk = jax.random.normal(ks[1], (b, t, kvh, d), jnp.bfloat16)
+    v = jax.random.normal(ks[2], (b, t, kvh, d), jnp.bfloat16)
+    got = jax.jit(
+        lambda q, k, v: flash_attention(q, k, v, causal=True, window=win,
+                                        interpret=not ON_TPU)
+    )(q, kk, v)
+    want = _dense_reference(q, kk, v, None, None, None, True, win)
+    check(f"flash windowed T{t} win{win}", got, want, rtol=3e-2, atol=3e-2)
 
 
 def paged_parity() -> None:
@@ -122,6 +136,18 @@ def ragged_parity() -> None:
         want = decode_attn._dense_reference(q, kk, v, ln)
         check(f"ragged decode B{b} S{s} H{h}/{kvh}", got, want,
               rtol=3e-2, atol=3e-2)
+    # Sliding-window band: first/last block clamps + in-block mask.
+    ks = jax.random.split(jax.random.fold_in(key, 11), 3)
+    b, s, h, kvh, d, win = 2, 2048, 8, 4, 128, 300
+    q = jax.random.normal(ks[0], (b, 1, h, d), jnp.bfloat16)
+    kk = jax.random.normal(ks[1], (b, s, kvh, d), jnp.bfloat16)
+    v = jax.random.normal(ks[2], (b, s, kvh, d), jnp.bfloat16)
+    ln = jnp.asarray([1900, 64], jnp.int32)
+    got = jax.jit(functools.partial(
+        decode_attn.ragged_decode_attention, window=win
+    ))(q, kk, v, ln)
+    want = decode_attn._dense_reference(q, kk, v, ln, window=win)
+    check(f"ragged windowed S{s} win{win}", got, want, rtol=3e-2, atol=3e-2)
 
 
 def main() -> int:
@@ -135,7 +161,10 @@ def main() -> int:
     ragged_parity()
     paged_parity()
     mode = "compiled" if ON_TPU else "interpret"
-    print(f"kernel_parity: ALL PASS ({mode}, backend={backend})")
+    # v2: round 5 added the windowed-flash leg — versioning the marker
+    # makes tools/tpu_runbook.sh re-run the sweep on the next TPU window
+    # instead of skipping on a pre-window PARITY_TPU.log.
+    print(f"kernel_parity: ALL PASS v2 ({mode}, backend={backend})")
     return 0
 
 
