@@ -66,6 +66,28 @@ def pytest_pyfunc_call(pyfuncitem):
     return None
 
 
+def pytest_collection_modifyitems(config, items):
+    """``@pytest.mark.fragile_xla_cpu`` — the SINGLE definition of the
+    fresh-process isolation mechanism: XLA:CPU segfaults
+    nondeterministically in backend_compile_and_load once a long-lived
+    process accumulates ~300 tests of compile history (the crash follows
+    whatever compiles LAST, not a specific program — see
+    tests/runtime/test_isolated.py).  Marked tests skip in the main
+    process and run inside test_isolated.py's fresh subprocess
+    (DLT_RUN_ISOLATED=1).  Tests carrying the marker must also be listed
+    in test_isolated.ISOLATED or they silently lose coverage."""
+    if os.environ.get("DLT_RUN_ISOLATED") == "1":
+        return
+    skip = pytest.mark.skip(
+        reason="compile-heavy/fragile on the long-lived XLA:CPU suite "
+               "process; exercised fresh-process by "
+               "tests/runtime/test_isolated.py"
+    )
+    for item in items:
+        if "fragile_xla_cpu" in item.keywords:
+            item.add_marker(skip)
+
+
 @pytest.fixture(scope="session")
 def devices8():
     devs = jax.devices()

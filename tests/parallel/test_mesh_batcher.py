@@ -96,12 +96,7 @@ def test_mesh_batcher_prefix_caching(tiny, devices8):
     assert res[rid] == solo(cfg, params, prefix + suffix, 8)
 
 
-@pytest.mark.skipif(
-    __import__("os").environ.get("DLT_RUN_ISOLATED") != "1",
-    reason="compile-heavy penalized mesh decode; runs fresh-process via "
-           "tests/runtime/test_isolated.py (XLA:CPU long-lived-process "
-           "compile fragility)",
-)
+@pytest.mark.fragile_xla_cpu  # shared marker — tests/conftest.py
 def test_mesh_batcher_penalties_match_single_device(tiny, devices8):
     """Per-request presence/frequency penalties on a dp x tp mesh: the
     [B, V] output histogram rides decode_chunk replicated (scheduling

@@ -161,18 +161,9 @@ def test_logprobs_aligned_deterministic_and_streamed(tiny):
 
 
 # The two speculative tests below compile spec_chunk programs (plain and
-# penalized); the suite's XLA:CPU crash budget is cumulative, so they run
-# fresh-process via tests/runtime/test_isolated.py like the rest of the
-# speculative family.
-_fragile_xla_cpu = pytest.mark.skipif(
-    __import__("os").environ.get("DLT_RUN_ISOLATED") != "1",
-    reason="compile-heavy speculative rounds; runs fresh-process via "
-           "tests/runtime/test_isolated.py (XLA:CPU long-lived-process "
-           "compile fragility)",
-)
-
-
-@_fragile_xla_cpu
+# penalized) — fresh-process via tests/runtime/test_isolated.py (shared
+# marker, tests/conftest.py).
+@pytest.mark.fragile_xla_cpu
 def test_speculative_logprobs_match_plain(tiny):
     """Speculative mode gathers chosen-token logprobs from the verify
     pass's logits; at temperature 0 they must match the plain batcher's
@@ -242,7 +233,7 @@ def test_penalty_validation(tiny):
         b.submit([1, 2], max_new_tokens=4, frequency_penalty=float("nan"))
 
 
-@_fragile_xla_cpu
+@pytest.mark.fragile_xla_cpu
 def test_speculative_penalties_match_plain(tiny):
     """Penalized speculative batching is bit-exact vs the penalized plain
     batcher: verify position j's penalty histogram (base + drafts 1..j)

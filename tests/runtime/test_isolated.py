@@ -49,6 +49,14 @@ ISOLATED = [
     "test_speculative_penalties_match_plain",
     "tests/parallel/test_mesh_batcher.py::"
     "test_mesh_batcher_penalties_match_single_device",
+    # Round-5 windowed-kernel additions (flash window band + windowed
+    # ragged decode): each parametrization compiles fresh programs.
+    "tests/ops/test_flash.py::test_windowed_static_matches_dense",
+    "tests/ops/test_flash.py::test_windowed_dynamic_matches_dense",
+    "tests/ops/test_flash.py::test_windowed_grad_matches_dot",
+    "tests/ops/test_decode_attn.py::test_windowed_kernel_matches_dense",
+    "tests/ops/test_decode_attn.py::test_batcher_windowed_ragged_matches_solo",
+    "tests/models/test_sliding_window.py::test_flash_impl_matches_windowed_dot",
 ]
 
 

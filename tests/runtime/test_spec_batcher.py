@@ -8,8 +8,6 @@ prefix caching (draft prefills the full prompt), and the draft backfill
 after fully accepted rounds (self-draft).
 """
 
-import os
-
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -18,13 +16,8 @@ import pytest
 from distributed_llms_tpu.models import model as model_lib, presets
 from distributed_llms_tpu.runtime.batcher import ContinuousBatcher
 
-# Whole-family fresh-process isolation — see test_speculative.py's
-# fragile_xla_cpu note and tests/runtime/test_isolated.py.
-pytestmark = pytest.mark.skipif(
-    os.environ.get("DLT_RUN_ISOLATED") != "1",
-    reason="speculative while_loop compiles segfault XLA:CPU in long-lived "
-           "processes; exercised by test_isolated.py in a fresh process",
-)
+# Whole-family fresh-process isolation — shared marker, tests/conftest.py.
+pytestmark = pytest.mark.fragile_xla_cpu
 
 
 @pytest.fixture(scope="module")

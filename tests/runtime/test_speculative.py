@@ -8,8 +8,6 @@ path hard; draft == target exercises full acceptance (a == k every round).
 """
 
 import dataclasses
-import os
-
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -29,12 +27,7 @@ from distributed_llms_tpu.runtime.speculative import speculative_generate_tokens
 # test family therefore runs in a FRESH subprocess via test_isolated.py
 # and is skipped in the main process.  This is an XLA:CPU compiler
 # robustness issue, not a product bug: TPU uses a different compiler.
-fragile_xla_cpu = pytest.mark.skipif(
-    os.environ.get("DLT_RUN_ISOLATED") != "1",
-    reason="speculative while_loop compiles segfault XLA:CPU in long-lived "
-           "processes; exercised by test_isolated.py in a fresh process",
-)
-pytestmark = fragile_xla_cpu
+pytestmark = pytest.mark.fragile_xla_cpu  # shared marker: tests/conftest.py
 
 
 @pytest.fixture(scope="module")

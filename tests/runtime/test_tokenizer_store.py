@@ -28,16 +28,10 @@ VOCAB = {"<unk>": 0, "<eos>": 1, "hello": 2, "world": 3, "foo": 4, "bar": 5}
 
 # The cluster tests below compile engine programs from a worker thread at
 # the very TAIL of the suite (~300 tests of compile history in one
-# process) — the same XLA:CPU long-lived-process fragility the speculative
-# family documents (tests/runtime/test_speculative.py:22-36): 2/2 full-
-# suite runs on 2026-07-31 segfaulted in backend_compile_and_load inside
-# generate_text here, while every fresh-process run passes.  Same remedy:
-# skip in the main process, run via test_isolated.py in a fresh one.
-fragile_xla_cpu = pytest.mark.skipif(
-    os.environ.get("DLT_RUN_ISOLATED") != "1",
-    reason="cluster engine compiles segfault XLA:CPU late in a long-lived "
-           "suite process; exercised by test_isolated.py in a fresh process",
-)
+# process): 2/2 full-suite runs on 2026-07-31 segfaulted in
+# backend_compile_and_load inside generate_text here, while every
+# fresh-process run passes.  Shared marker — tests/conftest.py.
+fragile_xla_cpu = pytest.mark.fragile_xla_cpu
 
 
 def make_hf_tokenizer_dir(path: str) -> str:
