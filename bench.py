@@ -694,13 +694,15 @@ def _measure_spec_batching(
 def _measure_ragged_decode(
     preset: str = "tinyllama-1.1b", dtype: str = "bfloat16",
     max_len: int = 8192, slots: int = 8, iters: int = 5,
+    window: int | None = None,
 ) -> dict:
     """Long-context decode-chunk latency: dense full-width attention vs the
     ragged decode kernel (ops/decode_attn.py) on a batch whose rows sit at
     very different cache depths — the continuous-batcher traffic shape.  The
     dense path reads all B*S KV slots per step; the ragged kernel reads only
-    sum(lengths).  Real kernels only (TPU) — interpret mode would time the
-    emulator."""
+    sum(lengths) — or, with ``window`` (Mistral-style sliding window), only
+    sum(min(length, window)) per step.  Real kernels only (TPU) — interpret
+    mode would time the emulator."""
     import dataclasses
     import os
 
@@ -714,7 +716,8 @@ def _measure_ragged_decode(
     # table — positions past the trained range are numerically fine for a
     # throughput measurement); without this the tinyllama preset's 2048 cap
     # would silently shrink the "8k" row to a 2k measurement.
-    cfg = get_preset(preset, dtype=dtype, max_seq_len=max_len)
+    cfg = get_preset(preset, dtype=dtype, max_seq_len=max_len,
+                     sliding_window=window)
     params = model_lib.init_params(jax.random.key(0), cfg)
     rng = np.random.RandomState(0)
     # Mixed depths: a few deep rows, mostly shallow — mean fill ~35%.
@@ -760,6 +763,7 @@ def _measure_ragged_decode(
         "preset": preset,
         "max_len": max_len,
         "slots": slots,
+        **({"window": window} if window is not None else {}),
         "mean_fill": round(float(lens.mean()) / max_len, 3),
         "platform": jax.devices()[0].platform,
         "dense_chunk_ms": round(t_dense * 1e3, 1),
@@ -1046,12 +1050,15 @@ def _measure_local_proc_batching(
 
 def _measure_prefill_flash(
     preset: str = "tinyllama-1.1b", batch: int = 2, seq: int = 2048,
-    dtype: str = "bfloat16", iters: int = 5,
+    dtype: str = "bfloat16", iters: int = 5, window: int | None = None,
 ) -> dict:
     """Prefill (full-forward) throughput, dot vs Pallas flash attention, on
     the real device — puts ops/flash.py on the record (it otherwise runs only
     in CPU interpret mode in tests) and checks numerics on-device once.
-    VERDICT r2 weak item 4 / round-1 weak item 7."""
+    ``window``: sliding-window variant (Mistral-style) — the kernel skips
+    out-of-window tiles without DMAing them, while the dot path pays the
+    full dense masked matmul; the speedup at seq >> window is the row's
+    subject.  VERDICT r2 weak item 4 / round-1 weak item 7."""
     import dataclasses
 
     import numpy as np
@@ -1060,7 +1067,8 @@ def _measure_prefill_flash(
     from distributed_llms_tpu.models.presets import get_preset
 
     cfg_dot = get_preset(preset, dtype=dtype)
-    cfg_dot = dataclasses.replace(cfg_dot, attn_impl="dot")
+    cfg_dot = dataclasses.replace(cfg_dot, attn_impl="dot",
+                                  sliding_window=window)
     cfg_flash = dataclasses.replace(cfg_dot, attn_impl="flash")
     params = model_lib.init_params(jax.random.key(0), cfg_dot)
     tokens = jax.random.randint(
@@ -1086,6 +1094,7 @@ def _measure_prefill_flash(
     )
     return {
         "preset": preset, "batch": batch, "seq": seq,
+        **({"window": window} if window is not None else {}),
         "platform": jax.devices()[0].platform,
         "prefill_tok_per_s_dot": round(batch * seq / t_dot, 1),
         "prefill_tok_per_s_flash": round(batch * seq / t_flash, 1),
@@ -1293,9 +1302,11 @@ def run_ladder(args, degraded: str | None) -> list[dict]:
     if only is not None:
         known = {str(e["config"]) for e in LADDER} | {
             "serving-latency", "continuous-batching", "paged-batching",
-            "ragged-decode-8k", "quant-matmul-bw", "prefill-flash-2048",
-            "prefill-flash-8192", "hop-latency", "spec-decode",
-            "spec-decode-7b-int8", "spec-batching", "local-proc-batching",
+            "ragged-decode-8k", "ragged-decode-win-8k", "quant-matmul-bw",
+            "prefill-flash-2048", "prefill-flash-8192",
+            "prefill-flash-win-8192", "hop-latency",
+            "spec-decode", "spec-decode-7b-int8", "spec-batching",
+            "local-proc-batching",
         }
         unknown = only - known
         if unknown:  # a typo must not masquerade as a clean zero-row run
@@ -1417,6 +1428,10 @@ def run_ladder(args, degraded: str | None) -> list[dict]:
         aux += [
             ("paged-batching", lambda: _measure_paged_batching(dtype=dtype)),
             ("ragged-decode-8k", lambda: _measure_ragged_decode(dtype=dtype)),
+            # Windowed variant: the kernel reads only each row's window
+            # span — the long-context decode win for Mistral-style models.
+            ("ragged-decode-win-8k", lambda: _measure_ragged_decode(
+                dtype=dtype, window=1024)),
             ("quant-matmul-bw", lambda: _measure_quant_matmul_bw(
                 iters=max(args.iters, 5))),
             # Speculative decoding (runtime/speculative.py): small-model
@@ -1439,6 +1454,14 @@ def run_ladder(args, degraded: str | None) -> list[dict]:
                 _measure_prefill_flash, batch=b, seq=seq, dtype=dtype,
                 iters=args.iters))
             for seq, b in ((2048, 2), (8192, 1))
+        ]
+        # Windowed prefill (Mistral-style 2048-window at 8k context): the
+        # kernel's window band skips out-of-window tiles entirely while
+        # the dot path pays the full dense masked matmul.
+        aux += [
+            ("prefill-flash-win-8192", functools.partial(
+                _measure_prefill_flash, batch=1, seq=8192, dtype=dtype,
+                iters=args.iters, window=2048)),
         ]
     for name, fn in aux:
         if not want(name):

@@ -75,9 +75,10 @@ def generate(ladder_path: str) -> str:
     listed = [str(e["config"]) for e in bench.LADDER] + [
         # Aux rows run_ladder appends after the decode configs.
         "serving-latency", "continuous-batching", "local-proc-batching",
-        "ragged-decode-8k", "quant-matmul-bw", "spec-decode",
-        "spec-decode-7b-int8", "spec-batching", "paged-batching",
-        "prefill-flash-2048", "prefill-flash-8192", "hop-latency",
+        "ragged-decode-8k", "ragged-decode-win-8k", "quant-matmul-bw",
+        "spec-decode", "spec-decode-7b-int8", "spec-batching",
+        "paged-batching", "prefill-flash-2048", "prefill-flash-8192",
+        "prefill-flash-win-8192", "hop-latency",
     ]
     extras = [c for c in rows if c not in listed]
     for cfg_id in listed + extras:
