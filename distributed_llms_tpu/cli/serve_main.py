@@ -53,6 +53,7 @@ def build_server(args) -> InferenceServer:
         batch_slots=args.slots,
         max_len=args.max_len,
         chunk_steps=args.chunk_steps,
+        prefill_chunk=args.prefill_chunk,
     )
     return InferenceServer(
         batcher,
@@ -94,6 +95,11 @@ def main(argv=None) -> None:
                     help="per-row cache length (default: runtime.max_seq_len)")
     ap.add_argument("--chunk-steps", type=int, default=8,
                     help="decode steps per scheduling chunk")
+    ap.add_argument("--prefill-chunk", type=int, default=None,
+                    help="chunked prefill: admit at most this many prompt "
+                         "tokens per scheduling round, so long prompts "
+                         "never stall in-flight decodes (default: "
+                         "monolithic admission)")
     ap.add_argument("--max-pending", type=int, default=256,
                     help="in-flight request cap before 429s")
     ap.add_argument("--platform", default=None,

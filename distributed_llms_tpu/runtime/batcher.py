@@ -535,6 +535,67 @@ def admit_row_with_prefix(
     return (cache, *_replicated(pm, tok, row_valid, lp))
 
 
+@partial(jax.jit, static_argnames=("cfg",),
+         donate_argnames=("row_k", "row_v"))
+def prefill_chunk_step(
+    params: Any,
+    cfg: ModelConfig,
+    row_k: jax.Array,   # [..., 1, S, KVH, HD] transient single-row KV
+    row_v: jax.Array,
+    done: jax.Array,    # scalar int32 — prompt tokens already in the row
+    chunk: jax.Array,   # [Tc] int32 — next chunk, right-padded (bucketed)
+    clen: jax.Array,    # scalar int32 true chunk length
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """One chunk of a CHUNKED prefill: consume ``chunk`` into the transient
+    single-row cache at offset ``done`` — the same continuation math as
+    prefix-cached admission (the "prefix" is the row's own partial prompt),
+    so the accumulated attention is bit-identical to a monolithic prefill.
+    row_k/row_v are DONATED (the update happens in place instead of
+    copying the full row cache every chunk) — _start_chunked hands this
+    step exclusively-owned buffers, copying a registered prefix's KV once
+    up front rather than aliasing it.
+    Returns (row_k', row_v', last_logits [1, V] at the chunk's last real
+    position — the sampling source once the prompt completes)."""
+    logits, row_cache = _prefill_row_with_prefix(
+        model_lib.forward, params, cfg, row_k, row_v, done, chunk
+    )
+    last = jnp.take_along_axis(
+        logits, jnp.maximum(clen - 1, 0)[None, None, None], axis=1
+    )[:, 0]  # [1, V]
+    return row_cache.k, row_cache.v, last
+
+
+@partial(
+    jax.jit,
+    static_argnames=("cfg", "temperature", "top_k", "top_p"),
+    donate_argnames=("cache",),
+)
+def finish_chunked_admission(
+    cfg: ModelConfig,
+    cache: Any,
+    slot: jax.Array,
+    row_k: jax.Array,
+    row_v: jax.Array,
+    last_logits: jax.Array,  # [1, V] from the final prefill_chunk_step
+    total_len: jax.Array,    # scalar int32 — full prompt length
+    rng: jax.Array,
+    temperature: float = 0.0,
+    top_k: int = 0,
+    top_p: float = 1.0,
+    temp_req: jax.Array | None = None,
+    topp_req: jax.Array | None = None,
+) -> tuple[Any, jax.Array, jax.Array, jax.Array]:
+    """Tail of a chunked admission: sample the first token from the final
+    chunk's last-position logits and splice the fully-prefilled transient
+    row into the shared cache — the same _finish_admission used by the
+    monolithic paths, so results are bit-identical."""
+    return _finish_admission(
+        cache, slot, KVCache(k=row_k, v=row_v), last_logits[:, None, :],
+        jnp.int32(1), rng, temperature, top_k, top_p, total_len,
+        temp_req=temp_req, topp_req=topp_req,
+    )
+
+
 def _paged_pool(cfg: ModelConfig, num_pages: int, page_size: int, dtype=None):
     """KV page pools [L, NB, BLK, KVH, HD] (distinct k/v buffers — the
     chunk fns donate the cache)."""
@@ -804,8 +865,26 @@ class _Prefix:
 
 
 @dataclass
+class _PendingPrefill:
+    """A chunked prefill in flight: the request's prompt enters the row's
+    TRANSIENT single-row cache ``prefill_chunk`` tokens per scheduling
+    round (decode rounds interleave), splicing into the shared cache only
+    when complete."""
+
+    req: _Request
+    row_k: Any          # transient [..., 1, S, KVH, HD] accumulating KV
+    row_v: Any
+    done: int           # prompt tokens already consumed (incl. prefix)
+    ids: list[int]      # the request's own ids (prefix KV pre-seeded)
+    total_len: int      # prefix + prompt length
+    last_logits: Any | None = None  # [1, V] after the latest chunk
+
+
+@dataclass
 class _RowState:
     rid: int | None = None
+    prefilling: bool = False  # chunked prefill in flight: the slot is
+    #                     reserved but must not publish or decode yet
     emitted: list[int] = field(default_factory=list)
     lps: list[float] = field(default_factory=list)  # per-token logprobs
     #                     (raw TARGET distribution), aligned with emitted —
@@ -864,6 +943,16 @@ class ContinuousBatcher:
         draft_params: Any = None,
         draft_cfg: ModelConfig | None = None,
         spec_k: int = 4,
+        # Chunked prefill: admission consumes at most this many prompt
+        # tokens per scheduling round (one pending row per round), so a
+        # long prompt never stalls in-flight decodes for its whole prefill
+        # — the serving-QoS lever for mixed long/short traffic.  None =
+        # monolithic admission.  Results stay token-identical (the chunk
+        # steps are the prefix-continuation math against the row's own
+        # partial prompt; logprob values agree to float drift — the same
+        # attention reduced in different shapes).  Single-device
+        # contiguous plain mode.
+        prefill_chunk: int | None = None,
     ) -> None:
         if max_len > cfg.max_seq_len:
             raise ValueError(
@@ -924,6 +1013,19 @@ class ContinuousBatcher:
                 )
             if spec_k < 1:
                 raise ValueError(f"spec_k must be >= 1, got {spec_k}")
+        if prefill_chunk is not None:
+            if prefill_chunk < 1:
+                raise ValueError(
+                    f"prefill_chunk must be >= 1, got {prefill_chunk}"
+                )
+            if self.speculative or parallel is not None or paged_pages is not None:
+                raise ValueError(
+                    "chunked prefill is single-device contiguous plain-"
+                    "batcher mode for now (no mesh, no paged KV, no "
+                    "speculative draft)"
+                )
+        self.prefill_chunk = prefill_chunk
+        self._prefills: dict[int, _PendingPrefill] = {}  # slot -> pending
         self.draft_params = draft_params
         self.draft_cfg = draft_cfg
         self.spec_k = spec_k
@@ -1201,6 +1303,9 @@ class ContinuousBatcher:
                 if row.pages:
                     self.free_pages.extend(row.pages)
                     self.tables[i] = 0
+                # A chunked prefill in flight just drops its transient row
+                # cache — nothing was spliced into the shared cache yet.
+                self._prefills.pop(i, None)
                 self.rows[i] = _RowState()
                 self.active[i] = False
                 self.budget[i] = 0
@@ -1215,16 +1320,30 @@ class ContinuousBatcher:
         return sub
 
     def _admit_pending(self) -> None:
+        # Advance at most ONE pending chunked prefill per round — the
+        # round's prefill budget; decode rounds interleave between chunks.
+        if self._prefills:
+            self._advance_chunk(next(iter(self._prefills)))
         active_host = self.active
         for i in range(self.b):
             if not self.queue:
                 return
-            if active_host[i]:
+            if active_host[i] or self.rows[i].rid is not None:
+                # rid set while inactive = a chunked prefill holds the slot.
                 continue
             req = self.queue.popleft()
             pfx = self.prefixes[req.prefix] if req.prefix is not None else None
             pfx_len = len(pfx.ids) if pfx else 0
             total_len = pfx_len + len(req.ids)
+            if (self.prefill_chunk is not None
+                    and len(req.ids) > self.prefill_chunk):
+                if self._prefills:
+                    # One chunked prefill at a time (strict per-round
+                    # budget) and strict FIFO: requeue, stop admitting.
+                    self.queue.appendleft(req)
+                    return
+                self._start_chunked(i, req, pfx)
+                continue
             pages: list[int] = []
             if self.paged:
                 # Allocate only the pages prompt+budget need; a dry pool
@@ -1299,41 +1418,111 @@ class ContinuousBatcher:
                     jnp.int32(i), jnp.asarray(dprompt),
                     jnp.int32(len(full_ids)),
                 )
-            tok = int(tok)  # replicated scalar — identical on every process
-            self.last_tok[i] = tok
-            self.temp_row[i] = req_t
-            self.topp_row[i] = req_p
-            self.pres_row[i] = req.presence_penalty
-            self.freq_row[i] = req.frequency_penalty
-            if req.presence_penalty or req.frequency_penalty:
-                if self.tok_counts is None:
-                    self.tok_counts = jnp.zeros(
-                        (self.b, self.cfg.vocab_size), jnp.int32
-                    )
-                self.tok_counts = _reset_count_row(
-                    self.tok_counts, jnp.int32(i), jnp.int32(tok)
+            self._activate_row(i, req, tok, lp, row_valid, total_len,
+                               req_t, req_p, pages)
+
+    def _activate_row(self, i, req, tok, lp, row_valid, total_len,
+                      req_t, req_p, pages):
+        """Host bookkeeping tail of EVERY admission (monolithic and
+        chunked): record the sampled first token, arm the row's scheduling
+        state, stream the token."""
+        tok = int(tok)  # replicated scalar — identical on every process
+        self.last_tok[i] = tok
+        self.temp_row[i] = req_t
+        self.topp_row[i] = req_p
+        self.pres_row[i] = req.presence_penalty
+        self.freq_row[i] = req.frequency_penalty
+        if req.presence_penalty or req.frequency_penalty:
+            if self.tok_counts is None:
+                self.tok_counts = jnp.zeros(
+                    (self.b, self.cfg.vocab_size), jnp.int32
                 )
-            self.real_lens[i] = total_len
-            self.valid[i] = np.asarray(row_valid)
-            self.active[i] = True
-            # The first token came out of admission; the row may emit
-            # budget-1 more from decode chunks.
-            self.budget[i] = req.max_new_tokens - 1
-            self.rows[i] = _RowState(
-                rid=req.rid, emitted=[tok], lps=[float(lp)],
-                remaining=req.max_new_tokens - 1, pages=pages,
+            self.tok_counts = _reset_count_row(
+                self.tok_counts, jnp.int32(i), jnp.int32(tok)
             )
-            log.debug("admitted request %d into slot %d", req.rid, i)
-            if req.max_new_tokens == 1 or tok == self.eos_id:
-                self.active[i] = False
-            if self._on_tokens is not None:
-                # Stream the admission token; completion (done=True) is
-                # always announced by _collect's publish sweep.  State
-                # advances BEFORE the callback so a raising callback can
-                # never cause a re-delivery on a later run().
-                self.rows[i].streamed = 1
-                self._on_tokens(req.rid, [tok], False, [float(lp)])
-            METRICS.inc("batcher.admitted")
+        self.real_lens[i] = total_len
+        self.valid[i] = np.asarray(row_valid)
+        self.active[i] = True
+        # The first token came out of admission; the row may emit
+        # budget-1 more from decode chunks.
+        self.budget[i] = req.max_new_tokens - 1
+        self.rows[i] = _RowState(
+            rid=req.rid, emitted=[tok], lps=[float(lp)],
+            remaining=req.max_new_tokens - 1, pages=pages,
+        )
+        log.debug("admitted request %d into slot %d", req.rid, i)
+        if req.max_new_tokens == 1 or tok == self.eos_id:
+            self.active[i] = False
+        if self._on_tokens is not None:
+            # Stream the admission token; completion (done=True) is
+            # always announced by _collect's publish sweep.  State
+            # advances BEFORE the callback so a raising callback can
+            # never cause a re-delivery on a later run().
+            self.rows[i].streamed = 1
+            self._on_tokens(req.rid, [tok], False, [float(lp)])
+        METRICS.inc("batcher.admitted")
+
+    # -- chunked prefill ---------------------------------------------------
+
+    def _start_chunked(self, i: int, req: _Request, pfx) -> None:
+        """Reserve slot ``i`` and begin a chunked prefill (first chunk runs
+        this round).  Prefix-cached requests seed the transient row with a
+        COPY of the registered prefix KV — one copy up front makes the
+        buffers exclusively ours, so every chunk step can donate them
+        (update in place) instead of copying the row cache per chunk."""
+        if pfx is not None:
+            row_k, row_v, done = jnp.copy(pfx.k), jnp.copy(pfx.v), len(pfx.ids)
+        else:
+            rc = model_lib.init_cache(self.cfg, 1, self.s,
+                                      dtype=self.cache.k.dtype)
+            row_k, row_v, done = rc.k, rc.v, 0
+        self.rows[i] = _RowState(rid=req.rid, prefilling=True,
+                                 remaining=req.max_new_tokens)
+        self._prefills[i] = _PendingPrefill(
+            req=req, row_k=row_k, row_v=row_v, done=done,
+            ids=list(req.ids), total_len=done + len(req.ids),
+        )
+        self._advance_chunk(i)
+
+    def _advance_chunk(self, i: int) -> None:
+        """Consume one ``prefill_chunk``-sized bite of slot ``i``'s pending
+        prompt; finish the admission when the prompt completes."""
+        pp = self._prefills[i]
+        pfx_len = pp.total_len - len(pp.ids)
+        clen = min(self.prefill_chunk, pp.total_len - pp.done)
+        off = pp.done - pfx_len
+        # Bucket for compile reuse, capped so cache_index + T <= width
+        # (forward's contract; dynamic_update_slice clamps overflows).
+        tc = min(_bucket(clen), self.s - pp.done)
+        chunk = np.full((tc,), self.pad_id, np.int32)
+        chunk[:clen] = pp.ids[off: off + clen]
+        pp.row_k, pp.row_v, pp.last_logits = prefill_chunk_step(
+            self.params, self.cfg, pp.row_k, pp.row_v, jnp.int32(pp.done),
+            jnp.asarray(chunk), jnp.int32(clen),
+        )
+        pp.done += clen
+        METRICS.inc("batcher.prefill_chunks")
+        if pp.done < pp.total_len:
+            return
+        req = pp.req
+        req_t = (self.sampling["temperature"] if req.temperature is None
+                 else float(req.temperature))
+        req_p = (self.sampling["top_p"] if req.top_p is None
+                 else float(req.top_p))
+        custom = (req_t != self.sampling["temperature"]
+                  or req_p != self.sampling["top_p"])
+        extra = (
+            dict(temp_req=jnp.float32(req_t), topp_req=jnp.float32(req_p))
+            if custom else {}
+        )
+        self.cache, tok, row_valid, lp = finish_chunked_admission(
+            self.cfg, self.cache, jnp.int32(i), pp.row_k, pp.row_v,
+            pp.last_logits, jnp.int32(pp.total_len), self._split_rng(),
+            **self.sampling, **extra,
+        )
+        del self._prefills[i]
+        self._activate_row(i, req, tok, lp, row_valid, pp.total_len,
+                           req_t, req_p, pages=[])
 
     def _collect(
         self, toks: np.ndarray, was_active: np.ndarray,
@@ -1359,10 +1548,11 @@ class ContinuousBatcher:
                 if t == self.eos_id:
                     break
         # Rows that finished this chunk publish their result and free up.
+        # (Chunked prefills in flight are inactive but NOT finished.)
         active_host = self.active
         for i in range(self.b):
             row = self.rows[i]
-            if row.rid is not None and not active_host[i]:
+            if row.rid is not None and not active_host[i] and not row.prefilling:
                 # Trim anything emitted past the row's EOS.
                 if self.eos_id >= 0 and self.eos_id in row.emitted:
                     cut = row.emitted.index(self.eos_id) + 1

@@ -436,6 +436,9 @@ class InferenceEngine:
         page_size: int | None = None,
         speculative: bool | None = None,  # None -> rt.spec_decode; needs an
         #   attached draft + greedy + single-device contiguous mode
+        prefill_chunk: int | None = None,  # chunked prefill: admit at most
+        #   this many prompt tokens per scheduling round (single-device
+        #   contiguous plain mode; see ContinuousBatcher)
     ):
         """A ContinuousBatcher over this engine's model: requests admit into
         an in-flight decode batch as rows free up (runtime/batcher.py) —
@@ -523,6 +526,7 @@ class InferenceEngine:
             kv_dtype=self.rt.kv_cache_dtype,
             parallel=self.parallel,
             paged_pages=paged_pages, page_size=page_size,
+            prefill_chunk=prefill_chunk,
         )
 
     # -- speculative decoding (runtime/speculative.py): greedy-exact at

@@ -1,0 +1,171 @@
+"""Chunked prefill (runtime/batcher.py prefill_chunk).
+
+Invariant: admission that consumes a prompt ``prefill_chunk`` tokens per
+scheduling round — interleaved with other rows' decode chunks — produces
+TOKEN-IDENTICAL results vs monolithic admission: the chunk steps are the
+prefix-continuation math against the row's own partial prompt (the same
+machinery as prefix-cached admission, pinned equivalent by
+tests/runtime/test_session.py), and the final first-token sample runs the
+same _finish_admission.  Logprob values agree to float drift (the same
+attention reduces in different shapes).  What changes is scheduling
+latency, never tokens.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_llms_tpu.models import model as model_lib, presets
+from distributed_llms_tpu.runtime.batcher import ContinuousBatcher
+
+# Fresh-process isolation (compile-heavy; shared marker, tests/conftest.py).
+pytestmark = pytest.mark.fragile_xla_cpu
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    cfg = presets.get_preset("llama-tiny", vocab_size=512)
+    params = model_lib.init_params(jax.random.key(0), cfg)
+    return cfg, params
+
+
+def _run(cfg, params, reqs, chunk=None, prefixes=(), **kw):
+    b = ContinuousBatcher(
+        cfg, params, batch_slots=2, max_len=96, chunk_steps=4,
+        prefill_chunk=chunk, **kw,
+    )
+    for name, ids in prefixes:
+        b.register_prefix(name, ids)
+    rids = [b.submit(ids, max_new_tokens=n, **req_kw)
+            for ids, n, req_kw in reqs]
+    return b, rids, b.run()
+
+
+@pytest.mark.parametrize("chunk", [1, 3, 16])
+def test_chunked_matches_monolithic(tiny, chunk):
+    """Mixed long/short prompts, more requests than slots: every request's
+    tokens AND logprobs match the monolithic batcher exactly, for chunk
+    sizes splitting prompts at 1, mid, and barely."""
+    cfg, params = tiny
+    reqs = [
+        (list(range(7, 27)), 6, {}),        # 20-token prompt: chunks
+        ([4, 4, 4], 5, {}),                 # short: admits monolithically
+        (list(range(40, 75)), 8, {}),       # 35 tokens, slot reuse
+        ([11, 12], 9, {}),
+    ]
+    plain_b, rp, plain = _run(cfg, params, reqs)
+    pb, rc, chunked = _run(cfg, params, reqs, chunk=chunk)
+    for a, c in zip(rp, rc):
+        assert plain[a] == chunked[c], (a, plain[a], chunked[c])
+        assert len(plain_b.result_logprobs[a]) == len(pb.result_logprobs[c])
+        # Tokens are bit-identical (argmax is drift-stable); logprob VALUES
+        # carry float-level drift (~1e-5) because chunked forwards reduce
+        # the same attention in different shapes.
+        for x, y in zip(plain_b.result_logprobs[a], pb.result_logprobs[c]):
+            assert abs(x - y) < 1e-3, (x, y)
+
+
+def test_chunked_prefix_cached_matches(tiny):
+    """Prefix-cached requests: the registered prefix KV seeds the transient
+    row (never mutated — no donation), the suffix chunks, results equal the
+    monolithic prefix path."""
+    cfg, params = tiny
+    prefixes = [("sys", [9, 8, 7, 6, 5])]
+    reqs = [
+        (list(range(20, 36)), 7, {"prefix": "sys"}),
+        ([1, 2], 5, {"prefix": "sys"}),
+        ([4, 4, 4], 6, {}),
+    ]
+    _, rp, plain = _run(cfg, params, reqs, prefixes=prefixes)
+    pb, rc, chunked = _run(cfg, params, reqs, chunk=4, prefixes=prefixes)
+    for a, c in zip(rp, rc):
+        assert plain[a] == chunked[c]
+    # The prefix is reusable afterwards (its buffers were not donated).
+    rid = pb.submit([3], max_new_tokens=4, prefix="sys")
+    assert len(pb.run()[rid]) == 4
+
+
+def test_chunked_streaming_and_sampling(tiny):
+    """Streaming reassembles exactly (first token streams at admission
+    completion) and greedy rows stay bit-exact vs monolithic even while a
+    sampled row shares the batch.  The SAMPLED row itself draws from the
+    same distribution but a different RNG stream (the split order follows
+    the scheduling rounds, which chunking changes) — pinned per-seed
+    deterministic instead of bit-equal."""
+    cfg, params = tiny
+    reqs = [
+        (list(range(7, 25)), 6, {"temperature": 1.1}),
+        ([4, 4], 5, {}),
+    ]
+    _, rp, plain = _run(cfg, params, reqs, seed=3)
+
+    def chunked_run():
+        b = ContinuousBatcher(cfg, params, batch_slots=2, max_len=96,
+                              chunk_steps=4, prefill_chunk=5, seed=3)
+        rids = [b.submit(ids, max_new_tokens=n, **kw)
+                for ids, n, kw in reqs]
+        streamed = {r: [] for r in rids}
+        dones = {r: 0 for r in rids}
+
+        def cb(rid, new, done, lps):
+            streamed[rid].extend(new)
+            dones[rid] += bool(done)
+
+        res = b.run(on_tokens=cb)
+        for r in rids:
+            assert streamed[r] == res[r]
+            assert dones[r] == 1
+        return [res[r] for r in rids]
+
+    first = chunked_run()
+    assert len(first[0]) == 6
+    assert first[1] == plain[rp[1]]     # greedy neighbor: bit-exact
+    assert first == chunked_run()       # sampled row: per-seed deterministic
+
+
+def test_chunked_cancel_mid_prefill(tiny):
+    """Cancelling a request whose prompt is still chunking frees the slot
+    (nothing was spliced into the shared cache) and later requests reuse
+    it with exact results."""
+    cfg, params = tiny
+    b = ContinuousBatcher(cfg, params, batch_slots=1, max_len=96,
+                          chunk_steps=4, prefill_chunk=3)
+    long_rid = b.submit(list(range(7, 27)), max_new_tokens=6)
+
+    # Drive ONE scheduling round manually: the prefill starts but cannot
+    # finish (20 tokens / 3-token chunks).
+    b._admit_pending()
+    assert b._prefills and b.rows[0].prefilling
+    assert b.cancel_row(long_rid)
+    assert not b._prefills and b.rows[0].rid is None
+    assert b.results[long_rid] == []
+
+    follow = b.submit([4, 4, 4], max_new_tokens=5)
+    res = b.run()
+    solo = ContinuousBatcher(cfg, params, batch_slots=1, max_len=96,
+                             chunk_steps=4)
+    srid = solo.submit([4, 4, 4], max_new_tokens=5)
+    assert res[follow] == solo.run()[srid]
+
+
+def test_chunked_guards(tiny):
+    cfg, params = tiny
+    with pytest.raises(ValueError, match="prefill_chunk"):
+        ContinuousBatcher(cfg, params, max_len=64, prefill_chunk=0)
+    with pytest.raises(ValueError, match="single-device"):
+        ContinuousBatcher(cfg, params, max_len=64, prefill_chunk=4,
+                          draft_params=params, draft_cfg=cfg)
+    from distributed_llms_tpu.core.config import RuntimeConfig
+    from distributed_llms_tpu.runtime.engine import InferenceEngine
+
+    eng = InferenceEngine.from_preset(
+        "llama-tiny", RuntimeConfig(max_decode_steps=6, max_seq_len=96),
+        vocab_size=300,
+    )
+    cb = eng.continuous_batcher(batch_slots=2, max_len=64, prefill_chunk=4)
+    assert cb.prefill_chunk == 4
+    rid = cb.submit("hello world, a long-ish prompt", max_new_tokens=5)
+    plain = eng.continuous_batcher(batch_slots=2, max_len=64)
+    prid = plain.submit("hello world, a long-ish prompt", max_new_tokens=5)
+    assert cb.run()[rid] == plain.run()[prid]
