@@ -67,14 +67,28 @@ def build_server(args) -> InferenceServer:
 async def _serve(args) -> None:
     server = build_server(args)
     stop = asyncio.Event()
+    force = asyncio.Event()
     loop = asyncio.get_running_loop()
+
+    def on_signal():
+        # First signal: graceful drain.  Second: cut the drain short.
+        (force if stop.is_set() else stop).set()
+
     for sig in (signal.SIGINT, signal.SIGTERM):
-        loop.add_signal_handler(sig, stop.set)
+        loop.add_signal_handler(sig, on_signal)
     host, port = await server.start()
     log.info("ready on http://%s:%s (Ctrl-C to stop)", host, port)
     await stop.wait()
-    log.info("shutting down...")
-    await server.stop()
+    log.info("shutting down (draining up to %.0fs; signal again to force)...",
+             args.drain_timeout)
+    drain = asyncio.create_task(server.stop(drain_timeout=args.drain_timeout))
+    forcer = asyncio.create_task(force.wait())
+    await asyncio.wait({drain, forcer}, return_when=asyncio.FIRST_COMPLETED)
+    if not drain.done():
+        log.info("second signal: forcing immediate shutdown")
+        server.force_stop()
+    await drain
+    forcer.cancel()
 
 
 def main(argv=None) -> None:
@@ -102,6 +116,9 @@ def main(argv=None) -> None:
                          "monolithic admission)")
     ap.add_argument("--max-pending", type=int, default=256,
                     help="in-flight request cap before 429s")
+    ap.add_argument("--drain-timeout", type=float, default=30.0,
+                    help="graceful shutdown: seconds to let in-flight "
+                         "requests finish before cancelling (0 = immediate)")
     ap.add_argument("--platform", default=None,
                     help="force a jax platform (e.g. cpu) — the axon TPU "
                          "plugin ignores JAX_PLATFORMS, so this sets "

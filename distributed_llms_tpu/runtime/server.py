@@ -48,6 +48,7 @@ _REASONS = {
     405: "Method Not Allowed", 413: "Payload Too Large",
     429: "Too Many Requests", 431: "Request Header Fields Too Large",
     500: "Internal Server Error", 501: "Not Implemented",
+    503: "Service Unavailable",
 }
 
 
@@ -119,6 +120,7 @@ class InferenceServer:
         self._cancelled: set[int] = set()  # loop writes, engine consumes
         self._work = threading.Event()
         self._stopping = False
+        self._draining = False  # graceful stop: reject new, finish in-flight
         self._server: asyncio.base_events.Server | None = None
         self._engine: threading.Thread | None = None
         self._loop: asyncio.AbstractEventLoop | None = None
@@ -145,8 +147,20 @@ class InferenceServer:
         assert self._server is not None
         return self._server.sockets[0].getsockname()[1]
 
-    async def stop(self) -> None:
-        """Cancel in-flight rows, stop the engine thread, close sockets."""
+    async def stop(self, drain_timeout: float = 0.0) -> None:
+        """Stop serving.  ``drain_timeout > 0``: graceful — new requests
+        get 500 immediately while in-flight ones run to completion (up to
+        the deadline), then the engine stops; anything still unfinished at
+        the deadline is cancelled.  ``0``: immediate — in-flight rows are
+        cancel-flagged and the engine drains within one chunk."""
+        self._draining = True
+        if drain_timeout > 0:
+            loop = asyncio.get_running_loop()
+            deadline = loop.time() + drain_timeout
+            # force_stop() flips _stopping mid-drain (second SIGTERM/^C).
+            while (self._requests and loop.time() < deadline
+                   and not self._stopping):
+                await asyncio.sleep(0.05)
         self._stopping = True
         for rid in list(self._requests):
             self._cancelled.add(rid)
@@ -160,6 +174,11 @@ class InferenceServer:
             for w in list(self._conns):
                 w.close()
             await self._server.wait_closed()
+
+    def force_stop(self) -> None:
+        """Cut a graceful drain short (second SIGTERM/Ctrl-C): in-flight
+        rows cancel at their next chunk instead of running to completion."""
+        self._stopping = True
 
     # -- engine thread -----------------------------------------------------
 
@@ -415,6 +434,11 @@ class InferenceServer:
             raise BadRequest("'n' must be <= 8")
         if len(self._requests) + n > self.max_pending:
             await self._json(writer, 429, _err_body("server request queue is full"))
+            return
+        if self._draining and not self._stopping:
+            # Graceful drain (rolling restarts): 503 tells load balancers
+            # to retry elsewhere — 500 would read as an application error.
+            await self._json(writer, 503, _err_body("server is draining"))
             return
         if self._stopping:
             await self._json(writer, 500, _err_body("server is shutting down"))

@@ -337,6 +337,37 @@ def test_chunked_body_rejected(tiny):
     run_with_server(make_batcher(tiny), fn)
 
 
+def test_graceful_drain_finishes_in_flight(tiny):
+    """stop(drain_timeout>0): new requests get 500 immediately, in-flight
+    ones run to completion (full token budget, finish_reason length) —
+    the SIGTERM semantics of dlt-serve --drain-timeout."""
+    async def fn(host, port, srv):
+        req_task = asyncio.create_task(_request(
+            host, port, "POST", "/v1/completions",
+            {"prompt": "hello", "max_tokens": 24},
+        ))
+        for _ in range(200):  # wait until the request is registered
+            if srv._requests:
+                break
+            await asyncio.sleep(0.02)
+        assert srv._requests
+        stop_task = asyncio.create_task(srv.stop(drain_timeout=60.0))
+        await asyncio.sleep(0)  # let stop() flip _draining
+        status_new, body_new = await _request(
+            host, port, "POST", "/v1/completions",
+            {"prompt": "x", "max_tokens": 2},
+        )
+        # 503, not 500: load balancers treat it as retry-elsewhere.
+        assert status_new == 503 and b"draining" in body_new
+        status, body = await req_task
+        assert status == 200
+        out = json.loads(body)
+        assert out["usage"]["completion_tokens"] == 24  # NOT cancelled
+        await stop_task
+
+    run_with_server(make_batcher(tiny), fn)
+
+
 def test_shutdown_drains_pending_request(tiny):
     from distributed_llms_tpu.runtime.server import _Mailbox
 
