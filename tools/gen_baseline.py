@@ -45,6 +45,8 @@ def _result_cell(row: dict) -> str:
         ("tok_per_s_in_engine", "in-engine tok/s"),
         ("cluster_overhead_pct", "cluster overhead %"),
         ("rtt_1tok_p50_ms", "1-tok RTT p50 ms"),
+        ("short_done_ms_monolithic", "short-req ms (monolithic)"),
+        ("short_done_ms_chunked", "short-req ms (chunked)"),
     ):
         if row.get(k) is not None:
             v = row[k]
@@ -75,6 +77,7 @@ def generate(ladder_path: str) -> str:
     listed = [str(e["config"]) for e in bench.LADDER] + [
         # Aux rows run_ladder appends after the decode configs.
         "serving-latency", "continuous-batching", "local-proc-batching",
+        "chunked-prefill",
         "ragged-decode-8k", "ragged-decode-win-8k", "quant-matmul-bw",
         "spec-decode", "spec-decode-7b-int8", "spec-batching",
         "paged-batching", "prefill-flash-2048", "prefill-flash-8192",

@@ -75,9 +75,9 @@ fi
 ROWS_LONG="3-int8 3 3-int4 3-int8-b8 3-int8-b16 4-int4 4-int8 4 \
 spec-decode-7b-int8"
 ROWS_SHORT="1 1-b32 2 2-b32 serving-latency continuous-batching paged-batching \
-ragged-decode-8k ragged-decode-win-8k quant-matmul-bw spec-decode \
-spec-batching prefill-flash-2048 prefill-flash-8192 prefill-flash-win-8192 \
-hop-latency"
+chunked-prefill ragged-decode-8k ragged-decode-win-8k quant-matmul-bw \
+spec-decode spec-batching prefill-flash-2048 prefill-flash-8192 \
+prefill-flash-win-8192 hop-latency"
 
 run_row() {  # run_row <name> <timeout-secs>; rc 0 = row recorded, 3 = abort
   local r="$1" tmo="$2" attempt p rc
