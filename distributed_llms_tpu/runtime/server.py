@@ -56,13 +56,16 @@ class _Mailbox:
     """Per-request delivery queue, filled by the engine thread via
     call_soon_threadsafe, drained by the owning handler coroutine.
     ``finished`` flips once generation concluded (done seen / stop acked)
-    so the disconnect path knows whether a cancel flag is still needed."""
+    so the disconnect path knows whether a cancel flag is still needed.
+    ``t0``/``first_seen`` drive the TTFT histogram (first delivery)."""
 
-    __slots__ = ("queue", "finished")
+    __slots__ = ("queue", "finished", "t0", "first_seen")
 
     def __init__(self) -> None:
         self.queue: asyncio.Queue = asyncio.Queue()
         self.finished = False
+        self.t0 = time.perf_counter()
+        self.first_seen = False
 
 
 class BadRequest(ValueError):
@@ -245,6 +248,7 @@ class InferenceServer:
         self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
     ) -> None:
         self._conns.add(writer)
+        t0 = time.perf_counter()  # request receipt: latency clocks start here
         try:
             try:
                 # Deadline covers the parse phase only: generation itself
@@ -255,7 +259,7 @@ class InferenceServer:
                 )
             except _Responded:
                 return
-            await self._route(writer, method, path, body)
+            await self._route(writer, method, path, body, t0)
         except (asyncio.TimeoutError, ConnectionError, OSError, ValueError,
                 EOFError):  # IncompleteReadError: client hung up mid-body
             pass
@@ -301,7 +305,8 @@ class InferenceServer:
         body = await reader.readexactly(content_len) if content_len else b""
         return method, path, body
 
-    async def _route(self, writer, method: str, path: str, body: bytes) -> None:
+    async def _route(self, writer, method: str, path: str, body: bytes,
+                     t0: float) -> None:
         if method == "GET" and path == "/healthz":
             await self._plain(writer, 200, "ok\n")
         elif method == "GET" and path == "/metrics":
@@ -322,7 +327,8 @@ class InferenceServer:
                 req = json.loads(body or b"{}")
                 if not isinstance(req, dict):
                     raise BadRequest("request body must be a JSON object")
-                await self._completions(writer, req, chat="chat" in path)
+                await self._completions(writer, req, chat="chat" in path,
+                                        t0=t0)
             except (BadRequest, json.JSONDecodeError) as e:
                 await self._json(writer, 400, _err_body(str(e)))
         elif method not in ("GET", "POST"):
@@ -407,7 +413,10 @@ class InferenceServer:
             )
         return out[0], out[1], out[2], out[3]
 
-    async def _completions(self, writer, req: dict, chat: bool) -> None:
+    async def _completions(self, writer, req: dict, chat: bool,
+                           t0: float | None = None) -> None:
+        if t0 is None:
+            t0 = time.perf_counter()
         prompt_ids, _ = self._parse_prompt(req, chat)
         max_tokens = _field(
             req, "max_completion_tokens" if chat else "max_tokens",
@@ -453,6 +462,7 @@ class InferenceServer:
         for idx in range(n):
             rid = self.batcher.next_rid
             mbox = _Mailbox()
+            mbox.t0 = t0  # latency clocks run from request receipt
             self._requests[rid] = mbox
             try:
                 got = self.batcher.submit(
@@ -490,6 +500,10 @@ class InferenceServer:
         except (ConnectionError, OSError, asyncio.TimeoutError):
             METRICS.inc("server.disconnects")
         finally:
+            METRICS.observe(
+                "server.request_seconds",
+                time.perf_counter() - subs[0][2].t0,
+            )
             # Runs on EVERY exit (normal, disconnect, or an unexpected
             # exception from the serve path): rows still generating get
             # cancel-flagged — the engine consumes the flag at its next
@@ -523,6 +537,16 @@ class InferenceServer:
         hold = max((len(s) for s in stop), default=1) - 1
         while True:
             toks, done, err, new_lps = await mbox.queue.get()
+            if err is None and not mbox.first_seen:
+                # Time to first token, measured from request receipt
+                # (mbox.t0 is set by _completions from _handle's clock, so
+                # body read + parse + tokenization count).  Error/shutdown
+                # notices are NOT samples — they would poison the
+                # quantiles with time-to-failure.  Exported at /metrics.
+                mbox.first_seen = True
+                METRICS.observe(
+                    "server.ttft_seconds", time.perf_counter() - mbox.t0
+                )
             if err is not None:
                 mbox.finished = True
                 yield "", ids, lps, True, err

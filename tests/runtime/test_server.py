@@ -122,6 +122,16 @@ def test_health_models_metrics(tiny):
         assert status == 200
         status, _ = await _request(host, port, "GET", "/nope")
         assert status == 404
+        # Latency histograms appear after serving a request: TTFT (first
+        # mailbox delivery) and end-to-end request duration.
+        status, _ = await _request(
+            host, port, "POST", "/v1/completions",
+            {"prompt": "hi", "max_tokens": 3},
+        )
+        assert status == 200
+        _, body = await _request(host, port, "GET", "/metrics")
+        assert b"server_ttft_seconds" in body
+        assert b"server_request_seconds" in body
 
     run_with_server(make_batcher(tiny), fn)
 
