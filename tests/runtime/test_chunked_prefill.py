@@ -12,8 +12,6 @@ latency, never tokens.
 """
 
 import jax
-import jax.numpy as jnp
-import numpy as np
 import pytest
 
 from distributed_llms_tpu.models import model as model_lib, presets

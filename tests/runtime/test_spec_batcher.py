@@ -9,7 +9,6 @@ after fully accepted rounds (self-draft).
 """
 
 import jax
-import jax.numpy as jnp
 import numpy as np
 import pytest
 
