@@ -1109,6 +1109,119 @@ def _measure_chunked_prefill(
     }
 
 
+def _measure_prefix_cache_ttft(
+    preset: str | None = None, dtype: str = "bfloat16",
+    prefix_len: int = 384, suffix_len: int = 16, requests: int = 8,
+    page_size: int = 64, new_tokens: int = 4, iters: int = 2,
+    shared_frac: float = 0.75,
+) -> dict:
+    """Automatic prefix caching (hash-block KV reuse in the paged pool):
+    TTFT on a ``shared_frac`` shared-prefix workload — the chat-traffic
+    shape (system prompts, few-shot templates; production chat traffic
+    shares far more than half its prefix tokens) — with the cache ON vs
+    OFF.  Requests are
+    served one at a time so each TTFT isolates its own admission prefill;
+    with the cache ON, shared-prefix requests prefill only their un-cached
+    suffix (a page-table gather replaces the prefix prefill).  The ratio is
+    a compute effect (prefill tokens skipped), honestly measurable on any
+    platform; prefill-tokens-saved and the cache hit rate come from the
+    batcher's own PrefixCache counters, warm-up excluded.  Per-request
+    TTFTs take the min over ``iters`` passes with a FRESH batcher+cache
+    per pass (so a later pass never turns the unique prompts into hits) —
+    the same host-stall defense as the sibling min-of-2 rows."""
+    import numpy as np
+
+    from distributed_llms_tpu.runtime.batcher import ContinuousBatcher
+
+    preset = preset or ("gpt2-125m" if jax.devices()[0].platform == "cpu"
+                        else "tinyllama-1.1b")
+    cfg, params = _build_params(preset, dtype, None)
+    total = prefix_len + suffix_len + new_tokens
+    max_len = min(-(-total // page_size) * page_size,
+                  cfg.max_seq_len // page_size * page_size)
+    if max_len < total:  # tiny-preset guard: shrink the prefix to fit
+        prefix_len = max_len - suffix_len - new_tokens
+    pool = 3 * (max_len // page_size) + 1
+    rng = np.random.RandomState(0)
+    shared = rng.randint(1, cfg.vocab_size, size=prefix_len).tolist()
+    # Interleave shared and unique requests (no ordering artifact): the
+    # first shared_frac of each position-modulo stripe shares the prefix.
+    n_unique = max(1, round(requests * (1.0 - shared_frac)))
+    stride = requests // n_unique
+    is_shared = [(i % stride) != stride - 1 for i in range(requests)]
+    workload = []
+    for i in range(requests):
+        if is_shared[i]:
+            ids = shared + rng.randint(1, cfg.vocab_size,
+                                       size=suffix_len).tolist()
+        else:
+            ids = rng.randint(1, cfg.vocab_size,
+                              size=prefix_len + suffix_len).tolist()
+        workload.append(ids)
+
+    def run(auto: bool):
+        b = ContinuousBatcher(
+            cfg, params, batch_slots=2, max_len=max_len, chunk_steps=4,
+            paged_pages=pool, page_size=page_size, prefix_cache=auto,
+        )
+        # Warm: two shared-prefix requests compile both admission programs
+        # (full-prompt miss and suffix-continuation hit) and, cache-on,
+        # seed the pages the measured requests will hit.
+        for _ in range(2):
+            b.submit(shared + rng.randint(1, cfg.vocab_size,
+                                          size=suffix_len).tolist(),
+                     max_new_tokens=new_tokens)
+            b.run()
+        # Snapshot after warm-up so the reported savings and hit rate
+        # describe ONLY the measured workload.
+        warm = ((b.prefix_cache.hit_tokens, b.prefix_cache.miss_tokens)
+                if auto else (0, 0))
+        ttfts = []
+        for ids in workload:
+            seen = {}
+
+            def cb(rid, new, done, lps):
+                seen.setdefault("t", time.perf_counter())
+
+            t0 = time.perf_counter()
+            b.submit(ids, max_new_tokens=new_tokens)
+            b.run(on_tokens=cb)
+            ttfts.append(seen["t"] - t0)
+        return ttfts, b, warm
+
+    def measure(auto: bool):
+        best, b, warm = run(auto)
+        for _ in range(iters - 1):
+            ttfts, b, warm = run(auto)
+            best = [min(a, c) for a, c in zip(best, ttfts)]
+        return best, b, warm
+
+    ttfts_off, _b, _w = measure(False)
+    ttfts_on, b_on, (warm_hits, warm_misses) = measure(True)
+    pc = b_on.prefix_cache
+    hits = pc.hit_tokens - warm_hits
+    misses = pc.miss_tokens - warm_misses
+    mean = lambda xs: sum(xs) / len(xs)  # noqa: E731
+    shared_off = [t for t, s in zip(ttfts_off, is_shared) if s]
+    shared_on = [t for t, s in zip(ttfts_on, is_shared) if s]
+    return {
+        "preset": preset,
+        "prefix_len": prefix_len,
+        "suffix_len": suffix_len,
+        "requests": requests,
+        "shared_prefix_frac": round(sum(is_shared) / requests, 3),
+        "page_size": page_size,
+        "platform": jax.devices()[0].platform,
+        "ttft_ms_cache_off": round(mean(ttfts_off) * 1e3, 1),
+        "ttft_ms_cache_on": round(mean(ttfts_on) * 1e3, 1),
+        "ttft_ms_shared_off": round(mean(shared_off) * 1e3, 1),
+        "ttft_ms_shared_on": round(mean(shared_on) * 1e3, 1),
+        "speedup": round(mean(ttfts_off) / mean(ttfts_on), 3),
+        "prefill_tokens_saved": hits,
+        "hit_rate": round(hits / max(hits + misses, 1), 3),
+    }
+
+
 def _measure_prefill_flash(
     preset: str = "tinyllama-1.1b", batch: int = 2, seq: int = 2048,
     dtype: str = "bfloat16", iters: int = 5, window: int | None = None,
@@ -1181,8 +1294,12 @@ def _measure_hop_latency(d_model: int = 4096, batch: int = 8, iters: int = 50) -
     def body(x):
         return jax.lax.ppermute(x, "pipe", perm)
 
+    try:
+        shard_map = jax.shard_map  # jax >= 0.5
+    except AttributeError:  # 0.4.x keeps it in experimental
+        from jax.experimental.shard_map import shard_map
     f = jax.jit(
-        jax.shard_map(body, mesh=mesh, in_specs=P("pipe"), out_specs=P("pipe"))
+        shard_map(body, mesh=mesh, in_specs=P("pipe"), out_specs=P("pipe"))
     )
     dtype = jnp.float32 if devs[0].platform == "cpu" else jnp.bfloat16
     x = jax.device_put(
@@ -1205,6 +1322,48 @@ def _measure_hop_latency(d_model: int = 4096, batch: int = 8, iters: int = 50) -
         "p95_us": round(float(p95) * 1e6, 1),
         "note": "jit dispatch included; one full ring rotation per sample",
     }
+
+
+def _measure_hop_latency_cpu_fallback(n_devices: int = 4) -> dict | None:
+    """Run _measure_hop_latency over an n-device VIRTUAL CPU mesh in a
+    fresh subprocess (XLA parses xla_force_host_platform_device_count once
+    per process, so the already-initialized parent can't grow devices).
+    An upper bound on a real interconnect hop — jit dispatch included —
+    but a recorded number beats prose quoting an artifact-less one."""
+    code = (
+        "import os, json\n"
+        "os.environ['XLA_FLAGS'] = (os.environ.get('XLA_FLAGS', '') +"
+        f" ' --xla_force_host_platform_device_count={n_devices}')\n"
+        "import jax\n"
+        "jax.config.update('jax_platforms', 'cpu')\n"
+        "import bench\n"
+        "print('HOP=' + json.dumps(bench._measure_hop_latency()))\n"
+    )
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c", code], capture_output=True, text=True,
+            timeout=600, cwd=os.path.dirname(os.path.abspath(__file__)),
+        )
+    except subprocess.TimeoutExpired:
+        return None
+    for line in reversed(r.stdout.strip().splitlines()):
+        if line.startswith("HOP="):
+            try:
+                out = json.loads(line[4:])
+            except json.JSONDecodeError:
+                return None
+            if out is not None:
+                import datetime
+
+                date = datetime.datetime.now(
+                    datetime.timezone.utc
+                ).strftime("%Y-%m-%d")
+                out["platform"] = "cpu (fake mesh)"
+                # Self-stamp: the parent's _stamp() reports the PARENT's
+                # platform, which may be a real chip this number never ran on.
+                out["measured_on"] = f"{date} cpu (fake mesh)"
+            return out
+    return None
 
 
 def _stamp() -> str:
@@ -1367,7 +1526,7 @@ def run_ladder(args, degraded: str | None) -> list[dict]:
             "prefill-flash-2048", "prefill-flash-8192",
             "prefill-flash-win-8192", "hop-latency",
             "spec-decode", "spec-decode-7b-int8", "spec-batching",
-            "local-proc-batching", "chunked-prefill",
+            "local-proc-batching", "chunked-prefill", "prefix-cache-ttft",
         }
         unknown = only - known
         if unknown:  # a typo must not masquerade as a clean zero-row run
@@ -1484,6 +1643,11 @@ def run_ladder(args, degraded: str | None) -> list[dict]:
         # interference — a scheduling effect, meaningful on any platform.
         ("chunked-prefill", lambda: _measure_chunked_prefill(
             dtype=dtype, iters=args.iters)),
+        # Automatic prefix caching: TTFT with hash-block KV reuse ON vs OFF
+        # on 75%-shared-prefix traffic (the chat shape) — a prefill-compute
+        # effect, meaningful on any platform.
+        ("prefix-cache-ttft", lambda: _measure_prefix_cache_ttft(
+            dtype=dtype)),
     ]
     if not on_cpu:
         # Paged vs contiguous batching (pool at ~45% of contiguous KV
@@ -1554,17 +1718,34 @@ def run_ladder(args, degraded: str | None) -> list[dict]:
         emit()
     if want("hop-latency"):
         hop = _measure_hop_latency()
+        degraded_hop = degraded
+        if hop is None:
+            # One visible device: measure the CPU fake-mesh upper bound in
+            # a SUBPROCESS (xla_force_host_platform_device_count is frozen
+            # once this process's backend initialized) so the artifact
+            # records a number instead of a skip — BASELINE.md used to
+            # quote this bound from prose the JSON lacked.
+            hop = _measure_hop_latency_cpu_fallback()
+            degraded_hop = ("cpu fake-mesh (virtual devices, jit dispatch "
+                            "included) — upper bound only, not an ICI hop")
         if hop is not None:
-            rows.append({"config": "hop-latency", **hop,
-                         "measured_on": _stamp()})
+            row = {"config": "hop-latency", **hop}
+            # The fallback stamps itself 'cpu (fake mesh)' — the parent's
+            # _stamp() would claim the PARENT's platform (e.g. tpu) for a
+            # number measured on virtual CPU devices.
+            row.setdefault("measured_on", _stamp())
+            if degraded_hop:
+                row["degraded"] = degraded_hop
+            rows.append(row)
             print(f"# hop latency: {hop}", file=sys.stderr)
         else:
-            # SURVEY §6 metric is unmeasurable on one chip — record that
-            # explicitly rather than omitting the row (VERDICT r2 weak 5).
+            # SURVEY §6 metric is unmeasurable on one chip and the CPU
+            # fallback also failed — record that explicitly rather than
+            # omitting the row (VERDICT r2 weak 5).
             rows.append({
                 "config": "hop-latency",
-                "skipped": "needs >1 device; single-chip bench env — CPU "
-                           "fake-mesh upper bound is in BASELINE.md",
+                "skipped": "needs >1 device and the cpu fake-mesh "
+                           "subprocess fallback failed",
             })
     emit()
     return rows

@@ -54,6 +54,10 @@ def build_server(args) -> InferenceServer:
         max_len=args.max_len,
         chunk_steps=args.chunk_steps,
         prefill_chunk=args.prefill_chunk,
+        prefill_concurrency=args.prefill_concurrency,
+        paged_pages=args.paged_pages,
+        page_size=args.page_size,
+        prefix_cache=args.prefix_cache,
     )
     return InferenceServer(
         batcher,
@@ -109,11 +113,33 @@ def main(argv=None) -> None:
                     help="per-row cache length (default: runtime.max_seq_len)")
     ap.add_argument("--chunk-steps", type=int, default=8,
                     help="decode steps per scheduling chunk")
+    ap.add_argument("--paged-pages", type=int, default=None,
+                    help="paged KV: size of the shared page pool (pages); "
+                         "rows allocate only what prompt+budget need and a "
+                         "dry pool back-pressures admission (default: "
+                         "runtime.paged_pages; 0 forces contiguous)")
+    ap.add_argument("--page-size", type=int, default=None,
+                    help="paged KV: tokens per page (default: "
+                         "runtime.page_size)")
+    ap.add_argument("--prefix-cache", action=argparse.BooleanOptionalAction,
+                    default=None,
+                    help="automatic prefix caching over the paged pool: "
+                         "full prompt pages are content-hashed and reused "
+                         "copy-free across requests (refcounted pages, LRU "
+                         "eviction under pool pressure); needs --paged-pages."
+                         "  Per-request opt-out: \"prefix_cache\": false.  "
+                         "(default: runtime.prefix_cache)")
     ap.add_argument("--prefill-chunk", type=int, default=None,
                     help="chunked prefill: admit at most this many prompt "
-                         "tokens per scheduling round, so long prompts "
-                         "never stall in-flight decodes (default: "
-                         "monolithic admission)")
+                         "tokens per scheduling round per pending prefill, "
+                         "so long prompts never stall in-flight decodes "
+                         "(default: monolithic admission)")
+    ap.add_argument("--prefill-concurrency", type=int, default=2,
+                    help="chunked prefills in flight at once — two long "
+                         "prompts interleave their admissions instead of "
+                         "serializing (1 restores the old one-at-a-time "
+                         "limit; per-round prefill work is bounded by "
+                         "prefill-chunk x this)")
     ap.add_argument("--max-pending", type=int, default=256,
                     help="in-flight request cap before 429s")
     ap.add_argument("--drain-timeout", type=float, default=30.0,

@@ -233,6 +233,13 @@ class RuntimeConfig:
     # a dry pool back-pressures admission.  None = contiguous per-slot KV.
     paged_pages: int | None = None
     page_size: int = 64
+    # Automatic prefix caching over the paged pool (runtime/batcher.py
+    # PrefixCache): full prompt pages are content-hashed and shared
+    # copy-free across rows (refcounted; LRU eviction under pool
+    # pressure), so repeated prompt prefixes — system prompts, few-shot
+    # templates, multi-turn history — prefill only their un-cached
+    # suffix.  Requires paged_pages; ignored (with a warning) otherwise.
+    prefix_cache: bool = False
     # Speculative decoding (runtime/speculative.py).  With spec_decode=True
     # on a single-device full-precision engine, generate_text transparently
     # routes greedy requests through the speculative loop (results are

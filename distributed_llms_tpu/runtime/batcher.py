@@ -47,7 +47,8 @@ results.
 
 from __future__ import annotations
 
-from collections import deque
+import hashlib
+from collections import OrderedDict, deque
 from dataclasses import dataclass, field
 from functools import partial
 from typing import Any
@@ -93,11 +94,11 @@ def _replicated(pm, *xs):
 
 
 def _sample_first(logits, last_idx, rng, temperature, top_k, top_p,
-                  temp_req=None, topp_req=None):
+                  temp_req=None, topp_req=None, topk_req=None):
     """Sample the admitted row's first token from the last real position's
     logits — the one sampling tail shared by every admission path.
-    ``temp_req``/``topp_req`` (traced scalars) override the static knobs
-    for per-request sampling without a recompile per value."""
+    ``temp_req``/``topp_req``/``topk_req`` (traced scalars) override the
+    static knobs for per-request sampling without a recompile per value."""
     next_logits = jnp.take_along_axis(
         logits, jnp.maximum(last_idx - 1, 0)[None, None, None], axis=1
     )[:, 0]
@@ -107,6 +108,8 @@ def _sample_first(logits, last_idx, rng, temperature, top_k, top_p,
         tok = sampling.sample_rows(
             rng, next_logits, jnp.reshape(temp_req, (1,)), top_k,
             jnp.reshape(topp_req, (1,)),
+            top_k_rows=(None if topk_req is None
+                        else jnp.reshape(topk_req, (1,))),
         )[0]
     # Chosen-token logprob under the RAW model distribution (the OpenAI
     # logprobs contract) — one [V] log-softmax, trivial next to the
@@ -151,13 +154,13 @@ def _prefill_row_with_prefix(fwd, params, cfg, prefix_k, prefix_v, prefix_len,
 
 def _finish_admission(
     cache, slot, row_cache, logits, last_idx, rng, temperature, top_k, top_p,
-    total_len, temp_req=None, topp_req=None,
+    total_len, temp_req=None, topp_req=None, topk_req=None,
 ):
     """Shared admission tail (plain and prefix-cached paths): sample the
     first token from the last real position's logits, splice the prefilled
     row into the shared cache, report the row's valid slots."""
     tok, lp = _sample_first(logits, last_idx, rng, temperature, top_k, top_p,
-                            temp_req, topp_req)
+                            temp_req, topp_req, topk_req)
     ax = _batch_axis(cache.k.ndim)
 
     def splice(full, row):
@@ -192,6 +195,7 @@ def admit_row(
     pm: Any = None,  # ParallelModel — GSPMD dp/tp mesh batching
     temp_req: jax.Array | None = None,  # traced per-request overrides
     topp_req: jax.Array | None = None,
+    topk_req: jax.Array | None = None,
 ) -> tuple[Any, jax.Array, jax.Array, jax.Array]:
     """Prefill one request into batch row ``slot``.  Returns
     (cache', first_token, row_valid [S], first_token_logprob) —
@@ -204,6 +208,7 @@ def admit_row(
     cache, tok, row_valid, lp = _finish_admission(
         cache, slot, row_cache, logits, plen, rng, temperature, top_k, top_p,
         total_len=plen, temp_req=temp_req, topp_req=topp_req,
+        topk_req=topk_req,
     )
     return (cache, *_replicated(pm, tok, row_valid, lp))
 
@@ -520,6 +525,7 @@ def admit_row_with_prefix(
     pm: Any = None,  # ParallelModel — GSPMD dp/tp mesh batching
     temp_req: jax.Array | None = None,  # traced per-request overrides
     topp_req: jax.Array | None = None,
+    topk_req: jax.Array | None = None,
 ) -> tuple[Any, jax.Array, jax.Array, jax.Array]:
     """Prefix-cached admission: the shared prefix's KV (computed ONCE by
     ``register_prefix``) seeds the row; only the request's suffix prefills —
@@ -531,6 +537,7 @@ def admit_row_with_prefix(
     cache, tok, row_valid, lp = _finish_admission(
         cache, slot, row_cache, logits, clen, rng, temperature, top_k, top_p,
         total_len=prefix_len + clen, temp_req=temp_req, topp_req=topp_req,
+        topk_req=topk_req,
     )
     return (cache, *_replicated(pm, tok, row_valid, lp))
 
@@ -584,6 +591,7 @@ def finish_chunked_admission(
     top_p: float = 1.0,
     temp_req: jax.Array | None = None,
     topp_req: jax.Array | None = None,
+    topk_req: jax.Array | None = None,
 ) -> tuple[Any, jax.Array, jax.Array, jax.Array]:
     """Tail of a chunked admission: sample the first token from the final
     chunk's last-position logits and splice the fully-prefilled transient
@@ -592,7 +600,7 @@ def finish_chunked_admission(
     return _finish_admission(
         cache, slot, KVCache(k=row_k, v=row_v), last_logits[:, None, :],
         jnp.int32(1), rng, temperature, top_k, top_p, total_len,
-        temp_req=temp_req, topp_req=topp_req,
+        temp_req=temp_req, topp_req=topp_req, topk_req=topk_req,
     )
 
 
@@ -606,16 +614,19 @@ def _paged_pool(cfg: ModelConfig, num_pages: int, page_size: int, dtype=None):
 
 
 def _paged_splice(cache, page_list, row_cache, logits, last_idx, rng,
-                  temperature, top_k, top_p, temp_req=None, topp_req=None):
+                  temperature, top_k, top_p, temp_req=None, topp_req=None,
+                  topk_req=None):
     """Admission tail for the paged pool: sample the first token, then
     scatter the contiguous transient row cache into the row's pages.
     ``page_list`` [P] is padded with the reserved scratch page 0 past the
     allocation, so the fixed-shape scatter stays compiled once — the extra
     writes land in the scratch page, whose contents no LIVE row ever reads
     (freed rows' clamped decode reads do touch it, but their outputs are
-    masked to pad)."""
+    masked to pad).  Prefix-cache-hit admissions also route their CACHED
+    positions to the scratch page: the shared pages already hold exactly
+    that KV and must never be rewritten while other rows read them."""
     tok, lp = _sample_first(logits, last_idx, rng, temperature, top_k, top_p,
-                            temp_req, topp_req)
+                            temp_req, topp_req, topk_req)
     p = page_list.shape[0]
     blk = cache.k.shape[2]
 
@@ -648,6 +659,7 @@ def admit_row_paged(
     top_p: float = 1.0,
     temp_req: jax.Array | None = None,  # traced per-request overrides
     topp_req: jax.Array | None = None,
+    topk_req: jax.Array | None = None,
 ) -> tuple[Any, jax.Array, jax.Array]:
     """Paged admission: dense causal prefill on a transient contiguous row
     cache, then scatter its pages into the pool.
@@ -658,7 +670,7 @@ def admit_row_paged(
     )
     return _paged_splice(
         cache, page_list, row_cache, logits, plen, rng, temperature, top_k,
-        top_p, temp_req, topp_req,
+        top_p, temp_req, topp_req, topk_req,
     )
 
 
@@ -683,6 +695,7 @@ def admit_row_with_prefix_paged(
     top_p: float = 1.0,
     temp_req: jax.Array | None = None,  # traced per-request overrides
     topp_req: jax.Array | None = None,
+    topk_req: jax.Array | None = None,
 ) -> tuple[Any, jax.Array, jax.Array]:
     """Prefix-cached paged admission: the prefix KV seeds the transient row
     cache, only the suffix prefills, then the pages scatter into the pool.
@@ -692,7 +705,55 @@ def admit_row_with_prefix_paged(
     )
     return _paged_splice(
         cache, page_list, row_cache, logits, clen, rng, temperature, top_k,
-        top_p, temp_req, topp_req,
+        top_p, temp_req, topp_req, topk_req,
+    )
+
+
+@partial(
+    jax.jit,
+    static_argnames=("cfg", "temperature", "top_k", "top_p"),
+    donate_argnames=("cache",),
+)
+def admit_row_auto_paged(
+    params: Any,
+    cfg: ModelConfig,
+    cache: Any,  # page-pool KVCache, [L, NB, BLK, KVH, HD] leaves
+    read_list: jax.Array,   # [P] int32 — the row's FULL page table (cached
+    #   run first, then freshly allocated pages, scratch-padded)
+    write_list: jax.Array,  # [P] int32 — same, but cached positions routed
+    #   to the scratch page 0 (shared pages are read-only)
+    prefix_len: jax.Array,  # scalar int32 — tokens covered by cached pages
+    chunk: jax.Array,  # [Tc] int32 — the un-cached suffix, right-padded
+    clen: jax.Array,  # scalar int32 true suffix length
+    rng: jax.Array,
+    temperature: float = 0.0,
+    top_k: int = 0,
+    top_p: float = 1.0,
+    temp_req: jax.Array | None = None,  # traced per-request overrides
+    topp_req: jax.Array | None = None,
+    topk_req: jax.Array | None = None,
+) -> tuple[Any, jax.Array, jax.Array]:
+    """AUTOMATIC prefix-cache admission: the row's cached prefix KV is
+    gathered out of its own (shared, refcounted) pool pages into the
+    transient contiguous row cache, only the un-cached suffix runs through
+    the model (the same continuation math as the named-prefix path), and
+    the result scatters back through ``write_list`` — cached positions land
+    in the scratch page, so a shared page is never rewritten.  The gather
+    reads the pool BEFORE the splice updates it, all inside one donated
+    program.  Returns (cache', tok, logprob)."""
+    l, _, blk, kvh, hd = cache.k.shape
+    p = read_list.shape[0]
+
+    def gather(pool):  # [L, NB, BLK, KVH, HD] -> [L, 1, P*BLK, KVH, HD]
+        return pool[:, read_list].reshape(l, 1, p * blk, kvh, hd)
+
+    logits, row_cache = _prefill_row_with_prefix(
+        _fwd(None), params, cfg, gather(cache.k), gather(cache.v),
+        prefix_len, chunk,
+    )
+    return _paged_splice(
+        cache, write_list, row_cache, logits, clen, rng, temperature, top_k,
+        top_p, temp_req, topp_req, topk_req,
     )
 
 
@@ -724,6 +785,7 @@ def decode_chunk(
     tables: jax.Array | None = None,  # [B, P] page table — cache is a pool
     temp_row: jax.Array | None = None,  # [B] traced per-row temperature
     topp_row: jax.Array | None = None,  # [B] traced per-row top-p
+    topk_row: jax.Array | None = None,  # [B] traced per-row top-k
     counts: jax.Array | None = None,  # [B, V] int32 output-token histogram
     pres_row: jax.Array | None = None,  # [B] traced presence penalties
     freq_row: jax.Array | None = None,  # [B] traced frequency penalties
@@ -731,9 +793,9 @@ def decode_chunk(
            jax.Array, jax.Array, jax.Array | None]:
     """K decode steps with per-row positions.  Returns
     (toks [B, K], cache', last_tok', real_lens', valid', active', budget',
-    logprobs [B, K], counts').  ``temp_row``/``topp_row`` switch sampling
-    to the per-row path (sampling.sample_rows) — per-request sampling in
-    one shared batch.  ``counts``+``pres_row``+``freq_row`` engage OpenAI
+    logprobs [B, K], counts').  ``temp_row``/``topp_row``/``topk_row``
+    switch sampling to the per-row path (sampling.sample_rows) —
+    per-request sampling in one shared batch.  ``counts``+``pres_row``+``freq_row`` engage OpenAI
     presence/frequency penalties: logits adjust by
     ``- freq*count - pres*(count > 0)`` per row BEFORE sampling, and the
     histogram tracks every emitted token (rows with zero penalties read
@@ -789,6 +851,7 @@ def decode_chunk(
             tok = sampling.sample_rows(
                 rng_step, sample_from, temp_row, top_k,
                 1.0 if topp_row is None else topp_row,
+                top_k_rows=topk_row,
             )
         if cnts is not None:
             cnts = cnts.at[
@@ -853,8 +916,12 @@ class _Request:
     prefix: str | None = None
     temperature: float | None = None  # None -> the batcher's config
     top_p: float | None = None
+    top_k: int | None = None
     presence_penalty: float = 0.0   # OpenAI-style, applied to output tokens
     frequency_penalty: float = 0.0
+    prefix_cache: bool = True  # per-request opt-out of AUTOMATIC caching
+    digests: list | None = None  # memoized page digests — a back-pressured
+    #   request retries admission every round; its prompt hash never changes
 
 
 @dataclass
@@ -862,6 +929,88 @@ class _Prefix:
     ids: list[int]
     k: Any  # [..., 1, S, KVH, HD] single-row KV holding the prefix
     v: Any
+
+
+class PrefixCache:
+    """Content-addressed index of pool pages for AUTOMATIC prefix caching
+    (vLLM/SGLang-style): every FULL page of an admitted prompt is keyed by
+    a chained content digest (a page's digest commits to every token before
+    it, so equal digests mean equal full prefixes), and later admissions
+    reuse the longest cached page-run copy-free through their page tables.
+
+    Ownership model: refcounts live with the batcher's pool allocator; this
+    class only maps digests <-> pages and keeps the LRU of UNREFERENCED
+    pages whose cached content is still resident — those are reclaimable
+    (evicted oldest-first under pool pressure) but serve hits until then.
+    Stats are cumulative per batcher and mirrored into the process-wide
+    METRICS registry (gateway /metrics)."""
+
+    def __init__(self) -> None:
+        self.by_hash: dict[bytes, int] = {}
+        self.page_hash: dict[int, bytes] = {}
+        self.lru: OrderedDict[int, None] = OrderedDict()  # oldest first
+        self.hit_tokens = 0
+        self.miss_tokens = 0
+        self.lookups = 0
+        self.hits = 0
+        self.evictions = 0
+
+    @staticmethod
+    def page_digests(ids: list[int], page_size: int, n_pages: int) -> list[bytes]:
+        """Chained blake2b digests of the first ``n_pages`` full pages:
+        digest_i = H(digest_{i-1} || tokens of page i)."""
+        digests: list[bytes] = []
+        prev = b"dlt-prefix-cache-v1"
+        for i in range(n_pages):
+            h = hashlib.blake2b(prev, digest_size=16)
+            h.update(np.asarray(
+                ids[i * page_size: (i + 1) * page_size], np.int64
+            ).tobytes())
+            prev = h.digest()
+            digests.append(prev)
+        return digests
+
+    def match(self, digests: list[bytes]) -> list[int]:
+        """Pages of the longest cached run from the start (maybe empty)."""
+        pages: list[int] = []
+        for d in digests:
+            p = self.by_hash.get(d)
+            if p is None:
+                break
+            pages.append(p)
+        return pages
+
+    def register(self, page: int, digest: bytes) -> None:
+        """Publish ``page`` as the holder of ``digest``.  First writer wins:
+        if another page already holds this content, the new page stays
+        private (it frees normally when its row releases it)."""
+        if digest not in self.by_hash:
+            self.by_hash[digest] = page
+            self.page_hash[page] = digest
+
+    def forget(self, page: int) -> None:
+        """Drop a page's cache entry (eviction): its content is no longer
+        addressable and the page returns to plain-allocator life."""
+        d = self.page_hash.pop(page, None)
+        if d is not None:
+            self.by_hash.pop(d, None)
+        self.lru.pop(page, None)
+
+    def record_lookup(self, hit_tokens: int, miss_tokens: int) -> None:
+        self.lookups += 1
+        self.hits += hit_tokens > 0
+        self.hit_tokens += hit_tokens
+        self.miss_tokens += miss_tokens
+        METRICS.inc("batcher.prefix_cache.lookups")
+        if hit_tokens > 0:
+            METRICS.inc("batcher.prefix_cache.hits")
+        METRICS.inc("batcher.prefix_cache.hit_tokens", hit_tokens)
+        METRICS.inc("batcher.prefix_cache.miss_tokens", miss_tokens)
+        total = self.hit_tokens + self.miss_tokens
+        if total:
+            METRICS.set_gauge(
+                "batcher.prefix_cache.hit_rate", self.hit_tokens / total
+            )
 
 
 @dataclass
@@ -933,6 +1082,16 @@ class ContinuousBatcher:
         #   the pool can be far smaller than batch_slots * max_len; a full
         #   pool back-pressures admission instead of OOMing.
         page_size: int = 64,
+        # Automatic prefix caching (paged mode only): every full page of an
+        # admitted prompt is content-hashed into a PrefixCache; later
+        # requests reuse the longest cached page-run COPY-FREE through
+        # their page tables (pages are refcounted; unreferenced cached
+        # pages persist in an LRU and are evicted only under pool
+        # pressure), so only the un-cached suffix prefills.  Transparent:
+        # no register_prefix call needed; per-request opt-out via
+        # submit(prefix_cache=False).  Tokens at temperature 0 stay
+        # identical to solo decodes (tests/runtime/test_prefix_cache.py).
+        prefix_cache: bool = False,
         # Speculative batching: every scheduling round drafts spec_k
         # tokens per row with the draft model and verifies them in ONE
         # target forward.  temperature == 0: tokens stay bit-identical to
@@ -944,15 +1103,21 @@ class ContinuousBatcher:
         draft_cfg: ModelConfig | None = None,
         spec_k: int = 4,
         # Chunked prefill: admission consumes at most this many prompt
-        # tokens per scheduling round (one pending row per round), so a
-        # long prompt never stalls in-flight decodes for its whole prefill
-        # — the serving-QoS lever for mixed long/short traffic.  None =
+        # tokens per scheduling round PER PENDING PREFILL (up to
+        # ``prefill_concurrency`` advance concurrently), so a long prompt
+        # never stalls in-flight decodes for its whole prefill — the
+        # serving-QoS lever for mixed long/short traffic.  None =
         # monolithic admission.  Results stay token-identical (the chunk
         # steps are the prefix-continuation math against the row's own
         # partial prompt; logprob values agree to float drift — the same
         # attention reduced in different shapes).  Single-device
         # contiguous plain mode.
         prefill_chunk: int | None = None,
+        # How many chunked prefills may be in flight at once: two long
+        # prompts interleave their admission chunks instead of serializing
+        # head-of-line (strict FIFO still gates STARTING one — the queue
+        # front waits for a free prefill slot, never jumps it).
+        prefill_concurrency: int = 2,
     ) -> None:
         if max_len > cfg.max_seq_len:
             raise ValueError(
@@ -1024,7 +1189,21 @@ class ContinuousBatcher:
                     "batcher mode for now (no mesh, no paged KV, no "
                     "speculative draft)"
                 )
+        if prefill_concurrency < 1:
+            # Validated regardless of prefill_chunk: a bad value must not
+            # pass construction just because chunking happens to be off.
+            raise ValueError(
+                f"prefill_concurrency must be >= 1, got "
+                f"{prefill_concurrency}"
+            )
+        if prefix_cache and paged_pages is None:
+            raise ValueError(
+                "automatic prefix caching runs over the paged KV pool; "
+                "pass paged_pages (or use register_prefix for the "
+                "contiguous named-prefix path)"
+            )
         self.prefill_chunk = prefill_chunk
+        self.prefill_concurrency = prefill_concurrency
         self._prefills: dict[int, _PendingPrefill] = {}  # slot -> pending
         self.draft_params = draft_params
         self.draft_cfg = draft_cfg
@@ -1108,12 +1287,18 @@ class ContinuousBatcher:
             )
         self.page_size = page_size
         self.paged = paged_pages is not None
+        self.prefix_cache: PrefixCache | None = None
         if self.paged:
             self.pages_per_row = max_len // page_size
             # Page 0 is the permanent scratch page: fixed-shape admissions
             # pad their page lists with it, and no row ever reads it.
             self.free_pages = list(range(1, paged_pages))
+            # Refcounts of allocated pages (prefix-cache hits share pages
+            # across rows; a page returns to free/LRU only at refcount 0).
+            self.page_refs: dict[int, int] = {}
             self.tables = np.zeros((batch_slots, self.pages_per_row), np.int32)
+            if prefix_cache:
+                self.prefix_cache = PrefixCache()
         # Scheduling state lives as HOST numpy mirrors: every process holds
         # the same values (the jitted chunk fns return them constrained
         # replicated, and np.asarray of a replicated output is legal on all
@@ -1135,6 +1320,7 @@ class ContinuousBatcher:
         # the traced per-row sampling path only while such a row is live.
         self.temp_row = np.full((batch_slots,), temperature, np.float32)
         self.topp_row = np.full((batch_slots,), top_p, np.float32)
+        self.topk_row = np.full((batch_slots,), top_k, np.int32)
         self.pres_row = np.zeros((batch_slots,), np.float32)
         self.freq_row = np.zeros((batch_slots,), np.float32)
         # Output-token histogram [B, V], allocated on the first penalized
@@ -1147,6 +1333,11 @@ class ContinuousBatcher:
         # Per-token logprobs of each finished request; same lifecycle as
         # ``results`` (speculative mode gathers them from verify logits).
         self.result_logprobs: dict[int, list[float]] = {}
+        # Prompt tokens served from the automatic prefix cache, per rid —
+        # set at admission, read by serving front-ends for usage reporting
+        # (OpenAI prompt_tokens_details.cached_tokens); same lifecycle as
+        # ``results``.
+        self.prefix_cached_tokens: dict[int, int] = {}
         self.prefixes: dict[str, _Prefix] = {}
         self._rng = jax.random.key(seed)
         self._next_rid = 0
@@ -1182,6 +1373,59 @@ class ContinuousBatcher:
         )
         self.prefixes[name] = _Prefix(ids, jax.block_until_ready(row_cache.k), row_cache.v)
 
+    # -- paged pool allocator (refcounted; automatic prefix cache) ---------
+
+    def _pages_available(self) -> int:
+        """Pages an admission could obtain: the free list plus every
+        LRU-parked cached page (reclaimable under pressure)."""
+        pc = self.prefix_cache
+        return len(self.free_pages) + (len(pc.lru) if pc else 0)
+
+    def _alloc_pages(self, n: int) -> list[int]:
+        """Allocate ``n`` pages at refcount 1, evicting LRU-cold cached
+        pages when the free list runs dry (the caller checked
+        ``_pages_available`` first)."""
+        pc = self.prefix_cache
+        out: list[int] = []
+        for _ in range(n):
+            if self.free_pages:
+                p = self.free_pages.pop()
+            else:
+                p, _ = pc.lru.popitem(last=False)  # the coldest entry
+                pc.forget(p)
+                pc.evictions += 1
+                METRICS.inc("batcher.prefix_cache.evicted_pages")
+            self.page_refs[p] = 1
+            out.append(p)
+        return out
+
+    def _retain_page(self, p: int) -> None:
+        """Take a reference on a cached page (a prefix-cache hit): pages
+        referenced by live rows bump their refcount; LRU-parked ones come
+        back referenced (their content stays addressable)."""
+        if p in self.page_refs:
+            self.page_refs[p] += 1
+        else:
+            del self.prefix_cache.lru[p]
+            self.page_refs[p] = 1
+
+    def _release_pages(self, pages: list[int]) -> None:
+        """Drop one reference per page.  At refcount 0 a content-cached
+        page parks at the LRU's most-recently-used end — still serving
+        hits until pool pressure reclaims it — while an uncached page
+        returns straight to the free list."""
+        pc = self.prefix_cache
+        for p in pages:
+            left = self.page_refs[p] - 1
+            if left:
+                self.page_refs[p] = left
+                continue
+            del self.page_refs[p]
+            if pc is not None and p in pc.page_hash:
+                pc.lru[p] = None
+            else:
+                self.free_pages.append(p)
+
     # -- submission --------------------------------------------------------
 
     @property
@@ -1197,16 +1441,19 @@ class ContinuousBatcher:
     def submit(
         self, prompt: str | list[int], max_new_tokens: int = 32,
         prefix: str | None = None, temperature: float | None = None,
-        top_p: float | None = None, presence_penalty: float = 0.0,
-        frequency_penalty: float = 0.0,
+        top_p: float | None = None, top_k: int | None = None,
+        presence_penalty: float = 0.0,
+        frequency_penalty: float = 0.0, prefix_cache: bool = True,
     ) -> int:
-        """Queue a request.  ``temperature``/``top_p`` override the
-        batcher's sampling config FOR THIS REQUEST (serving front-ends:
-        per-request sampling in a shared batch); ``top_k`` stays
-        batcher-wide (static under jit).  None keeps the config value.
-        ``presence_penalty``/``frequency_penalty`` (OpenAI semantics,
-        [-2, 2]) adjust logits against this request's own output tokens
-        before sampling."""
+        """Queue a request.  ``temperature``/``top_p``/``top_k`` override
+        the batcher's sampling config FOR THIS REQUEST (serving
+        front-ends: per-request sampling in a shared batch; per-row top_k
+        rides a traced mask, no recompile per value).  None keeps the
+        config value.  ``presence_penalty``/``frequency_penalty`` (OpenAI
+        semantics, [-2, 2]) adjust logits against this request's own
+        output tokens before sampling.  ``prefix_cache=False`` opts this
+        request out of AUTOMATIC prefix caching (its prompt is neither
+        matched against nor published into the shared page cache)."""
         ids = (
             self.tokenizer.encode(prompt)
             if isinstance(prompt, str)
@@ -1238,6 +1485,33 @@ class ContinuousBatcher:
                 f"({self.sampling['top_p']}); per-request overrides are "
                 "not supported"
             )
+        if top_k is not None:
+            # Upper bound: the per-row override rides an int32 traced
+            # scalar — an unbounded Python int would overflow jnp.int32 at
+            # admission and crash the engine thread instead of 400-ing.
+            if isinstance(top_k, bool) or not isinstance(top_k, int) \
+                    or not 0 <= top_k <= 2**31 - 1:
+                raise ValueError(
+                    f"top_k must be an int in [0, 2**31), got {top_k!r}"
+                )
+            if self.speculative and top_k != self.sampling["top_k"]:
+                raise ValueError(
+                    "speculative batching samples with the engine-wide "
+                    f"top_k ({self.sampling['top_k']}); per-request "
+                    "overrides are not supported"
+                )
+            eff_t = (self.sampling["temperature"] if temperature is None
+                     else temperature)
+            if eff_t == 0.0:
+                # A greedy row takes the argmax regardless of top_k;
+                # dropping the no-op override keeps the static decode
+                # program (the traced per-row mask pays a per-step [B, V]
+                # sort for output that cannot change).
+                top_k = None
+        if not isinstance(prefix_cache, bool):
+            raise ValueError(
+                f"prefix_cache must be a bool, got {prefix_cache!r}"
+            )
         for name, pen in (("presence_penalty", presence_penalty),
                           ("frequency_penalty", frequency_penalty)):
             if not -2.0 <= pen <= 2.0:  # also rejects NaN/inf
@@ -1260,9 +1534,10 @@ class ContinuousBatcher:
         self._next_rid += 1
         self.queue.append(_Request(
             rid, ids, max_new_tokens, prefix=prefix,
-            temperature=temperature, top_p=top_p,
+            temperature=temperature, top_p=top_p, top_k=top_k,
             presence_penalty=float(presence_penalty),
             frequency_penalty=float(frequency_penalty),
+            prefix_cache=prefix_cache,
         ))
         return rid
 
@@ -1301,7 +1576,7 @@ class ContinuousBatcher:
                 self.results[rid] = row.emitted
                 self.result_logprobs[rid] = row.lps
                 if row.pages:
-                    self.free_pages.extend(row.pages)
+                    self._release_pages(row.pages)
                     self.tables[i] = 0
                 # A chunked prefill in flight just drops its transient row
                 # cache — nothing was spliced into the shared cache yet.
@@ -1320,10 +1595,13 @@ class ContinuousBatcher:
         return sub
 
     def _admit_pending(self) -> None:
-        # Advance at most ONE pending chunked prefill per round — the
-        # round's prefill budget; decode rounds interleave between chunks.
-        if self._prefills:
-            self._advance_chunk(next(iter(self._prefills)))
+        # Advance every pending chunked prefill one chunk per round — up to
+        # prefill_concurrency in flight, so the round's prefill work is at
+        # most prefill_concurrency * prefill_chunk tokens (interleaved long
+        # prompts trade per-round decode latency for admission
+        # parallelism); decode rounds interleave between chunks.
+        for slot in list(self._prefills):
+            self._advance_chunk(slot)
         active_host = self.active
         for i in range(self.b):
             if not self.queue:
@@ -1337,25 +1615,59 @@ class ContinuousBatcher:
             total_len = pfx_len + len(req.ids)
             if (self.prefill_chunk is not None
                     and len(req.ids) > self.prefill_chunk):
-                if self._prefills:
-                    # One chunked prefill at a time (strict per-round
-                    # budget) and strict FIFO: requeue, stop admitting.
+                if len(self._prefills) >= self.prefill_concurrency:
+                    # Prefill slots full, and strict FIFO: requeue, stop
+                    # admitting (the queue front never gets jumped).
                     self.queue.appendleft(req)
                     return
                 self._start_chunked(i, req, pfx)
                 continue
             pages: list[int] = []
+            cached_pages: list[int] = []
+            cached_len = 0
+            digests: list[bytes] = []
             if self.paged:
                 # Allocate only the pages prompt+budget need; a dry pool
                 # back-pressures the queue (FIFO: put the request back and
-                # stop admitting) instead of overcommitting.
-                n_pages = -(-(total_len + req.max_new_tokens) // self.page_size)
-                if len(self.free_pages) < n_pages:
+                # stop admitting) instead of overcommitting.  With the
+                # automatic prefix cache, LRU-cold cached pages count as
+                # allocatable (eviction inside _alloc_pages) — pressure
+                # evicts cold cache entries before queueing admissions.
+                blk = self.page_size
+                n_pages = -(-(total_len + req.max_new_tokens) // blk)
+                pc = self.prefix_cache
+                auto = pc is not None and pfx is None and req.prefix_cache
+                if auto:
+                    # Hash every FULL prompt page (chained digests,
+                    # memoized on the request — a back-pressured admission
+                    # retries every round and must not rehash a long
+                    # prompt each time); hits are capped one page short of
+                    # the whole prompt so at least one real suffix token
+                    # always prefills (the admission samples the first
+                    # token from its logits).
+                    if req.digests is None:
+                        req.digests = PrefixCache.page_digests(
+                            req.ids, blk, len(req.ids) // blk
+                        )
+                    digests = req.digests
+                    cached_pages = pc.match(
+                        digests[: (len(req.ids) - 1) // blk]
+                    )
+                    cached_len = len(cached_pages) * blk
+                    # Retain hits BEFORE allocating: eviction must never
+                    # reclaim the very run we just matched.
+                    for p in cached_pages:
+                        self._retain_page(p)
+                if self._pages_available() < n_pages - len(cached_pages):
+                    self._release_pages(cached_pages)
                     self.queue.appendleft(req)
                     return
-                pages = [self.free_pages.pop() for _ in range(n_pages)]
+                if auto:
+                    pc.record_lookup(cached_len, total_len - cached_len)
+                pages = self._alloc_pages(n_pages - len(cached_pages))
                 page_list = np.zeros((self.pages_per_row,), np.int32)
-                page_list[: n_pages] = pages  # scratch-page padded
+                page_list[: len(cached_pages)] = cached_pages
+                page_list[len(cached_pages): n_pages] = pages  # + scratch pad
                 self.tables[i] = page_list
             # Bucket for compile reuse, but never past what fits after the
             # prefix: forward's contract is cache_index + T <= max_len, and
@@ -1371,18 +1683,42 @@ class ContinuousBatcher:
                      else float(req.temperature))
             req_p = (self.sampling["top_p"] if req.top_p is None
                      else float(req.top_p))
+            req_k = (self.sampling["top_k"] if req.top_k is None
+                     else int(req.top_k))
             custom = (req_t != self.sampling["temperature"]
-                      or req_p != self.sampling["top_p"])
+                      or req_p != self.sampling["top_p"]
+                      or req_k != self.sampling["top_k"])
             extra = (
                 dict(temp_req=jnp.float32(req_t), topp_req=jnp.float32(req_p))
                 if custom else {}
             )
+            if custom and req_k != self.sampling["top_k"]:
+                extra["topk_req"] = jnp.int32(req_k)
             if self.paged and pfx is not None:
                 self.cache, tok, lp = admit_row_with_prefix_paged(
                     self.params, self.cfg, self.cache, jnp.asarray(page_list),
                     pfx.k, pfx.v, jnp.int32(pfx_len),
                     jnp.asarray(prompt), jnp.int32(len(req.ids)),
                     self._split_rng(), **self.sampling, **extra,
+                )
+                row_valid = np.arange(self.s) < total_len
+            elif self.paged and cached_len:
+                # Prefix-cache HIT: the cached run seeds the row through a
+                # pool gather; only the suffix prefills.  Writes for the
+                # cached positions are routed to the scratch page — shared
+                # pages are read-only while any row references them.
+                write_list = page_list.copy()
+                write_list[: len(cached_pages)] = 0
+                suffix = req.ids[cached_len:]
+                tc = min(_bucket(len(suffix)), self.s - cached_len)
+                chunk = np.full((tc,), self.pad_id, np.int32)
+                chunk[: len(suffix)] = suffix
+                self.cache, tok, lp = admit_row_auto_paged(
+                    self.params, self.cfg, self.cache,
+                    jnp.asarray(page_list), jnp.asarray(write_list),
+                    jnp.int32(cached_len), jnp.asarray(chunk),
+                    jnp.int32(len(suffix)), self._split_rng(),
+                    **self.sampling, **extra,
                 )
                 row_valid = np.arange(self.s) < total_len
             elif self.paged:
@@ -1405,6 +1741,14 @@ class ContinuousBatcher:
                     jnp.asarray(prompt), jnp.int32(len(req.ids)),
                     self._split_rng(), pm=self.pm, **self.sampling, **extra,
                 )
+            if digests:
+                # Publish the row's full prompt pages (first writer wins;
+                # a digest another page already holds leaves ours private).
+                # Pages inside the cached run are already published; the
+                # fresh ones now hold exactly the hashed content — the
+                # admission scatter just wrote it.
+                for j in range(len(cached_pages), len(digests)):
+                    self.prefix_cache.register(int(page_list[j]), digests[j])
             if self.speculative:
                 # Seed the DRAFT cache for this row: full prompt (prefix
                 # caching stores only target KV, so the draft prefills
@@ -1419,10 +1763,11 @@ class ContinuousBatcher:
                     jnp.int32(len(full_ids)),
                 )
             self._activate_row(i, req, tok, lp, row_valid, total_len,
-                               req_t, req_p, pages)
+                               req_t, req_p, cached_pages + pages,
+                               req_k=req_k, cached_len=cached_len)
 
     def _activate_row(self, i, req, tok, lp, row_valid, total_len,
-                      req_t, req_p, pages):
+                      req_t, req_p, pages, req_k=None, cached_len=0):
         """Host bookkeeping tail of EVERY admission (monolithic and
         chunked): record the sampled first token, arm the row's scheduling
         state, stream the token."""
@@ -1430,8 +1775,12 @@ class ContinuousBatcher:
         self.last_tok[i] = tok
         self.temp_row[i] = req_t
         self.topp_row[i] = req_p
+        self.topk_row[i] = (self.sampling["top_k"] if req_k is None
+                            else req_k)
         self.pres_row[i] = req.presence_penalty
         self.freq_row[i] = req.frequency_penalty
+        if self.prefix_cache is not None:
+            self.prefix_cached_tokens[req.rid] = cached_len
         if req.presence_penalty or req.frequency_penalty:
             if self.tok_counts is None:
                 self.tok_counts = jnp.zeros(
@@ -1509,12 +1858,17 @@ class ContinuousBatcher:
                  else float(req.temperature))
         req_p = (self.sampling["top_p"] if req.top_p is None
                  else float(req.top_p))
+        req_k = (self.sampling["top_k"] if req.top_k is None
+                 else int(req.top_k))
         custom = (req_t != self.sampling["temperature"]
-                  or req_p != self.sampling["top_p"])
+                  or req_p != self.sampling["top_p"]
+                  or req_k != self.sampling["top_k"])
         extra = (
             dict(temp_req=jnp.float32(req_t), topp_req=jnp.float32(req_p))
             if custom else {}
         )
+        if custom and req_k != self.sampling["top_k"]:
+            extra["topk_req"] = jnp.int32(req_k)
         self.cache, tok, row_valid, lp = finish_chunked_admission(
             self.cfg, self.cache, jnp.int32(i), pp.row_k, pp.row_v,
             pp.last_logits, jnp.int32(pp.total_len), self._split_rng(),
@@ -1522,7 +1876,7 @@ class ContinuousBatcher:
         )
         del self._prefills[i]
         self._activate_row(i, req, tok, lp, row_valid, pp.total_len,
-                           req_t, req_p, pages=[])
+                           req_t, req_p, pages=[], req_k=req_k)
 
     def _collect(
         self, toks: np.ndarray, was_active: np.ndarray,
@@ -1561,8 +1915,8 @@ class ContinuousBatcher:
                 self.results[row.rid] = row.emitted
                 self.result_logprobs[row.rid] = row.lps
                 rid, final = row.rid, row.emitted[row.streamed:]
-                if row.pages:  # paged: return the row's pool pages
-                    self.free_pages.extend(row.pages)
+                if row.pages:  # paged: drop the row's page references
+                    self._release_pages(row.pages)
                     self.tables[i] = 0
                 final_lps = row.lps[row.streamed:]
                 self.rows[i] = _RowState()
@@ -1653,6 +2007,7 @@ class ContinuousBatcher:
                 rows_live = self.active & (
                     (self.temp_row != self.sampling["temperature"])
                     | (self.topp_row != self.sampling["top_p"])
+                    | (self.topk_row != self.sampling["top_k"])
                 )
                 per_row = {}
                 if bool(rows_live.any()):
@@ -1662,6 +2017,14 @@ class ContinuousBatcher:
                         # softmax+cumsum mask entirely (sample_rows takes
                         # the static keep-everything path).
                         per_row["topp_row"] = jnp.asarray(self.topp_row)
+                    if not bool((
+                        self.topk_row[self.active] == self.sampling["top_k"]
+                    ).all()):
+                        # Engaged only while a row's top_k diverges from
+                        # the engine-wide static value — the traced mask
+                        # pays a per-step [B, V] sort the static path
+                        # doesn't.
+                        per_row["topk_row"] = jnp.asarray(self.topk_row)
                 pen_live = self.active & (
                     (self.pres_row != 0.0) | (self.freq_row != 0.0)
                 )

@@ -434,11 +434,18 @@ class InferenceEngine:
         self, batch_slots: int = 8, max_len: int | None = None,
         chunk_steps: int = 8, paged_pages: int | None = None,
         page_size: int | None = None,
+        prefix_cache: bool | None = None,  # None -> rt.prefix_cache;
+        #   automatic hash-block KV reuse over the paged pool (needs paged
+        #   mode — a config-inherited flag degrades with a warning where
+        #   paged itself does)
         speculative: bool | None = None,  # None -> rt.spec_decode; needs an
         #   attached draft + greedy + single-device contiguous mode
         prefill_chunk: int | None = None,  # chunked prefill: admit at most
-        #   this many prompt tokens per scheduling round (single-device
-        #   contiguous plain mode; see ContinuousBatcher)
+        #   this many prompt tokens per scheduling round PER PENDING
+        #   prefill (single-device contiguous plain mode; see
+        #   ContinuousBatcher)
+        prefill_concurrency: int = 2,  # chunked prefills in flight at once
+        #   (1 restores the old one-at-a-time head-of-line behavior)
     ):
         """A ContinuousBatcher over this engine's model: requests admit into
         an in-flight decode batch as rows free up (runtime/batcher.py) —
@@ -467,6 +474,9 @@ class InferenceEngine:
             paged_pages = None
         if page_size is None:
             page_size = self.rt.page_size
+        explicit_cache = prefix_cache is not None
+        if prefix_cache is None:
+            prefix_cache = self.rt.prefix_cache
         if paged_pages is not None and self.parallel is not None:
             if explicit:
                 raise ValueError(
@@ -482,6 +492,20 @@ class InferenceEngine:
                 paged_pages,
             )
             paged_pages = None
+        if prefix_cache and paged_pages is None:
+            if explicit_cache:
+                raise ValueError(
+                    "automatic prefix caching needs the paged KV pool; "
+                    "pass paged_pages (or set runtime.paged_pages)"
+                )
+            # Config-inherited flag on an engine that serves contiguous
+            # (e.g. a mesh worker sharing a paged cluster config): degrade
+            # instead of erroring, like paged itself does above.
+            log.warning(
+                "runtime.prefix_cache ignored: this engine serves "
+                "contiguous KV (no paged pool to cache pages in)"
+            )
+            prefix_cache = False
         if self.parallel is not None:
             # The shared cache shards its batch over 'data'; round the slot
             # count up so every mesh shape serves (extra slots are harmless
@@ -526,7 +550,9 @@ class InferenceEngine:
             kv_dtype=self.rt.kv_cache_dtype,
             parallel=self.parallel,
             paged_pages=paged_pages, page_size=page_size,
+            prefix_cache=bool(prefix_cache),
             prefill_chunk=prefill_chunk,
+            prefill_concurrency=prefill_concurrency,
         )
 
     # -- speculative decoding (runtime/speculative.py): greedy-exact at

@@ -71,6 +71,21 @@ def sample(
     ).astype(jnp.int32)
 
 
+def _mask_top_k_rows(logits: jax.Array, k: jax.Array) -> jax.Array:
+    """Top-k mask with a TRACED per-row ``k`` [B] (rows with k <= 0 keep the
+    full vocabulary).  The cutoff equals :func:`_mask_top_k`'s for a uniform
+    batch — same kept set, ties included — so a batch whose rows all carry
+    the engine-wide k draws identically to the static path."""
+    k = jnp.asarray(k, jnp.int32)[:, None]
+    sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
+    ranks = jnp.arange(logits.shape[-1], dtype=jnp.int32)[None, :]
+    keep_sorted = ranks < jnp.maximum(k, 1)
+    cutoff = jnp.min(
+        jnp.where(keep_sorted, sorted_logits, jnp.inf), axis=-1, keepdims=True
+    )
+    return jnp.where((k > 0) & (logits < cutoff), -jnp.inf, logits)
+
+
 def _mask_top_p_rows(logits: jax.Array, p: jax.Array) -> jax.Array:
     """Top-p mask with a TRACED per-row ``p`` [B] (same math as
     :func:`_mask_top_p`, which specializes on a static scalar)."""
@@ -91,19 +106,25 @@ def sample_rows(
     temperature: jax.Array,  # [B] — 0 means greedy for that row
     top_k: int = 0,
     top_p: jax.Array | float = 1.0,  # [B] or scalar, traced
+    top_k_rows: jax.Array | None = None,  # [B] int32 traced — overrides the
+    #   static ``top_k`` when given (per-request top_k in a shared batch)
 ) -> jax.Array:
-    """Per-row sampling: each batch row draws with its OWN temperature and
-    top-p — continuous-batching serving mixes per-request sampling configs
-    in one decode step without recompiling (the knobs are traced inputs,
-    not static).  ``top_k`` stays static and shared: ``lax.top_k`` needs a
-    compile-time k.  Rows with temperature == 0 take the greedy token
-    (identical to :func:`greedy`); the warp order matches :func:`sample`,
-    so a uniform batch draws the same tokens as the static path under the
-    same rng."""
+    """Per-row sampling: each batch row draws with its OWN temperature,
+    top-p, and (via ``top_k_rows``) top-k — continuous-batching serving
+    mixes per-request sampling configs in one decode step without
+    recompiling (the knobs are traced inputs, not static).  Without
+    ``top_k_rows`` the static ``top_k`` applies batch-wide (``lax.top_k``
+    needs a compile-time k; the traced variant pays a full [B, V] sort).
+    Rows with temperature == 0 take the greedy token (identical to
+    :func:`greedy`); the warp order matches :func:`sample`, so a uniform
+    batch draws the same tokens as the static path under the same rng."""
     temperature = jnp.asarray(temperature, logits.dtype)
     safe_t = jnp.where(temperature > 0, temperature, 1.0)[:, None]
     warped = logits / safe_t
-    warped = _mask_top_k(warped, top_k)
+    if top_k_rows is not None:
+        warped = _mask_top_k_rows(warped, top_k_rows)
+    else:
+        warped = _mask_top_k(warped, top_k)
     if not (isinstance(top_p, (int, float)) and float(top_p) >= 1.0):
         # Static keep-everything fast path: the [B, V] sort+softmax+cumsum
         # is pure waste when no row asked for top-p.

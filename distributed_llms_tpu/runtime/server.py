@@ -57,15 +57,19 @@ class _Mailbox:
     call_soon_threadsafe, drained by the owning handler coroutine.
     ``finished`` flips once generation concluded (done seen / stop acked)
     so the disconnect path knows whether a cancel flag is still needed.
-    ``t0``/``first_seen`` drive the TTFT histogram (first delivery)."""
+    ``t0``/``first_seen`` drive the TTFT histogram (first delivery).
+    ``cached_tokens`` is filled by the engine thread on first delivery
+    (prompt tokens served from the automatic prefix cache — surfaced as
+    usage.prompt_tokens_details); read loop-side only after done."""
 
-    __slots__ = ("queue", "finished", "t0", "first_seen")
+    __slots__ = ("queue", "finished", "t0", "first_seen", "cached_tokens")
 
     def __init__(self) -> None:
         self.queue: asyncio.Queue = asyncio.Queue()
         self.finished = False
         self.t0 = time.perf_counter()
         self.first_seen = False
+        self.cached_tokens: int | None = None
 
 
 class BadRequest(ValueError):
@@ -220,11 +224,20 @@ class InferenceServer:
             # them so a long-lived server's memory stays flat.
             self.batcher.results.clear()
             self.batcher.result_logprobs.clear()
+            self.batcher.prefix_cached_tokens.clear()
 
     def _deliver(self, rid: int, toks: list[int], done: bool,
                  lps: list[float] | None = None) -> None:
         # Engine thread, between device chunks: the one safe point to act
         # on loop-side cancel flags.
+        mbox = self._requests.get(rid)
+        if mbox is not None and mbox.cached_tokens is None:
+            # Prefix-cache usage accounting: the batcher recorded the rid's
+            # cached prompt tokens at admission (before any delivery); this
+            # thread owns the batcher, so the read is race-free.  A plain
+            # int attribute write is GIL-atomic; the loop reads it only
+            # after the done delivery it is ordered before.
+            mbox.cached_tokens = self.batcher.prefix_cached_tokens.get(rid, 0)
         if rid in self._cancelled:
             self._cancelled.discard(rid)
             if not done:
@@ -368,10 +381,11 @@ class InferenceServer:
         raise BadRequest("'prompt' must be a non-empty string or token-id list")
 
     def _parse_sampling(self, req: dict):
-        """Per-request temperature/top_p ride the batcher's per-row
-        sampling path; presence/frequency penalties adjust against the
-        request's own output histogram; top_k stays engine-wide (static
-        under jit).  Returns (temperature, top_p, presence, frequency)."""
+        """Per-request temperature/top_p/top_k ride the batcher's per-row
+        sampling path (top_k via a traced per-row mask — no recompile per
+        value); presence/frequency penalties adjust against the request's
+        own output histogram.
+        Returns (temperature, top_p, top_k, presence, frequency)."""
         import math
 
         out = []
@@ -405,13 +419,13 @@ class InferenceServer:
             # checks here would just drift.
             out.append(float(pen))
         want_k = req.get("top_k")
-        if want_k is not None and want_k != self.batcher.sampling["top_k"]:
-            raise BadRequest(
-                f"this server samples with top_k="
-                f"{self.batcher.sampling['top_k']} (fixed at engine build); "
-                "per-request top_k is not supported"
-            )
-        return out[0], out[1], out[2], out[3]
+        if want_k is not None:
+            if not isinstance(want_k, int) or isinstance(want_k, bool) \
+                    or want_k < 0:
+                raise BadRequest("'top_k' must be an integer >= 0")
+            # Speculative engines accept only the engine-wide value —
+            # submit() enforces it and its ValueError becomes a 400.
+        return out[0], out[1], want_k, out[2], out[3]
 
     async def _completions(self, writer, req: dict, chat: bool,
                            t0: float | None = None) -> None:
@@ -425,7 +439,13 @@ class InferenceServer:
         stream = bool(req.get("stream", False))
         stop = _stop_list(req)
         prefix = req.get("prefix")
-        temperature, top_p, pres_pen, freq_pen = self._parse_sampling(req)
+        use_cache = req.get("prefix_cache", True)
+        if not isinstance(use_cache, bool):
+            # Extension knob: opt THIS request out of automatic prefix
+            # caching (its prompt neither matches nor populates the cache).
+            raise BadRequest("'prefix_cache' must be a boolean")
+        temperature, top_p, top_k, pres_pen, freq_pen = \
+            self._parse_sampling(req)
         lp_req = req.get("logprobs")
         if lp_req is None or lp_req is False:
             want_lp = False
@@ -467,8 +487,9 @@ class InferenceServer:
             try:
                 got = self.batcher.submit(
                     prompt_ids, max_new_tokens=max_tokens, prefix=prefix,
-                    temperature=temperature, top_p=top_p,
+                    temperature=temperature, top_p=top_p, top_k=top_k,
                     presence_penalty=pres_pen, frequency_penalty=freq_pen,
+                    prefix_cache=use_cache,
                 )
                 assert got == rid
             except (ValueError, KeyError) as e:
@@ -641,6 +662,8 @@ class InferenceServer:
             return
         choices = []
         total_completion = 0
+        cached = [m.cached_tokens for _, _, m in subs
+                  if m.cached_tokens is not None]
         for (idx, _rid, _mbox), (text, ids, lps, reason, _e) in zip(subs, outs):
             choice = (
                 {"index": idx,
@@ -666,6 +689,11 @@ class InferenceServer:
                 "prompt_tokens": n_prompt,
                 "completion_tokens": total_completion,
                 "total_tokens": n_prompt + total_completion,
+                # OpenAI usage extension: prompt tokens served from the
+                # automatic prefix cache instead of being re-prefilled
+                # (max across choices — every choice shares one prompt).
+                **({"prompt_tokens_details": {"cached_tokens": max(cached)}}
+                   if cached else {}),
             },
         })
 

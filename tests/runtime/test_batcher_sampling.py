@@ -71,6 +71,43 @@ def test_per_request_equals_batcher_config(tiny):
     assert out_a == out_b
 
 
+def test_per_request_top_k_equals_batcher_config(tiny):
+    """submit(top_k=k) on a top_k=0 batcher draws the same tokens as a
+    batcher CONFIGURED with top_k=k: the traced per-row top-k mask keeps
+    exactly the static mask's token set (ties included), so the
+    categorical draw matches on the same rng stream — admission and
+    decode chunks both."""
+    ids = [5, 6, 7, 8]
+    a = make(tiny, temperature=0.8, top_k=5, seed=3)
+    ra = a.submit(ids, max_new_tokens=10)
+    out_a = a.run()[ra]
+
+    b = make(tiny, temperature=0.8, seed=3)  # top_k=0 config
+    rb = b.submit(ids, max_new_tokens=10, top_k=5)
+    out_b = b.run()[rb]
+    assert out_a == out_b
+
+    # top_k=1 at temperature>0 collapses to the greedy argmax chain.
+    c = make(tiny, temperature=0.8, seed=3)
+    rc = c.submit(ids, max_new_tokens=10, top_k=1)
+    g = make(tiny)
+    rg = g.submit(ids, max_new_tokens=10)
+    assert c.run()[rc] == g.run()[rg]
+
+
+def test_top_k_row_isolated_from_neighbors(tiny):
+    """A top_k-overriding row must not disturb a greedy neighbor (the
+    per-row path leaves temperature-0 rows on the argmax)."""
+    ids, n = [7, 1, 9], 8
+    solo_b = make(tiny)
+    srid = solo_b.submit(ids, max_new_tokens=n)
+    want = solo_b.run()[srid]
+    b = make(tiny)
+    rid = b.submit(ids, max_new_tokens=n)
+    b.submit([2, 3, 4], max_new_tokens=6, temperature=1.4, top_k=3)
+    assert b.run()[rid] == want
+
+
 def test_sampled_deterministic_and_not_greedy(tiny):
     ids = [5, 6, 7, 8]
     runs = []
@@ -107,6 +144,16 @@ def test_submit_validation(tiny):
         b.submit([1, 2], max_new_tokens=4, top_p=0.0)
     with pytest.raises(ValueError, match="top_p"):
         b.submit([1, 2], max_new_tokens=4, top_p=1.5)
+    with pytest.raises(ValueError, match="top_k"):
+        b.submit([1, 2], max_new_tokens=4, top_k=-1)
+    with pytest.raises(ValueError, match="top_k"):
+        b.submit([1, 2], max_new_tokens=4, top_k=2.5)
+    with pytest.raises(ValueError, match="top_k"):
+        b.submit([1, 2], max_new_tokens=4, top_k=True)
+    with pytest.raises(ValueError, match="top_k"):
+        # int32 bound: an unbounded int would overflow the traced scalar
+        # at admission — crash the engine thread instead of a 400.
+        b.submit([1, 2], max_new_tokens=4, top_k=2**40)
 
 
 def test_speculative_rejects_per_request_sampling(tiny):
@@ -120,8 +167,10 @@ def test_speculative_rejects_per_request_sampling(tiny):
     # config do not.
     with pytest.raises(ValueError, match="engine-wide"):
         b.submit([1, 2, 3], max_new_tokens=4, temperature=0.7)
-    # Explicit temperature=0 matches this engine's config (greedy).
-    rid = b.submit([1, 2, 3], max_new_tokens=4, temperature=0.0)
+    with pytest.raises(ValueError, match="engine-wide"):
+        b.submit([1, 2, 3], max_new_tokens=4, top_k=5)
+    # Explicit values matching this engine's config are accepted.
+    rid = b.submit([1, 2, 3], max_new_tokens=4, temperature=0.0, top_k=0)
     assert rid >= 0
 
 

@@ -122,6 +122,42 @@ def test_chunked_streaming_and_sampling(tiny):
     assert first == chunked_run()       # sampled row: per-seed deterministic
 
 
+def test_interleaved_long_prompts_prefill_concurrently(tiny):
+    """Two long prompts chunk their prefills CONCURRENTLY (the old
+    one-in-flight head-of-line limit is lifted): both are pending at once
+    mid-admission, and every request still matches the monolithic batcher
+    token for token."""
+    cfg, params = tiny
+    reqs = [
+        (list(range(7, 27)), 6, {}),     # 20 tokens: chunks
+        (list(range(40, 62)), 5, {}),    # 22 tokens: chunks alongside
+        ([4, 4, 4], 7, {}),
+    ]
+    _, rp, plain = _run(cfg, params, reqs)
+    b = ContinuousBatcher(cfg, params, batch_slots=3, max_len=96,
+                          chunk_steps=4, prefill_chunk=3)
+    rids = [b.submit(ids, max_new_tokens=n) for ids, n, _kw in reqs]
+    # One scheduling round admits both long prompts into prefill slots.
+    b._admit_pending()
+    assert len(b._prefills) == 2, "long prompts did not interleave"
+    assert sum(r.prefilling for r in b.rows) == 2
+    chunked = b.run()
+    for a, c in zip(rp, rids):
+        assert plain[a] == chunked[c], (a, plain[a], chunked[c])
+
+    # The cap still binds: a third long prompt waits (FIFO) while two are
+    # in flight, and a 1-slot concurrency behaves like the old limit.
+    b2 = ContinuousBatcher(cfg, params, batch_slots=3, max_len=96,
+                           chunk_steps=4, prefill_chunk=3,
+                           prefill_concurrency=1)
+    for ids, n, _kw in reqs[:2]:
+        b2.submit(ids, max_new_tokens=n)
+    b2._admit_pending()
+    assert len(b2._prefills) == 1
+    res2 = b2.run()
+    assert list(res2.values()) == [plain[rp[0]], plain[rp[1]]]
+
+
 def test_chunked_cancel_mid_prefill(tiny):
     """Cancelling a request whose prompt is still chunking frees the slot
     (nothing was spliced into the shared cache) and later requests reuse

@@ -301,7 +301,11 @@ def test_bad_requests_rejected(tiny):
             ({"prompt": "x", "n": 0}, 400),
             ({"prompt": "x", "temperature": -0.1}, 400),
             ({"prompt": "x", "top_p": 0.0}, 400),
-            ({"prompt": "x", "top_k": 7}, 400),             # top_k is engine-wide
+            ({"prompt": "x", "top_k": -1}, 400),
+            ({"prompt": "x", "top_k": 1.5}, 400),
+            ({"prompt": "x", "top_k": True}, 400),
+            ({"prompt": "x", "top_k": 2**40}, 400),  # > int32: 400, not crash
+            ({"prompt": "x", "prefix_cache": "yes"}, 400),
             ({"prompt": "x", "stop": ["a", "b", "c", "d", "e"]}, 400),
             ({"prompt": "x" * 500, "max_tokens": 8}, 400),  # exceeds max_len
             ({"prompt": "x", "prefix": "nope"}, 400),       # unknown prefix
@@ -319,15 +323,55 @@ def test_bad_requests_rejected(tiny):
         status = int((await reader.readline()).split()[1])
         assert status == 400
         writer.close()
-        # Per-request sampling rides the batcher's per-row path.
+        # Per-request sampling rides the batcher's per-row path — top_k
+        # included (no longer rejected as engine-wide).
         status, _ = await _request(
             host, port, "POST", "/v1/completions",
             {"prompt": "ok", "max_tokens": 2, "temperature": 0.9,
-             "top_p": 0.95},
+             "top_p": 0.95, "top_k": 7},
         )
         assert status == 200
 
     run_with_server(make_batcher(tiny), fn)
+
+
+def test_prefix_cache_usage_and_metrics(tiny):
+    """Through a paged prefix-cache-enabled gateway: a repeated prompt's
+    second request reports its cached prompt tokens in
+    usage.prompt_tokens_details, text stays the deterministic greedy
+    decode, the opt-out knob works, and the cache counters show on
+    /metrics."""
+    shared = "shared system prompt " * 3  # > one 16-token page of bytes
+    prompt = shared + "tail"
+    want = expected_text(tiny, prompt, 6)
+
+    async def fn(host, port, srv):
+        outs = []
+        for body in (
+            {"prompt": prompt, "max_tokens": 6},
+            {"prompt": prompt, "max_tokens": 6},
+            {"prompt": prompt, "max_tokens": 6, "prefix_cache": False},
+        ):
+            status, raw = await _request(
+                host, port, "POST", "/v1/completions", body
+            )
+            assert status == 200
+            outs.append(json.loads(raw))
+        for out in outs:
+            assert out["choices"][0]["text"] == want
+        first, second, opted_out = outs
+        assert first["usage"]["prompt_tokens_details"]["cached_tokens"] == 0
+        assert second["usage"]["prompt_tokens_details"]["cached_tokens"] > 0
+        assert opted_out["usage"]["prompt_tokens_details"]["cached_tokens"] == 0
+        _, body = await _request(host, port, "GET", "/metrics")
+        assert b"batcher_prefix_cache_hit_tokens" in body
+        assert b"batcher_prefix_cache_lookups" in body
+
+    run_with_server(
+        make_batcher(tiny, max_len=96, paged_pages=19, page_size=16,
+                     prefix_cache=True),
+        fn,
+    )
 
 
 def test_chunked_body_rejected(tiny):

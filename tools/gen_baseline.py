@@ -47,6 +47,12 @@ def _result_cell(row: dict) -> str:
         ("rtt_1tok_p50_ms", "1-tok RTT p50 ms"),
         ("short_done_ms_monolithic", "short-req ms (monolithic)"),
         ("short_done_ms_chunked", "short-req ms (chunked)"),
+        ("ttft_ms_cache_off", "TTFT ms cache-off"),
+        ("ttft_ms_cache_on", "TTFT ms cache-on"),
+        ("ttft_ms_shared_off", "shared-prefix TTFT ms off"),
+        ("ttft_ms_shared_on", "shared-prefix TTFT ms on"),
+        ("prefill_tokens_saved", "prefill tokens saved"),
+        ("hit_rate", "hit rate"),
     ):
         if row.get(k) is not None:
             v = row[k]
@@ -77,7 +83,7 @@ def generate(ladder_path: str) -> str:
     listed = [str(e["config"]) for e in bench.LADDER] + [
         # Aux rows run_ladder appends after the decode configs.
         "serving-latency", "continuous-batching", "local-proc-batching",
-        "chunked-prefill",
+        "chunked-prefill", "prefix-cache-ttft",
         "ragged-decode-8k", "ragged-decode-win-8k", "quant-matmul-bw",
         "spec-decode", "spec-decode-7b-int8", "spec-batching",
         "paged-batching", "prefill-flash-2048", "prefill-flash-8192",
