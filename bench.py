@@ -1222,6 +1222,109 @@ def _measure_prefix_cache_ttft(
     }
 
 
+def _measure_fault_recovery(
+    preset: str | None = None, dtype: str = "bfloat16",
+    requests: int = 8, new_tokens: int = 24, page_size: int = 16,
+) -> dict:
+    """Crash-safe serving (runtime/server.py supervisor): inject a
+    decode-step crash under concurrent load and measure (a) supervisor
+    recovery latency — crash to the first post-restart token delivery —
+    and (b) the fraction of requests that still complete.  Zero-streamed
+    requests re-admit (temp-0 exact); requests that had streamed before the
+    crash fail with a structured error, so the completed fraction is
+    (requests - rows_in_flight_at_crash) / requests by design.  A pure
+    host-scheduling effect, honestly measurable on any platform."""
+    import asyncio
+    import json as _json
+
+    from distributed_llms_tpu.core.observability import METRICS
+    from distributed_llms_tpu.runtime.batcher import ContinuousBatcher
+    from distributed_llms_tpu.runtime.faults import FaultPlane
+    from distributed_llms_tpu.runtime.server import InferenceServer
+    from distributed_llms_tpu.runtime.tokenizer import ByteTokenizer
+
+    preset = preset or ("gpt2-125m" if jax.devices()[0].platform == "cpu"
+                        else "tinyllama-1.1b")
+    cfg, params = _build_params(preset, dtype, None)
+    tok = ByteTokenizer()
+    max_len = 8 * page_size
+    slots = 2
+
+    def make_batcher(faults=None):
+        return ContinuousBatcher(
+            cfg, params, tokenizer=tok, eos_id=tok.eos_id, pad_id=tok.pad_id,
+            batch_slots=slots, max_len=max_len, chunk_steps=4,
+            paged_pages=2 * slots * (max_len // page_size) + 1,
+            page_size=page_size, faults=faults,
+        )
+
+    # Warm both compiled programs (admission + decode) outside the timing.
+    warm = make_batcher()
+    warm.submit("warm me up", max_new_tokens=new_tokens)
+    warm.run()
+
+    async def one_request(host, port, i):
+        reader, writer = await asyncio.open_connection(host, port)
+        body = _json.dumps({
+            "prompt": f"request number {i}", "max_tokens": new_tokens,
+        }).encode()
+        writer.write(
+            f"POST /v1/completions HTTP/1.1\r\nHost: b\r\n"
+            f"Content-Length: {len(body)}\r\n\r\n".encode() + body
+        )
+        await writer.drain()
+        status = int((await reader.readline()).split()[1])
+        while (await reader.readline()) not in (b"\r\n", b"\n", b""):
+            pass
+        out = _json.loads(await reader.read())
+        writer.close()
+        return status, out
+
+    async def drive() -> dict:
+        plane = FaultPlane.parse("batcher.decode:raise@2")
+        srv = InferenceServer(make_batcher(plane), model_name="bench",
+                              host="127.0.0.1", port=0)
+        host, port = await srv.start()
+        restarts0 = METRICS.get_counter("server.engine_restarts")
+        retried0 = METRICS.get_counter("server.requests_retried")
+        rec0 = METRICS.snapshot()["histograms"].get(
+            "server.recovery_seconds", {}
+        ).get("count", 0)
+        t0 = time.perf_counter()
+        outs = await asyncio.gather(*[
+            one_request(host, port, i) for i in range(requests)
+        ])
+        wall = time.perf_counter() - t0
+        await srv.stop()
+        completed = sum(
+            1 for status, out in outs
+            if status == 200
+            and out["usage"]["completion_tokens"] == new_tokens
+        )
+        rec = METRICS.snapshot()["histograms"].get(
+            "server.recovery_seconds", {}
+        )
+        assert rec.get("count", 0) > rec0, "supervisor never recovered"
+        return {
+            "requests": requests,
+            "new_tokens": new_tokens,
+            "completed": completed,
+            "completed_frac": round(completed / requests, 3),
+            "engine_restarts": int(
+                METRICS.get_counter("server.engine_restarts") - restarts0
+            ),
+            "requests_retried": int(
+                METRICS.get_counter("server.requests_retried") - retried0
+            ),
+            "recovery_ms": round(rec["max"] * 1e3, 1),
+            "wall_ms": round(wall * 1e3, 1),
+        }
+
+    out = asyncio.run(drive())
+    out.update({"preset": preset, "platform": jax.devices()[0].platform})
+    return out
+
+
 def _measure_prefill_flash(
     preset: str = "tinyllama-1.1b", batch: int = 2, seq: int = 2048,
     dtype: str = "bfloat16", iters: int = 5, window: int | None = None,
@@ -1527,6 +1630,7 @@ def run_ladder(args, degraded: str | None) -> list[dict]:
             "prefill-flash-win-8192", "hop-latency",
             "spec-decode", "spec-decode-7b-int8", "spec-batching",
             "local-proc-batching", "chunked-prefill", "prefix-cache-ttft",
+            "fault-recovery",
         }
         unknown = only - known
         if unknown:  # a typo must not masquerade as a clean zero-row run
@@ -1648,6 +1752,11 @@ def run_ladder(args, degraded: str | None) -> list[dict]:
         # effect, meaningful on any platform.
         ("prefix-cache-ttft", lambda: _measure_prefix_cache_ttft(
             dtype=dtype)),
+        # Crash-safe serving: decode-step crash injected under concurrent
+        # load; stamps supervisor recovery latency and the fraction of
+        # requests that still complete — a host-scheduling effect,
+        # meaningful on any platform.
+        ("fault-recovery", lambda: _measure_fault_recovery(dtype=dtype)),
     ]
     if not on_cpu:
         # Paged vs contiguous batching (pool at ~45% of contiguous KV

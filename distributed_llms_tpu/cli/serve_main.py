@@ -49,22 +49,41 @@ def build_server(args) -> InferenceServer:
         default_name = args.preset
     else:
         raise SystemExit("one of --store or --preset is required")
-    batcher = engine.continuous_batcher(
-        batch_slots=args.slots,
-        max_len=args.max_len,
-        chunk_steps=args.chunk_steps,
-        prefill_chunk=args.prefill_chunk,
-        prefill_concurrency=args.prefill_concurrency,
-        paged_pages=args.paged_pages,
-        page_size=args.page_size,
-        prefix_cache=args.prefix_cache,
-    )
+    faults = None
+    fault_spec = ",".join(args.fault or []) or rt.faults
+    if fault_spec:
+        from ..runtime.faults import FaultPlane
+
+        faults = FaultPlane.parse(fault_spec)
+        log.warning("fault injection armed: %s", faults.describe())
+
+    def make_batcher():
+        # Called once now and again by the supervisor after an engine
+        # crash: a respawn must share the already-armed fault plane (rules
+        # that fired stay fired) while rebuilding pool + caches fresh.
+        return engine.continuous_batcher(
+            batch_slots=args.slots,
+            max_len=args.max_len,
+            chunk_steps=args.chunk_steps,
+            prefill_chunk=args.prefill_chunk,
+            prefill_concurrency=args.prefill_concurrency,
+            paged_pages=args.paged_pages,
+            page_size=args.page_size,
+            prefix_cache=args.prefix_cache,
+            faults=faults,
+        )
+
     return InferenceServer(
-        batcher,
+        make_batcher(),
         model_name=args.model_name or default_name,
         host=args.host,
         port=args.port,
         max_pending=args.max_pending,
+        batcher_factory=make_batcher,
+        request_timeout_s=(args.request_timeout
+                           if args.request_timeout is not None
+                           else rt.request_timeout_s),
+        watchdog_timeout_s=args.watchdog_timeout,
     )
 
 
@@ -145,6 +164,24 @@ def main(argv=None) -> None:
     ap.add_argument("--drain-timeout", type=float, default=30.0,
                     help="graceful shutdown: seconds to let in-flight "
                          "requests finish before cancelling (0 = immediate)")
+    ap.add_argument("--request-timeout", type=float, default=None,
+                    help="default per-request deadline in seconds: an "
+                         "expired request cancels at the next chunk and "
+                         "returns finish_reason \"timeout\" with its "
+                         "partial output; a request's own timeout_s field "
+                         "wins (default: runtime.request_timeout_s)")
+    ap.add_argument("--watchdog-timeout", type=float, default=30.0,
+                    help="engine watchdog: /healthz flips unhealthy when "
+                         "in-flight work exists but no chunk was delivered "
+                         "for this many seconds")
+    ap.add_argument("--fault", action="append", default=[],
+                    help="deterministic fault injection spec "
+                         "(runtime/faults.py grammar, repeatable): e.g. "
+                         "'batcher.decode:raise@3' crashes the 3rd decode "
+                         "chunk, 'batcher.page_alloc:exhaust@1+' dries the "
+                         "KV pool, 'batcher.decode:stall@2:1.5' wedges a "
+                         "chunk for the watchdog.  Operator drills / CI "
+                         "only — the supervisor restart is the tested path")
     ap.add_argument("--platform", default=None,
                     help="force a jax platform (e.g. cpu) — the axon TPU "
                          "plugin ignores JAX_PLATFORMS, so this sets "

@@ -33,7 +33,9 @@ class CoordinatorClient:
         )
         try:
             while True:
-                msg = await protocol.receive_message(self._reader, timeout=timeout)
+                msg = await protocol.receive_message(
+                    self._reader, timeout=timeout, writer=self._writer
+                )
                 if msg.get("msg_id") == msg_id:
                     if msg["type"] == "ERROR":
                         raise RuntimeError(str(msg.get("payload")))

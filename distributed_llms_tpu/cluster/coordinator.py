@@ -59,8 +59,15 @@ class Task:
 
 
 class Coordinator:
-    def __init__(self, cfg: ClusterConfig | None = None) -> None:
+    def __init__(self, cfg: ClusterConfig | None = None,
+                 faults: Any = None) -> None:
+        # ``faults``: FaultPlane | None (runtime/faults.py).  Site
+        # "coordinator.dispatch" (tag = task type): a "drop" rule models a
+        # dispatch lost in flight — the task stays assigned and unanswered,
+        # exercising the submitter-timeout / retry machinery without
+        # wall-clock-killing a worker.
         self.cfg = cfg or ClusterConfig()
+        self.faults = faults
         self.workers: dict[str, WorkerInfo] = {}
         self.task_queue: asyncio.Queue[Task] = asyncio.Queue()
         self.tasks: dict[str, Task] = {}
@@ -126,7 +133,7 @@ class Coordinator:
         worker_id: str | None = None
         try:
             while True:
-                frame = await protocol.receive_message(reader)
+                frame = await protocol.receive_message(reader, writer=writer)
                 for msg in protocol.unbatch(frame):
                     worker_id = await self._handle_message(msg, writer, worker_id)
         except (asyncio.IncompleteReadError, ConnectionError):
@@ -596,6 +603,13 @@ class Coordinator:
             task.attempts += 1
             task.assigned_to = info.worker_id
             info.status = "busy"
+            if self.faults is not None:
+                rule = self.faults.fire("coordinator.dispatch",
+                                        tag=task.payload["type"])
+                if rule is not None and rule.action == "drop":
+                    # The dispatch vanished in flight: task stays assigned
+                    # and unanswered until the submitter's timeout fires.
+                    continue
             try:
                 await protocol.send_message(
                     info.writer,

@@ -61,6 +61,55 @@ class ProtocolError(Exception):
     pass
 
 
+# -- deterministic fault injection (runtime/faults.py) ----------------------
+#
+# A process-wide FaultPlane consulted by send_message / receive_message at
+# sites "proto.send" / "proto.recv", tagged with the message type — so a
+# test (or an operator drill) can drop, delay, or sever exact control-plane
+# frames instead of killing processes and sleeping past wall-clock
+# deadlines.  Process-global on purpose: the framing functions are free
+# functions with no instance to hang state on.  Tests MUST uninstall
+# (set_fault_plane(None)) in teardown.
+
+_FAULTS = None
+
+
+def set_fault_plane(plane) -> None:
+    """Install (or with ``None`` uninstall) the process-wide FaultPlane for
+    protocol framing.  Returns nothing; idempotent."""
+    global _FAULTS
+    _FAULTS = plane
+
+
+def get_fault_plane():
+    return _FAULTS
+
+
+async def _apply_frame_fault(site: str, msg: dict,
+                             writer: asyncio.StreamWriter | None) -> str | None:
+    """Consult the installed plane for one frame.  Returns "drop" when the
+    caller must swallow the frame; applies "delay" here; "close" severs the
+    stream and raises so both peers observe a real connection failure."""
+    if _FAULTS is None:
+        return None
+    rule = _FAULTS.fire(site, tag=msg.get("type"))
+    if rule is None:
+        return None
+    if rule.action == "drop":
+        return "drop"
+    if rule.action == "delay":
+        await asyncio.sleep(rule.arg or 0.0)
+        return "delay"
+    if rule.action == "close":
+        if writer is not None:
+            writer.close()
+        raise ConnectionResetError(
+            f"fault injection: connection closed at {site} "
+            f"({msg.get('type')})"
+        )
+    return rule.action
+
+
 def encode(msg: dict[str, Any], compress: bool | None = None) -> bytes:
     """Frame one message.  ``compress=None`` auto-compresses bodies >=
     COMPRESS_MIN when it actually shrinks them."""
@@ -94,38 +143,50 @@ def decode_header(header: bytes) -> tuple[int, int]:
 
 
 async def send_message(writer: asyncio.StreamWriter, msg: dict[str, Any]) -> None:
+    if _FAULTS is not None:
+        if await _apply_frame_fault("proto.send", msg, writer) == "drop":
+            return  # frame swallowed: the wire never sees it
     writer.write(encode(msg))
     await writer.drain()
 
 
 async def receive_message(
-    reader: asyncio.StreamReader, timeout: float | None = None
+    reader: asyncio.StreamReader, timeout: float | None = None,
+    *, writer: asyncio.StreamWriter | None = None
 ) -> dict[str, Any]:
     """Read one frame.  A TimeoutError may fire mid-frame (header consumed,
     body pending) which desynchronizes the stream — callers must treat the
     connection as dead after a timeout and reconnect (CoordinatorClient
-    does)."""
+    does).  ``writer`` is the stream's paired writer, used only by an
+    installed FaultPlane: a ``proto.recv ... close`` rule severs it so the
+    PEER observes a real connection failure too, not just a local raise."""
     async def _recv() -> dict[str, Any]:
-        header = await reader.readexactly(8)
-        n, flags = decode_header(header)
-        body = await reader.readexactly(n)
-        if flags & _FLAG_ZLIB:
-            # Bounded inflate: cap the output BEFORE allocating it, so a
-            # decompression bomb can't balloon past MAX_FRAME.
+        while True:
+            header = await reader.readexactly(8)
+            n, flags = decode_header(header)
+            body = await reader.readexactly(n)
+            if flags & _FLAG_ZLIB:
+                # Bounded inflate: cap the output BEFORE allocating it, so a
+                # decompression bomb can't balloon past MAX_FRAME.
+                try:
+                    d = zlib.decompressobj()
+                    body = d.decompress(body, MAX_FRAME + 1)
+                except zlib.error as e:
+                    raise ProtocolError(f"bad compressed frame: {e}") from e
+                if len(body) > MAX_FRAME or d.unconsumed_tail:
+                    raise ProtocolError("decompressed frame too large")
             try:
-                d = zlib.decompressobj()
-                body = d.decompress(body, MAX_FRAME + 1)
-            except zlib.error as e:
-                raise ProtocolError(f"bad compressed frame: {e}") from e
-            if len(body) > MAX_FRAME or d.unconsumed_tail:
-                raise ProtocolError("decompressed frame too large")
-        try:
-            msg = json.loads(body.decode("utf-8"))
-        except (UnicodeDecodeError, json.JSONDecodeError) as e:
-            raise ProtocolError(f"invalid frame body: {e}") from e
-        if not isinstance(msg, dict) or msg.get("type") not in MESSAGE_TYPES:
-            raise ProtocolError(f"invalid message: {str(msg)[:200]}")
-        return msg
+                msg = json.loads(body.decode("utf-8"))
+            except (UnicodeDecodeError, json.JSONDecodeError) as e:
+                raise ProtocolError(f"invalid frame body: {e}") from e
+            if not isinstance(msg, dict) or msg.get("type") not in MESSAGE_TYPES:
+                raise ProtocolError(f"invalid message: {str(msg)[:200]}")
+            if _FAULTS is not None:
+                # "drop" on receive: pretend this frame was lost in flight
+                # and keep reading (the sender believes it was delivered).
+                if await _apply_frame_fault("proto.recv", msg, writer) == "drop":
+                    continue
+            return msg
 
     if timeout is None:
         return await _recv()

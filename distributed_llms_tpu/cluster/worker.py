@@ -55,10 +55,15 @@ class WorkerHost:
         rt: RuntimeConfig | None = None,
         engine_factory: Any = None,  # (store_dir, shards, rt) -> engine-like
         mesh_cfg: MeshConfig | None = None,
+        faults: Any = None,  # FaultPlane | None (runtime/faults.py): sites
+        #   worker.heartbeat (drop a beat), worker.handle (crash a command
+        #   handler), worker.result (drop/sever the reply) — deterministic
+        #   stand-ins for process death in the cluster fault tests
     ) -> None:
         self.cfg = cfg or ClusterConfig()
         self.rt = rt or RuntimeConfig()
         self.mesh_cfg = mesh_cfg
+        self.faults = faults
         self.host = coordinator_host
         self.port = coordinator_port
         self.engine_factory = engine_factory or self._default_engine_factory
@@ -104,7 +109,8 @@ class WorkerHost:
                     {"capabilities": device_capabilities(), "worker_id": self.worker_id},
                 ),
             )
-            ack = await protocol.receive_message(reader, timeout=10.0)
+            ack = await protocol.receive_message(reader, timeout=10.0,
+                                                 writer=writer)
             if ack["type"] != "REGISTER_ACK":
                 raise protocol.ProtocolError(f"expected REGISTER_ACK, got {ack['type']}")
             self.worker_id = ack["payload"]["worker_id"]
@@ -146,6 +152,13 @@ class WorkerHost:
     async def _heartbeat_loop(self, writer: asyncio.StreamWriter, interval: float) -> None:
         while not self._stop.is_set():
             await asyncio.sleep(interval)
+            if self.faults is not None:
+                rule = self.faults.fire("worker.heartbeat")
+                if rule is not None and rule.action == "drop":
+                    # Deterministic liveness fault: the worker stays alive
+                    # but its heartbeats stop — the coordinator's deadline
+                    # eviction must fire (the path D10 left untested).
+                    continue
             try:
                 await protocol.send_message(writer, protocol.message("HEARTBEAT", {}))
             except (ConnectionError, OSError):
@@ -155,15 +168,34 @@ class WorkerHost:
 
     async def _serve(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter) -> None:
         while not self._stop.is_set():
-            frame = await protocol.receive_message(reader)
+            frame = await protocol.receive_message(reader, writer=writer)
             for msg in protocol.unbatch(frame):
                 msg_id = msg.get("msg_id")
                 try:
                     result = await self._handle(msg)
                     if msg_id is not None:
+                        if self.faults is not None:
+                            rule = self.faults.fire("worker.result",
+                                                    tag=msg["type"])
+                            if rule is not None and rule.action == "drop":
+                                continue  # reply lost in flight
+                            if rule is not None and rule.action == "close":
+                                # Die exactly at the answer: the coordinator
+                                # sees EOF, evicts, and must retry the task
+                                # on a survivor — deterministically.
+                                writer.close()
+                                raise ConnectionResetError(
+                                    "fault injection: worker died before "
+                                    "replying"
+                                )
                         await protocol.send_message(
                             writer, protocol.message("RESULT", result, msg_id=msg_id)
                         )
+                except ConnectionError:
+                    # The stream is dead (peer gone or injected close) —
+                    # an ERROR reply could never be delivered; let run()'s
+                    # connection handling end this worker.
+                    raise
                 except Exception as e:  # report, don't die (coordinator retries)
                     log.exception("command %s failed", msg["type"])
                     if msg_id is not None:
@@ -175,6 +207,10 @@ class WorkerHost:
     async def _handle(self, msg: dict) -> Any:
         mtype = msg["type"]
         payload = msg.get("payload") or {}
+        if self.faults is not None:
+            # "raise" here surfaces as an ERROR reply -> coordinator retry:
+            # the deterministic task-failure fault.
+            self.faults.fire("worker.handle", tag=mtype)
         if mtype == "PLACE_SHARDS":
             store_dir = payload["store_dir"]
             shards = payload["shards"]

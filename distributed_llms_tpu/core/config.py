@@ -250,6 +250,18 @@ class RuntimeConfig:
     spec_decode: bool = False
     spec_k: int = 4
     spec_draft_quantize: int = 4
+    # Deterministic fault injection (runtime/faults.py): a comma-separated
+    # spec like "batcher.decode:raise@3,proto.send/HEARTBEAT:drop@1+".
+    # Engine/batcher hot paths and the cluster protocol framing consult the
+    # parsed FaultPlane; the serving supervisor's restart/re-admit path is
+    # what this exists to exercise.  None disables.
+    faults: str | None = None
+    # Default per-request wall-clock deadline (seconds) applied by the
+    # serving gateway when a request carries no "timeout_s" field of its
+    # own.  An expired request cancels at the next chunk boundary and
+    # returns finish_reason "timeout" with the tokens produced so far.
+    # None = no default deadline.
+    request_timeout_s: float | None = None
 
 
 @dataclass(frozen=True)

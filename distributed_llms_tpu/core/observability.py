@@ -111,6 +111,17 @@ class Metrics:
     def timer(self, name: str) -> "_Timer":
         return _Timer(self, name)
 
+    def get_counter(self, name: str) -> float:
+        """Point read of one counter (0.0 when never incremented) — the
+        supervisor's restart accounting and tests read through this
+        instead of snapshotting the whole registry."""
+        with self._lock:
+            return self._counters.get(name, 0.0)
+
+    def get_gauge(self, name: str, default: float = 0.0) -> float:
+        with self._lock:
+            return self._gauges.get(name, default)
+
     def snapshot(self) -> dict:
         with self._lock:
             return {

@@ -1013,6 +1013,122 @@ class PrefixCache:
             )
 
 
+class PagePool:
+    """Refcounted KV page allocator for paged mode.  Owns the free list and
+    per-page refcounts, and cooperates with an optional :class:`PrefixCache`
+    whose LRU parks unreferenced-but-content-cached pages (still serving
+    hits, reclaimable under pressure).  Page 0 is the permanent scratch
+    page: never allocated, never freed, never read by a live row.
+
+    Extracted from the batcher so the invariants have one owner and one
+    audit (:meth:`assert_consistent`) — the recovery path's leak class
+    (dangling refcounts / pinned cache pages after a crashed ``run``) is
+    exactly a violation of these invariants, and the serving supervisor
+    runs the audit after every engine restart."""
+
+    def __init__(self, num_pages: int,
+                 prefix_cache: "PrefixCache | None" = None) -> None:
+        self.num_pages = num_pages
+        self.free_pages: list[int] = list(range(1, num_pages))
+        # Refcounts of allocated pages (prefix-cache hits share pages
+        # across rows; a page returns to free/LRU only at refcount 0).
+        self.page_refs: dict[int, int] = {}
+        self.prefix_cache = prefix_cache
+
+    def available(self) -> int:
+        """Pages an admission could obtain: the free list plus every
+        LRU-parked cached page (reclaimable under pressure)."""
+        pc = self.prefix_cache
+        return len(self.free_pages) + (len(pc.lru) if pc else 0)
+
+    def alloc(self, n: int) -> list[int]:
+        """Allocate ``n`` pages at refcount 1, evicting LRU-cold cached
+        pages when the free list runs dry (the caller checked
+        :meth:`available` first)."""
+        pc = self.prefix_cache
+        out: list[int] = []
+        for _ in range(n):
+            if self.free_pages:
+                p = self.free_pages.pop()
+            else:
+                p, _ = pc.lru.popitem(last=False)  # the coldest entry
+                pc.forget(p)
+                pc.evictions += 1
+                METRICS.inc("batcher.prefix_cache.evicted_pages")
+            self.page_refs[p] = 1
+            out.append(p)
+        return out
+
+    def retain(self, p: int) -> None:
+        """Take a reference on a cached page (a prefix-cache hit): pages
+        referenced by live rows bump their refcount; LRU-parked ones come
+        back referenced (their content stays addressable)."""
+        if p in self.page_refs:
+            self.page_refs[p] += 1
+        else:
+            del self.prefix_cache.lru[p]
+            self.page_refs[p] = 1
+
+    def release(self, pages: list[int]) -> None:
+        """Drop one reference per page.  At refcount 0 a content-cached
+        page parks at the LRU's most-recently-used end — still serving
+        hits until pool pressure reclaims it — while an uncached page
+        returns straight to the free list."""
+        pc = self.prefix_cache
+        for p in pages:
+            left = self.page_refs[p] - 1
+            if left:
+                self.page_refs[p] = left
+                continue
+            del self.page_refs[p]
+            if pc is not None and p in pc.page_hash:
+                pc.lru[p] = None
+            else:
+                self.free_pages.append(p)
+
+    def assert_consistent(self, live_rows=()) -> None:
+        """Audit the allocator's partition invariants; AssertionError on
+        the first violation.  ``live_rows`` is the page lists of currently
+        resident rows — every reference comes from exactly one row hold,
+        so per-page refcounts must EQUAL the row-hold counts (a dangling
+        ref or a pinned cache page after a crashed run fails here)."""
+        pc = self.prefix_cache
+        lru = set(pc.lru) if pc is not None else set()
+        free = set(self.free_pages)
+        refed = set(self.page_refs)
+        assert len(free) == len(self.free_pages), (
+            f"free list holds duplicates: {sorted(self.free_pages)}"
+        )
+        assert 0 not in (free | refed | lru), "scratch page 0 escaped the pool"
+        for a, b, what in ((free, refed, "free and refcounted"),
+                           (free, lru, "free and LRU-parked"),
+                           (refed, lru, "refcounted and LRU-parked")):
+            assert not (a & b), f"pages both {what}: {sorted(a & b)}"
+        accounted = free | refed | lru
+        expect = set(range(1, self.num_pages))
+        assert accounted == expect, (
+            f"pages leaked (neither free, refcounted, nor LRU-parked): "
+            f"{sorted(expect - accounted)}; "
+            f"foreign pages: {sorted(accounted - expect)}"
+        )
+        assert all(v >= 1 for v in self.page_refs.values()), (
+            f"non-positive refcounts: {self.page_refs}"
+        )
+        holds: dict[int, int] = {}
+        for pages in live_rows:
+            for p in pages:
+                holds[p] = holds.get(p, 0) + 1
+        assert holds == self.page_refs, (
+            f"refcounts diverge from live-row holds: refs={self.page_refs} "
+            f"holds={holds}"
+        )
+        if pc is not None:
+            for p in lru:
+                assert p in pc.page_hash, (
+                    f"LRU-parked page {p} has no cached content"
+                )
+
+
 @dataclass
 class _PendingPrefill:
     """A chunked prefill in flight: the request's prompt enters the row's
@@ -1118,7 +1234,20 @@ class ContinuousBatcher:
         # head-of-line (strict FIFO still gates STARTING one — the queue
         # front waits for a free prefill slot, never jumps it).
         prefill_concurrency: int = 2,
+        # Deterministic fault injection (runtime/faults.py FaultPlane):
+        # sites batcher.admit / batcher.decode / batcher.page_alloc are
+        # consulted each scheduling round, so tests and operator drills can
+        # crash, stall, or dry-pool the engine at an exact chunk.  None
+        # disables (zero overhead beyond one attribute check per round).
+        faults: Any = None,
     ) -> None:
+        # Snapshot the constructor arguments FIRST (before any local
+        # variables or normalization appear) so respawn() can rebuild an
+        # identical fresh batcher after an engine crash — params/tokenizer/
+        # fault plane are shared by reference; caches and pools are rebuilt.
+        self._ctor_args = {
+            k: v for k, v in locals().items() if k not in ("self", "__class__")
+        }
         if max_len > cfg.max_seq_len:
             raise ValueError(
                 f"max_len {max_len} exceeds model max_seq_len {cfg.max_seq_len}"
@@ -1288,17 +1417,16 @@ class ContinuousBatcher:
         self.page_size = page_size
         self.paged = paged_pages is not None
         self.prefix_cache: PrefixCache | None = None
+        self.pool: PagePool | None = None
+        self.faults = faults  # FaultPlane | None (runtime/faults.py)
         if self.paged:
             self.pages_per_row = max_len // page_size
-            # Page 0 is the permanent scratch page: fixed-shape admissions
-            # pad their page lists with it, and no row ever reads it.
-            self.free_pages = list(range(1, paged_pages))
-            # Refcounts of allocated pages (prefix-cache hits share pages
-            # across rows; a page returns to free/LRU only at refcount 0).
-            self.page_refs: dict[int, int] = {}
-            self.tables = np.zeros((batch_slots, self.pages_per_row), np.int32)
             if prefix_cache:
                 self.prefix_cache = PrefixCache()
+            # Page 0 is the permanent scratch page: fixed-shape admissions
+            # pad their page lists with it, and no row ever reads it.
+            self.pool = PagePool(paged_pages, prefix_cache=self.prefix_cache)
+            self.tables = np.zeros((batch_slots, self.pages_per_row), np.int32)
         # Scheduling state lives as HOST numpy mirrors: every process holds
         # the same values (the jitted chunk fns return them constrained
         # replicated, and np.asarray of a replicated output is legal on all
@@ -1373,58 +1501,54 @@ class ContinuousBatcher:
         )
         self.prefixes[name] = _Prefix(ids, jax.block_until_ready(row_cache.k), row_cache.v)
 
-    # -- paged pool allocator (refcounted; automatic prefix cache) ---------
+    # -- paged pool allocator (PagePool; refcounted, prefix-cache LRU) -----
+
+    @property
+    def free_pages(self) -> list[int]:
+        """The pool's free list (paged mode) — kept as a property so tests
+        and callers that predate the PagePool extraction keep working."""
+        return self.pool.free_pages
+
+    @property
+    def page_refs(self) -> dict[int, int]:
+        return self.pool.page_refs
 
     def _pages_available(self) -> int:
-        """Pages an admission could obtain: the free list plus every
-        LRU-parked cached page (reclaimable under pressure)."""
-        pc = self.prefix_cache
-        return len(self.free_pages) + (len(pc.lru) if pc else 0)
+        return self.pool.available()
 
     def _alloc_pages(self, n: int) -> list[int]:
-        """Allocate ``n`` pages at refcount 1, evicting LRU-cold cached
-        pages when the free list runs dry (the caller checked
-        ``_pages_available`` first)."""
-        pc = self.prefix_cache
-        out: list[int] = []
-        for _ in range(n):
-            if self.free_pages:
-                p = self.free_pages.pop()
-            else:
-                p, _ = pc.lru.popitem(last=False)  # the coldest entry
-                pc.forget(p)
-                pc.evictions += 1
-                METRICS.inc("batcher.prefix_cache.evicted_pages")
-            self.page_refs[p] = 1
-            out.append(p)
-        return out
+        return self.pool.alloc(n)
 
     def _retain_page(self, p: int) -> None:
-        """Take a reference on a cached page (a prefix-cache hit): pages
-        referenced by live rows bump their refcount; LRU-parked ones come
-        back referenced (their content stays addressable)."""
-        if p in self.page_refs:
-            self.page_refs[p] += 1
-        else:
-            del self.prefix_cache.lru[p]
-            self.page_refs[p] = 1
+        self.pool.retain(p)
 
     def _release_pages(self, pages: list[int]) -> None:
-        """Drop one reference per page.  At refcount 0 a content-cached
-        page parks at the LRU's most-recently-used end — still serving
-        hits until pool pressure reclaims it — while an uncached page
-        returns straight to the free list."""
-        pc = self.prefix_cache
-        for p in pages:
-            left = self.page_refs[p] - 1
-            if left:
-                self.page_refs[p] = left
-                continue
-            del self.page_refs[p]
-            if pc is not None and p in pc.page_hash:
-                pc.lru[p] = None
-            else:
-                self.free_pages.append(p)
+        self.pool.release(pages)
+
+    def assert_pool_consistent(self) -> None:
+        """Audit the page pool against the resident rows (no-op in
+        contiguous mode).  The serving supervisor runs this after every
+        engine restart; paged tests run it after each workload — a failure
+        means refcounts or cache pins leaked, the recovery-path bug class
+        this audit exists to catch."""
+        if self.pool is not None:
+            self.pool.assert_consistent(
+                [r.pages for r in self.rows if r.pages]
+            )
+
+    # -- crash recovery ----------------------------------------------------
+
+    def respawn(self) -> "ContinuousBatcher":
+        """A fresh batcher built from this one's construction arguments:
+        new KV pool/cache and prefix cache, empty queue and rows, zeroed
+        scheduling state.  This is the crash-recovery primitive: after
+        ``run`` raises, the device state is unreconstructable (the jitted
+        chunk programs donate the cache), so the supervisor discards the
+        instance wholesale and re-admits work into a respawn.  Weights,
+        tokenizer, and the fault plane carry over by reference; rid
+        continuity (``_next_rid``) and named-prefix KV are the caller's to
+        transplant."""
+        return ContinuousBatcher(**self._ctor_args)
 
     # -- submission --------------------------------------------------------
 
@@ -1595,6 +1719,9 @@ class ContinuousBatcher:
         return sub
 
     def _admit_pending(self) -> None:
+        if self.faults is not None:
+            # Injection site "batcher.admit": one hit per admission round.
+            self.faults.fire("batcher.admit")
         # Advance every pending chunked prefill one chunk per round — up to
         # prefill_concurrency in flight, so the round's prefill work is at
         # most prefill_concurrency * prefill_chunk tokens (interleaved long
@@ -1658,7 +1785,14 @@ class ContinuousBatcher:
                     # reclaim the very run we just matched.
                     for p in cached_pages:
                         self._retain_page(p)
-                if self._pages_available() < n_pages - len(cached_pages):
+                # Injection site "batcher.page_alloc": an "exhaust" rule
+                # simulates a dry pool — the admission takes the exact
+                # back-pressure path a real exhaustion would (requeue,
+                # released hits, FIFO preserved).
+                rule = (self.faults.fire("batcher.page_alloc")
+                        if self.faults is not None else None)
+                if (rule is not None and rule.action == "exhaust") or \
+                        self._pages_available() < n_pages - len(cached_pages):
                     self._release_pages(cached_pages)
                     self.queue.appendleft(req)
                     return
@@ -1972,6 +2106,13 @@ class ContinuousBatcher:
                 if not self.queue and all(r.rid is None for r in self.rows):
                     break
                 continue
+            if self.faults is not None:
+                # Injection site "batcher.decode": one hit per decode /
+                # speculative chunk about to be dispatched.  A "raise" rule
+                # here is the canonical engine crash (propagates out of
+                # run() into the serving supervisor); "stall" models a
+                # wedged device call for the watchdog.
+                self.faults.fire("batcher.decode")
             counts = None
             counts_out = None  # updated penalty histogram (either branch)
             if self.speculative:

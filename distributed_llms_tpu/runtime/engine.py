@@ -446,6 +446,9 @@ class InferenceEngine:
         #   ContinuousBatcher)
         prefill_concurrency: int = 2,  # chunked prefills in flight at once
         #   (1 restores the old one-at-a-time head-of-line behavior)
+        faults: Any = None,  # FaultPlane | None; None -> parse rt.faults —
+        #   deterministic fault injection into the batcher's hot paths
+        #   (runtime/faults.py), the lever behind `dlt-serve --fault`
     ):
         """A ContinuousBatcher over this engine's model: requests admit into
         an in-flight decode batch as rows free up (runtime/batcher.py) —
@@ -538,6 +541,14 @@ class InferenceEngine:
                 draft_params=self.draft_params, draft_cfg=self.draft_cfg,
                 spec_k=self.rt.spec_k,
             )
+        if faults is None and self.rt.faults:
+            # Config-driven fault plane (operator drills / CI): each batcher
+            # gets its OWN plane so once-only rules stay once-only per
+            # serving lifetime, not per respawn (respawn() shares the
+            # instance by reference, preserving already-fired counters).
+            from .faults import FaultPlane
+
+            faults = FaultPlane.parse(self.rt.faults)
         tok = self.tokenizer
         return ContinuousBatcher(
             self.cfg, self.params, tokenizer=tok,
@@ -553,6 +564,7 @@ class InferenceEngine:
             prefix_cache=bool(prefix_cache),
             prefill_chunk=prefill_chunk,
             prefill_concurrency=prefill_concurrency,
+            faults=faults,
         )
 
     # -- speculative decoding (runtime/speculative.py): greedy-exact at

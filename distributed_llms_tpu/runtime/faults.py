@@ -1,0 +1,207 @@
+"""Deterministic fault injection: the tested half of crash-only serving.
+
+The cluster layer already implements the paper scaffold's fault contract
+(heartbeat eviction, task retry/reassignment), but nothing in the tree could
+*provoke* those paths on purpose — recovery was exercised only by killing
+tasks and sleeping past wall-clock deadlines.  Crash-only design (Candea &
+Fox, HotOS'03) demands the opposite: recovery must be the ordinary, tested
+path.  This module is the lever: a ``FaultPlane`` holds named injection
+rules that the hot paths consult at fixed sites, so a test (or an operator
+drill via ``dlt-serve --fault``) can crash the Nth decode chunk, dry up the
+KV page pool, stall the engine under the watchdog, or drop/delay/sever
+cluster protocol frames — deterministically, with no timing dependence.
+
+Spec grammar (comma-separated rules)::
+
+    rule   := site[/tag] ":" action ["@" when] [":" arg]
+    when   := N        fire on the Nth matching hit only (default: 1)
+            | N+       fire on every matching hit from the Nth on
+            | *        fire on every matching hit
+    arg    := seconds (stall / delay)
+
+Examples::
+
+    batcher.decode:raise@3            crash the 3rd decode chunk
+    batcher.page_alloc:exhaust@1+     every admission sees a dry page pool
+    batcher.decode:stall@2:1.5        sleep 1.5 s before the 2nd chunk
+    proto.send/HEARTBEAT:drop@1+      swallow all heartbeat frames
+    proto.recv:close@5                sever the stream at the 5th frame
+
+Sites wired in this tree (callers pass ``tag`` where noted):
+
+- ``batcher.admit``       each admission round (ContinuousBatcher)
+- ``batcher.decode``      before each decode/speculative chunk
+- ``batcher.page_alloc``  paged-pool allocation check (``exhaust`` forces
+  the back-pressure path as if the pool were dry)
+- ``proto.send`` / ``proto.recv``  cluster protocol framing, tag = message
+  type (install process-wide via ``cluster.protocol.set_fault_plane``)
+- ``worker.heartbeat``    one heartbeat tick (``drop`` skips the send)
+- ``worker.result``       a worker about to answer, tag = command type
+- ``worker.handle``       a command handler about to run, tag = command type
+- ``coordinator.dispatch``  a task about to be sent, tag = task type
+
+Actions ``raise`` (raises :class:`InjectedFault`) and ``stall`` (blocking
+sleep) are applied by :meth:`FaultPlane.fire` itself; the context-specific
+actions (``exhaust``, ``drop``, ``delay``, ``close``) are returned to the
+caller, which knows what "dropping" means at its site (``delay`` is returned
+rather than slept so async call sites can ``await`` it).
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+
+from ..core.observability import METRICS, get_logger
+
+log = get_logger("faults")
+
+ACTIONS = frozenset({"raise", "exhaust", "stall", "drop", "delay", "close"})
+# Actions fire() applies itself; the rest are returned for the call site.
+_SELF_APPLIED = frozenset({"raise", "stall"})
+
+
+class InjectedFault(RuntimeError):
+    """Raised by a ``raise`` rule.  Deliberately its own type so recovery
+    tests can assert the injected path (and only it) was taken."""
+
+
+@dataclass
+class FaultRule:
+    """One armed injection point.  ``hits`` counts matching traversals,
+    ``fired`` how many times the rule actually triggered."""
+
+    site: str
+    action: str
+    tag: str | None = None     # None matches any tag at the site
+    first: int = 1             # fire from the Nth matching hit ...
+    last: int | None = 1       # ... through this one (None = open-ended)
+    arg: float | None = None   # seconds for stall/delay
+    hits: int = field(default=0, compare=False)
+    fired: int = field(default=0, compare=False)
+
+    def matches(self, site: str, tag: str | None) -> bool:
+        return self.site == site and (self.tag is None or self.tag == tag)
+
+    def due(self) -> bool:
+        """Whether the CURRENT hit count falls in the firing window."""
+        if self.hits < self.first:
+            return False
+        return self.last is None or self.hits <= self.last
+
+    def describe(self) -> str:
+        site = self.site if self.tag is None else f"{self.site}/{self.tag}"
+        when = ("*" if (self.first, self.last) == (1, None)
+                else f"{self.first}+" if self.last is None
+                else str(self.first))
+        out = f"{site}:{self.action}@{when}"
+        return out if self.arg is None else f"{out}:{self.arg:g}"
+
+
+def _parse_rule(text: str) -> FaultRule:
+    parts = text.strip().split(":")
+    if len(parts) < 2:
+        raise ValueError(
+            f"fault rule {text!r} must look like site[/tag]:action[@when][:arg]"
+        )
+    site_tag, action_when = parts[0], parts[1]
+    site, _, tag = site_tag.partition("/")
+    if not site:
+        raise ValueError(f"fault rule {text!r}: empty site")
+    action, _, when = action_when.partition("@")
+    if action not in ACTIONS:
+        raise ValueError(
+            f"fault rule {text!r}: unknown action {action!r} "
+            f"(choose from {sorted(ACTIONS)})"
+        )
+    first, last = 1, 1
+    if when:
+        if when == "*":
+            first, last = 1, None
+        elif when.endswith("+"):
+            first, last = int(when[:-1]), None
+        else:
+            first = last = int(when)
+    if first < 1:
+        raise ValueError(f"fault rule {text!r}: hit index must be >= 1")
+    arg: float | None = None
+    if len(parts) > 2:
+        arg = float(parts[2])
+        if arg < 0:
+            raise ValueError(f"fault rule {text!r}: arg must be >= 0")
+    if action in ("stall", "delay") and arg is None:
+        raise ValueError(
+            f"fault rule {text!r}: {action} needs a seconds arg "
+            f"(e.g. {site}:{action}@1:0.5)"
+        )
+    return FaultRule(site=site, action=action, tag=tag or None,
+                     first=first, last=last, arg=arg)
+
+
+class FaultPlane:
+    """A set of :class:`FaultRule`\\ s consulted by instrumented hot paths.
+
+    Thread contract: each rule's counters are touched only by the thread(s)
+    traversing its site (the engine thread for ``batcher.*``, the event loop
+    for ``proto.*``); ``add`` from another thread is a GIL-atomic list
+    append, so tests may arm new rules mid-run.
+    """
+
+    def __init__(self, rules: list[FaultRule] | None = None) -> None:
+        self.rules: list[FaultRule] = list(rules or [])
+
+    @classmethod
+    def parse(cls, spec: str | None) -> "FaultPlane":
+        """Build a plane from the comma-separated spec grammar above.
+        ``None``/empty parses to an empty (never-firing) plane."""
+        rules = [
+            _parse_rule(part)
+            for part in (spec or "").split(",") if part.strip()
+        ]
+        return cls(rules)
+
+    def add(self, site: str, action: str, when: str = "1",
+            arg: float | None = None, tag: str | None = None) -> FaultRule:
+        """Arm one rule programmatically (``when`` uses the spec grammar:
+        ``"3"``, ``"2+"``, ``"*"``).  Returns the rule for later
+        inspection (``rule.fired``)."""
+        text = f"{site}{'/' + tag if tag else ''}:{action}@{when}"
+        if arg is not None:
+            text += f":{arg}"
+        rule = _parse_rule(text)
+        self.rules.append(rule)
+        return rule
+
+    def fire(self, site: str, tag: str | None = None) -> FaultRule | None:
+        """Record a traversal of ``site`` and trigger the first due rule.
+
+        ``raise`` rules raise :class:`InjectedFault`; ``stall`` rules sleep
+        ``arg`` seconds here (blocking — they model a wedged device call).
+        Every other action is returned as the rule for the call site to
+        apply.  Returns ``None`` when nothing fired.
+        """
+        hit: FaultRule | None = None
+        for rule in self.rules:
+            if not rule.matches(site, tag):
+                continue
+            rule.hits += 1
+            if hit is None and rule.due():
+                hit = rule
+        if hit is None:
+            return None
+        hit.fired += 1
+        METRICS.inc("faults.fired")
+        METRICS.inc(f"faults.fired.{hit.action}")
+        log.warning("fault injected: %s (hit %d at %s%s)", hit.describe(),
+                    hit.hits, site, f"/{tag}" if tag else "")
+        if hit.action == "raise":
+            raise InjectedFault(
+                f"injected fault at {site}"
+                f"{'/' + tag if tag else ''} (rule {hit.describe()})"
+            )
+        if hit.action == "stall":
+            time.sleep(hit.arg or 0.0)
+        return hit
+
+    def describe(self) -> str:
+        return ",".join(r.describe() for r in self.rules)

@@ -25,13 +25,37 @@ Endpoints:
   extension naming a registered KV prefix); ``stream: true`` serves SSE.
 - ``POST /v1/chat/completions``  chat shape via the tokenizer's own chat
   template (model-correct control tokens) or a plain-text fallback.
-- ``GET /v1/models``, ``GET /healthz``, ``GET /metrics`` (Prometheus).
+- ``GET /v1/models``, ``GET /healthz`` (real readiness/liveness JSON, non-200
+  when unhealthy or draining), ``GET /metrics`` (Prometheus).
+
+Crash-safe serving (crash-only design: recovery is the TESTED, ordinary
+path, provoked on demand by runtime/faults.py):
+
+- a SUPERVISOR wraps the engine thread: when ``batcher.run`` raises, the
+  batcher is discarded wholesale (its jitted chunks donate the KV cache, so
+  per-row device state is unreconstructable) and respawned fresh — pool,
+  prefix cache, scheduling state.  Requests that streamed ZERO tokens are
+  re-admitted under their original rid with a bounded retry budget (exact
+  at temperature 0 — the same recompute-is-exact contract as prefix-cache
+  reuse); partially-streamed ones fail with a structured error (deltas
+  cannot be retracted).  ``server_engine_restarts`` / \
+  ``server_requests_retried`` count it all, and a post-restart
+  ``PagePool.assert_consistent`` audit proves nothing leaked.
+- per-request DEADLINES: a ``timeout_s`` field (or the server-wide default)
+  cancels an expired request at its next chunk boundary; the client gets
+  ``finish_reason: "timeout"`` with the tokens produced so far and the row's
+  pages are freed through the ordinary cancel path.
+- an engine WATCHDOG: the engine stamps every delivery; ``/healthz`` reports
+  seconds-since-last-chunk and flips unhealthy when in-flight work exists
+  but the engine has not progressed within ``watchdog_timeout_s`` (a stalled
+  XLA dispatch looks exactly like this).
 """
 
 from __future__ import annotations
 
 import asyncio
 import json
+import math
 import threading
 import time
 import uuid
@@ -39,6 +63,17 @@ import uuid
 from ..core.observability import METRICS, get_logger
 
 log = get_logger("server")
+
+# How long a timed-out request waits for the engine to ack its cancel flag
+# (one chunk away on a healthy engine) before answering the client anyway.
+# The flag stays set on expiry, so the row is still freed whenever the
+# engine comes back — the client just stops waiting for proof.
+_TIMEOUT_ACK_GRACE_S = 10.0
+
+# Structured error message partially-streamed requests receive when the
+# engine restarts under them (their deltas cannot be retracted, so replaying
+# the request could duplicate output).
+_RESTART_ERR = "engine restarted mid-stream; partial output could not be resumed"
 
 _MAX_REQUEST_LINE = 8192
 _MAX_HEADERS = 100
@@ -60,9 +95,20 @@ class _Mailbox:
     ``t0``/``first_seen`` drive the TTFT histogram (first delivery).
     ``cached_tokens`` is filled by the engine thread on first delivery
     (prompt tokens served from the automatic prefix cache — surfaced as
-    usage.prompt_tokens_details); read loop-side only after done."""
+    usage.prompt_tokens_details); read loop-side only after done.
+    ``deadline`` is the request's absolute per-request deadline on the
+    perf_counter clock (None = no deadline).
+    ``meta``/``delivered``/``retries`` are the supervisor's per-request
+    state: the submit arguments (so a restart can re-admit verbatim), the
+    count of tokens the ENGINE delivered (the zero-streamed test —
+    loop-side queue state may lag), and the re-admissions consumed.  They
+    live on the mailbox so their lifetime IS the request's: once the
+    handler pops ``_requests[rid]`` nothing else needs cleanup, and an
+    engine-thread write racing that pop mutates a garbage object instead
+    of resurrecting a side-table entry."""
 
-    __slots__ = ("queue", "finished", "t0", "first_seen", "cached_tokens")
+    __slots__ = ("queue", "finished", "t0", "first_seen", "cached_tokens",
+                 "deadline", "meta", "delivered", "retries")
 
     def __init__(self) -> None:
         self.queue: asyncio.Queue = asyncio.Queue()
@@ -70,6 +116,10 @@ class _Mailbox:
         self.t0 = time.perf_counter()
         self.first_seen = False
         self.cached_tokens: int | None = None
+        self.deadline: float | None = None
+        self.meta: dict | None = None
+        self.delivered = 0
+        self.retries = 0
 
 
 class BadRequest(ValueError):
@@ -112,19 +162,43 @@ class InferenceServer:
         host: str = "0.0.0.0",
         port: int = 8000,
         max_pending: int = 256,
+        batcher_factory=None,  # () -> fresh batcher; default batcher.respawn
+        request_timeout_s: float | None = None,  # default per-request deadline
+        watchdog_timeout_s: float = 30.0,  # /healthz stall threshold
+        max_request_retries: int = 2,  # restart re-admissions per request
     ) -> None:
         if batcher.tokenizer is None:
             raise ValueError(
                 "InferenceServer needs a batcher with a tokenizer "
                 "(the completion API speaks text)"
             )
+        if request_timeout_s is not None and request_timeout_s <= 0:
+            raise ValueError(
+                f"request_timeout_s must be > 0, got {request_timeout_s}"
+            )
         self.batcher = batcher
         self.model_name = model_name
         self.host = host
         self.port = port
         self.max_pending = max_pending
+        self._batcher_factory = batcher_factory
+        self.request_timeout_s = request_timeout_s
+        self.watchdog_timeout_s = watchdog_timeout_s
+        self.max_request_retries = max_request_retries
         self._requests: dict[int, _Mailbox] = {}
         self._cancelled: set[int] = set()  # loop writes, engine consumes
+        # Supervisor per-request state (meta/delivered/retries) rides on
+        # each _Mailbox — see its docstring.
+        # Serializes (next_rid + submit) on the loop thread against the
+        # supervisor's batcher swap on the engine thread: without it a
+        # submit could land in the dying batcher's queue after the
+        # supervisor scanned it, stranding the request forever.  Held only
+        # for host bookkeeping (never across an await or a device call).
+        self._submit_lock = threading.Lock()
+        self._restarts = 0
+        self._engine_dead = False  # respawn itself failed; serve errors
+        self._last_progress = time.monotonic()  # engine watchdog stamp
+        self._recover_t0: float | None = None  # crash time, for recovery_seconds
         self._work = threading.Event()
         self._stopping = False
         self._draining = False  # graceful stop: reject new, finish in-flight
@@ -176,6 +250,15 @@ class InferenceServer:
             # Every active row delivers each chunk, so the cancel flags
             # drain run() within one chunk; join must not block the loop.
             await asyncio.to_thread(self._engine.join, 60.0)
+        # The engine answered every mailbox; give handler coroutines a
+        # bounded window to consume those final deliveries and FLUSH their
+        # (partial) responses before the connections are torn down — a
+        # force-stopped request should see "200, fewer tokens", not a
+        # reset socket.  Bounded so a dead client cannot hold shutdown.
+        if self._loop is not None:
+            deadline = self._loop.time() + 5.0
+            while self._requests and self._loop.time() < deadline:
+                await asyncio.sleep(0.02)
         if self._server is not None:
             self._server.close()
             for w in list(self._conns):
@@ -209,28 +292,133 @@ class InferenceServer:
                 return
             if not self._pending():
                 continue
+            self._last_progress = time.monotonic()
             try:
                 self.batcher.run(on_tokens=self._deliver)
             except Exception:
-                log.exception("batcher.run failed; failing in-flight requests")
-                for rid in list(self._requests):
-                    self.batcher.cancel_row(rid)
-                    # Discard only rids we handled — a blanket clear()
-                    # could drop a disconnect flag the loop thread added
-                    # concurrently for a request not in this snapshot.
-                    self._cancelled.discard(rid)
-                    self._notify(rid, [], True, err="internal engine error")
+                log.exception("batcher.run crashed; supervising a restart")
+                try:
+                    self._recover_engine()
+                except Exception:
+                    # Respawn itself failed (OOM, wedged device): fail
+                    # everything in flight and mark the engine dead so
+                    # /healthz goes unhealthy — crash-only all the way up.
+                    log.exception(
+                        "engine recovery failed; failing in-flight requests"
+                    )
+                    self._engine_dead = True
+                    for rid in list(self._requests):
+                        self._cancelled.discard(rid)
+                        self._notify(rid, [], True,
+                                     err="engine unrecoverable")
+                    return
+                continue  # fresh batcher: nothing of the old run to clear
             # run() accumulated per-rid results we already streamed; drop
             # them so a long-lived server's memory stays flat.
             self.batcher.results.clear()
             self.batcher.result_logprobs.clear()
             self.batcher.prefix_cached_tokens.clear()
 
+    def _recover_engine(self) -> None:
+        """Supervisor (engine thread): replace the crashed batcher with a
+        fresh one and triage every in-flight request.
+
+        Zero-streamed requests re-admit under their ORIGINAL rid (the
+        handler's mailbox/cancel bookkeeping keys on it) with a bounded
+        retry budget — at temperature 0 the re-decode is token-identical,
+        the same recompute-is-exact contract prefix caching relies on.
+        Partially-streamed requests fail with a structured error: their
+        deltas are already on the wire and cannot be retracted.  The swap
+        and the queue re-seed happen under _submit_lock so a concurrent
+        HTTP submit can never land in the dying batcher."""
+        crash_t = time.monotonic()
+        old = self.batcher
+        new = (self._batcher_factory() if self._batcher_factory is not None
+               else old.respawn())
+        # Named prefixes are host-side KV (never donated); carry them over
+        # so registered system prompts survive the restart.
+        new.prefixes.update(old.prefixes)
+        retried: list[int] = []
+        failed: list[int] = []
+        with self._submit_lock:
+            for rid in sorted(self._requests):
+                mbox = self._requests[rid]
+                meta = mbox.meta
+                if rid in self._cancelled:
+                    # Canceller (disconnect/stop hit) initiated this and
+                    # already knows; ack quietly like cancel_row would.
+                    self._cancelled.discard(rid)
+                    self._notify(rid, [], True)
+                    continue
+                if (meta is not None
+                        and mbox.delivered == 0
+                        and mbox.retries < self.max_request_retries):
+                    mbox.retries += 1
+                    # Re-admit under the ORIGINAL rid (handler bookkeeping
+                    # keys on it) through the normal submit path, so every
+                    # validation/normalization rule applies identically.
+                    new._next_rid = rid
+                    try:
+                        got = new.submit(meta["ids"], **{
+                            k: v for k, v in meta.items() if k != "ids"
+                        })
+                        assert got == rid
+                        retried.append(rid)
+                        continue
+                    except (ValueError, KeyError):
+                        log.exception("re-admission of rid %d failed", rid)
+                failed.append(rid)
+                self._cancelled.discard(rid)
+                self._notify(rid, [], True, err=_RESTART_ERR)
+            new._next_rid = old._next_rid  # rid continuity across the swap
+            self.batcher = new
+        self._restarts += 1
+        if retried:
+            # Recovery latency closes at the first post-restart delivery.
+            self._recover_t0 = crash_t
+        else:
+            # Nothing to re-admit: recovery is complete right here — leaving
+            # _recover_t0 armed would bill the idle gap until the NEXT
+            # request as "recovery".
+            METRICS.observe(
+                "server.recovery_seconds", time.monotonic() - crash_t
+            )
+            self._recover_t0 = None
+        METRICS.inc("server.engine_restarts")
+        if retried:
+            METRICS.inc("server.requests_retried", len(retried))
+        # The fresh pool must audit clean — a failure here means respawn
+        # itself leaked, which the outer except escalates to engine-dead.
+        new.assert_pool_consistent()
+        log.warning(
+            "engine restarted (#%d): %d request(s) re-admitted, %d failed "
+            "partially-streamed", self._restarts, len(retried), len(failed),
+        )
+        self._last_progress = time.monotonic()
+        if retried or self._pending():
+            self._work.set()
+
     def _deliver(self, rid: int, toks: list[int], done: bool,
                  lps: list[float] | None = None) -> None:
         # Engine thread, between device chunks: the one safe point to act
         # on loop-side cancel flags.
+        self._last_progress = time.monotonic()  # watchdog: engine is moving
+        if self._recover_t0 is not None:
+            # First delivery after a supervised restart: recovery latency
+            # (crash -> tokens flowing again), exported at /metrics.
+            METRICS.observe(
+                "server.recovery_seconds", time.monotonic() - self._recover_t0
+            )
+            self._recover_t0 = None
         mbox = self._requests.get(rid)
+        if mbox is not None and toks:
+            # Engine-side streamed accounting: the supervisor's
+            # zero-streamed test reads THIS, not loop-side queue state
+            # (which lags by however many deliveries sit unconsumed).
+            # Writing through the mailbox is benign even if the handler
+            # pops _requests[rid] between the get() above and here — the
+            # write lands on a garbage object, not a resurrected entry.
+            mbox.delivered += len(toks)
         if mbox is not None and mbox.cached_tokens is None:
             # Prefix-cache usage accounting: the batcher recorded the rid's
             # cached prompt tokens at admission (before any delivery); this
@@ -243,8 +431,26 @@ class InferenceServer:
             if not done:
                 self.batcher.cancel_row(rid)
             self._notify(rid, toks, True, lps=lps)
+            self._sweep_cancelled(exclude=rid)
             return
         self._notify(rid, toks, done, lps=lps)
+        self._sweep_cancelled(exclude=rid)
+
+    def _sweep_cancelled(self, exclude: int) -> None:
+        """Consume cancel flags for OTHER rids at this chunk boundary.
+        A QUEUED request (no row yet, so no deliveries of its own) would
+        otherwise never see its flag consumed — a timed-out queued request
+        would sit out the full ack grace instead of cancelling at the next
+        chunk boundary as documented.  cancel_row is legal here: we are
+        inside run()'s on_tokens callback, the documented safe point."""
+        if len(self._cancelled) <= (1 if exclude in self._cancelled else 0):
+            return
+        for other in list(self._cancelled):
+            if other == exclude:
+                continue
+            if self.batcher.cancel_row(other):
+                self._cancelled.discard(other)
+                self._notify(other, [], True)
 
     def _notify(self, rid: int, toks: list[int], done: bool,
                 err: str | None = None, lps: list[float] | None = None):
@@ -318,11 +524,50 @@ class InferenceServer:
         body = await reader.readexactly(content_len) if content_len else b""
         return method, path, body
 
+    def health(self) -> tuple[int, dict]:
+        """Readiness/liveness report behind GET /healthz.  Non-200 while
+        draining (load balancers stop routing BEFORE the drain 503s start)
+        or when the engine is dead/stalled: stalled means in-flight work
+        exists but the engine has not delivered a chunk within
+        ``watchdog_timeout_s`` (a wedged device call looks exactly so)."""
+        age = time.monotonic() - self._last_progress
+        alive = (not self._engine_dead
+                 and self._engine is not None and self._engine.is_alive())
+        # "Work exists" must include batcher-held rows, not just open HTTP
+        # handlers: timed-out handlers answer their clients and leave
+        # _requests while a wedged engine still pins their rows/pages —
+        # keying on _requests alone would report a wedged engine healthy
+        # the moment the last handler gave up.  _pending() reads batcher
+        # state the engine thread owns, but only immutable-list iteration
+        # and attribute loads — safe cross-thread for a health probe.
+        busy = bool(self._requests) or bool(self._cancelled) or self._pending()
+        stalled = busy and age > self.watchdog_timeout_s
+        healthy = alive and not stalled and not self._draining
+        METRICS.set_gauge("server.engine_last_chunk_age_s", age)
+        status = ("ok" if healthy
+                  else "draining" if self._draining and alive and not stalled
+                  else "unhealthy")
+        return (200 if healthy else 503), {
+            "status": status,
+            "engine_alive": alive,
+            "engine_stalled": stalled,
+            "seconds_since_last_chunk": round(age, 3),
+            "draining": self._draining,
+            "inflight_requests": len(self._requests),
+            "engine_restarts": self._restarts,
+        }
+
     async def _route(self, writer, method: str, path: str, body: bytes,
                      t0: float) -> None:
         if method == "GET" and path == "/healthz":
-            await self._plain(writer, 200, "ok\n")
+            code, report = self.health()
+            await self._json(writer, code, report)
         elif method == "GET" and path == "/metrics":
+            # Refresh the watchdog gauge so scrapes see a current age.
+            METRICS.set_gauge(
+                "server.engine_last_chunk_age_s",
+                time.monotonic() - self._last_progress,
+            )
             await self._respond(
                 writer, 200, "text/plain; version=0.0.4; charset=utf-8",
                 METRICS.prometheus_text().encode(),
@@ -461,6 +706,19 @@ class InferenceServer:
         n = _field(req, "n", 1, int, minimum=1)
         if n > 8:
             raise BadRequest("'n' must be <= 8")
+        timeout_s = req.get("timeout_s")
+        if timeout_s is not None:
+            # Per-request deadline: generation past it cancels at the next
+            # chunk boundary and returns finish_reason "timeout" with the
+            # tokens produced so far.
+            if (not isinstance(timeout_s, (int, float))
+                    or isinstance(timeout_s, bool)
+                    or not math.isfinite(float(timeout_s))
+                    or float(timeout_s) <= 0):
+                raise BadRequest("'timeout_s' must be a positive number")
+            timeout_s = float(timeout_s)
+        else:
+            timeout_s = self.request_timeout_s  # server-wide default (maybe None)
         if len(self._requests) + n > self.max_pending:
             await self._json(writer, 429, _err_body("server request queue is full"))
             return
@@ -472,38 +730,64 @@ class InferenceServer:
         if self._stopping:
             await self._json(writer, 500, _err_body("server is shutting down"))
             return
+        if self._engine_dead:
+            # Recovery itself failed (the engine thread exited): a submit
+            # would queue into a batcher nothing will ever run — answer
+            # with the structured engine error instead of hanging the
+            # handler forever.  /healthz is already non-200.
+            await self._json(
+                writer, 500, _err_body("engine unrecoverable", "engine_error")
+            )
+            return
         # One batcher request per choice.  Register each mailbox BEFORE its
         # submit: the engine thread may already be inside run() and can
         # admit + deliver the moment the request hits the queue — a mailbox
         # registered after submit would miss those deliveries (and hang
         # forever on a 1-chunk completion).  All submissions happen on this
-        # loop thread, so next_rid is ours.
+        # loop thread, so next_rid is ours.  The whole block holds
+        # _submit_lock (pure host bookkeeping, no awaits) so the
+        # supervisor's batcher swap cannot interleave and strand a request
+        # in a dying batcher's queue.
+        meta = dict(
+            ids=list(prompt_ids), max_new_tokens=max_tokens, prefix=prefix,
+            temperature=temperature, top_p=top_p, top_k=top_k,
+            presence_penalty=pres_pen, frequency_penalty=freq_pen,
+            prefix_cache=use_cache,
+        )
         subs: list[tuple[int, int, _Mailbox]] = []  # (choice index, rid, mbox)
-        for idx in range(n):
-            rid = self.batcher.next_rid
-            mbox = _Mailbox()
-            mbox.t0 = t0  # latency clocks run from request receipt
-            self._requests[rid] = mbox
-            try:
-                got = self.batcher.submit(
-                    prompt_ids, max_new_tokens=max_tokens, prefix=prefix,
-                    temperature=temperature, top_p=top_p, top_k=top_k,
-                    presence_penalty=pres_pen, frequency_penalty=freq_pen,
-                    prefix_cache=use_cache,
-                )
-                assert got == rid
-            except (ValueError, KeyError) as e:
-                self._requests.pop(rid, None)
-                for _, r, _m in subs:
-                    # Already-queued siblings die too — via the cancel
-                    # flag, NOT cancel_row: the engine thread may be mid-
-                    # run() and owns the batcher state.
-                    self._cancelled.add(r)
-                    self._requests.pop(r, None)
-                self._work.set()  # let an idle engine drain the flags
-                await self._json(writer, 400, _err_body(str(e)))
-                return
-            subs.append((idx, rid, mbox))
+        sub_err: Exception | None = None
+        with self._submit_lock:
+            for idx in range(n):
+                rid = self.batcher.next_rid
+                mbox = _Mailbox()
+                mbox.t0 = t0  # latency clocks run from request receipt
+                if timeout_s is not None:
+                    mbox.deadline = t0 + timeout_s
+                mbox.meta = meta
+                self._requests[rid] = mbox
+                try:
+                    got = self.batcher.submit(
+                        prompt_ids, max_new_tokens=max_tokens, prefix=prefix,
+                        temperature=temperature, top_p=top_p, top_k=top_k,
+                        presence_penalty=pres_pen, frequency_penalty=freq_pen,
+                        prefix_cache=use_cache,
+                    )
+                    assert got == rid
+                except (ValueError, KeyError) as e:
+                    self._requests.pop(rid, None)
+                    for _, r, _m in subs:
+                        # Already-queued siblings die too — via the cancel
+                        # flag, NOT cancel_row: the engine thread may be
+                        # mid-run() and owns the batcher state.
+                        self._cancelled.add(r)
+                        self._requests.pop(r, None)
+                    sub_err = e
+                    break
+                subs.append((idx, rid, mbox))
+        if sub_err is not None:
+            self._work.set()  # let an idle engine drain the flags
+            await self._json(writer, 400, _err_body(str(sub_err)))
+            return
         self._work.set()
         METRICS.inc("server.requests")
         oid = ("chatcmpl-" if chat else "cmpl-") + uuid.uuid4().hex[:24]
@@ -554,10 +838,66 @@ class InferenceServer:
         ids: list[int] = []
         lps: list[float] = []
         stopped_at: int | None = None
+        timed_out = False
         scanned = 0  # chars already known stop-free
         hold = max((len(s) for s in stop), default=1) - 1
         while True:
-            toks, done, err, new_lps = await mbox.queue.get()
+            try:
+                if timed_out:
+                    # Deadline already hit; we only wait (briefly) for the
+                    # engine to ack the cancel so the row is provably freed.
+                    toks, done, err, new_lps = await asyncio.wait_for(
+                        mbox.queue.get(), _TIMEOUT_ACK_GRACE_S
+                    )
+                elif mbox.deadline is not None:
+                    try:
+                        # Deliveries already sitting in the mailbox were
+                        # produced BEFORE now (possibly the final done) —
+                        # bill them even if the deadline lapsed while this
+                        # handler was blocked writing to a slow client.
+                        # Only an EMPTY mailbox past the deadline times out.
+                        toks, done, err, new_lps = mbox.queue.get_nowait()
+                    except asyncio.QueueEmpty:
+                        remaining = mbox.deadline - time.perf_counter()
+                        if remaining <= 0:
+                            raise asyncio.TimeoutError from None
+                        toks, done, err, new_lps = await asyncio.wait_for(
+                            mbox.queue.get(), remaining
+                        )
+                else:
+                    toks, done, err, new_lps = await mbox.queue.get()
+            except asyncio.TimeoutError:
+                if timed_out:
+                    # Engine never acked within the grace window (stalled):
+                    # answer the client anyway.  The cancel flag stays set,
+                    # so the row still frees whenever the engine recovers.
+                    if stopped_at is not None:
+                        yield None, ids, lps, True, "stopped"
+                    else:
+                        yield tok.decode(ids), ids, lps, True, "timeout"
+                    return
+                # Deadline expired.  After a stop-sequence hit the response
+                # already terminated on "stop" and we are only draining the
+                # cancel ack — switch to the bounded ack wait but don't
+                # relabel a legitimate stop as a timeout (the rid is
+                # already cancel-flagged from the hit).
+                timed_out = True
+                if stopped_at is None:
+                    self._cancelled.add(rid)
+                    self._work.set()
+                    METRICS.inc("server.request_timeouts")
+                continue
+            if timed_out:
+                # Post-deadline deliveries exist only to confirm the row is
+                # freed; their tokens arrived past the deadline — not billed.
+                if done:
+                    mbox.finished = True
+                    if stopped_at is not None:
+                        yield None, ids, lps, True, "stopped"
+                    else:
+                        yield tok.decode(ids), ids, lps, True, "timeout"
+                    return
+                continue
             if err is None and not mbox.first_seen:
                 # Time to first token, measured from request receipt
                 # (mbox.t0 is set by _completions from _handle's clock, so
@@ -638,12 +978,18 @@ class InferenceServer:
                     text = t
                 reason = "stop"
                 break
+            if err == "timeout":
+                # Deadline hit: the tokens produced so far ARE the response.
+                if t is not None:
+                    text = t
+                reason = "timeout"
+                break
             if err is not None:
                 return text, ids, lps, reason, err
             text = t
             if done:
                 break
-        if reason != "stop" and self.batcher.eos_id >= 0 and (
+        if reason == "length" and self.batcher.eos_id >= 0 and (
             ids and ids[-1] == self.batcher.eos_id
         ):
             reason = "stop"
@@ -658,7 +1004,7 @@ class InferenceServer:
         ])
         fatal = next((e for *_x, e in outs if e is not None), None)
         if fatal is not None:
-            await self._json(writer, 500, _err_body(fatal))
+            await self._json(writer, 500, _err_body(fatal, _err_type(fatal)))
             return
         choices = []
         total_completion = 0
@@ -756,9 +1102,13 @@ class InferenceServer:
         async for text, ids, lps, done, err in self._collect_until_done(mbox, rid, stop):
             if err == "stopped":
                 stopped = True
+            elif err == "timeout":
+                reason = "timeout"  # final chunk carries it below
             elif err is not None:
                 await emit(
-                    b"data: " + json.dumps(_err_body(err)).encode() + b"\n\n"
+                    b"data: "
+                    + json.dumps(_err_body(err, _err_type(err))).encode()
+                    + b"\n\n"
                 )
                 break
             if text is not None:
@@ -792,10 +1142,10 @@ class InferenceServer:
             if delta and not done:
                 await emit(chunk(delta, None, lp_slice()))
             if done:
-                if stopped or (
+                if reason == "length" and (stopped or (
                     self.batcher.eos_id >= 0 and ids
                     and ids[-1] == self.batcher.eos_id
-                ):
+                )):
                     reason = "stop"
                 await emit(chunk(delta, reason, lp_slice()))
                 break
@@ -854,8 +1204,17 @@ class _Responded(Exception):
     """Internal: the parse phase already wrote an error response."""
 
 
-def _err_body(msg: str) -> dict:
-    return {"error": {"message": msg, "type": "invalid_request_error"}}
+def _err_body(msg: str, type_: str = "invalid_request_error") -> dict:
+    return {"error": {"message": msg, "type": type_}}
+
+
+def _err_type(msg: str) -> str:
+    """Error class for a mailbox-delivered failure: engine-side faults get
+    a structured machine-readable type (clients distinguish 'the engine
+    restarted under me, retry if idempotent' from bad input)."""
+    if msg in (_RESTART_ERR, "engine unrecoverable"):
+        return "engine_error"
+    return "server_error"
 
 
 def _lp_field(tok, ids: list[int], lps: list[float], chat: bool) -> dict:

@@ -1,7 +1,10 @@
 """Control-plane tests: real asyncio sockets on localhost (the reference
 faked its wire with mocked sockets, SURVEY §4; these run the actual stack),
 plus fault-injection: dead-worker eviction and task retry — capabilities the
-reference planned (plan.md:430-436) but never built."""
+reference planned (plan.md:430-436) but never built.  The fault paths are
+provoked DETERMINISTICALLY via runtime/faults.py (drop heartbeats, sever a
+reply connection) instead of killing tasks and sleeping past wall-clock
+deadlines."""
 
 import asyncio
 import json
@@ -13,6 +16,7 @@ from distributed_llms_tpu.cluster.client import CoordinatorClient
 from distributed_llms_tpu.cluster.coordinator import Coordinator
 from distributed_llms_tpu.cluster.worker import WorkerHost
 from distributed_llms_tpu.core.config import ClusterConfig, RuntimeConfig
+from distributed_llms_tpu.runtime.faults import FaultPlane
 
 
 def fast_cfg(**kw):
@@ -107,6 +111,45 @@ async def test_receive_timeout():
         await coord.stop()
 
 
+@pytest.mark.asyncio
+async def test_protocol_frame_faults_close_delay_drop():
+    """The fault plane wired into protocol framing: close severs the
+    stream mid-request, delay stalls a frame, drop swallows one on receive
+    — all deterministic, all through the REAL coordinator socket."""
+    import time
+
+    coord = Coordinator(fast_cfg())
+    await coord.start()
+    try:
+        # close: the client's GET_STATUS send dies with a connection error.
+        protocol.set_fault_plane(
+            FaultPlane.parse("proto.send/GET_STATUS:close@1")
+        )
+        with pytest.raises(ConnectionError, match="fault injection"):
+            async with CoordinatorClient("127.0.0.1", coord.port) as c:
+                await c.status()
+        # delay: the same request completes, measurably later.
+        protocol.set_fault_plane(
+            FaultPlane.parse("proto.send/GET_STATUS:delay@1:0.2")
+        )
+        t0 = time.perf_counter()
+        async with CoordinatorClient("127.0.0.1", coord.port) as c:
+            status = await c.status()
+        assert time.perf_counter() - t0 >= 0.2
+        assert "workers" in status
+        # drop on receive: the first RESULT frame is "lost in flight"; the
+        # client's read times out even though the coordinator answered.
+        protocol.set_fault_plane(
+            FaultPlane.parse("proto.recv/RESULT:drop@1")
+        )
+        with pytest.raises(asyncio.TimeoutError):
+            async with CoordinatorClient("127.0.0.1", coord.port) as c:
+                await c.request("GET_STATUS", timeout=0.5)
+    finally:
+        protocol.set_fault_plane(None)  # global hook: ALWAYS uninstall
+        await coord.stop()
+
+
 # ---------------------------------------------------------------------------
 # registration / heartbeat / eviction
 # ---------------------------------------------------------------------------
@@ -141,21 +184,29 @@ async def start_worker(coord, factory=fake_factory, **kw):
 
 @pytest.mark.asyncio
 async def test_register_heartbeat_and_eviction():
+    """Deadline eviction (reference never evicted: D10), provoked by FAULT
+    INJECTION: the worker stays alive but a `worker.heartbeat:drop@1+` rule
+    swallows every beat — exactly a silently-wedged host, with no task
+    killing and no fixed sleeps (poll loops bound the waits)."""
+    plane = FaultPlane()
     coord = Coordinator(fast_cfg())
     await coord.start()
     try:
-        w, wt = await start_worker(coord)
+        w, wt = await start_worker(coord, faults=plane)
         assert w.worker_id in coord.workers
         # heartbeats keep it alive past the timeout window
         await asyncio.sleep(0.9)
         assert w.worker_id in coord.workers
 
-        # kill the worker silently -> deadline eviction (reference never
-        # evicted: D10)
-        wt.cancel()
-        await asyncio.sleep(0.05)
-        await asyncio.sleep(1.0)
+        # Arm the fault mid-run: every subsequent heartbeat is dropped.
+        rule = plane.add("worker.heartbeat", "drop", when="1+")
+        for _ in range(200):  # poll-wait for the deadline eviction
+            if w.worker_id not in coord.workers:
+                break
+            await asyncio.sleep(0.05)
         assert w.worker_id not in coord.workers
+        assert rule.fired >= 1  # beats were really dropped, not just late
+        wt.cancel()
     finally:
         await coord.stop()
 
@@ -257,41 +308,60 @@ async def test_plan_place_generate_roundtrip(tmp_path):
 @pytest.mark.asyncio
 async def test_task_retry_on_worker_death(tmp_path):
     """Task dispatched to a worker that dies mid-flight is retried on the
-    survivor (planned in the reference, never built)."""
-
-    class SlowEngine(FakeEngine):
-        def generate_text(self, prompts, max_new_tokens=None):
-            import time
-
-            time.sleep(0.5)
-            return super().generate_text(prompts, max_new_tokens)
-
+    survivor (planned in the reference, never built).  Deterministic via
+    fault injection: the victim's `worker.result/GENERATE:close@1` rule
+    severs its connection at the exact moment it would reply — no
+    sleep-until-in-flight sampling, no task cancellation."""
     calls = []
 
     def factory(store_dir, shards, rt):
         calls.append(shards)
-        return SlowEngine()
+        return FakeEngine()
 
+    # The dispatcher picks the lowest idle worker id, and ids assign in
+    # registration order — the FIRST worker is deterministically the victim.
+    victim_plane = FaultPlane.parse("worker.result/GENERATE:close@1")
     coord = Coordinator(fast_cfg())
     await coord.start()
     try:
-        w1, t1 = await start_worker(coord, factory=factory, rt=RuntimeConfig())
+        w1, t1 = await start_worker(coord, factory=factory,
+                                    rt=RuntimeConfig(), faults=victim_plane)
         w2, t2 = await start_worker(coord, factory=factory)
         coord.plan_shards(2, store_dir=str(tmp_path))
         await coord.place_shards()
-        assert len(calls) == 2  # both workers built (slow) engines
+        assert len(calls) == 2  # both workers built engines
 
-        gen = asyncio.create_task(coord.generate(["x"], max_new_tokens=2))
-        await asyncio.sleep(0.15)  # task is in-flight on some worker
-        inflight = [t for t in coord.tasks.values()]
-        assert inflight, "task finished before fault injection"
-        victim = inflight[0].assigned_to
-        vw, vt = (w1, t1) if victim == w1.worker_id else (w2, t2)
-        vt.cancel()  # dies silently mid-task
-        out = await asyncio.wait_for(gen, timeout=15)
+        out = await asyncio.wait_for(
+            coord.generate(["x"], max_new_tokens=2), timeout=15
+        )
         assert out["text"] == ["x!"]
+        assert victim_plane.rules[0].fired == 1  # the victim really died
+        assert w1.worker_id not in coord.workers  # ...and was evicted
         for t in (t1, t2):
             t.cancel()
+    finally:
+        await coord.stop()
+
+
+@pytest.mark.asyncio
+async def test_task_retry_on_injected_handler_fault(tmp_path):
+    """An InjectedFault inside a worker's command handler surfaces as an
+    ERROR reply and the coordinator retries — the handler-crash leg of the
+    retry contract, distinct from connection death above."""
+    coord = Coordinator(fast_cfg())
+    await coord.start()
+    try:
+        plane = FaultPlane.parse("worker.handle/GENERATE:raise@1")
+        w, wt = await start_worker(coord, faults=plane)
+        coord.plan_shards(1, store_dir=str(tmp_path))
+        await coord.place_shards()
+        out = await asyncio.wait_for(
+            coord.generate(["y"], max_new_tokens=2), timeout=15
+        )
+        assert out["text"] == ["y!"]
+        assert plane.rules[0].fired == 1
+        assert w.worker_id in coord.workers  # handler crash, not death
+        wt.cancel()
     finally:
         await coord.stop()
 

@@ -66,8 +66,12 @@ def test_paged_mixed_budgets_match_solo(tiny):
     res = b.run()
     for rid, (ids, n) in zip(rids, reqs):
         assert res[rid] == solo(cfg, params, ids, n), f"request {rid} diverged"
-    # Every page returned to the pool at the end.
+    # Every page returned to the pool at the end, and the allocator's
+    # partition/refcount invariants audit clean (PagePool.assert_consistent
+    # — the recovery-path leak detector, also run after every supervisor
+    # engine restart).
     assert sorted(b.free_pages) == list(range(1, 9))
+    b.assert_pool_consistent()
 
 
 def test_paged_backpressure_and_reuse(tiny):
@@ -84,6 +88,7 @@ def test_paged_backpressure_and_reuse(tiny):
     for rid, (ids, n) in zip(rids, reqs):
         assert res[rid] == solo(cfg, params, ids, n), f"request {rid} diverged"
     assert sorted(b.free_pages) == [1, 2]
+    b.assert_pool_consistent()
 
 
 def test_paged_prefix_caching(tiny):

@@ -131,6 +131,8 @@ def test_refcount_never_frees_a_live_page(tiny):
     assert res[r2] == solo(cfg, params, SHARED + [4, 4], 2)
     accounted()
     assert not b.page_refs  # everything released; cached pages in the LRU
+    # The full allocator audit (partition + refcount-vs-row-holds) agrees.
+    b.assert_pool_consistent()
 
 
 def test_lru_eviction_under_pool_pressure(tiny):

@@ -112,8 +112,16 @@ def run_with_server(batcher, fn, **srv_kw):
 
 def test_health_models_metrics(tiny):
     async def fn(host, port, srv):
+        # /healthz is a real readiness report now: JSON body, 200 only
+        # while the engine thread is alive, unstalled, and not draining.
         status, body = await _request(host, port, "GET", "/healthz")
-        assert (status, body) == (200, b"ok\n")
+        assert status == 200
+        health = json.loads(body)
+        assert health["status"] == "ok"
+        assert health["engine_alive"] is True
+        assert health["draining"] is False
+        assert health["engine_restarts"] == 0
+        assert "seconds_since_last_chunk" in health
         status, body = await _request(host, port, "GET", "/v1/models")
         assert status == 200
         models = json.loads(body)
@@ -396,14 +404,18 @@ def test_graceful_drain_finishes_in_flight(tiny):
     ones run to completion (full token budget, finish_reason length) —
     the SIGTERM semantics of dlt-serve --drain-timeout."""
     async def fn(host, port, srv):
+        # 64 tokens of budget (~16 scheduling chunks) so the request is
+        # reliably still in flight when the drain starts — 24 used to
+        # complete inside one poll interval on a warm jit cache and flake
+        # the srv._requests check below.
         req_task = asyncio.create_task(_request(
             host, port, "POST", "/v1/completions",
-            {"prompt": "hello", "max_tokens": 24},
+            {"prompt": "hello", "max_tokens": 64},
         ))
-        for _ in range(200):  # wait until the request is registered
+        for _ in range(500):  # wait until the request is registered
             if srv._requests:
                 break
-            await asyncio.sleep(0.02)
+            await asyncio.sleep(0.01)
         assert srv._requests
         stop_task = asyncio.create_task(srv.stop(drain_timeout=60.0))
         await asyncio.sleep(0)  # let stop() flip _draining
@@ -416,8 +428,67 @@ def test_graceful_drain_finishes_in_flight(tiny):
         status, body = await req_task
         assert status == 200
         out = json.loads(body)
-        assert out["usage"]["completion_tokens"] == 24  # NOT cancelled
+        assert out["usage"]["completion_tokens"] == 64  # NOT cancelled
         await stop_task
+
+    run_with_server(make_batcher(tiny), fn)
+
+
+def test_force_stop_cuts_graceful_drain_short(tiny):
+    """Second-SIGTERM semantics: force_stop() mid-drain cancels in-flight
+    rows at their next chunk instead of letting them run to completion —
+    the drain returns promptly and the client gets a PARTIAL response.
+    A stall fault paces every chunk so the request is deterministically
+    still in flight when the force-stop lands (a warm jit cache can
+    otherwise finish 64 tokens inside the test's reaction time)."""
+    from distributed_llms_tpu.runtime.faults import FaultPlane
+
+    plane = FaultPlane.parse("batcher.decode:stall@1+:0.05")
+
+    async def fn(host, port, srv):
+        req_task = asyncio.create_task(_request(
+            host, port, "POST", "/v1/completions",
+            {"prompt": "linger", "max_tokens": 64},
+        ))
+        for _ in range(500):  # wait until the request is in flight
+            if srv._requests:
+                break
+            await asyncio.sleep(0.01)
+        assert srv._requests
+        t0 = asyncio.get_running_loop().time()
+        stop_task = asyncio.create_task(srv.stop(drain_timeout=60.0))
+        await asyncio.sleep(0.05)  # the drain is now waiting on the request
+        assert not stop_task.done()
+        srv.force_stop()  # second SIGTERM: cut the drain short
+        status, body = await req_task
+        await asyncio.wait_for(stop_task, timeout=30)
+        # Nowhere near the 60 s drain deadline.
+        assert asyncio.get_running_loop().time() - t0 < 30
+        assert status == 200
+        out = json.loads(body)
+        # Cancelled at a chunk boundary: fewer tokens than requested.
+        assert 0 < out["usage"]["completion_tokens"] < 64
+
+    run_with_server(make_batcher(tiny, max_len=128, faults=plane), fn)
+
+
+def test_force_stop_with_just_queued_request(tiny):
+    """Shutdown racing a just-queued request: the request lands in the
+    batcher queue as force_stop() flips _stopping — the engine's stopping
+    drain must still answer its mailbox (a structured shutdown error), not
+    strand the handler forever."""
+    from distributed_llms_tpu.runtime.server import _Mailbox
+
+    async def fn(host, port, srv):
+        rid = srv.batcher.next_rid
+        mbox = _Mailbox()
+        srv._requests[rid] = mbox
+        assert srv.batcher.submit("raced", max_new_tokens=8) == rid
+        srv.force_stop()  # immediate: skips the drain entirely
+        srv._work.set()
+        toks, done, err, _lps = await asyncio.wait_for(mbox.queue.get(), 10)
+        assert done and err == "server is shutting down"
+        srv._requests.pop(rid, None)
 
     run_with_server(make_batcher(tiny), fn)
 

@@ -53,6 +53,10 @@ def _result_cell(row: dict) -> str:
         ("ttft_ms_shared_on", "shared-prefix TTFT ms on"),
         ("prefill_tokens_saved", "prefill tokens saved"),
         ("hit_rate", "hit rate"),
+        ("recovery_ms", "recovery ms"),
+        ("completed_frac", "completed frac"),
+        ("engine_restarts", "engine restarts"),
+        ("requests_retried", "requests retried"),
     ):
         if row.get(k) is not None:
             v = row[k]
@@ -83,7 +87,7 @@ def generate(ladder_path: str) -> str:
     listed = [str(e["config"]) for e in bench.LADDER] + [
         # Aux rows run_ladder appends after the decode configs.
         "serving-latency", "continuous-batching", "local-proc-batching",
-        "chunked-prefill", "prefix-cache-ttft",
+        "chunked-prefill", "prefix-cache-ttft", "fault-recovery",
         "ragged-decode-8k", "ragged-decode-win-8k", "quant-matmul-bw",
         "spec-decode", "spec-decode-7b-int8", "spec-batching",
         "paged-batching", "prefill-flash-2048", "prefill-flash-8192",
