@@ -1325,6 +1325,100 @@ def _measure_fault_recovery(
     return out
 
 
+def _measure_overload_goodput(
+    preset: str | None = None, dtype: str = "bfloat16",
+    requests: int = 10, new_tokens: int = 48, page_size: int = 16,
+) -> dict:
+    """Overload-safe serving (PR 3): offered load at ~2x the KV pool's
+    token capacity against a small paged pool.  Rows admit with prompt +
+    one decode page and GROW on demand; the pool runs dry mid-storm, so
+    the engine preempts (recompute, temp-0 exact) while the server's cost
+    gate sheds the tail of the burst with 429 + Retry-After.  Reported:
+    goodput (completed tokens/s of wall time), the shed fraction, and the
+    preemption count — a host-scheduling effect, honestly measurable on
+    any platform.  Clients take NO retries (we are measuring the shed
+    policy, not retry patience)."""
+    import asyncio
+
+    from distributed_llms_tpu.cluster.client import ServingClient
+    from distributed_llms_tpu.core.observability import METRICS
+    from distributed_llms_tpu.runtime.batcher import ContinuousBatcher
+    from distributed_llms_tpu.runtime.server import InferenceServer
+    from distributed_llms_tpu.runtime.tokenizer import ByteTokenizer
+
+    preset = preset or ("gpt2-125m" if jax.devices()[0].platform == "cpu"
+                        else "tinyllama-1.1b")
+    cfg, params = _build_params(preset, dtype, None)
+    tok = ByteTokenizer()
+    slots = 8
+    max_len = 8 * page_size
+    pool_pages = 21  # 20 usable = 320-token capacity at page 16
+
+    def make_batcher():
+        return ContinuousBatcher(
+            cfg, params, tokenizer=tok, eos_id=tok.eos_id, pad_id=tok.pad_id,
+            batch_slots=slots, max_len=max_len, chunk_steps=4,
+            paged_pages=pool_pages, page_size=page_size,
+        )
+
+    # Warm the compiled programs outside the timing.
+    warm = make_batcher()
+    warm.submit("warm me up", max_new_tokens=new_tokens)
+    warm.run()
+
+    prompts = [f"overload req {i:02d}" for i in range(requests)]
+    capacity = (pool_pages - 1) * page_size
+    offered = sum(len(tok.encode(p)) + new_tokens for p in prompts)
+
+    async def drive() -> dict:
+        srv = InferenceServer(
+            make_batcher(), model_name="bench", host="127.0.0.1", port=0,
+            shed_cost_factor=1.2,
+        )
+        host, port = await srv.start()
+        preempt0 = METRICS.get_counter("batcher.preemptions_total")
+        shed0 = METRICS.get_counter("server.requests_shed_total")
+        clients = [ServingClient(host, port, max_retries=0)
+                   for _ in prompts]
+        t0 = time.perf_counter()
+        outs = await asyncio.gather(*[
+            c.completions({"prompt": p, "max_tokens": new_tokens})
+            for c, p in zip(clients, prompts)
+        ])
+        wall = time.perf_counter() - t0
+        for _ in range(200):  # drain before the audit
+            if all(r.rid is None for r in srv.batcher.rows):
+                break
+            await asyncio.sleep(0.05)
+        srv.batcher.assert_pool_consistent()
+        await srv.stop()
+        completed = [o for s, o in outs if s == 200]
+        good_tokens = sum(o["usage"]["completion_tokens"] for o in completed)
+        shed = sum(1 for s, _o in outs if s in (429, 503))
+        assert len(completed) + shed == requests, outs
+        return {
+            "requests": requests,
+            "new_tokens": new_tokens,
+            "pool_capacity_tokens": capacity,
+            "offered_x": round(offered / capacity, 2),
+            "completed": len(completed),
+            "completed_frac": round(len(completed) / requests, 3),
+            "shed_frac": round(shed / requests, 3),
+            "goodput_tok_per_s": round(good_tokens / wall, 1),
+            "preemptions": int(
+                METRICS.get_counter("batcher.preemptions_total") - preempt0
+            ),
+            "requests_shed": int(
+                METRICS.get_counter("server.requests_shed_total") - shed0
+            ),
+            "wall_ms": round(wall * 1e3, 1),
+        }
+
+    out = asyncio.run(drive())
+    out.update({"preset": preset, "platform": jax.devices()[0].platform})
+    return out
+
+
 def _measure_prefill_flash(
     preset: str = "tinyllama-1.1b", batch: int = 2, seq: int = 2048,
     dtype: str = "bfloat16", iters: int = 5, window: int | None = None,
@@ -1630,7 +1724,7 @@ def run_ladder(args, degraded: str | None) -> list[dict]:
             "prefill-flash-win-8192", "hop-latency",
             "spec-decode", "spec-decode-7b-int8", "spec-batching",
             "local-proc-batching", "chunked-prefill", "prefix-cache-ttft",
-            "fault-recovery",
+            "fault-recovery", "overload-goodput",
         }
         unknown = only - known
         if unknown:  # a typo must not masquerade as a clean zero-row run
@@ -1757,6 +1851,12 @@ def run_ladder(args, degraded: str | None) -> list[dict]:
         # requests that still complete — a host-scheduling effect,
         # meaningful on any platform.
         ("fault-recovery", lambda: _measure_fault_recovery(dtype=dtype)),
+        # Overload-safe serving: ~2x pool-capacity offered load against a
+        # small paged pool; stamps goodput, the shed fraction (cost-gate
+        # 429s with Retry-After), and how many preemptions the on-demand
+        # growth plane took — a host-scheduling effect, meaningful on any
+        # platform.
+        ("overload-goodput", lambda: _measure_overload_goodput(dtype=dtype)),
     ]
     if not on_cpu:
         # Paged vs contiguous batching (pool at ~45% of contiguous KV

@@ -84,6 +84,9 @@ def build_server(args) -> InferenceServer:
                            if args.request_timeout is not None
                            else rt.request_timeout_s),
         watchdog_timeout_s=args.watchdog_timeout,
+        shed_cost_factor=(args.shed_cost_factor
+                          if args.shed_cost_factor is not None
+                          else rt.shed_cost_factor),
     )
 
 
@@ -170,6 +173,13 @@ def main(argv=None) -> None:
                          "returns finish_reason \"timeout\" with its "
                          "partial output; a request's own timeout_s field "
                          "wins (default: runtime.request_timeout_s)")
+    ap.add_argument("--shed-cost-factor", type=float, default=None,
+                    help="estimated-cost admission gate: 429 (with "
+                         "Retry-After) once queued + resident token mass "
+                         "exceeds this multiple of KV capacity — overload "
+                         "sheds at the front door instead of queueing "
+                         "doomed work (0 disables; default: "
+                         "runtime.shed_cost_factor)")
     ap.add_argument("--watchdog-timeout", type=float, default=30.0,
                     help="engine watchdog: /healthz flips unhealthy when "
                          "in-flight work exists but no chunk was delivered "

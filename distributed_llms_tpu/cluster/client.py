@@ -1,9 +1,16 @@
 """Thin control-plane client (used by the CLI and tests): ask the
-coordinator for status/metrics or submit generation, over the JSON protocol."""
+coordinator for status/metrics or submit generation, over the JSON protocol.
+Plus :class:`ServingClient`, an overload-aware HTTP client for the serving
+gateway (runtime/server.py): 429/503 answers carry ``Retry-After``, and the
+client honors it with jittered exponential backoff on top — the polite-load
+half of the server's shedding contract (bench.py's overload ladder row and
+the overload tests drive traffic through it)."""
 
 from __future__ import annotations
 
 import asyncio
+import json
+import random
 import uuid
 from typing import Any
 
@@ -51,3 +58,91 @@ class CoordinatorClient:
 
     async def metrics(self) -> dict:
         return await self.request("GET_METRICS")
+
+
+class ServingClient:
+    """Async HTTP client for the serving gateway with overload-aware
+    retries.
+
+    A 429 (queue full / cost gate) or 503 (draining / shed) answer is
+    retried up to ``max_retries`` times: the wait honors the server's
+    ``Retry-After`` header (clamped to ``retry_after_cap_s`` when set — CI
+    and benches cannot sleep 30 s per hint) PLUS a jittered exponential
+    term ``U(0,1) * min(backoff_cap_s, backoff_base_s * 2^attempt)``, so a
+    thundering herd that was shed together does not come back together.
+    Connection errors retry on the same schedule (the server may be
+    mid-restart) — NOTE that a connection dying mid-response therefore
+    re-submits a request the server may have fully served (at-least-once
+    semantics; fine for the benches/tests this client drives, not for
+    billing-sensitive traffic).  ``retries_taken`` counts backoff waits
+    for tests/bench.
+    """
+
+    def __init__(self, host: str, port: int, max_retries: int = 4,
+                 backoff_base_s: float = 0.25, backoff_cap_s: float = 8.0,
+                 retry_after_cap_s: float | None = None,
+                 rng: random.Random | None = None) -> None:
+        self.host = host
+        self.port = port
+        self.max_retries = max_retries
+        self.backoff_base_s = backoff_base_s
+        self.backoff_cap_s = backoff_cap_s
+        self.retry_after_cap_s = retry_after_cap_s
+        self.retries_taken = 0
+        self._rng = rng if rng is not None else random.Random()
+
+    async def _once(self, path: str, body: dict) -> tuple[int, dict, dict]:
+        reader, writer = await asyncio.open_connection(self.host, self.port)
+        try:
+            payload = json.dumps(body).encode()
+            writer.write(
+                f"POST {path} HTTP/1.1\r\nHost: {self.host}\r\n"
+                f"Content-Length: {len(payload)}\r\n\r\n".encode() + payload
+            )
+            await writer.drain()
+            status = int((await reader.readline()).split()[1])
+            headers: dict[str, str] = {}
+            while True:
+                line = await reader.readline()
+                if line in (b"\r\n", b"\n", b""):
+                    break
+                name, _, value = line.decode("latin-1").partition(":")
+                headers[name.strip().lower()] = value.strip()
+            raw = await reader.read()
+            out = json.loads(raw) if raw.strip() else {}
+            return status, headers, out
+        finally:
+            writer.close()
+
+    def _delay_s(self, attempt: int, headers: dict[str, str]) -> float:
+        try:
+            hinted = float(headers.get("retry-after", 0) or 0)
+        except ValueError:
+            hinted = 0.0
+        if self.retry_after_cap_s is not None:
+            hinted = min(hinted, self.retry_after_cap_s)
+        jittered = self._rng.random() * min(
+            self.backoff_cap_s, self.backoff_base_s * (2 ** attempt)
+        )
+        return hinted + jittered
+
+    async def completions(
+        self, body: dict, path: str = "/v1/completions",
+    ) -> tuple[int, dict]:
+        """POST a completion request; returns (status, response body).
+        Retries 429/503 (and connection failures) with Retry-After-honoring
+        jittered exponential backoff; any other status returns as-is."""
+        attempt = 0
+        while True:
+            headers: dict[str, str] = {}
+            try:
+                status, headers, out = await self._once(path, body)
+            except (ConnectionError, OSError, IndexError, ValueError):
+                status, out = None, {}
+            if status is not None and status not in (429, 503):
+                return status, out
+            if attempt >= self.max_retries:
+                return (status if status is not None else 599), out
+            await asyncio.sleep(self._delay_s(attempt, headers))
+            attempt += 1
+            self.retries_taken += 1

@@ -259,9 +259,16 @@ class RuntimeConfig:
     # Default per-request wall-clock deadline (seconds) applied by the
     # serving gateway when a request carries no "timeout_s" field of its
     # own.  An expired request cancels at the next chunk boundary and
-    # returns finish_reason "timeout" with the tokens produced so far.
+    # returns finish_reason "timeout" with the tokens produced so far; one
+    # that expires while still QUEUED is shed with 503 + Retry-After.
     # None = no default deadline.
     request_timeout_s: float | None = None
+    # Estimated-cost admission gate (runtime/server.py): new requests 429
+    # (with Retry-After) once queued + resident token mass exceeds this
+    # multiple of the batcher's KV capacity — sustained overload sheds at
+    # the front door instead of queueing work doomed to time out.
+    # None/0 disables the gate.
+    shed_cost_factor: float | None = 2.0
 
 
 @dataclass(frozen=True)

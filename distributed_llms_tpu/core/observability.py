@@ -104,6 +104,13 @@ class Metrics:
         with self._lock:
             self._gauges[name] = value
 
+    def set_gauges(self, values: dict[str, float]) -> None:
+        """Set a family of gauges under one lock acquisition — occupancy
+        views (e.g. the KV pool's batcher_pool_* snapshot) publish several
+        numbers that should land atomically for a scrape."""
+        with self._lock:
+            self._gauges.update(values)
+
     def observe(self, name: str, value: float) -> None:
         with self._lock:
             self._hists[name].observe(value)

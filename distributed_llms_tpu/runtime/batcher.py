@@ -48,6 +48,7 @@ results.
 from __future__ import annotations
 
 import hashlib
+import time
 from collections import OrderedDict, deque
 from dataclasses import dataclass, field
 from functools import partial
@@ -604,6 +605,40 @@ def finish_chunked_admission(
     )
 
 
+@partial(
+    jax.jit,
+    static_argnames=("temperature", "top_k", "top_p"),
+    donate_argnames=("cache",),  # row_k/row_v feed a gather-reshape XLA
+    #   cannot alias — donating them only triggers the unused-donation
+    #   warning every admission.
+)
+def finish_chunked_admission_paged(
+    cache: Any,              # page-pool KVCache
+    page_list: jax.Array,    # [P] int32, scratch-padded
+    row_k: jax.Array,        # [L, 1, P*BLK, KVH, HD] fully-prefilled row
+    row_v: jax.Array,
+    last_logits: jax.Array,  # [1, V] from the final prefill_chunk_step
+    rng: jax.Array,
+    temperature: float = 0.0,
+    top_k: int = 0,
+    top_p: float = 1.0,
+    temp_req: jax.Array | None = None,
+    topp_req: jax.Array | None = None,
+    topk_req: jax.Array | None = None,
+) -> tuple[Any, jax.Array, jax.Array]:
+    """Tail of a chunked admission in PAGED mode: sample the first token
+    from the final chunk's logits and scatter the transient row's pages
+    into the pool through ``page_list`` — the same _paged_splice every
+    monolithic paged admission uses, so results are bit-identical.  Pages
+    are allocated only HERE (on-demand: the whole prefill ran pageless),
+    so a long prompt never pins pool pages while it chunks in."""
+    return _paged_splice(
+        cache, page_list, KVCache(k=row_k, v=row_v),
+        last_logits[:, None, :], jnp.int32(1), rng, temperature, top_k,
+        top_p, temp_req, topp_req, topk_req,
+    )
+
+
 def _paged_pool(cfg: ModelConfig, num_pages: int, page_size: int, dtype=None):
     """KV page pools [L, NB, BLK, KVH, HD] (distinct k/v buffers — the
     chunk fns donate the cache)."""
@@ -908,7 +943,10 @@ def _bucket(n: int, floor: int = 8) -> int:
     return b
 
 
-@dataclass
+@dataclass(eq=False)  # identity equality: deque.remove/queue scans then
+#   compare C-level object pointers instead of running a generated Python
+#   __eq__ per element — the engine thread's queue scans stay atomic under
+#   the GIL against the serving loop thread's concurrent submit() appends.
 class _Request:
     rid: int
     ids: list[int]  # suffix ids when prefix is set, else the full prompt
@@ -922,6 +960,19 @@ class _Request:
     prefix_cache: bool = True  # per-request opt-out of AUTOMATIC caching
     digests: list | None = None  # memoized page digests — a back-pressured
     #   request retries admission every round; its prompt hash never changes
+    # Overload plane (PR 3): admission order is (priority desc, rid asc) —
+    # higher priority admits first and is preempted last; rid breaks ties
+    # FIFO (and lets a preempted request resume ahead of later arrivals).
+    priority: int = 0
+    # Absolute time.perf_counter() deadline: a request still QUEUED past it
+    # is shed (results empty, shed[rid] set) instead of admitted doomed.
+    deadline: float | None = None
+    # Preemption-with-recompute state: tokens this request already emitted
+    # (and streamed) in a previous residency.  ``ids`` then holds
+    # prompt + resume_emitted, so re-admission prefills the full context
+    # and the admission token CONTINUES the sequence (temp-0 exact).
+    resume_emitted: list[int] | None = None
+    resume_lps: list[float] | None = None
 
 
 @dataclass
@@ -1034,6 +1085,41 @@ class PagePool:
         # across rows; a page returns to free/LRU only at refcount 0).
         self.page_refs: dict[int, int] = {}
         self.prefix_cache = prefix_cache
+        # Watermarks: the least headroom an admission has ever seen and the
+        # most pages rows have ever held at once — the two numbers that say
+        # whether a production pool is sized right (a min_available of 0
+        # means admissions back-pressured or preempted; a peak_held far
+        # under num_pages means the pool is over-provisioned).
+        self.min_available = num_pages - 1
+        self.peak_held = 0
+
+    def _note_watermarks(self) -> None:
+        avail = self.available()
+        if avail < self.min_available:
+            self.min_available = avail
+        held = len(self.page_refs)
+        if held > self.peak_held:
+            self.peak_held = held
+
+    def stats(self) -> dict[str, int]:
+        """Occupancy snapshot: every usable page is exactly one of free /
+        LRU-cached / row-held (the partition assert_consistent audits)."""
+        pc = self.prefix_cache
+        return {
+            "total_pages": self.num_pages - 1,  # page 0 is scratch
+            "free_pages": len(self.free_pages),
+            "cached_pages": len(pc.lru) if pc is not None else 0,
+            "held_pages": len(self.page_refs),
+            "min_available": self.min_available,
+            "peak_held": self.peak_held,
+        }
+
+    def publish_gauges(self) -> None:
+        """Mirror the occupancy view into the process-wide METRICS registry
+        (rendered as batcher_pool_* on the gateway's /metrics)."""
+        METRICS.set_gauges({
+            f"batcher.pool.{k}": float(v) for k, v in self.stats().items()
+        })
 
     def available(self) -> int:
         """Pages an admission could obtain: the free list plus every
@@ -1057,6 +1143,7 @@ class PagePool:
                 METRICS.inc("batcher.prefix_cache.evicted_pages")
             self.page_refs[p] = 1
             out.append(p)
+        self._note_watermarks()
         return out
 
     def retain(self, p: int) -> None:
@@ -1068,6 +1155,7 @@ class PagePool:
         else:
             del self.prefix_cache.lru[p]
             self.page_refs[p] = 1
+        self._note_watermarks()
 
     def release(self, pages: list[int]) -> None:
         """Drop one reference per page.  At refcount 0 a content-cached
@@ -1150,6 +1238,12 @@ class _RowState:
     rid: int | None = None
     prefilling: bool = False  # chunked prefill in flight: the slot is
     #                     reserved but must not publish or decode yet
+    req: "_Request | None" = None  # the request as admitted — preemption
+    #                     rebuilds a resume request from it
+    priority: int = 0   # mirror of req.priority (victim selection)
+    admit_seq: int = 0  # monotone admission stamp: among equal priorities
+    #                     the MOST recently admitted row is preempted first
+    #                     (its lost work is smallest, vLLM's policy)
     emitted: list[int] = field(default_factory=list)
     lps: list[float] = field(default_factory=list)  # per-token logprobs
     #                     (raw TARGET distribution), aligned with emitted —
@@ -1194,9 +1288,13 @@ class ContinuousBatcher:
         seed: int = 0,
         parallel: Any = None,  # parallel.api.ParallelModel (GSPMD dp/tp)
         paged_pages: int | None = None,  # KV page-pool size (pages) — paged
-        #   mode: rows allocate only the pages their prompt+budget need, so
-        #   the pool can be far smaller than batch_slots * max_len; a full
-        #   pool back-pressures admission instead of OOMing.
+        #   mode: rows admit with pages for the PROMPT plus one decode page
+        #   and GROW on demand at chunk boundaries (vLLM's on-demand block
+        #   allocation), so the pool can be far smaller than
+        #   batch_slots * max_len; a dry pool evicts LRU-cold cached pages,
+        #   then preempts the lowest-priority / most-recently-admitted row
+        #   (freed pages now, recompute later — temp-0 streams stay exact),
+        #   then back-pressures admission instead of OOMing.
         page_size: int = 64,
         # Automatic prefix caching (paged mode only): every full page of an
         # admitted prompt is content-hashed into a PrefixCache; later
@@ -1312,11 +1410,13 @@ class ContinuousBatcher:
                 raise ValueError(
                     f"prefill_chunk must be >= 1, got {prefill_chunk}"
                 )
-            if self.speculative or parallel is not None or paged_pages is not None:
+            if self.speculative or parallel is not None:
+                # Paged mode composes since PR 3: the prefill runs against
+                # the pageless transient row and pool pages are allocated
+                # only at the finishing splice (on-demand, preemption-aware).
                 raise ValueError(
-                    "chunked prefill is single-device contiguous plain-"
-                    "batcher mode for now (no mesh, no paged KV, no "
-                    "speculative draft)"
+                    "chunked prefill is single-device mode for now (no "
+                    "mesh, no speculative draft)"
                 )
         if prefill_concurrency < 1:
             # Validated regardless of prefill_chunk: a bad value must not
@@ -1457,6 +1557,12 @@ class ContinuousBatcher:
         self.tok_counts: jax.Array | None = None
         self.rows = [_RowState() for _ in range(batch_slots)]
         self.queue: deque[_Request] = deque()
+        # Overload plane: rids shed while still queued (deadline expired
+        # before admission) with the reason — serving front-ends read it at
+        # the done delivery to answer 503 instead of a bare empty result.
+        self.shed: dict[int, str] = {}
+        self.preemptions = 0  # rows preempted for pool pressure (cumulative)
+        self._admit_seq = 0   # monotone admission stamp (victim selection)
         self.results: dict[int, list[int]] = {}
         # Per-token logprobs of each finished request; same lifecycle as
         # ``results`` (speculative mode gathers them from verify logits).
@@ -1525,6 +1631,14 @@ class ContinuousBatcher:
     def _release_pages(self, pages: list[int]) -> None:
         self.pool.release(pages)
 
+    def capacity_tokens(self) -> int:
+        """KV capacity in tokens: the denominator of the serving gateway's
+        estimated-cost admission gate.  Paged mode counts usable pool pages
+        (page 0 is scratch); contiguous mode counts slot-owned width."""
+        if self.paged:
+            return (self.pool.num_pages - 1) * self.page_size
+        return self.b * self.s
+
     def assert_pool_consistent(self) -> None:
         """Audit the page pool against the resident rows (no-op in
         contiguous mode).  The serving supervisor runs this after every
@@ -1568,6 +1682,7 @@ class ContinuousBatcher:
         top_p: float | None = None, top_k: int | None = None,
         presence_penalty: float = 0.0,
         frequency_penalty: float = 0.0, prefix_cache: bool = True,
+        priority: int = 0, deadline: float | None = None,
     ) -> int:
         """Queue a request.  ``temperature``/``top_p``/``top_k`` override
         the batcher's sampling config FOR THIS REQUEST (serving
@@ -1577,7 +1692,15 @@ class ContinuousBatcher:
         semantics, [-2, 2]) adjust logits against this request's own
         output tokens before sampling.  ``prefix_cache=False`` opts this
         request out of AUTOMATIC prefix caching (its prompt is neither
-        matched against nor published into the shared page cache)."""
+        matched against nor published into the shared page cache).
+
+        ``priority`` orders admission (higher first; FIFO within a
+        priority) and shields the row from preemption by lower-priority
+        work.  ``deadline`` is an ABSOLUTE time.perf_counter() timestamp:
+        a request still queued past it is shed (``shed[rid]`` records the
+        reason, results stay empty) instead of admitted doomed —
+        single-device only; multi-process meshes ignore deadlines (clocks
+        diverge across hosts and the admission loop must stay lockstep)."""
         ids = (
             self.tokenizer.encode(prompt)
             if isinstance(prompt, str)
@@ -1636,6 +1759,22 @@ class ContinuousBatcher:
             raise ValueError(
                 f"prefix_cache must be a bool, got {prefix_cache!r}"
             )
+        if isinstance(priority, bool) or not isinstance(priority, int) \
+                or not -(2**31) <= priority < 2**31:
+            raise ValueError(
+                f"priority must be an int in [-2**31, 2**31), got {priority!r}"
+            )
+        if deadline is not None:
+            import math
+
+            if isinstance(deadline, bool) \
+                    or not isinstance(deadline, (int, float)) \
+                    or not math.isfinite(float(deadline)):
+                raise ValueError(
+                    f"deadline must be a finite perf_counter timestamp, "
+                    f"got {deadline!r}"
+                )
+            deadline = float(deadline)
         for name, pen in (("presence_penalty", presence_penalty),
                           ("frequency_penalty", frequency_penalty)):
             if not -2.0 <= pen <= 2.0:  # also rejects NaN/inf
@@ -1661,7 +1800,7 @@ class ContinuousBatcher:
             temperature=temperature, top_p=top_p, top_k=top_k,
             presence_penalty=float(presence_penalty),
             frequency_penalty=float(frequency_penalty),
-            prefix_cache=prefix_cache,
+            prefix_cache=prefix_cache, priority=priority, deadline=deadline,
         ))
         return rid
 
@@ -1686,8 +1825,10 @@ class ContinuousBatcher:
         for req in list(self.queue):
             if req.rid == rid:
                 self.queue.remove(req)
-                self.results[rid] = []
-                self.result_logprobs[rid] = []
+                # A preempted request waiting for recompute already emitted
+                # (and streamed) a prefix — that IS its partial result.
+                self.results[rid] = list(req.resume_emitted or [])
+                self.result_logprobs[rid] = list(req.resume_lps or [])
                 METRICS.inc("batcher.cancelled")
                 return True
         for i in range(self.b):
@@ -1718,10 +1859,262 @@ class ContinuousBatcher:
         self._rng, sub = jax.random.split(self._rng)
         return sub
 
+    def _free_slot(self) -> int | None:
+        for i in range(self.b):
+            if not self.active[i] and self.rows[i].rid is None:
+                return i
+        return None
+
+    def _next_request(self) -> _Request:
+        """Admission order: highest priority first, FIFO (rid) within a
+        priority.  A preempted request keeps its original rid, so it
+        resumes ahead of later same-priority arrivals.  Deterministic in
+        the queue contents alone, so multi-process meshes stay lockstep.
+        The serving loop thread appends to the deque concurrently, so the
+        scan runs over a list() snapshot (a single C-level copy, atomic
+        under the GIL) — iterating the live deque with a Python key
+        callback could observe a mid-iteration append and raise."""
+        return max(list(self.queue), key=lambda r: (r.priority, -r.rid))
+
+    def _shed_expired_queued(self) -> None:
+        """Drop queued requests whose deadline has already passed: a
+        request that cannot possibly deliver a token before its deadline
+        must be SHED (the client gets 503 + Retry-After from the serving
+        gateway) rather than admitted doomed — admitting it would burn a
+        prefill plus pool pages on work nobody is waiting for.  A
+        PREEMPTED request waiting for recompute is different: it already
+        streamed tokens, so it finishes with that partial output (the
+        serving layer's own deadline reports ``finish_reason: "timeout"``)
+        — shedding it would discard delivered work and falsely tell the
+        client a retry is safe.  Wall-clock dependent, so multi-process
+        meshes skip it (host clocks diverge and the admission loop must
+        stay lockstep)."""
+        if self.pm is not None:
+            return
+        now = time.perf_counter()
+        # list() snapshot: the serving loop thread appends concurrently
+        # (a C-level copy is atomic under the GIL; a Python-level scan of
+        # the live deque is not).
+        for req in list(self.queue):
+            if req.deadline is None or req.deadline > now:
+                continue
+            self.queue.remove(req)
+            self.results[req.rid] = list(req.resume_emitted or [])
+            self.result_logprobs[req.rid] = list(req.resume_lps or [])
+            if req.resume_emitted:
+                # Mid-generation expiry (preempted, then the deadline
+                # lapsed while requeued): finish with the tokens already
+                # streamed — they ARE the response.
+                METRICS.inc("batcher.cancelled")
+                log.info(
+                    "finished preempted request %d at deadline with %d "
+                    "token(s)", req.rid, len(req.resume_emitted),
+                )
+            else:
+                self.shed[req.rid] = "queue deadline expired before admission"
+                METRICS.inc("batcher.shed_total")
+                log.info("shed queued request %d (deadline expired)", req.rid)
+            if self._on_tokens is not None:
+                self._on_tokens(req.rid, [], True, None)
+
+    # -- overload plane: preemption + on-demand growth (paged mode) --------
+
+    def _pick_victim(self, below_priority: int | None = None) -> int | None:
+        """The row to preempt under pool pressure: lowest priority first,
+        most-recently-admitted among equals (its lost work is smallest —
+        vLLM's recompute-preemption policy).  ``below_priority`` restricts
+        to STRICTLY lower-priority victims (the admission path: a newcomer
+        never preempts its own class, which would livelock two requests
+        trading the same pages).  Rows holding no pool pages (chunked
+        prefills in flight) are skipped — preempting them frees nothing.
+        INACTIVE rows are skipped too: a row that finished at admission
+        (max_new_tokens == 1, or EOS as its first token) still holds rid
+        and pages until _collect's publish sweep — preempting it would
+        requeue a COMPLETED request with a fresh 1-token budget and emit
+        a token past its max_tokens/EOS; its pages free at the chunk
+        boundary anyway."""
+        best: int | None = None
+        best_key: tuple[int, int] | None = None
+        for i in range(self.b):
+            r = self.rows[i]
+            if r.rid is None or not r.pages or not self.active[i]:
+                continue
+            if below_priority is not None and r.priority >= below_priority:
+                continue
+            key = (r.priority, -r.admit_seq)
+            if best is None or key < best_key:
+                best, best_key = i, key
+        return best
+
+    def _preempt_row(self, i: int, reason: str) -> None:
+        """Preempt resident row ``i``: free its pages NOW, keep the tokens
+        it already emitted, and requeue it for RECOMPUTE — the resume
+        request prefills prompt + emitted prefix (cheap when the automatic
+        prefix cache still holds the prompt pages; a resume long enough to
+        take the CHUNKED prefill path re-prefills in full — chunked paged
+        admission does not consult the cache yet) and its admission token
+        continues the sequence, so at temperature 0 the reunited stream is
+        token-identical to an unpreempted run (pinned by
+        tests/runtime/test_overload.py)."""
+        if self.faults is not None:
+            # Injection site "batcher.preempt": one hit per preemption —
+            # a "raise" rule crashes mid-preemption (the supervisor-restart
+            # drill for this path); tests read rule.fired for determinism.
+            self.faults.fire("batcher.preempt")
+        row = self.rows[i]
+        req = row.req
+        pp = self._prefills.pop(i, None)
+        if pp is not None or row.prefilling:
+            # Chunked prefill in flight: nothing reached the pool yet —
+            # drop the transient row cache and requeue the request as-is.
+            resume = req
+        else:
+            prior = list(req.resume_emitted or [])
+            base_ids = (req.ids[: len(req.ids) - len(prior)]
+                        if prior else req.ids)
+            resume = _Request(
+                req.rid, list(base_ids) + list(row.emitted),
+                max(1, row.remaining), prefix=req.prefix,
+                temperature=req.temperature, top_p=req.top_p,
+                top_k=req.top_k, presence_penalty=req.presence_penalty,
+                frequency_penalty=req.frequency_penalty,
+                prefix_cache=req.prefix_cache, priority=req.priority,
+                deadline=req.deadline, resume_emitted=list(row.emitted),
+                resume_lps=list(row.lps),
+            )
+        freed = len(row.pages)
+        if row.pages:
+            self._release_pages(row.pages)
+            self.tables[i] = 0
+        self.rows[i] = _RowState()
+        self.active[i] = False
+        self.budget[i] = 0
+        self.queue.append(resume)
+        self.preemptions += 1
+        METRICS.inc("batcher.preemptions_total")
+        log.info(
+            "preempted rid %d from slot %d (%s): freed %d page(s), "
+            "%d token(s) kept for recompute", resume.rid, i, reason, freed,
+            len(resume.resume_emitted or []),
+        )
+
+    def _ensure_pages(self, need: int, tag: str,
+                      below_priority: int | None = None,
+                      self_slot: int | None = None) -> bool:
+        """THE pressure loop (one definition for admission, chunked-finish
+        and growth): fire the ``batcher.page_alloc`` fault site (an
+        ``exhaust`` rule simulates a dry pool), then preempt victims until
+        :meth:`available` covers ``need`` pages.  ``below_priority``
+        restricts victims to STRICTLY lower priority (the admission paths:
+        a newcomer never preempts its own class, which would livelock two
+        requests trading the same pages); ``self_slot`` is the growth
+        path's fallback — with no other victim the grower itself yields
+        (requeued for recompute so higher-priority residents keep their
+        pages).  Returns True when ``need`` pages are obtainable (the
+        caller allocs); False on back-pressure or self-preemption
+        (nothing was allocated)."""
+        rule = (self.faults.fire("batcher.page_alloc", tag=tag)
+                if self.faults is not None else None)
+        avail = (0 if rule is not None and rule.action == "exhaust"
+                 else self._pages_available())
+        while avail < need:
+            v = self._pick_victim(below_priority=below_priority)
+            if v is None:
+                if self_slot is None:
+                    return False
+                v = self_slot  # no other victim: the grower itself yields
+            self._preempt_row(
+                v, "admission" if below_priority is not None else "growth"
+            )
+            if v == self_slot:
+                return False
+            avail = self._pages_available()
+        return True
+
+    def _reserve_row_pages(self, i, req, total_len, pfx):
+        """Paged admission reservation, ON-DEMAND: pages for the prompt
+        plus one decode page — NOT the full prompt+budget footprint (PR 1's
+        policy), which left most reserved pages empty while the queue
+        back-pressured.  The chunk-boundary growth loop (:meth:`_grow_rows`)
+        allocates the rest only as the row actually reaches them.  A dry
+        pool first evicts LRU-cold cached pages (inside alloc), then
+        preempts a STRICTLY lower-priority victim, then back-pressures.
+        Returns (page_list, pages, cached_pages, cached_len, digests), or
+        None on back-pressure (nothing allocated, hits released)."""
+        blk = self.page_size
+        n_full = -(-(total_len + req.max_new_tokens) // blk)
+        n_init = min(n_full, -(-total_len // blk) + 1)
+        pc = self.prefix_cache
+        auto = pc is not None and pfx is None and req.prefix_cache
+        cached_pages: list[int] = []
+        cached_len = 0
+        digests: list[bytes] = []
+        if auto:
+            # Hash every FULL prompt page (chained digests, memoized on
+            # the request — a back-pressured admission retries every round
+            # and must not rehash a long prompt each time); hits are
+            # capped one page short of the whole prompt so at least one
+            # real suffix token always prefills (the admission samples the
+            # first token from its logits).
+            if req.digests is None:
+                req.digests = PrefixCache.page_digests(
+                    req.ids, blk, len(req.ids) // blk
+                )
+            digests = req.digests
+            cached_pages = pc.match(digests[: (len(req.ids) - 1) // blk])
+            cached_len = len(cached_pages) * blk
+            # Retain hits BEFORE allocating: eviction must never reclaim
+            # the very run we just matched.
+            for p in cached_pages:
+                self._retain_page(p)
+        need = n_init - len(cached_pages)
+        if not self._ensure_pages(need, "admit", below_priority=req.priority):
+            self._release_pages(cached_pages)
+            return None
+        if auto:
+            pc.record_lookup(cached_len, total_len - cached_len)
+        pages = self._alloc_pages(need)
+        page_list = np.zeros((self.pages_per_row,), np.int32)
+        page_list[: len(cached_pages)] = cached_pages
+        page_list[len(cached_pages): n_init] = pages  # + scratch pad
+        self.tables[i] = page_list
+        return page_list, pages, cached_pages, cached_len, digests
+
+    def _grow_rows(self) -> None:
+        """Chunk-boundary page growth (paged mode): before each decode
+        chunk, every active row that will write past its allocated pages
+        this chunk gets the missing pages — evicting LRU-cold cached pages
+        first, then preempting the lowest-priority / most-recently-admitted
+        victim (possibly the growing row itself: it requeues for recompute
+        and higher-priority residents keep their pages)."""
+        blk = self.page_size
+        for i in range(self.b):
+            row = self.rows[i]
+            if row.rid is None or not self.active[i] or row.prefilling:
+                continue
+            horizon = int(self.real_lens[i]) + min(
+                self.chunk_steps, int(self.budget[i])
+            )
+            need_pages = -(-horizon // blk)
+            have = len(row.pages)
+            if need_pages <= have:
+                continue
+            n = need_pages - have
+            # The fault site (tag "grow") fires only when a row actually
+            # needs new pages, so rule windows count real allocation
+            # attempts.
+            if not self._ensure_pages(n, "grow", self_slot=i):
+                continue  # the grower itself was preempted
+            fresh = self._alloc_pages(n)
+            row.pages.extend(fresh)
+            self.tables[i][have:need_pages] = fresh
+            METRICS.inc("batcher.pages_grown", n)
+
     def _admit_pending(self) -> None:
         if self.faults is not None:
             # Injection site "batcher.admit": one hit per admission round.
             self.faults.fire("batcher.admit")
+        self._shed_expired_queued()
         # Advance every pending chunked prefill one chunk per round — up to
         # prefill_concurrency in flight, so the round's prefill work is at
         # most prefill_concurrency * prefill_chunk tokens (interleaved long
@@ -1729,24 +2122,21 @@ class ContinuousBatcher:
         # parallelism); decode rounds interleave between chunks.
         for slot in list(self._prefills):
             self._advance_chunk(slot)
-        active_host = self.active
-        for i in range(self.b):
-            if not self.queue:
+        while self.queue:
+            i = self._free_slot()
+            if i is None:
                 return
-            if active_host[i] or self.rows[i].rid is not None:
-                # rid set while inactive = a chunked prefill holds the slot.
-                continue
-            req = self.queue.popleft()
+            req = self._next_request()
             pfx = self.prefixes[req.prefix] if req.prefix is not None else None
             pfx_len = len(pfx.ids) if pfx else 0
             total_len = pfx_len + len(req.ids)
             if (self.prefill_chunk is not None
                     and len(req.ids) > self.prefill_chunk):
                 if len(self._prefills) >= self.prefill_concurrency:
-                    # Prefill slots full, and strict FIFO: requeue, stop
-                    # admitting (the queue front never gets jumped).
-                    self.queue.appendleft(req)
+                    # Prefill slots full, and strict admission order: stop
+                    # admitting (the selected request never gets jumped).
                     return
+                self.queue.remove(req)
                 self._start_chunked(i, req, pfx)
                 continue
             pages: list[int] = []
@@ -1754,55 +2144,14 @@ class ContinuousBatcher:
             cached_len = 0
             digests: list[bytes] = []
             if self.paged:
-                # Allocate only the pages prompt+budget need; a dry pool
-                # back-pressures the queue (FIFO: put the request back and
-                # stop admitting) instead of overcommitting.  With the
-                # automatic prefix cache, LRU-cold cached pages count as
-                # allocatable (eviction inside _alloc_pages) — pressure
-                # evicts cold cache entries before queueing admissions.
-                blk = self.page_size
-                n_pages = -(-(total_len + req.max_new_tokens) // blk)
-                pc = self.prefix_cache
-                auto = pc is not None and pfx is None and req.prefix_cache
-                if auto:
-                    # Hash every FULL prompt page (chained digests,
-                    # memoized on the request — a back-pressured admission
-                    # retries every round and must not rehash a long
-                    # prompt each time); hits are capped one page short of
-                    # the whole prompt so at least one real suffix token
-                    # always prefills (the admission samples the first
-                    # token from its logits).
-                    if req.digests is None:
-                        req.digests = PrefixCache.page_digests(
-                            req.ids, blk, len(req.ids) // blk
-                        )
-                    digests = req.digests
-                    cached_pages = pc.match(
-                        digests[: (len(req.ids) - 1) // blk]
-                    )
-                    cached_len = len(cached_pages) * blk
-                    # Retain hits BEFORE allocating: eviction must never
-                    # reclaim the very run we just matched.
-                    for p in cached_pages:
-                        self._retain_page(p)
-                # Injection site "batcher.page_alloc": an "exhaust" rule
-                # simulates a dry pool — the admission takes the exact
-                # back-pressure path a real exhaustion would (requeue,
-                # released hits, FIFO preserved).
-                rule = (self.faults.fire("batcher.page_alloc")
-                        if self.faults is not None else None)
-                if (rule is not None and rule.action == "exhaust") or \
-                        self._pages_available() < n_pages - len(cached_pages):
-                    self._release_pages(cached_pages)
-                    self.queue.appendleft(req)
+                got = self._reserve_row_pages(i, req, total_len, pfx)
+                if got is None:
+                    # Dry pool with no preemptable victim: back-pressure.
+                    # The request stays queued (never removed), admission
+                    # stops for this round.
                     return
-                if auto:
-                    pc.record_lookup(cached_len, total_len - cached_len)
-                pages = self._alloc_pages(n_pages - len(cached_pages))
-                page_list = np.zeros((self.pages_per_row,), np.int32)
-                page_list[: len(cached_pages)] = cached_pages
-                page_list[len(cached_pages): n_pages] = pages  # + scratch pad
-                self.tables[i] = page_list
+                page_list, pages, cached_pages, cached_len, digests = got
+            self.queue.remove(req)
             # Bucket for compile reuse, but never past what fits after the
             # prefix: forward's contract is cache_index + T <= max_len, and
             # dynamic_update_slice CLAMPS an overflowing start — the suffix
@@ -1915,23 +2264,38 @@ class ContinuousBatcher:
         self.freq_row[i] = req.frequency_penalty
         if self.prefix_cache is not None:
             self.prefix_cached_tokens[req.rid] = cached_len
+        prior = list(req.resume_emitted or [])
+        prior_lps = list(req.resume_lps or [])
         if req.presence_penalty or req.frequency_penalty:
             if self.tok_counts is None:
                 self.tok_counts = jnp.zeros(
                     (self.b, self.cfg.vocab_size), jnp.int32
                 )
-            self.tok_counts = _reset_count_row(
-                self.tok_counts, jnp.int32(i), jnp.int32(tok)
-            )
+            if prior:
+                # Resumed after preemption: the penalty histogram must see
+                # every token THIS request has emitted across residencies,
+                # or the recompute would sample from differently-penalized
+                # logits than the unpreempted run.
+                rowc = np.zeros((self.cfg.vocab_size,), np.int32)
+                np.add.at(rowc, np.asarray(prior + [tok], np.int64), 1)
+                self.tok_counts = self.tok_counts.at[i].set(
+                    jnp.asarray(rowc)
+                )
+            else:
+                self.tok_counts = _reset_count_row(
+                    self.tok_counts, jnp.int32(i), jnp.int32(tok)
+                )
         self.real_lens[i] = total_len
         self.valid[i] = np.asarray(row_valid)
         self.active[i] = True
         # The first token came out of admission; the row may emit
         # budget-1 more from decode chunks.
         self.budget[i] = req.max_new_tokens - 1
+        self._admit_seq += 1
         self.rows[i] = _RowState(
-            rid=req.rid, emitted=[tok], lps=[float(lp)],
+            rid=req.rid, emitted=prior + [tok], lps=prior_lps + [float(lp)],
             remaining=req.max_new_tokens - 1, pages=pages,
+            req=req, priority=req.priority, admit_seq=self._admit_seq,
         )
         log.debug("admitted request %d into slot %d", req.rid, i)
         if req.max_new_tokens == 1 or tok == self.eos_id:
@@ -1940,8 +2304,10 @@ class ContinuousBatcher:
             # Stream the admission token; completion (done=True) is
             # always announced by _collect's publish sweep.  State
             # advances BEFORE the callback so a raising callback can
-            # never cause a re-delivery on a later run().
-            self.rows[i].streamed = 1
+            # never cause a re-delivery on a later run().  A resumed row's
+            # prior tokens were streamed in its previous residency —
+            # streamed starts past them, so nothing re-delivers.
+            self.rows[i].streamed = len(prior) + 1
             self._on_tokens(req.rid, [tok], False, [float(lp)])
         METRICS.inc("batcher.admitted")
 
@@ -1959,8 +2325,11 @@ class ContinuousBatcher:
             rc = model_lib.init_cache(self.cfg, 1, self.s,
                                       dtype=self.cache.k.dtype)
             row_k, row_v, done = rc.k, rc.v, 0
+        self._admit_seq += 1
         self.rows[i] = _RowState(rid=req.rid, prefilling=True,
-                                 remaining=req.max_new_tokens)
+                                 remaining=req.max_new_tokens,
+                                 req=req, priority=req.priority,
+                                 admit_seq=self._admit_seq)
         self._prefills[i] = _PendingPrefill(
             req=req, row_k=row_k, row_v=row_v, done=done,
             ids=list(req.ids), total_len=done + len(req.ids),
@@ -1969,22 +2338,27 @@ class ContinuousBatcher:
 
     def _advance_chunk(self, i: int) -> None:
         """Consume one ``prefill_chunk``-sized bite of slot ``i``'s pending
-        prompt; finish the admission when the prompt completes."""
+        prompt; finish the admission when the prompt completes.  In paged
+        mode the finish ALLOCATES the row's pages on demand (prompt + one
+        decode page) — a dry pool preempts a strictly-lower-priority
+        victim, else the finish retries next round (the prefilled transient
+        row is kept; no work is lost)."""
         pp = self._prefills[i]
-        pfx_len = pp.total_len - len(pp.ids)
-        clen = min(self.prefill_chunk, pp.total_len - pp.done)
-        off = pp.done - pfx_len
-        # Bucket for compile reuse, capped so cache_index + T <= width
-        # (forward's contract; dynamic_update_slice clamps overflows).
-        tc = min(_bucket(clen), self.s - pp.done)
-        chunk = np.full((tc,), self.pad_id, np.int32)
-        chunk[:clen] = pp.ids[off: off + clen]
-        pp.row_k, pp.row_v, pp.last_logits = prefill_chunk_step(
-            self.params, self.cfg, pp.row_k, pp.row_v, jnp.int32(pp.done),
-            jnp.asarray(chunk), jnp.int32(clen),
-        )
-        pp.done += clen
-        METRICS.inc("batcher.prefill_chunks")
+        if pp.done < pp.total_len:
+            pfx_len = pp.total_len - len(pp.ids)
+            clen = min(self.prefill_chunk, pp.total_len - pp.done)
+            off = pp.done - pfx_len
+            # Bucket for compile reuse, capped so cache_index + T <= width
+            # (forward's contract; dynamic_update_slice clamps overflows).
+            tc = min(_bucket(clen), self.s - pp.done)
+            chunk = np.full((tc,), self.pad_id, np.int32)
+            chunk[:clen] = pp.ids[off: off + clen]
+            pp.row_k, pp.row_v, pp.last_logits = prefill_chunk_step(
+                self.params, self.cfg, pp.row_k, pp.row_v, jnp.int32(pp.done),
+                jnp.asarray(chunk), jnp.int32(clen),
+            )
+            pp.done += clen
+            METRICS.inc("batcher.prefill_chunks")
         if pp.done < pp.total_len:
             return
         req = pp.req
@@ -2003,14 +2377,32 @@ class ContinuousBatcher:
         )
         if custom and req_k != self.sampling["top_k"]:
             extra["topk_req"] = jnp.int32(req_k)
-        self.cache, tok, row_valid, lp = finish_chunked_admission(
-            self.cfg, self.cache, jnp.int32(i), pp.row_k, pp.row_v,
-            pp.last_logits, jnp.int32(pp.total_len), self._split_rng(),
-            **self.sampling, **extra,
-        )
+        if self.paged:
+            blk = self.page_size
+            n_full = -(-(pp.total_len + req.max_new_tokens) // blk)
+            n_init = min(n_full, -(-pp.total_len // blk) + 1)
+            if not self._ensure_pages(n_init, "admit",
+                                      below_priority=req.priority):
+                return  # retry the finish next round; prefill is kept
+            pages = self._alloc_pages(n_init)
+            page_list = np.zeros((self.pages_per_row,), np.int32)
+            page_list[:n_init] = pages
+            self.tables[i] = page_list
+            self.cache, tok, lp = finish_chunked_admission_paged(
+                self.cache, jnp.asarray(page_list), pp.row_k, pp.row_v,
+                pp.last_logits, self._split_rng(), **self.sampling, **extra,
+            )
+            row_valid = np.arange(self.s) < pp.total_len
+        else:
+            pages = []
+            self.cache, tok, row_valid, lp = finish_chunked_admission(
+                self.cfg, self.cache, jnp.int32(i), pp.row_k, pp.row_v,
+                pp.last_logits, jnp.int32(pp.total_len), self._split_rng(),
+                **self.sampling, **extra,
+            )
         del self._prefills[i]
         self._activate_row(i, req, tok, lp, row_valid, pp.total_len,
-                           req_t, req_p, pages=[], req_k=req_k)
+                           req_t, req_p, pages=pages, req_k=req_k)
 
     def _collect(
         self, toks: np.ndarray, was_active: np.ndarray,
@@ -2098,6 +2490,14 @@ class ContinuousBatcher:
             r.rid is not None for r in self.rows
         ):
             self._admit_pending()
+            if self.paged:
+                # Chunk-boundary growth: rows about to write past their
+                # allocated pages get them NOW (or preempt / yield) — the
+                # decode chunk below must never scatter a live row's KV
+                # into the scratch page.  (Occupancy gauges are published
+                # at /metrics scrape time, not here: the decode loop is
+                # the latency-critical path.)
+                self._grow_rows()
             was_active = self.active.copy()
             if not was_active.any():
                 self._collect(

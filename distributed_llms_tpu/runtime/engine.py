@@ -442,7 +442,8 @@ class InferenceEngine:
         #   attached draft + greedy + single-device contiguous mode
         prefill_chunk: int | None = None,  # chunked prefill: admit at most
         #   this many prompt tokens per scheduling round PER PENDING
-        #   prefill (single-device contiguous plain mode; see
+        #   prefill (single-device plain mode, contiguous or paged — paged
+        #   finishes allocate pool pages on demand at the splice; see
         #   ContinuousBatcher)
         prefill_concurrency: int = 2,  # chunked prefills in flight at once
         #   (1 restores the old one-at-a-time head-of-line behavior)
@@ -455,7 +456,11 @@ class InferenceEngine:
         no head-of-line blocking on mixed-length traffic.  Single-device
         engines and GSPMD data/tensor-parallel meshes; pipelined and
         sequence-parallel meshes keep their own decode schedules (the
-        batcher constructor rejects them).
+        batcher constructor rejects them).  Paged mode is overload-safe:
+        rows admit with prompt + one decode page, grow on demand at chunk
+        boundaries, and a dry pool preempts the lowest-priority /
+        most-recently-admitted row for recompute (temp-0 exact) instead of
+        wedging — see submit(priority=, deadline=).
         """
         if self.parallel is not None and (
             self.parallel.pipelined or self.parallel.seq_parallel

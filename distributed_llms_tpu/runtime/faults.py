@@ -31,8 +31,15 @@ Sites wired in this tree (callers pass ``tag`` where noted):
 
 - ``batcher.admit``       each admission round (ContinuousBatcher)
 - ``batcher.decode``      before each decode/speculative chunk
-- ``batcher.page_alloc``  paged-pool allocation check (``exhaust`` forces
-  the back-pressure path as if the pool were dry)
+- ``batcher.page_alloc``  paged-pool allocation check, tag = ``admit``
+  (admission reservation) or ``grow`` (chunk-boundary on-demand growth);
+  ``exhaust`` forces the pressure path as if the pool were dry — the
+  caller then preempts a victim row or back-pressures, exactly as a real
+  exhaustion would
+- ``batcher.preempt``     one hit per row preemption, fired BEFORE the
+  victim's pages are freed (a ``raise`` here crashes mid-preemption — the
+  supervisor-restart drill for the preemption path; tests read
+  ``rule.fired`` to pin how many preemptions a storm actually took)
 - ``proto.send`` / ``proto.recv``  cluster protocol framing, tag = message
   type (install process-wide via ``cluster.protocol.set_fault_plane``)
 - ``worker.heartbeat``    one heartbeat tick (``drop`` skips the send)

@@ -49,6 +49,27 @@ path, provoked on demand by runtime/faults.py):
   seconds-since-last-chunk and flips unhealthy when in-flight work exists
   but the engine has not progressed within ``watchdog_timeout_s`` (a stalled
   XLA dispatch looks exactly like this).
+
+Overload-safe serving (PR 3; README "Overload behavior"):
+
+- a per-request ``priority`` field orders admission (higher first, FIFO
+  within a priority) and shields rows from preemption — under KV pool
+  pressure the engine grows rows on demand and preempts the lowest-priority,
+  most-recently-admitted row for recompute instead of wedging;
+- an estimated-COST gate: when queued + resident token mass exceeds
+  ``shed_cost_factor`` x the batcher's KV capacity, new requests 429
+  immediately with ``Retry-After`` — overload sheds at the front door;
+- queue-time deadlines: a request whose ``timeout_s`` expires before it
+  has produced ANY output (still queued, or admitted but still prefilling)
+  is shed with 503 + ``Retry-After`` (type ``overloaded_error``) instead
+  of being admitted doomed — no deltas were delivered, so a retry is
+  safe; one that expires after tokens flowed keeps today's 200 +
+  ``finish_reason: "timeout"`` partial-output contract (a preempted
+  request's streamed prefix counts: it finishes with that output);
+- every 429/503 the server emits (queue full, cost gate, draining, shed,
+  unhealthy /healthz) carries a ``Retry-After`` header scaled to the
+  committed work; ``cluster.client.ServingClient`` honors it with jittered
+  exponential backoff.
 """
 
 from __future__ import annotations
@@ -74,6 +95,11 @@ _TIMEOUT_ACK_GRACE_S = 10.0
 # engine restarts under them (their deltas cannot be retracted, so replaying
 # the request could duplicate output).
 _RESTART_ERR = "engine restarted mid-stream; partial output could not be resumed"
+
+# Mailbox-delivered error prefix for load-shed requests (queue deadline
+# expired before admission): the blocking handler answers 503 with a
+# Retry-After so clients and load balancers back off instead of retrying hot.
+_SHED_ERR = "shed before admission: "
 
 _MAX_REQUEST_LINE = 8192
 _MAX_HEADERS = 100
@@ -166,6 +192,12 @@ class InferenceServer:
         request_timeout_s: float | None = None,  # default per-request deadline
         watchdog_timeout_s: float = 30.0,  # /healthz stall threshold
         max_request_retries: int = 2,  # restart re-admissions per request
+        # Estimated-cost admission gate: 429 (with Retry-After) when the
+        # token mass already queued + resident would exceed this multiple
+        # of the batcher's KV capacity — sustained overload sheds EARLY,
+        # at the front door, instead of queueing work that will time out
+        # doomed.  None/0 disables the gate (queue-full still 429s).
+        shed_cost_factor: float | None = 2.0,
     ) -> None:
         if batcher.tokenizer is None:
             raise ValueError(
@@ -185,6 +217,7 @@ class InferenceServer:
         self.request_timeout_s = request_timeout_s
         self.watchdog_timeout_s = watchdog_timeout_s
         self.max_request_retries = max_request_retries
+        self.shed_cost_factor = shed_cost_factor
         self._requests: dict[int, _Mailbox] = {}
         self._cancelled: set[int] = set()  # loop writes, engine consumes
         # Supervisor per-request state (meta/delivered/retries) rides on
@@ -276,6 +309,30 @@ class InferenceServer:
         b = self.batcher
         return bool(b.queue) or any(r.rid is not None for r in b.rows)
 
+    def _pending_token_mass(self) -> int:
+        """Estimated token mass the engine still has to absorb: every
+        queued or resident request's prompt + budget.  A resumed
+        (preempted) request's ids already fold in its emitted prefix and
+        its budget shrank to the remainder, so the estimate never double
+        counts.  Loop-thread reads of engine-owned lists — snapshot
+        iteration only, same contract as the healthz probe."""
+        b = self.batcher
+        mass = 0
+        for r in list(b.queue):
+            mass += len(r.ids) + r.max_new_tokens
+        for row in list(b.rows):
+            req = row.req
+            if row.rid is not None and req is not None:
+                mass += len(req.ids) + req.max_new_tokens
+        return mass
+
+    def _retry_after_s(self) -> int:
+        """Retry-After hint for 429/503 answers: roughly how many
+        pool-capacity drains of work are already committed, clamped to
+        [1, 30] — a coarse, monotone backoff signal, not a promise."""
+        cap = max(1, self.batcher.capacity_tokens())
+        return int(min(30, max(1, -(-self._pending_token_mass() // cap))))
+
     def _engine_loop(self) -> None:
         while True:
             self._work.wait()
@@ -314,10 +371,13 @@ class InferenceServer:
                     return
                 continue  # fresh batcher: nothing of the old run to clear
             # run() accumulated per-rid results we already streamed; drop
-            # them so a long-lived server's memory stays flat.
+            # them so a long-lived server's memory stays flat.  (Shed
+            # reasons are popped at delivery; clear what disconnected
+            # handlers left behind.)
             self.batcher.results.clear()
             self.batcher.result_logprobs.clear()
             self.batcher.prefix_cached_tokens.clear()
+            self.batcher.shed.clear()
 
     def _recover_engine(self) -> None:
         """Supervisor (engine thread): replace the crashed batcher with a
@@ -426,14 +486,20 @@ class InferenceServer:
             # int attribute write is GIL-atomic; the loop reads it only
             # after the done delivery it is ordered before.
             mbox.cached_tokens = self.batcher.prefix_cached_tokens.get(rid, 0)
+        # A done delivery for a rid the batcher SHED (queue deadline
+        # expired before admission) carries the shed reason as a
+        # structured error: the handler answers 503 + Retry-After, not an
+        # empty 200.  Engine thread owns batcher.shed; popped exactly once.
+        shed = self.batcher.shed.pop(rid, None) if done else None
+        err = (_SHED_ERR + shed) if shed is not None else None
         if rid in self._cancelled:
             self._cancelled.discard(rid)
             if not done:
                 self.batcher.cancel_row(rid)
-            self._notify(rid, toks, True, lps=lps)
+            self._notify(rid, toks, True, err=err, lps=lps)
             self._sweep_cancelled(exclude=rid)
             return
-        self._notify(rid, toks, done, lps=lps)
+        self._notify(rid, toks, done, err=err, lps=lps)
         self._sweep_cancelled(exclude=rid)
 
     def _sweep_cancelled(self, exclude: int) -> None:
@@ -561,13 +627,23 @@ class InferenceServer:
                      t0: float) -> None:
         if method == "GET" and path == "/healthz":
             code, report = self.health()
-            await self._json(writer, code, report)
+            # Every non-200 carries Retry-After: probes and load balancers
+            # get an explicit back-off hint (draining/stalled is transient).
+            await self._json(writer, code, report, headers=(
+                None if code == 200
+                else {"Retry-After": str(self._retry_after_s())}
+            ))
         elif method == "GET" and path == "/metrics":
-            # Refresh the watchdog gauge so scrapes see a current age.
+            # Refresh the watchdog gauge so scrapes see a current age, and
+            # the pool occupancy view (batcher_pool_*) so an idle engine
+            # still exports current free/cached/held page counts.
             METRICS.set_gauge(
                 "server.engine_last_chunk_age_s",
                 time.monotonic() - self._last_progress,
             )
+            pool = getattr(self.batcher, "pool", None)
+            if pool is not None:
+                pool.publish_gauges()
             await self._respond(
                 writer, 200, "text/plain; version=0.0.4; charset=utf-8",
                 METRICS.prometheus_text().encode(),
@@ -710,7 +786,8 @@ class InferenceServer:
         if timeout_s is not None:
             # Per-request deadline: generation past it cancels at the next
             # chunk boundary and returns finish_reason "timeout" with the
-            # tokens produced so far.
+            # tokens produced so far; a request still QUEUED at expiry is
+            # shed with 503 + Retry-After instead of admitted doomed.
             if (not isinstance(timeout_s, (int, float))
                     or isinstance(timeout_s, bool)
                     or not math.isfinite(float(timeout_s))
@@ -719,13 +796,43 @@ class InferenceServer:
             timeout_s = float(timeout_s)
         else:
             timeout_s = self.request_timeout_s  # server-wide default (maybe None)
+        priority = req.get("priority", 0)
+        # Extension field: admission order (higher first; FIFO within a
+        # priority) and preemption shield — under pool pressure the engine
+        # preempts the lowest-priority, most-recently-admitted row first.
+        if (isinstance(priority, bool) or not isinstance(priority, int)
+                or not -(2**31) <= priority < 2**31):
+            raise BadRequest("'priority' must be an integer")
+        # Shed gates, all BEFORE any delivery state is registered: a shed
+        # request must leave zero trace (no _Mailbox, no batcher queue
+        # entry) — the leak-check test pins this.
         if len(self._requests) + n > self.max_pending:
-            await self._json(writer, 429, _err_body("server request queue is full"))
+            await self._shed_json(
+                writer, 429, "server request queue is full", "queue_full"
+            )
             return
+        if self.shed_cost_factor:
+            # Estimated-cost gate: token mass already committed (queued +
+            # resident prompt+budget) plus this request against the KV
+            # capacity.  Sustained overload 429s at the front door — the
+            # cheap place — instead of queueing work doomed to time out.
+            mass = self._pending_token_mass() \
+                + n * (len(prompt_ids) + max_tokens)
+            cap = self.batcher.capacity_tokens()
+            if mass > self.shed_cost_factor * cap:
+                await self._shed_json(
+                    writer, 429,
+                    f"server overloaded: {mass} tokens of work queued "
+                    f"against {cap}-token KV capacity", "cost_gate",
+                )
+                return
         if self._draining and not self._stopping:
             # Graceful drain (rolling restarts): 503 tells load balancers
             # to retry elsewhere — 500 would read as an application error.
-            await self._json(writer, 503, _err_body("server is draining"))
+            await self._json(
+                writer, 503, _err_body("server is draining"),
+                headers={"Retry-After": str(self._retry_after_s())},
+            )
             return
         if self._stopping:
             await self._json(writer, 500, _err_body("server is shutting down"))
@@ -748,11 +855,12 @@ class InferenceServer:
         # _submit_lock (pure host bookkeeping, no awaits) so the
         # supervisor's batcher swap cannot interleave and strand a request
         # in a dying batcher's queue.
+        deadline = t0 + timeout_s if timeout_s is not None else None
         meta = dict(
             ids=list(prompt_ids), max_new_tokens=max_tokens, prefix=prefix,
             temperature=temperature, top_p=top_p, top_k=top_k,
             presence_penalty=pres_pen, frequency_penalty=freq_pen,
-            prefix_cache=use_cache,
+            prefix_cache=use_cache, priority=priority, deadline=deadline,
         )
         subs: list[tuple[int, int, _Mailbox]] = []  # (choice index, rid, mbox)
         sub_err: Exception | None = None
@@ -761,8 +869,7 @@ class InferenceServer:
                 rid = self.batcher.next_rid
                 mbox = _Mailbox()
                 mbox.t0 = t0  # latency clocks run from request receipt
-                if timeout_s is not None:
-                    mbox.deadline = t0 + timeout_s
+                mbox.deadline = deadline
                 mbox.meta = meta
                 self._requests[rid] = mbox
                 try:
@@ -770,7 +877,8 @@ class InferenceServer:
                         prompt_ids, max_new_tokens=max_tokens, prefix=prefix,
                         temperature=temperature, top_p=top_p, top_k=top_k,
                         presence_penalty=pres_pen, frequency_penalty=freq_pen,
-                        prefix_cache=use_cache,
+                        prefix_cache=use_cache, priority=priority,
+                        deadline=deadline,
                     )
                     assert got == rid
                 except (ValueError, KeyError) as e:
@@ -783,6 +891,18 @@ class InferenceServer:
                         self._requests.pop(r, None)
                     sub_err = e
                     break
+                except BaseException:
+                    # Anything else (a failed rid-continuity assert, an
+                    # engine invariant error) must not strand registered
+                    # mailboxes in _requests: each leaked entry permanently
+                    # inflates the queue-full gate's count — enough of them
+                    # and every future request 429s on a server doing no
+                    # work.  Clean up, then let the error surface.
+                    self._requests.pop(rid, None)
+                    for _, r, _m in subs:
+                        self._cancelled.add(r)
+                        self._requests.pop(r, None)
+                    raise
                 subs.append((idx, rid, mbox))
         if sub_err is not None:
             self._work.set()  # let an idle engine drain the flags
@@ -892,7 +1012,12 @@ class InferenceServer:
                 # freed; their tokens arrived past the deadline — not billed.
                 if done:
                     mbox.finished = True
-                    if stopped_at is not None:
+                    if err is not None and err.startswith(_SHED_ERR):
+                        # The engine shed the still-queued request at this
+                        # chunk boundary: nothing was ever produced — the
+                        # answer is 503 + Retry-After, not an empty 200.
+                        yield "", ids, lps, True, err
+                    elif stopped_at is not None:
                         yield None, ids, lps, True, "stopped"
                     else:
                         yield tok.decode(ids), ids, lps, True, "timeout"
@@ -989,6 +1114,15 @@ class InferenceServer:
             text = t
             if done:
                 break
+        if reason == "timeout" and not ids and mbox.delivered == 0:
+            # Deadline expired with NOTHING ever produced — still queued,
+            # or admitted but mid-chunked-prefill (the only admitted state
+            # with zero deliveries); either way the engine's shed ack may
+            # have been eaten by a stall.  No deltas ever reached the
+            # client, so a retry is safe: answer a 503 shed, not a useless
+            # empty 200 "timeout".
+            return text, ids, lps, reason, \
+                _SHED_ERR + "deadline expired before any output was produced"
         if reason == "length" and self.batcher.eos_id >= 0 and (
             ids and ids[-1] == self.batcher.eos_id
         ):
@@ -1004,7 +1138,15 @@ class InferenceServer:
         ])
         fatal = next((e for *_x, e in outs if e is not None), None)
         if fatal is not None:
-            await self._json(writer, 500, _err_body(fatal, _err_type(fatal)))
+            if fatal.startswith(_SHED_ERR):
+                # Load-shed before admission: 503 + Retry-After tells the
+                # client (and its load balancer) to back off and retry —
+                # the request was never worked on, so a retry is safe.
+                await self._shed_json(writer, 503, fatal, "queue_deadline")
+            else:
+                await self._json(
+                    writer, 500, _err_body(fatal, _err_type(fatal))
+                )
             return
         choices = []
         total_completion = 0
@@ -1182,17 +1324,35 @@ class InferenceServer:
     async def _plain(self, writer, code: int, body: str) -> None:
         await self._respond(writer, code, "text/plain", body.encode())
 
-    async def _json(self, writer, code: int, obj: dict) -> None:
+    async def _json(self, writer, code: int, obj: dict,
+                    headers: dict[str, str] | None = None) -> None:
         await self._respond(
-            writer, code, "application/json", (json.dumps(obj) + "\n").encode()
+            writer, code, "application/json",
+            (json.dumps(obj) + "\n").encode(), headers=headers,
         )
 
-    async def _respond(self, writer, code: int, ctype: str, payload: bytes) -> None:
+    async def _shed_json(self, writer, code: int, msg: str,
+                         reason: str) -> None:
+        """Answer a shed request (429 too-busy / 503 not-yet-admitted):
+        structured overloaded_error body + a Retry-After header so clients
+        and load balancers back off instead of retrying hot, and the shed
+        counters the dashboards alarm on."""
+        METRICS.inc("server.requests_shed_total")
+        METRICS.inc(f"server.requests_shed.{reason}")
+        await self._json(
+            writer, code, _err_body(msg, "overloaded_error"),
+            headers={"Retry-After": str(self._retry_after_s())},
+        )
+
+    async def _respond(self, writer, code: int, ctype: str, payload: bytes,
+                       headers: dict[str, str] | None = None) -> None:
+        extra = "".join(f"{k}: {v}\r\n" for k, v in (headers or {}).items())
         writer.write(
             (
                 f"HTTP/1.1 {code} {_REASONS.get(code, '')}\r\n"
                 f"Content-Type: {ctype}\r\n"
                 f"Content-Length: {len(payload)}\r\n"
+                f"{extra}"
                 "Connection: close\r\n\r\n"
             ).encode()
             + payload
@@ -1211,9 +1371,12 @@ def _err_body(msg: str, type_: str = "invalid_request_error") -> dict:
 def _err_type(msg: str) -> str:
     """Error class for a mailbox-delivered failure: engine-side faults get
     a structured machine-readable type (clients distinguish 'the engine
-    restarted under me, retry if idempotent' from bad input)."""
+    restarted under me, retry if idempotent' from bad input, from 'the
+    server shed me unworked — retry after backoff')."""
     if msg in (_RESTART_ERR, "engine unrecoverable"):
         return "engine_error"
+    if msg.startswith(_SHED_ERR):
+        return "overloaded_error"
     return "server_error"
 
 

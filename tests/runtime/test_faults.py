@@ -314,13 +314,16 @@ def test_server_default_timeout_applies(tiny):
                     request_timeout_s=0.25)
 
 
-def test_timeout_of_queued_request_acks_at_chunk_boundary(tiny):
+def test_timeout_of_queued_request_is_shed_503(tiny):
     """A request whose deadline expires while it is still QUEUED (slot
-    held by another row) must cancel at the next chunk boundary via the
-    engine's cancel sweep — not sit out the full ack grace window."""
+    held by another row) is SHED at the next chunk boundary — a 503 with
+    Retry-After and a structured overloaded_error, NOT an empty 200
+    "timeout": nothing was ever produced, so the client should retry
+    elsewhere/later (PR 2 answered 200 here, admitted-doomed style)."""
     import time
 
     plane = FaultPlane.parse("batcher.decode:stall@1+:0.05")
+    shed0 = METRICS.get_counter("server.requests_shed_total")
 
     async def fn(host, port, srv):
         long_task = asyncio.create_task(_request(
@@ -338,12 +341,13 @@ def test_timeout_of_queued_request_acks_at_chunk_boundary(tiny):
             {"prompt": "queued", "max_tokens": 8, "timeout_s": 0.2},
         )
         dt = time.perf_counter() - t0
-        assert status == 200
+        assert status == 503, raw
         out = json.loads(raw)
-        assert out["choices"][0]["finish_reason"] == "timeout"
-        assert out["usage"]["completion_tokens"] == 0  # never admitted
-        # Chunk-boundary ack, nowhere near the 10 s grace fallback.
+        assert out["error"]["type"] == "overloaded_error", out
+        assert "shed" in out["error"]["message"]
+        # Chunk-boundary shed, nowhere near the 10 s grace fallback.
         assert dt < 5.0, dt
+        assert METRICS.get_counter("server.requests_shed_total") > shed0
         status, _ = await long_task
         assert status == 200
 

@@ -1,0 +1,627 @@
+"""Overload-safe serving (runtime/batcher.py + runtime/server.py, PR 3).
+
+The acceptance contract pinned here: under pool exhaustion (real or
+injected) at roughly twice the KV pool's token capacity of offered load,
+every request either COMPLETES with temp-0 tokens identical to its solo run
+(preempted rows resume via recompute) or is SHED with a structured 429/503
+carrying Retry-After — never an engine_error, never a wedge — and the page
+allocator audits clean afterward (``assert_pool_consistent``).
+
+Mechanisms covered:
+- on-demand page growth: admission takes prompt + one decode page; chunk
+  boundaries grow rows as they actually reach new pages;
+- preemption with recompute: a dry pool preempts the lowest-priority /
+  most-recently-admitted row — pages freed now, emitted tokens kept, the
+  request requeued to prefill prompt + emitted prefix (exact at temp 0);
+- priority admission order and the strictly-lower-priority admission guard;
+- queue-deadline shedding (batcher-side) and the server's cost gate /
+  queue-full 429s with Retry-After;
+- chunked prefill over the paged pool (pages allocated only at the finish);
+- the _Mailbox leak class around front-door rejections;
+- ServingClient's Retry-After-honoring jittered backoff.
+"""
+
+import asyncio
+import json
+import random
+import time
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from distributed_llms_tpu.cluster.client import ServingClient
+from distributed_llms_tpu.core.observability import METRICS
+from distributed_llms_tpu.models import model as model_lib, presets
+from distributed_llms_tpu.runtime import generate as gen_lib
+from distributed_llms_tpu.runtime.batcher import ContinuousBatcher
+from distributed_llms_tpu.runtime.faults import FaultPlane
+from distributed_llms_tpu.runtime.server import InferenceServer
+from distributed_llms_tpu.runtime.tokenizer import ByteTokenizer
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    cfg = presets.get_preset("llama-tiny", vocab_size=512)
+    params = model_lib.init_params(jax.random.key(0), cfg)
+    return cfg, params
+
+
+def solo(cfg, params, ids, n_new, eos_id=-1):
+    out = gen_lib.generate_tokens(
+        params, cfg, jnp.asarray([ids], jnp.int32),
+        jnp.asarray([len(ids)], jnp.int32), jax.random.key(9),
+        max_new_tokens=n_new, eos_id=eos_id, pad_id=0,
+    )
+    toks = np.asarray(out)[0].tolist()
+    if eos_id >= 0 and eos_id in toks:
+        toks = toks[: toks.index(eos_id) + 1]
+    return toks
+
+
+def _paged(cfg, params, **kw):
+    kw.setdefault("batch_slots", 3)
+    kw.setdefault("max_len", 64)
+    kw.setdefault("chunk_steps", 4)
+    kw.setdefault("page_size", 16)
+    kw.setdefault("paged_pages", 9)
+    return ContinuousBatcher(cfg, params, **kw)
+
+
+# -- on-demand growth -------------------------------------------------------
+
+
+def test_admission_reserves_prompt_plus_one_page_only(tiny):
+    """A long-budget request admits holding pages for its prompt plus one
+    decode page — NOT its full prompt+budget footprint — and the growth
+    loop adds the rest only as decode actually reaches them, with tokens
+    identical to the fully-reserved run."""
+    cfg, params = tiny
+    b = _paged(cfg, params, batch_slots=1)
+    grown0 = METRICS.get_counter("batcher.pages_grown")
+    rid = b.submit([7, 1, 9, 2], max_new_tokens=44)  # full need: 3 pages
+    b._admit_pending()
+    assert len(b.rows[0].pages) == 2, "admission over-reserved"
+    res = b.run()
+    assert res[rid] == solo(cfg, params, [7, 1, 9, 2], 44)
+    assert METRICS.get_counter("batcher.pages_grown") - grown0 >= 1
+    b.assert_pool_consistent()
+    assert sorted(b.free_pages) == list(range(1, 9))
+    # The watermark view saw the growth.
+    stats = b.pool.stats()
+    assert stats["peak_held"] == 3 and stats["free_pages"] == 8
+
+
+def test_growth_overcommit_preempts_and_stays_exact(tiny):
+    """Three rows whose FULL footprints exceed the pool together admit
+    anyway (on-demand), growth drains the pool, the loser is preempted and
+    resumes via recompute — every token stream still equals its solo run,
+    and the allocator audits clean."""
+    cfg, params = tiny
+    b = _paged(cfg, params)  # 8 usable pages; 3 rows x 3 full pages = 9
+    reqs = [([7, 1, 9, 2], 44), ([4, 4, 4, 4], 44), ([9, 8, 7, 3], 44)]
+    rids = [b.submit(ids, max_new_tokens=n) for ids, n in reqs]
+    res = b.run()
+    for rid, (ids, n) in zip(rids, reqs):
+        assert res[rid] == solo(cfg, params, ids, n), f"rid {rid} diverged"
+    assert b.preemptions >= 1
+    b.assert_pool_consistent()
+    assert sorted(b.free_pages) == list(range(1, 9))
+
+
+def test_preemption_streams_resume_without_duplicates(tiny):
+    """Streamed deliveries across a preemption: the resumed row continues
+    from where it left off — concatenated deliveries equal the final
+    result, nothing re-delivers, and done fires exactly once per rid."""
+    cfg, params = tiny
+    b = _paged(cfg, params)
+    reqs = [([7, 1, 9, 2], 44), ([4, 4, 4, 4], 44), ([9, 8, 7, 3], 44)]
+    rids = [b.submit(ids, max_new_tokens=n) for ids, n in reqs]
+    streamed: dict[int, list[int]] = {rid: [] for rid in rids}
+    dones: dict[int, int] = {rid: 0 for rid in rids}
+
+    def cb(rid, toks, done, lps):
+        streamed[rid].extend(toks)
+        if done:
+            dones[rid] += 1
+
+    res = b.run(on_tokens=cb)
+    assert b.preemptions >= 1
+    for rid in rids:
+        assert streamed[rid] == res[rid], f"rid {rid} stream diverged"
+        assert dones[rid] == 1
+    b.assert_pool_consistent()
+
+
+# -- priority ---------------------------------------------------------------
+
+
+def test_priority_orders_admission(tiny):
+    cfg, params = tiny
+    b = _paged(cfg, params, batch_slots=1)
+    done_order = []
+    r_lo = b.submit([1, 2, 3], max_new_tokens=4, priority=0)
+    r_mid = b.submit([7, 7, 7], max_new_tokens=4, priority=1)
+    r_hi = b.submit([4, 5, 6], max_new_tokens=4, priority=5)
+    b.run(on_tokens=lambda rid, t, d, l: done_order.append(rid) if d else None)
+    assert done_order == [r_hi, r_mid, r_lo]
+
+
+def test_admission_never_preempts_equal_priority(tiny):
+    """The admission path preempts only STRICTLY lower-priority victims:
+    an injected dry pool with only same-priority residents back-pressures
+    (PR 2's behavior) instead of livelocking two requests trading pages."""
+    cfg, params = tiny
+    plane = FaultPlane.parse("batcher.page_alloc/admit:exhaust@2")
+    b = _paged(cfg, params, batch_slots=2, faults=plane)
+    p0 = METRICS.get_counter("batcher.preemptions_total")
+    r1 = b.submit([5, 5], max_new_tokens=4)
+    r2 = b.submit([6, 6], max_new_tokens=4)  # admission 2 sees a dry pool
+    res = b.run()
+    assert plane.rules[0].fired == 1
+    assert METRICS.get_counter("batcher.preemptions_total") == p0
+    assert res[r1] == solo(cfg, params, [5, 5], 4)
+    assert res[r2] == solo(cfg, params, [6, 6], 4)
+    b.assert_pool_consistent()
+
+
+def test_higher_priority_admission_preempts_lower(tiny):
+    """A higher-priority arrival whose admission finds the pool dry evicts
+    a lower-priority resident; the victim resumes later and both streams
+    stay exact."""
+    cfg, params = tiny
+    plane = FaultPlane.parse("batcher.page_alloc/admit:exhaust@2")
+    b = _paged(cfg, params, batch_slots=2, faults=plane)
+    r_lo = b.submit([5, 5], max_new_tokens=24, priority=0)
+    b._admit_pending()  # r_lo resident (page_alloc hit 1: not due)
+    assert b.rows[0].rid == r_lo
+    r_hi = b.submit([6, 6], max_new_tokens=4, priority=3)
+    res = b.run()  # r_hi's admission (hit 2) sees a dry pool -> preempts
+    assert b.preemptions >= 1
+    assert res[r_lo] == solo(cfg, params, [5, 5], 24)
+    assert res[r_hi] == solo(cfg, params, [6, 6], 4)
+    b.assert_pool_consistent()
+
+
+def test_finished_at_admission_row_is_never_a_victim(tiny):
+    """A row that FINISHED at admission (max_new_tokens=1) still holds its
+    rid and pages until the publish sweep — preempting it would requeue a
+    completed request with a fresh 1-token budget and emit a second token
+    past max_tokens.  A dry pool must back-pressure instead."""
+    cfg, params = tiny
+    plane = FaultPlane.parse("batcher.page_alloc/admit:exhaust@2")
+    b = _paged(cfg, params, batch_slots=2, faults=plane)
+    preempt0 = METRICS.get_counter("batcher.preemptions_total")
+    r_one = b.submit([5, 5, 7], max_new_tokens=1)
+    b._admit_pending()  # r_one admits AND finishes (hit 1: not due)
+    assert b.rows[0].rid == r_one and not b.active[0] and b.rows[0].pages
+    # Higher priority, so only the finished-row skip (not the
+    # strictly-lower-priority guard) protects r_one from eviction when
+    # this admission (hit 2) sees an injected dry pool.
+    r_hi = b.submit([6, 6], max_new_tokens=4, priority=3)
+    res = b.run()
+    assert plane.rules[0].fired == 1
+    assert METRICS.get_counter("batcher.preemptions_total") == preempt0
+    assert res[r_one] == solo(cfg, params, [5, 5, 7], 1)
+    assert len(res[r_one]) == 1, "completed request emitted extra tokens"
+    assert res[r_hi] == solo(cfg, params, [6, 6], 4)
+    b.assert_pool_consistent()
+
+
+# -- queue-deadline shedding (batcher) --------------------------------------
+
+
+def test_expired_queued_request_sheds_not_admits(tiny):
+    cfg, params = tiny
+    b = _paged(cfg, params, batch_slots=1)
+    shed0 = METRICS.get_counter("batcher.shed_total")
+    r1 = b.submit([1, 2, 3], max_new_tokens=8)
+    r2 = b.submit([4, 5, 6], max_new_tokens=8,
+                  deadline=time.perf_counter() - 0.5)
+    dones = []
+    res = b.run(on_tokens=lambda rid, t, d, l: dones.append(rid) if d else None)
+    assert res[r2] == [] and b.shed[r2].startswith("queue deadline")
+    assert r2 in dones  # the done delivery fired (servers key on it)
+    assert len(res[r1]) == 8
+    assert METRICS.get_counter("batcher.shed_total") - shed0 == 1
+    b.assert_pool_consistent()
+
+
+def test_expired_preempted_request_finishes_with_partial_not_shed(tiny):
+    """A PREEMPTED request whose deadline lapses while requeued for
+    recompute already streamed tokens — it must FINISH with that partial
+    output (the serving layer reports finish_reason "timeout"), never be
+    shed as never-worked-on: a shed claims a retry is safe, which would
+    duplicate the delivered prefix."""
+    cfg, params = tiny
+    b = _paged(cfg, params, batch_slots=1)
+    shed0 = METRICS.get_counter("batcher.shed_total")
+    from distributed_llms_tpu.runtime.batcher import _Request
+
+    rid = 7
+    b._next_rid = rid + 1
+    b.queue.append(_Request(
+        rid, [5, 5, 9, 9, 11, 12], 10,
+        deadline=time.perf_counter() - 0.1,
+        resume_emitted=[9, 11, 12], resume_lps=[-0.1, -0.2, -0.3],
+    ))
+    dones = []
+    b._on_tokens = lambda r, t, d, l: dones.append(r) if d else None
+    b._shed_expired_queued()
+    b._on_tokens = None
+    assert b.results[rid] == [9, 11, 12]
+    assert b.result_logprobs[rid] == [-0.1, -0.2, -0.3]
+    assert rid not in b.shed, "partial-output request was shed"
+    assert dones == [rid]
+    assert METRICS.get_counter("batcher.shed_total") == shed0
+    b.assert_pool_consistent()
+
+
+# -- chunked prefill over the paged pool ------------------------------------
+
+
+def test_chunked_prefill_paged_matches_solo(tiny):
+    """Chunked prefill now composes with paged KV: the prompt chunks into
+    the pageless transient row, pages are allocated only at the finishing
+    splice, and tokens equal the monolithic (and solo) run."""
+    cfg, params = tiny
+    long_p = list(np.random.RandomState(3).randint(1, 500, size=23))
+    b = _paged(cfg, params, batch_slots=2, prefill_chunk=5)
+    r_long = b.submit(long_p, max_new_tokens=6)
+    r_short = b.submit([4, 4, 4], max_new_tokens=5)
+    res = b.run()
+    assert res[r_long] == solo(cfg, params, long_p, 6)
+    assert res[r_short] == solo(cfg, params, [4, 4, 4], 5)
+    b.assert_pool_consistent()
+    assert sorted(b.free_pages) == list(range(1, 9))
+
+
+def test_preemption_storm_during_chunked_prefill(tiny):
+    """Preemption firing WHILE a chunked prefill is in flight: the
+    prefilling slot holds no pool pages (nothing to corrupt), growth
+    preempts a page-holding row instead, the prefill's own finish waits
+    out the pressure, and everything ends exact with a clean audit."""
+    cfg, params = tiny
+    long_p = list(np.random.RandomState(4).randint(1, 500, size=24))
+    plane = FaultPlane.parse("batcher.page_alloc/grow:exhaust@1")
+    b = _paged(cfg, params, batch_slots=3, prefill_chunk=6, faults=plane)
+    preempt0 = METRICS.get_counter("batcher.preemptions_total")
+    r_a = b.submit([7, 1, 9, 2], max_new_tokens=40)
+    r_b = b.submit([4, 4, 4, 4], max_new_tokens=40)
+    r_long = b.submit(long_p, max_new_tokens=6, priority=2)
+    res = b.run()
+    assert plane.rules[0].fired == 1
+    assert METRICS.get_counter("batcher.preemptions_total") > preempt0
+    assert res[r_a] == solo(cfg, params, [7, 1, 9, 2], 40)
+    assert res[r_b] == solo(cfg, params, [4, 4, 4, 4], 40)
+    assert res[r_long] == solo(cfg, params, long_p, 6)
+    b.assert_pool_consistent()
+    assert sorted(b.free_pages) == list(range(1, 9))
+
+
+# -- preemption vs the automatic prefix cache -------------------------------
+
+
+SHARED = list(np.random.RandomState(7).randint(1, 500, size=40))
+
+
+def test_preempted_row_holding_cached_prefix_pages(tiny):
+    """A preempted victim may hold refcounted prefix-cache pages shared
+    with a surviving row: preemption drops only the victim's references —
+    the survivor keeps reading the shared pages, the resume re-hits the
+    cache (recompute is cheap), and the allocator audits clean."""
+    cfg, params = tiny
+    shared16 = SHARED[:16]  # exactly one cacheable page
+    b = _paged(cfg, params, batch_slots=2, paged_pages=16,
+               prefix_cache=True)
+    # Publish the shared prompt page once.
+    r0 = b.submit(shared16 + [3], max_new_tokens=2)
+    assert b.run()[r0] == solo(cfg, params, shared16 + [3], 2)
+    pc = b.prefix_cache
+    assert len(pc.lru) >= 1
+    # Two hitting rows share the cached page and carry growth-needing
+    # budgets (19-token prompt -> 3 initial pages, 4 at full depth);
+    # force a growth-time preemption while both live.
+    plane = FaultPlane.parse("batcher.page_alloc/grow:exhaust@1")
+    b.faults = plane
+    checked = {}
+    r1 = b.submit(shared16 + [7, 1, 9], max_new_tokens=40)
+    r2 = b.submit(shared16 + [4, 4, 2], max_new_tokens=40)
+
+    def cb(rid, toks, done, lps):
+        if b.preemptions and "at_preempt" not in checked:
+            # The survivor still references the shared page: it must stay
+            # refcounted (never freed) even though the victim released.
+            shared_live = [p for p in pc.page_hash if p in b.page_refs]
+            checked["at_preempt"] = bool(shared_live)
+            b.assert_pool_consistent()
+
+    res = b.run(on_tokens=cb)
+    assert b.preemptions >= 1
+    assert checked.get("at_preempt"), "no shared page survived preemption"
+    assert res[r1] == solo(cfg, params, shared16 + [7, 1, 9], 40)
+    assert res[r2] == solo(cfg, params, shared16 + [4, 4, 2], 40)
+    b.assert_pool_consistent()
+
+
+# -- HTTP plumbing helpers --------------------------------------------------
+
+
+async def _request(host, port, method, path, body=None):
+    """Raw request; returns (status, headers dict, body bytes)."""
+    reader, writer = await asyncio.open_connection(host, port)
+    payload = b"" if body is None else json.dumps(body).encode()
+    writer.write(
+        f"{method} {path} HTTP/1.1\r\nHost: t\r\n"
+        f"Content-Length: {len(payload)}\r\n\r\n".encode() + payload
+    )
+    await writer.drain()
+    status = int((await reader.readline()).split()[1])
+    headers = {}
+    while True:
+        line = await reader.readline()
+        if line in (b"\r\n", b"\n", b""):
+            break
+        name, _, value = line.decode("latin-1").partition(":")
+        headers[name.strip().lower()] = value.strip()
+    data = await reader.read()
+    writer.close()
+    return status, headers, data
+
+
+def make_batcher(tiny, faults=None, **kw):
+    cfg, params = tiny
+    tok = ByteTokenizer()
+    kw.setdefault("batch_slots", 2)
+    kw.setdefault("max_len", 96)
+    kw.setdefault("chunk_steps", 4)
+    kw.setdefault("paged_pages", 8)  # 7 usable = 112-token capacity
+    kw.setdefault("page_size", 16)
+    return ContinuousBatcher(
+        cfg, params, tokenizer=tok, eos_id=tok.eos_id, pad_id=tok.pad_id,
+        faults=faults, **kw
+    )
+
+
+def run_with_server(batcher, fn, **srv_kw):
+    async def driver():
+        srv = InferenceServer(batcher, model_name="tiny", host="127.0.0.1",
+                              port=0, **srv_kw)
+        host, port = await srv.start()
+        try:
+            return await asyncio.wait_for(fn(host, port, srv), timeout=600)
+        finally:
+            await srv.stop()
+
+    return asyncio.run(driver())
+
+
+def expected_texts(tiny, reqs):
+    """Reference texts from a roomy, un-faulted batcher (exactness is
+    batching-invariant — pinned by the paged tests)."""
+    b = make_batcher(tiny, paged_pages=40, batch_slots=4)
+    rids = [b.submit(p, max_new_tokens=n) for p, n in reqs]
+    res = b.run()
+    return {p: b.tokenizer.decode(res[rid])
+            for rid, (p, n) in zip(rids, reqs)}
+
+
+# -- THE overload acceptance test -------------------------------------------
+
+
+def test_overload_storm_completes_or_sheds_structured(tiny):
+    """~2x pool-capacity offered load + injected growth exhaustion: every
+    request either completes with exact temp-0 text or sheds as 429/503
+    with Retry-After and a structured overloaded_error — zero
+    engine_error — and the pool audits clean after the storm."""
+    prompts = [(f"storm request {i}", 40) for i in range(5)]
+    wants = expected_texts(tiny, prompts)
+    # Offered: 5 x ~(16 prompt + 40 new) ~ 280 tokens vs 112-token pool
+    # capacity ~ 2.5x.  The grow-site exhaust forces one deterministic
+    # preemption on top of the real pressure.
+    plane = FaultPlane.parse("batcher.page_alloc/grow:exhaust@1")
+    preempt0 = METRICS.get_counter("batcher.preemptions_total")
+
+    async def fn(host, port, srv):
+        outs = await asyncio.gather(*[
+            _request(host, port, "POST", "/v1/completions",
+                     {"prompt": p, "max_tokens": n,
+                      "priority": (5 if i == 0 else 0)})
+            for i, (p, n) in enumerate(prompts)
+        ])
+        completed, shed = 0, 0
+        for (status, headers, raw), (p, n) in zip(outs, prompts):
+            body = json.loads(raw)
+            if status == 200:
+                assert body["choices"][0]["finish_reason"] == "length", body
+                assert body["choices"][0]["text"] == wants[p], p
+                completed += 1
+            else:
+                # Structured shed: 429 (cost gate / queue full) or 503
+                # (queue-deadline), always with Retry-After and an
+                # overloaded_error type — never engine_error.
+                assert status in (429, 503), (status, body)
+                assert body["error"]["type"] == "overloaded_error", body
+                assert int(headers["retry-after"]) >= 1
+                shed += 1
+        assert completed >= 1 and completed + shed == len(prompts)
+        assert shed >= 1, "cost gate never shed at 2.5x offered load"
+        assert METRICS.get_counter("batcher.preemptions_total") > preempt0
+        # Pool integrity after the storm, once the engine drains.
+        for _ in range(200):
+            if all(r.rid is None for r in srv.batcher.rows):
+                break
+            await asyncio.sleep(0.05)
+        srv.batcher.assert_pool_consistent()
+        # The occupancy view is exported on /metrics.
+        _, _, raw = await _request(host, port, "GET", "/metrics")
+        text = raw.decode()
+        for fam in ("batcher_pool_free_pages", "batcher_pool_held_pages",
+                    "batcher_pool_min_available", "batcher_preemptions_total",
+                    "server_requests_shed_total"):
+            assert fam in text, fam
+
+    run_with_server(make_batcher(tiny, faults=plane), fn,
+                    shed_cost_factor=1.0)
+
+
+@pytest.mark.slow
+def test_overload_storm_large_with_backoff(tiny):
+    """Nightly-sized storm: 16 requests at >2x capacity through
+    ServingClient's Retry-After backoff — with retries, goodput recovers
+    (more requests complete than slots exist) and the audit stays clean."""
+    prompts = [(f"big storm req {i:02d}", 32) for i in range(16)]
+    wants = expected_texts(tiny, prompts)
+
+    async def fn(host, port, srv):
+        clients = [
+            ServingClient(host, port, max_retries=8, backoff_base_s=0.05,
+                          backoff_cap_s=0.4, retry_after_cap_s=0.2,
+                          rng=random.Random(i))
+            for i in range(len(prompts))
+        ]
+        outs = await asyncio.gather(*[
+            c.completions({"prompt": p, "max_tokens": n})
+            for c, (p, n) in zip(clients, prompts)
+        ])
+        completed = 0
+        for (status, body), (p, n) in zip(outs, prompts):
+            if status == 200:
+                assert body["choices"][0]["text"] == wants[p], p
+                completed += 1
+            else:
+                assert body["error"]["type"] == "overloaded_error", body
+        assert completed > 4, f"only {completed} completed despite backoff"
+        assert sum(c.retries_taken for c in clients) >= 1
+        for _ in range(200):
+            if all(r.rid is None for r in srv.batcher.rows):
+                break
+            await asyncio.sleep(0.05)
+        srv.batcher.assert_pool_consistent()
+
+    run_with_server(make_batcher(tiny), fn, shed_cost_factor=1.5)
+
+
+# -- front-door gates, Retry-After, and the mailbox leak class --------------
+
+
+def test_cost_gate_429_retry_after_and_no_mailbox_leak(tiny):
+    async def fn(host, port, srv):
+        shed0 = METRICS.get_counter("server.requests_shed_total")
+        status, headers, raw = await _request(
+            host, port, "POST", "/v1/completions",
+            # 400-token budget vs 112-token capacity at factor 1.0.
+            {"prompt": "too big to ever fit", "max_tokens": 400},
+        )
+        body = json.loads(raw)
+        assert status == 429 and body["error"]["type"] == "overloaded_error"
+        assert int(headers["retry-after"]) >= 1
+        assert METRICS.get_counter("server.requests_shed_total") > shed0
+        # Nothing pre-registered survived the shed: no mailbox, no queue
+        # entry — the leak class this gate's ordering must never recreate.
+        assert not srv._requests
+        assert not srv.batcher.queue
+        # A small request still serves.
+        status, _, raw = await _request(
+            host, port, "POST", "/v1/completions",
+            {"prompt": "small", "max_tokens": 4},
+        )
+        assert status == 200
+        assert not srv._requests
+
+    run_with_server(make_batcher(tiny), fn, shed_cost_factor=1.0)
+
+
+def test_queue_full_429_retry_after_and_no_mailbox_leak(tiny):
+    async def fn(host, port, srv):
+        status, headers, raw = await _request(
+            host, port, "POST", "/v1/completions",
+            {"prompt": "hello", "max_tokens": 4},
+        )
+        # max_pending=0: every request 429s at the queue-full gate.
+        body = json.loads(raw)
+        assert status == 429 and body["error"]["type"] == "overloaded_error"
+        assert "queue is full" in body["error"]["message"]
+        assert int(headers["retry-after"]) >= 1
+        assert not srv._requests and not srv.batcher.queue
+
+    run_with_server(make_batcher(tiny), fn, max_pending=0)
+
+
+def test_submit_crash_does_not_strand_mailboxes(tiny):
+    """A non-ValueError failure inside the registration/submit block
+    (e.g. a broken batcher invariant) must not leave _Mailbox entries in
+    _requests — each leaked entry permanently inflates the queue-full
+    gate until a healthy server 429s everything."""
+    async def fn(host, port, srv):
+        orig = srv.batcher.submit
+
+        def boom(*a, **kw):
+            raise RuntimeError("batcher invariant violated")
+
+        srv.batcher.submit = boom
+        try:
+            await _request(
+                host, port, "POST", "/v1/completions",
+                {"prompt": "doomed", "max_tokens": 4},
+            )
+        except (IndexError, ConnectionError, asyncio.IncompleteReadError):
+            pass  # the handler died; a torn connection is acceptable
+        # ... but it must have cleaned its registration.
+        assert not srv._requests
+        srv.batcher.submit = orig
+        status, _, raw = await _request(
+            host, port, "POST", "/v1/completions",
+            {"prompt": "fine", "max_tokens": 4},
+        )
+        assert status == 200
+        assert not srv._requests
+
+    run_with_server(make_batcher(tiny), fn)
+
+
+def test_priority_field_validation(tiny):
+    async def fn(host, port, srv):
+        for bad in ("high", 1.5, True):
+            status, _, raw = await _request(
+                host, port, "POST", "/v1/completions",
+                {"prompt": "x", "max_tokens": 2, "priority": bad},
+            )
+            assert status == 400, (bad, raw)
+        status, _, _ = await _request(
+            host, port, "POST", "/v1/completions",
+            {"prompt": "x", "max_tokens": 2, "priority": -3},
+        )
+        assert status == 200
+
+    run_with_server(make_batcher(tiny), fn)
+
+
+def test_serving_client_backoff_honors_retry_after(tiny):
+    """ServingClient retries a queue-full 429 with Retry-After-honoring
+    jittered backoff and lands the request once the slot drains."""
+    plane = FaultPlane.parse("batcher.decode:stall@1+:0.05")
+
+    async def fn(host, port, srv):
+        hog = asyncio.create_task(_request(
+            host, port, "POST", "/v1/completions",
+            {"prompt": "hog", "max_tokens": 48},
+        ))
+        for _ in range(500):
+            if srv._requests:
+                break
+            await asyncio.sleep(0.01)
+        assert srv._requests  # max_pending=1: the next request 429s
+        client = ServingClient(host, port, max_retries=60,
+                               backoff_base_s=0.02, backoff_cap_s=0.2,
+                               retry_after_cap_s=0.1, rng=random.Random(1))
+        status, body = await client.completions(
+            {"prompt": "patient", "max_tokens": 4}
+        )
+        assert status == 200, body
+        assert client.retries_taken >= 1
+        await hog
+
+    run_with_server(make_batcher(tiny, faults=plane), fn, max_pending=1)

@@ -57,6 +57,10 @@ def _result_cell(row: dict) -> str:
         ("completed_frac", "completed frac"),
         ("engine_restarts", "engine restarts"),
         ("requests_retried", "requests retried"),
+        ("goodput_tok_per_s", "goodput tok/s"),
+        ("offered_x", "offered load x"),
+        ("shed_frac", "shed frac"),
+        ("preemptions", "preemptions"),
     ):
         if row.get(k) is not None:
             v = row[k]
@@ -88,6 +92,7 @@ def generate(ladder_path: str) -> str:
         # Aux rows run_ladder appends after the decode configs.
         "serving-latency", "continuous-batching", "local-proc-batching",
         "chunked-prefill", "prefix-cache-ttft", "fault-recovery",
+        "overload-goodput",
         "ragged-decode-8k", "ragged-decode-win-8k", "quant-matmul-bw",
         "spec-decode", "spec-decode-7b-int8", "spec-batching",
         "paged-batching", "prefill-flash-2048", "prefill-flash-8192",
