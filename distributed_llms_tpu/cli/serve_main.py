@@ -24,10 +24,44 @@ from ..runtime.server import InferenceServer
 
 log = get_logger("serve_main")
 
+# Flag <-> config contract, pinned by graftlint (GL303): every dlt-serve
+# flag is declared in exactly one of these two tables.  _RUNTIME_FLAGS
+# maps a flag to the RuntimeConfig field it shadows (the flag wins when
+# given; the field is the config-file/--override spelling) — field
+# existence is checked against core/config.py, so a rename there breaks
+# the gate here instead of silently orphaning the flag.
+_RUNTIME_FLAGS: dict[str, str] = {
+    "max-len": "max_seq_len",
+    "paged-pages": "paged_pages",
+    "page-size": "page_size",
+    "prefix-cache": "prefix_cache",
+    "request-timeout": "request_timeout_s",
+    "shed-cost-factor": "shed_cost_factor",
+    "fault": "faults",
+}
+# Server plumbing with no RuntimeConfig twin (transport, process, and
+# batcher-shape knobs that only make sense per serving process).
+_SERVER_ONLY_FLAGS = frozenset({
+    "store", "preset", "config", "override", "host", "port", "model-name",
+    "slots", "chunk-steps", "prefill-chunk", "prefill-concurrency",
+    "max-pending", "drain-timeout", "watchdog-timeout", "platform",
+})
+
 
 def build_server(args) -> InferenceServer:
     cfg = load_config(args.config, args.override)
     rt = cfg.runtime
+    # Parse the fault spec BEFORE the (slow) engine build: an operator's
+    # typo'd site must fail the boot in milliseconds, not after a full
+    # model load.  strict=True checks sites against FAULT_SITES — a rule
+    # that could never fire is config drift, not a no-op.
+    faults = None
+    fault_spec = ",".join(args.fault or []) or rt.faults
+    if fault_spec:
+        from ..runtime.faults import FaultPlane
+
+        faults = FaultPlane.parse(fault_spec, strict=True)
+        log.warning("fault injection armed: %s", faults.describe())
     if args.store:
         mesh_cfg = cfg.mesh if cfg.mesh.num_devices > 1 else None
         engine = InferenceEngine.from_store(args.store, rt=rt, mesh_cfg=mesh_cfg)
@@ -49,14 +83,6 @@ def build_server(args) -> InferenceServer:
         default_name = args.preset
     else:
         raise SystemExit("one of --store or --preset is required")
-    faults = None
-    fault_spec = ",".join(args.fault or []) or rt.faults
-    if fault_spec:
-        from ..runtime.faults import FaultPlane
-
-        faults = FaultPlane.parse(fault_spec)
-        log.warning("fault injection armed: %s", faults.describe())
-
     def make_batcher():
         # Called once now and again by the supervisor after an engine
         # crash: a respawn must share the already-armed fault plane (rules

@@ -68,10 +68,17 @@ class Coordinator:
         # wall-clock-killing a worker.
         self.cfg = cfg or ClusterConfig()
         self.faults = faults
-        self.workers: dict[str, WorkerInfo] = {}
+        # The control-plane state below is confined to the asyncio event
+        # loop (single-threaded by construction — the fix for the
+        # reference's D8/D9 races).  graftlint's lock-discipline rule pins
+        # the confinement: accesses must sit in async defs, or in sync
+        # helpers explicitly annotated "# graftlint: holds(event-loop)"
+        # (called only from coroutines / loop callbacks).
+        self.workers: dict[str, WorkerInfo] = {}  # guarded-by: event-loop
         self.task_queue: asyncio.Queue[Task] = asyncio.Queue()
-        self.tasks: dict[str, Task] = {}
-        self.shard_assignment: dict[int, str] = {}  # shard -> worker_id
+        self.tasks: dict[str, Task] = {}  # guarded-by: event-loop
+        # shard -> worker_id
+        self.shard_assignment: dict[int, str] = {}  # guarded-by: event-loop
         self.num_shards = 0
         self.store_dir: str | None = None
         self._server: asyncio.base_events.Server | None = None
@@ -302,6 +309,7 @@ class Coordinator:
         w = caps.get("capacity") or caps.get("memory_gb") or caps.get("num_devices") or 1
         return max(float(w), 1e-9)
 
+    # graftlint: holds(event-loop)
     def _balanced_assign(
         self, shards: list[int], load: dict[str, float] | None = None
     ) -> dict[int, str]:
@@ -317,6 +325,7 @@ class Coordinator:
             load[w] = load.get(w, 0.0) + 1
         return out
 
+    # graftlint: holds(event-loop)  (REPL/CLI callers run it via the loop)
     def plan_shards(
         self,
         num_shards: int,
@@ -482,6 +491,7 @@ class Coordinator:
             timeout=timeout,
         )
 
+    # graftlint: holds(event-loop)
     def _spmd_pool(self) -> bool:
         """True when registered workers are controllers of one multi-process
         SPMD runtime (single-worker dispatch would hang in a collective)."""
@@ -621,6 +631,7 @@ class Coordinator:
             except (ConnectionError, OSError) as e:
                 await self._evict(info.worker_id, reason=f"send failed: {e}")
 
+    # graftlint: holds(event-loop)
     def _pick_worker(self) -> WorkerInfo | None:
         idle = [w for w in self.workers.values() if w.status == "idle"]
         if idle:
@@ -630,6 +641,7 @@ class Coordinator:
 
     # -- introspection -----------------------------------------------------
 
+    # graftlint: holds(event-loop)  (served by the asyncio MetricsServer)
     def status(self) -> dict:
         return {
             "workers": {

@@ -92,9 +92,9 @@ class Metrics:
 
     def __init__(self) -> None:
         self._lock = threading.Lock()
-        self._counters: dict[str, float] = defaultdict(float)
-        self._gauges: dict[str, float] = {}
-        self._hists: dict[str, _Histogram] = defaultdict(_Histogram)
+        self._counters: dict[str, float] = defaultdict(float)  # guarded-by: self._lock
+        self._gauges: dict[str, float] = {}  # guarded-by: self._lock
+        self._hists: dict[str, _Histogram] = defaultdict(_Histogram)  # guarded-by: self._lock
 
     def inc(self, name: str, value: float = 1.0) -> None:
         with self._lock:
@@ -184,3 +184,65 @@ class _Timer:
 
 
 METRICS = Metrics()
+
+# THE registry of metric names this package emits.  Every name passed to
+# METRICS.inc/set_gauge/set_gauges/observe/timer must appear here (or match
+# a declared ``*`` pattern — f-string names register VERBATIM as their
+# pattern, e.g. ``faults.fired.*``).  graftlint's GL302 pins emission
+# sites to this dict, GL305 flags dead entries, and the README metric
+# table is generated from it — dashboards can't find what the registry
+# doesn't name.
+METRIC_DOCS: dict[str, str] = {
+    # -- continuous batcher (runtime/batcher.py) --
+    "batcher.admitted": "requests admitted into a batch row (counter)",
+    "batcher.completed": "requests that finished and published results",
+    "batcher.cancelled": "requests cancelled while queued or resident",
+    "batcher.shed_total": "queued requests shed at deadline expiry",
+    "batcher.preemptions_total": "rows preempted for KV pool pressure",
+    "batcher.pages_grown": "KV pages allocated by on-demand row growth",
+    "batcher.prefill_chunks": "chunked-prefill bites consumed",
+    "batcher.prefix_cache.lookups": "automatic prefix-cache lookups",
+    "batcher.prefix_cache.hits": "lookups that matched >= 1 cached page",
+    "batcher.prefix_cache.hit_tokens": "prompt tokens served from cache",
+    "batcher.prefix_cache.miss_tokens": "prompt tokens prefilled fresh",
+    "batcher.prefix_cache.hit_rate": "cumulative hit_tokens fraction (gauge)",
+    "batcher.prefix_cache.evicted_pages": "cached pages evicted under pressure",
+    "batcher.pool.*": "KV page-pool occupancy gauges (free/cached/held/"
+                      "total pages, min_available + peak_held watermarks)",
+    # -- serving gateway (runtime/server.py) --
+    "server.requests": "completion requests accepted past the shed gates",
+    "server.disconnects": "requests whose client went away mid-serve",
+    "server.request_seconds": "request latency, receipt to close (histogram)",
+    "server.ttft_seconds": "time to first token, from receipt (histogram)",
+    "server.request_timeouts": "requests that hit their deadline mid-flight",
+    "server.requests_shed_total": "requests answered 429/503 unworked",
+    "server.requests_shed.*": "shed requests by reason (queue_full, "
+                              "cost_gate, queue_deadline)",
+    "server.engine_restarts": "supervised engine respawns after a crash",
+    "server.requests_retried": "zero-streamed requests re-admitted on restart",
+    "server.recovery_seconds": "crash to tokens-flowing-again (histogram)",
+    "server.engine_last_chunk_age_s": "watchdog: seconds since last delivery",
+    # -- engine / sessions / profiling --
+    "engine.generated_tokens": "tokens generated by engine entry points",
+    "engine.generate_seconds": "wall seconds per generate call (histogram)",
+    "engine.spec_acceptance": "speculative decoding acceptance fraction",
+    "kv_spill.spills": "session KV caches spilled to host DRAM",
+    "kv_spill.restores": "session KV caches restored to device",
+    "kv_spill.host_bytes": "bytes of session KV resident on host (gauge)",
+    "kv_spill.resident_sessions": "session caches resident in HBM (gauge)",
+    "kv_spill.spilled_sessions": "session caches parked on host (gauge)",
+    "*.step_seconds": "per-StepTimer step latency (histogram; name prefix "
+                      "is the timer's, e.g. engine.generate)",
+    "*.tokens_per_second": "per-StepTimer sliding-window throughput gauge",
+    # -- cluster control plane --
+    "coordinator.workers": "registered workers (gauge)",
+    "coordinator.evictions": "workers evicted (heartbeat/connection loss)",
+    "coordinator.tasks_dispatched": "tasks sent to workers",
+    "coordinator.tasks_completed": "tasks answered with RESULT",
+    "coordinator.tasks_retried": "tasks requeued after worker failure",
+    "coordinator.tasks_failed": "tasks failed after max attempts",
+    "coordinator.shards_reassigned": "shards moved off evicted workers",
+    # -- fault injection (runtime/faults.py) --
+    "faults.fired": "injected faults triggered, total",
+    "faults.fired.*": "injected faults triggered, by action",
+}

@@ -48,6 +48,7 @@ results.
 from __future__ import annotations
 
 import hashlib
+import threading
 import time
 from collections import OrderedDict, deque
 from dataclasses import dataclass, field
@@ -1080,21 +1081,30 @@ class PagePool:
     def __init__(self, num_pages: int,
                  prefix_cache: "PrefixCache | None" = None) -> None:
         self.num_pages = num_pages
-        self.free_pages: list[int] = list(range(1, num_pages))
+        # Allocator lock: mutation happens on the engine thread, but the
+        # occupancy view (stats/publish_gauges behind /metrics, the
+        # supervisor's audit) reads from the serving loop thread — PR 3
+        # published those gauges off GIL-atomic len() reads, the pattern
+        # graftlint's GL101 now rejects.  The PrefixCache LRU is covered by
+        # THIS lock too: every lru mutation goes through alloc/retain/
+        # release (engine thread), every cross-thread read through stats().
+        self._lock = threading.Lock()
+        self.free_pages: list[int] = list(range(1, num_pages))  # guarded-by: self._lock
         # Refcounts of allocated pages (prefix-cache hits share pages
         # across rows; a page returns to free/LRU only at refcount 0).
-        self.page_refs: dict[int, int] = {}
+        self.page_refs: dict[int, int] = {}  # guarded-by: self._lock
         self.prefix_cache = prefix_cache
         # Watermarks: the least headroom an admission has ever seen and the
         # most pages rows have ever held at once — the two numbers that say
         # whether a production pool is sized right (a min_available of 0
         # means admissions back-pressured or preempted; a peak_held far
         # under num_pages means the pool is over-provisioned).
-        self.min_available = num_pages - 1
-        self.peak_held = 0
+        self.min_available = num_pages - 1  # guarded-by: self._lock
+        self.peak_held = 0  # guarded-by: self._lock
 
+    # graftlint: holds(self._lock)
     def _note_watermarks(self) -> None:
-        avail = self.available()
+        avail = self._available_locked()
         if avail < self.min_available:
             self.min_available = avail
         held = len(self.page_refs)
@@ -1103,16 +1113,18 @@ class PagePool:
 
     def stats(self) -> dict[str, int]:
         """Occupancy snapshot: every usable page is exactly one of free /
-        LRU-cached / row-held (the partition assert_consistent audits)."""
+        LRU-cached / row-held (the partition assert_consistent audits).
+        Safe from any thread (the /metrics scrape path)."""
         pc = self.prefix_cache
-        return {
-            "total_pages": self.num_pages - 1,  # page 0 is scratch
-            "free_pages": len(self.free_pages),
-            "cached_pages": len(pc.lru) if pc is not None else 0,
-            "held_pages": len(self.page_refs),
-            "min_available": self.min_available,
-            "peak_held": self.peak_held,
-        }
+        with self._lock:
+            return {
+                "total_pages": self.num_pages - 1,  # page 0 is scratch
+                "free_pages": len(self.free_pages),
+                "cached_pages": len(pc.lru) if pc is not None else 0,
+                "held_pages": len(self.page_refs),
+                "min_available": self.min_available,
+                "peak_held": self.peak_held,
+            }
 
     def publish_gauges(self) -> None:
         """Mirror the occupancy view into the process-wide METRICS registry
@@ -1121,11 +1133,16 @@ class PagePool:
             f"batcher.pool.{k}": float(v) for k, v in self.stats().items()
         })
 
+    # graftlint: holds(self._lock)
+    def _available_locked(self) -> int:
+        pc = self.prefix_cache
+        return len(self.free_pages) + (len(pc.lru) if pc else 0)
+
     def available(self) -> int:
         """Pages an admission could obtain: the free list plus every
         LRU-parked cached page (reclaimable under pressure)."""
-        pc = self.prefix_cache
-        return len(self.free_pages) + (len(pc.lru) if pc else 0)
+        with self._lock:
+            return self._available_locked()
 
     def alloc(self, n: int) -> list[int]:
         """Allocate ``n`` pages at refcount 1, evicting LRU-cold cached
@@ -1133,29 +1150,31 @@ class PagePool:
         :meth:`available` first)."""
         pc = self.prefix_cache
         out: list[int] = []
-        for _ in range(n):
-            if self.free_pages:
-                p = self.free_pages.pop()
-            else:
-                p, _ = pc.lru.popitem(last=False)  # the coldest entry
-                pc.forget(p)
-                pc.evictions += 1
-                METRICS.inc("batcher.prefix_cache.evicted_pages")
-            self.page_refs[p] = 1
-            out.append(p)
-        self._note_watermarks()
+        with self._lock:
+            for _ in range(n):
+                if self.free_pages:
+                    p = self.free_pages.pop()
+                else:
+                    p, _ = pc.lru.popitem(last=False)  # the coldest entry
+                    pc.forget(p)
+                    pc.evictions += 1
+                    METRICS.inc("batcher.prefix_cache.evicted_pages")
+                self.page_refs[p] = 1
+                out.append(p)
+            self._note_watermarks()
         return out
 
     def retain(self, p: int) -> None:
         """Take a reference on a cached page (a prefix-cache hit): pages
         referenced by live rows bump their refcount; LRU-parked ones come
         back referenced (their content stays addressable)."""
-        if p in self.page_refs:
-            self.page_refs[p] += 1
-        else:
-            del self.prefix_cache.lru[p]
-            self.page_refs[p] = 1
-        self._note_watermarks()
+        with self._lock:
+            if p in self.page_refs:
+                self.page_refs[p] += 1
+            else:
+                del self.prefix_cache.lru[p]
+                self.page_refs[p] = 1
+            self._note_watermarks()
 
     def release(self, pages: list[int]) -> None:
         """Drop one reference per page.  At refcount 0 a content-cached
@@ -1163,29 +1182,45 @@ class PagePool:
         hits until pool pressure reclaims it — while an uncached page
         returns straight to the free list."""
         pc = self.prefix_cache
-        for p in pages:
-            left = self.page_refs[p] - 1
-            if left:
-                self.page_refs[p] = left
-                continue
-            del self.page_refs[p]
-            if pc is not None and p in pc.page_hash:
-                pc.lru[p] = None
-            else:
-                self.free_pages.append(p)
+        with self._lock:
+            for p in pages:
+                left = self.page_refs[p] - 1
+                if left:
+                    self.page_refs[p] = left
+                    continue
+                del self.page_refs[p]
+                if pc is not None and p in pc.page_hash:
+                    pc.lru[p] = None
+                else:
+                    self.free_pages.append(p)
+
+    def publish_prefix(self, page: int, digest: bytes) -> None:
+        """Publish a page's cached content (:meth:`PrefixCache.register`)
+        under the allocator lock: the hash maps are engine-thread-written,
+        but :meth:`assert_consistent` snapshots them from any thread —
+        every cross-thread-visible PrefixCache mutation rides this lock
+        (``forget`` runs inside the locked :meth:`alloc`)."""
+        with self._lock:
+            self.prefix_cache.register(page, digest)
 
     def assert_consistent(self, live_rows=()) -> None:
         """Audit the allocator's partition invariants; AssertionError on
         the first violation.  ``live_rows`` is the page lists of currently
         resident rows — every reference comes from exactly one row hold,
         so per-page refcounts must EQUAL the row-hold counts (a dangling
-        ref or a pinned cache page after a crashed run fails here)."""
+        ref or a pinned cache page after a crashed run fails here).
+        Takes one consistent snapshot under the allocator lock; callable
+        from any thread."""
         pc = self.prefix_cache
-        lru = set(pc.lru) if pc is not None else set()
-        free = set(self.free_pages)
-        refed = set(self.page_refs)
-        assert len(free) == len(self.free_pages), (
-            f"free list holds duplicates: {sorted(self.free_pages)}"
+        with self._lock:
+            lru = set(pc.lru) if pc is not None else set()
+            hashed = set(pc.page_hash) if pc is not None else set()
+            free_list = list(self.free_pages)
+            refs = dict(self.page_refs)
+        free = set(free_list)
+        refed = set(refs)
+        assert len(free) == len(free_list), (
+            f"free list holds duplicates: {sorted(free_list)}"
         )
         assert 0 not in (free | refed | lru), "scratch page 0 escaped the pool"
         for a, b, what in ((free, refed, "free and refcounted"),
@@ -1199,22 +1234,21 @@ class PagePool:
             f"{sorted(expect - accounted)}; "
             f"foreign pages: {sorted(accounted - expect)}"
         )
-        assert all(v >= 1 for v in self.page_refs.values()), (
-            f"non-positive refcounts: {self.page_refs}"
+        assert all(v >= 1 for v in refs.values()), (
+            f"non-positive refcounts: {refs}"
         )
         holds: dict[int, int] = {}
         for pages in live_rows:
             for p in pages:
                 holds[p] = holds.get(p, 0) + 1
-        assert holds == self.page_refs, (
-            f"refcounts diverge from live-row holds: refs={self.page_refs} "
+        assert holds == refs, (
+            f"refcounts diverge from live-row holds: refs={refs} "
             f"holds={holds}"
         )
-        if pc is not None:
-            for p in lru:
-                assert p in pc.page_hash, (
-                    f"LRU-parked page {p} has no cached content"
-                )
+        for p in lru:
+            assert p in hashed, (
+                f"LRU-parked page {p} has no cached content"
+            )
 
 
 @dataclass
@@ -1556,7 +1590,15 @@ class ContinuousBatcher:
         # never see a penalty).
         self.tok_counts: jax.Array | None = None
         self.rows = [_RowState() for _ in range(batch_slots)]
-        self.queue: deque[_Request] = deque()
+        # Submission lock: the ONE cross-thread boundary of this class.
+        # Serving front-ends submit() from their own thread while the
+        # engine thread scans/admits; PR 3 relied on GIL-atomic deque ops
+        # and list() snapshots for this, which graftlint's lock-discipline
+        # rule (GL101) now rejects — every queue/_next_rid access below
+        # holds this lock instead.  Held only for host bookkeeping, never
+        # across a device call or a user callback.
+        self._lock = threading.Lock()
+        self.queue: deque[_Request] = deque()  # guarded-by: self._lock
         # Overload plane: rids shed while still queued (deadline expired
         # before admission) with the reason — serving front-ends read it at
         # the done delivery to answer 503 instead of a bare empty result.
@@ -1574,7 +1616,7 @@ class ContinuousBatcher:
         self.prefix_cached_tokens: dict[int, int] = {}
         self.prefixes: dict[str, _Prefix] = {}
         self._rng = jax.random.key(seed)
-        self._next_rid = 0
+        self._next_rid = 0  # guarded-by: self._lock
         self._on_tokens = None  # set per run() call (streaming callback)
 
     # -- prefix caching ------------------------------------------------------
@@ -1674,7 +1716,20 @@ class ContinuousBatcher:
         inside ``run()`` may admit the request and fire ``on_tokens``
         immediately — registering afterwards would race it.  Only valid
         when all submissions happen on one thread."""
-        return self._next_rid
+        with self._lock:
+            return self._next_rid
+
+    def has_queued(self) -> bool:
+        """Whether any request is waiting for admission (any thread)."""
+        with self._lock:
+            return bool(self.queue)
+
+    def queue_snapshot(self) -> "list[_Request]":
+        """Point-in-time copy of the submission queue, safe from any
+        thread — serving front-ends read queued work (healthz, the
+        estimated-cost gate) while the engine admits concurrently."""
+        with self._lock:
+            return list(self.queue)
 
     def submit(
         self, prompt: str | list[int], max_new_tokens: int = 32,
@@ -1793,15 +1848,17 @@ class ContinuousBatcher:
                 f"prompt ({pfx_len}+{len(ids)} tokens) + {max_new_tokens} new "
                 f"exceeds slot capacity {self.s}"
             )
-        rid = self._next_rid
-        self._next_rid += 1
-        self.queue.append(_Request(
-            rid, ids, max_new_tokens, prefix=prefix,
-            temperature=temperature, top_p=top_p, top_k=top_k,
-            presence_penalty=float(presence_penalty),
-            frequency_penalty=float(frequency_penalty),
-            prefix_cache=prefix_cache, priority=priority, deadline=deadline,
-        ))
+        with self._lock:
+            rid = self._next_rid
+            self._next_rid += 1
+            self.queue.append(_Request(
+                rid, ids, max_new_tokens, prefix=prefix,
+                temperature=temperature, top_p=top_p, top_k=top_k,
+                presence_penalty=float(presence_penalty),
+                frequency_penalty=float(frequency_penalty),
+                prefix_cache=prefix_cache, priority=priority,
+                deadline=deadline,
+            ))
         return rid
 
     def cancel_row(self, rid: int) -> bool:
@@ -1819,18 +1876,23 @@ class ContinuousBatcher:
         round, or the host scheduling mirrors diverge.
 
         Returns True if the rid was found queued or resident."""
-        # Scan a snapshot: a serving front-end may append to the live deque
-        # from its own thread mid-scan (deque ops are GIL-atomic; live
-        # iteration is not), then remove by identity (also atomic).
-        for req in list(self.queue):
-            if req.rid == rid:
-                self.queue.remove(req)
-                # A preempted request waiting for recompute already emitted
-                # (and streamed) a prefix — that IS its partial result.
-                self.results[rid] = list(req.resume_emitted or [])
-                self.result_logprobs[rid] = list(req.resume_lps or [])
-                METRICS.inc("batcher.cancelled")
-                return True
+        # Queue scan under the submission lock: a serving front-end may
+        # append from its own thread mid-scan.
+        dropped: _Request | None = None
+        with self._lock:
+            for req in self.queue:
+                if req.rid == rid:
+                    dropped = req
+                    break
+            if dropped is not None:
+                self.queue.remove(dropped)
+        if dropped is not None:
+            # A preempted request waiting for recompute already emitted
+            # (and streamed) a prefix — that IS its partial result.
+            self.results[rid] = list(dropped.resume_emitted or [])
+            self.result_logprobs[rid] = list(dropped.resume_lps or [])
+            METRICS.inc("batcher.cancelled")
+            return True
         for i in range(self.b):
             row = self.rows[i]
             if row.rid == rid:
@@ -1865,16 +1927,23 @@ class ContinuousBatcher:
                 return i
         return None
 
-    def _next_request(self) -> _Request:
+    def _next_request(self) -> "_Request | None":
         """Admission order: highest priority first, FIFO (rid) within a
         priority.  A preempted request keeps its original rid, so it
         resumes ahead of later same-priority arrivals.  Deterministic in
         the queue contents alone, so multi-process meshes stay lockstep.
-        The serving loop thread appends to the deque concurrently, so the
-        scan runs over a list() snapshot (a single C-level copy, atomic
-        under the GIL) — iterating the live deque with a Python key
-        callback could observe a mid-iteration append and raise."""
-        return max(list(self.queue), key=lambda r: (r.priority, -r.rid))
+        The serving loop thread appends concurrently — the scan holds the
+        submission lock.  Returns None on an empty queue."""
+        with self._lock:
+            if not self.queue:
+                return None
+            return max(self.queue, key=lambda r: (r.priority, -r.rid))
+
+    def _unqueue(self, req: "_Request") -> None:
+        """Remove an admitted request from the queue (identity compare —
+        _Request is eq=False) under the submission lock."""
+        with self._lock:
+            self.queue.remove(req)
 
     def _shed_expired_queued(self) -> None:
         """Drop queued requests whose deadline has already passed: a
@@ -1892,13 +1961,17 @@ class ContinuousBatcher:
         if self.pm is not None:
             return
         now = time.perf_counter()
-        # list() snapshot: the serving loop thread appends concurrently
-        # (a C-level copy is atomic under the GIL; a Python-level scan of
-        # the live deque is not).
-        for req in list(self.queue):
-            if req.deadline is None or req.deadline > now:
-                continue
-            self.queue.remove(req)
+        # Collect expired requests under the submission lock, then deliver
+        # OUTSIDE it: the on_tokens callback may re-enter this class
+        # (serving's cancel sweep calls cancel_row), which takes the lock.
+        expired: list[_Request] = []
+        with self._lock:
+            for req in list(self.queue):
+                if req.deadline is None or req.deadline > now:
+                    continue
+                self.queue.remove(req)
+                expired.append(req)
+        for req in expired:
             self.results[req.rid] = list(req.resume_emitted or [])
             self.result_logprobs[req.rid] = list(req.resume_lps or [])
             if req.resume_emitted:
@@ -1989,7 +2062,8 @@ class ContinuousBatcher:
         self.rows[i] = _RowState()
         self.active[i] = False
         self.budget[i] = 0
-        self.queue.append(resume)
+        with self._lock:
+            self.queue.append(resume)
         self.preemptions += 1
         METRICS.inc("batcher.preemptions_total")
         log.info(
@@ -2122,11 +2196,13 @@ class ContinuousBatcher:
         # parallelism); decode rounds interleave between chunks.
         for slot in list(self._prefills):
             self._advance_chunk(slot)
-        while self.queue:
+        while True:
             i = self._free_slot()
             if i is None:
                 return
             req = self._next_request()
+            if req is None:
+                return
             pfx = self.prefixes[req.prefix] if req.prefix is not None else None
             pfx_len = len(pfx.ids) if pfx else 0
             total_len = pfx_len + len(req.ids)
@@ -2136,7 +2212,7 @@ class ContinuousBatcher:
                     # Prefill slots full, and strict admission order: stop
                     # admitting (the selected request never gets jumped).
                     return
-                self.queue.remove(req)
+                self._unqueue(req)
                 self._start_chunked(i, req, pfx)
                 continue
             pages: list[int] = []
@@ -2151,7 +2227,7 @@ class ContinuousBatcher:
                     # stops for this round.
                     return
                 page_list, pages, cached_pages, cached_len, digests = got
-            self.queue.remove(req)
+            self._unqueue(req)
             # Bucket for compile reuse, but never past what fits after the
             # prefix: forward's contract is cache_index + T <= max_len, and
             # dynamic_update_slice CLAMPS an overflowing start — the suffix
@@ -2231,7 +2307,7 @@ class ContinuousBatcher:
                 # fresh ones now hold exactly the hashed content — the
                 # admission scatter just wrote it.
                 for j in range(len(cached_pages), len(digests)):
-                    self.prefix_cache.register(int(page_list[j]), digests[j])
+                    self.pool.publish_prefix(int(page_list[j]), digests[j])
             if self.speculative:
                 # Seed the DRAFT cache for this row: full prompt (prefix
                 # caching stores only target KV, so the draft prefills
@@ -2486,7 +2562,7 @@ class ContinuousBatcher:
 
     def _run_loop(self) -> dict[int, list[int]]:
         # Publish any 1-token requests finished by admission alone.
-        while self.queue or bool(self.active.any()) or any(
+        while self.has_queued() or bool(self.active.any()) or any(
             r.rid is not None for r in self.rows
         ):
             self._admit_pending()
@@ -2503,7 +2579,9 @@ class ContinuousBatcher:
                 self._collect(
                     np.zeros((self.b, 0), np.int32), was_active
                 )
-                if not self.queue and all(r.rid is None for r in self.rows):
+                if not self.has_queued() and all(
+                    r.rid is None for r in self.rows
+                ):
                     break
                 continue
             if self.faults is not None:

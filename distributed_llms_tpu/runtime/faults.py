@@ -67,6 +67,35 @@ ACTIONS = frozenset({"raise", "exhaust", "stall", "drop", "delay", "close"})
 # Actions fire() applies itself; the rest are returned for the call site.
 _SELF_APPLIED = frozenset({"raise", "stall"})
 
+# THE registry of wired injection sites: every site string a hot path
+# passes to fire() (and every site in an operator's --fault spec under
+# strict parsing) must appear here.  graftlint's GL301 pins call sites to
+# this dict and the README table is generated from it — a typo'd site is
+# otherwise a rule that silently never fires.
+FAULT_SITES: dict[str, str] = {
+    "batcher.admit":
+        "each admission round (ContinuousBatcher._admit_pending)",
+    "batcher.decode":
+        "before each decode/speculative chunk is dispatched",
+    "batcher.page_alloc":
+        "paged-pool allocation check; tag 'admit' (reservation) or 'grow' "
+        "(chunk-boundary growth) — 'exhaust' forces the pressure path",
+    "batcher.preempt":
+        "one hit per row preemption, BEFORE the victim's pages are freed",
+    "proto.send":
+        "cluster protocol frame about to be written (tag = message type)",
+    "proto.recv":
+        "cluster protocol frame just read (tag = message type)",
+    "worker.heartbeat":
+        "one worker heartbeat tick ('drop' skips the send)",
+    "worker.result":
+        "a worker about to answer (tag = command type)",
+    "worker.handle":
+        "a worker command handler about to run (tag = command type)",
+    "coordinator.dispatch":
+        "a task about to be sent to a worker (tag = task type)",
+}
+
 
 class InjectedFault(RuntimeError):
     """Raised by a ``raise`` rule.  Deliberately its own type so recovery
@@ -158,13 +187,25 @@ class FaultPlane:
         self.rules: list[FaultRule] = list(rules or [])
 
     @classmethod
-    def parse(cls, spec: str | None) -> "FaultPlane":
+    def parse(cls, spec: str | None, strict: bool = False) -> "FaultPlane":
         """Build a plane from the comma-separated spec grammar above.
-        ``None``/empty parses to an empty (never-firing) plane."""
+        ``None``/empty parses to an empty (never-firing) plane.
+        ``strict=True`` additionally rejects sites absent from
+        :data:`FAULT_SITES` — operator entry points (``dlt-serve
+        --fault``) use it so a typo'd site fails loudly instead of
+        parsing into a rule that never fires.  Tests exercising the
+        grammar itself keep the default and may use synthetic sites."""
         rules = [
             _parse_rule(part)
             for part in (spec or "").split(",") if part.strip()
         ]
+        if strict:
+            unknown = sorted({r.site for r in rules} - set(FAULT_SITES))
+            if unknown:
+                raise ValueError(
+                    f"unknown fault site(s) {unknown}; wired sites: "
+                    f"{sorted(FAULT_SITES)}"
+                )
         return cls(rules)
 
     def add(self, site: str, action: str, when: str = "1",
@@ -207,6 +248,7 @@ class FaultPlane:
                 f"{'/' + tag if tag else ''} (rule {hit.describe()})"
             )
         if hit.action == "stall":
+            # graftlint: ignore[GL401](stall deliberately blocks the engine thread — it models a wedged device call for the watchdog)
             time.sleep(hit.arg or 0.0)
         return hit
 

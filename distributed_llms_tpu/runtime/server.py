@@ -218,16 +218,20 @@ class InferenceServer:
         self.watchdog_timeout_s = watchdog_timeout_s
         self.max_request_retries = max_request_retries
         self.shed_cost_factor = shed_cost_factor
-        self._requests: dict[int, _Mailbox] = {}
-        self._cancelled: set[int] = set()  # loop writes, engine consumes
-        # Supervisor per-request state (meta/delivered/retries) rides on
-        # each _Mailbox — see its docstring.
         # Serializes (next_rid + submit) on the loop thread against the
         # supervisor's batcher swap on the engine thread: without it a
         # submit could land in the dying batcher's queue after the
-        # supervisor scanned it, stranding the request forever.  Held only
-        # for host bookkeeping (never across an await or a device call).
+        # supervisor scanned it, stranding the request forever.  Also
+        # guards the mailbox registry and cancel-flag set below (loop
+        # registers/pops, engine reads/consumes — PR 3 leaned on GIL-atomic
+        # dict/set ops here, which graftlint's GL101 now rejects).  Held
+        # only for host bookkeeping (never across an await or a device
+        # call); lock order is _submit_lock -> batcher._lock, everywhere.
         self._submit_lock = threading.Lock()
+        self._requests: dict[int, _Mailbox] = {}  # guarded-by: self._submit_lock
+        self._cancelled: set[int] = set()  # guarded-by: self._submit_lock
+        # Supervisor per-request state (meta/delivered/retries) rides on
+        # each _Mailbox — see its docstring.
         self._restarts = 0
         self._engine_dead = False  # respawn itself failed; serve errors
         self._last_progress = time.monotonic()  # engine watchdog stamp
@@ -272,12 +276,13 @@ class InferenceServer:
             loop = asyncio.get_running_loop()
             deadline = loop.time() + drain_timeout
             # force_stop() flips _stopping mid-drain (second SIGTERM/^C).
-            while (self._requests and loop.time() < deadline
+            while (self._inflight() and loop.time() < deadline
                    and not self._stopping):
                 await asyncio.sleep(0.05)
         self._stopping = True
-        for rid in list(self._requests):
-            self._cancelled.add(rid)
+        with self._submit_lock:
+            for rid in list(self._requests):
+                self._cancelled.add(rid)
         self._work.set()
         if self._engine is not None:
             # Every active row delivers each chunk, so the cancel flags
@@ -290,7 +295,7 @@ class InferenceServer:
         # reset socket.  Bounded so a dead client cannot hold shutdown.
         if self._loop is not None:
             deadline = self._loop.time() + 5.0
-            while self._requests and self._loop.time() < deadline:
+            while self._inflight() and self._loop.time() < deadline:
                 await asyncio.sleep(0.02)
         if self._server is not None:
             self._server.close()
@@ -305,20 +310,28 @@ class InferenceServer:
 
     # -- engine thread -----------------------------------------------------
 
+    def _inflight(self) -> int:
+        """Registered (mailbox-holding) requests, from any thread."""
+        with self._submit_lock:
+            return len(self._requests)
+
     def _pending(self) -> bool:
         b = self.batcher
-        return bool(b.queue) or any(r.rid is not None for r in b.rows)
+        # b.rows is engine-owned; this loop-thread probe only snapshot-
+        # iterates and reads immutable attributes (the documented healthz
+        # contract).  The queue read goes through the batcher's lock.
+        return b.has_queued() or any(r.rid is not None for r in list(b.rows))
 
     def _pending_token_mass(self) -> int:
         """Estimated token mass the engine still has to absorb: every
         queued or resident request's prompt + budget.  A resumed
         (preempted) request's ids already fold in its emitted prefix and
         its budget shrank to the remainder, so the estimate never double
-        counts.  Loop-thread reads of engine-owned lists — snapshot
-        iteration only, same contract as the healthz probe."""
+        counts.  The queue is read through the batcher's submission lock;
+        rows are engine-owned and snapshot-iterated (healthz contract)."""
         b = self.batcher
         mass = 0
-        for r in list(b.queue):
+        for r in b.queue_snapshot():
             mass += len(r.ids) + r.max_new_tokens
         for row in list(b.rows):
             req = row.req
@@ -342,10 +355,14 @@ class InferenceServer:
                 # last run and stop() (engine idle, _work set by both) is
                 # in the batcher queue but will never run — without this
                 # its handler coroutine blocks forever on its mailbox.
-                for rid in list(self._requests):
-                    self.batcher.cancel_row(rid)
-                    self._cancelled.discard(rid)
-                    self._notify(rid, [], True, err="server is shutting down")
+                # Lock order inside: _submit_lock -> batcher._lock (same
+                # as the submit path).
+                with self._submit_lock:
+                    for rid in list(self._requests):
+                        self.batcher.cancel_row(rid)
+                        self._cancelled.discard(rid)
+                        self._notify(rid, [], True,
+                                     err="server is shutting down")
                 return
             if not self._pending():
                 continue
@@ -364,10 +381,11 @@ class InferenceServer:
                         "engine recovery failed; failing in-flight requests"
                     )
                     self._engine_dead = True
-                    for rid in list(self._requests):
-                        self._cancelled.discard(rid)
-                        self._notify(rid, [], True,
-                                     err="engine unrecoverable")
+                    with self._submit_lock:
+                        for rid in list(self._requests):
+                            self._cancelled.discard(rid)
+                            self._notify(rid, [], True,
+                                         err="engine unrecoverable")
                     return
                 continue  # fresh batcher: nothing of the old run to clear
             # run() accumulated per-rid results we already streamed; drop
@@ -470,47 +488,45 @@ class InferenceServer:
                 "server.recovery_seconds", time.monotonic() - self._recover_t0
             )
             self._recover_t0 = None
-        mbox = self._requests.get(rid)
-        if mbox is not None and toks:
-            # Engine-side streamed accounting: the supervisor's
-            # zero-streamed test reads THIS, not loop-side queue state
-            # (which lags by however many deliveries sit unconsumed).
-            # Writing through the mailbox is benign even if the handler
-            # pops _requests[rid] between the get() above and here — the
-            # write lands on a garbage object, not a resurrected entry.
-            mbox.delivered += len(toks)
-        if mbox is not None and mbox.cached_tokens is None:
-            # Prefix-cache usage accounting: the batcher recorded the rid's
-            # cached prompt tokens at admission (before any delivery); this
-            # thread owns the batcher, so the read is race-free.  A plain
-            # int attribute write is GIL-atomic; the loop reads it only
-            # after the done delivery it is ordered before.
-            mbox.cached_tokens = self.batcher.prefix_cached_tokens.get(rid, 0)
         # A done delivery for a rid the batcher SHED (queue deadline
         # expired before admission) carries the shed reason as a
         # structured error: the handler answers 503 + Retry-After, not an
         # empty 200.  Engine thread owns batcher.shed; popped exactly once.
         shed = self.batcher.shed.pop(rid, None) if done else None
         err = (_SHED_ERR + shed) if shed is not None else None
-        if rid in self._cancelled:
+        with self._submit_lock:
+            mbox = self._requests.get(rid)
+            if mbox is not None and toks:
+                # Engine-side streamed accounting: the supervisor's
+                # zero-streamed test reads THIS, not loop-side queue state
+                # (which lags by however many deliveries sit unconsumed).
+                mbox.delivered += len(toks)
+            if mbox is not None and mbox.cached_tokens is None:
+                # Prefix-cache usage accounting: the batcher recorded the
+                # rid's cached prompt tokens at admission (before any
+                # delivery); this thread owns the batcher, so the read is
+                # race-free.  The loop reads it only after the done
+                # delivery it is ordered before.
+                mbox.cached_tokens = \
+                    self.batcher.prefix_cached_tokens.get(rid, 0)
+            cancelled = rid in self._cancelled
             self._cancelled.discard(rid)
-            if not done:
+            if cancelled and not done:
+                # Lock order _submit_lock -> batcher._lock (submit path's).
                 self.batcher.cancel_row(rid)
-            self._notify(rid, toks, True, err=err, lps=lps)
+            self._notify(rid, toks, True if cancelled else done,
+                         err=err, lps=lps)
             self._sweep_cancelled(exclude=rid)
-            return
-        self._notify(rid, toks, done, err=err, lps=lps)
-        self._sweep_cancelled(exclude=rid)
 
+    # graftlint: holds(self._submit_lock)
     def _sweep_cancelled(self, exclude: int) -> None:
         """Consume cancel flags for OTHER rids at this chunk boundary.
         A QUEUED request (no row yet, so no deliveries of its own) would
         otherwise never see its flag consumed — a timed-out queued request
         would sit out the full ack grace instead of cancelling at the next
         chunk boundary as documented.  cancel_row is legal here: we are
-        inside run()'s on_tokens callback, the documented safe point."""
-        if len(self._cancelled) <= (1 if exclude in self._cancelled else 0):
-            return
+        inside run()'s on_tokens callback, the documented safe point.
+        Caller holds _submit_lock."""
         for other in list(self._cancelled):
             if other == exclude:
                 continue
@@ -518,8 +534,12 @@ class InferenceServer:
                 self._cancelled.discard(other)
                 self._notify(other, [], True)
 
+    # graftlint: holds(self._submit_lock)
     def _notify(self, rid: int, toks: list[int], done: bool,
                 err: str | None = None, lps: list[float] | None = None):
+        """Queue one delivery onto the rid's mailbox (caller holds
+        _submit_lock — every producer already does, for the registry
+        scan/swap it performs around the notify)."""
         mbox = self._requests.get(rid)
         if mbox is not None and self._loop is not None:
             self._loop.call_soon_threadsafe(
@@ -604,9 +624,11 @@ class InferenceServer:
         # _requests while a wedged engine still pins their rows/pages —
         # keying on _requests alone would report a wedged engine healthy
         # the moment the last handler gave up.  _pending() reads batcher
-        # state the engine thread owns, but only immutable-list iteration
-        # and attribute loads — safe cross-thread for a health probe.
-        busy = bool(self._requests) or bool(self._cancelled) or self._pending()
+        # state through the batcher's own lock/snapshot contract.
+        with self._submit_lock:
+            inflight = len(self._requests)
+            cancels = bool(self._cancelled)
+        busy = inflight > 0 or cancels or self._pending()
         stalled = busy and age > self.watchdog_timeout_s
         healthy = alive and not stalled and not self._draining
         METRICS.set_gauge("server.engine_last_chunk_age_s", age)
@@ -619,7 +641,7 @@ class InferenceServer:
             "engine_stalled": stalled,
             "seconds_since_last_chunk": round(age, 3),
             "draining": self._draining,
-            "inflight_requests": len(self._requests),
+            "inflight_requests": inflight,
             "engine_restarts": self._restarts,
         }
 
@@ -806,7 +828,7 @@ class InferenceServer:
         # Shed gates, all BEFORE any delivery state is registered: a shed
         # request must leave zero trace (no _Mailbox, no batcher queue
         # entry) — the leak-check test pins this.
-        if len(self._requests) + n > self.max_pending:
+        if self._inflight() + n > self.max_pending:
             await self._shed_json(
                 writer, 429, "server request queue is full", "queue_full"
             )
@@ -934,14 +956,16 @@ class InferenceServer:
             # cancel-flagged — the engine consumes the flag at its next
             # delivery; only unfinished rids are flagged because rids are
             # never reused and a stale flag would sit in the set forever.
-            for _, rid, mbox in subs:
-                if mbox.finished:
-                    # Drop any stop-flag the engine never got to consume
-                    # (the row finished naturally in the same delivery).
-                    self._cancelled.discard(rid)
-                else:
-                    self._cancelled.add(rid)
-                self._requests.pop(rid, None)
+            with self._submit_lock:
+                for _, rid, mbox in subs:
+                    if mbox.finished:
+                        # Drop any stop-flag the engine never got to
+                        # consume (the row finished naturally in the same
+                        # delivery).
+                        self._cancelled.discard(rid)
+                    else:
+                        self._cancelled.add(rid)
+                    self._requests.pop(rid, None)
 
     async def _collect_until_done(self, mbox, rid, stop, need_text=True):
         """Drain the mailbox; yield (text_so_far, ids_so_far, done, err).
@@ -1003,7 +1027,8 @@ class InferenceServer:
                 # already cancel-flagged from the hit).
                 timed_out = True
                 if stopped_at is None:
-                    self._cancelled.add(rid)
+                    with self._submit_lock:
+                        self._cancelled.add(rid)
                     self._work.set()
                     METRICS.inc("server.request_timeouts")
                 continue
@@ -1073,7 +1098,8 @@ class InferenceServer:
                         # Flag for the engine; its next delivery for this
                         # rid (one chunk away at most — an active row
                         # streams every chunk) is the done ack.
-                        self._cancelled.add(rid)
+                        with self._submit_lock:
+                            self._cancelled.add(rid)
                 if done:
                     mbox.finished = True
                 yield text, ids, lps, done, (

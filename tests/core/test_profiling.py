@@ -1,21 +1,28 @@
 """Profiling subsystem (SURVEY §5.1: absent in the reference)."""
 
 import os
-import time
 
 from distributed_llms_tpu.core import profiling
 from distributed_llms_tpu.core.observability import METRICS
 
 
 def test_step_timer_records_metrics():
-    timer = profiling.StepTimer("t_test")
+    # Deterministic: a fake clock advances 10 ms per step instead of
+    # sleeping wall-clock time (graftlint GL501 — fast tests don't sleep),
+    # so the throughput gauge has an EXACT expected value.
+    fake = {"now": 0.0}
+
+    def clock() -> float:
+        return fake["now"]
+
+    timer = profiling.StepTimer("t_test", clock=clock)
     for _ in range(3):
         with timer.step(tokens=100):
-            time.sleep(0.01)
+            fake["now"] += 0.01
     snap = METRICS.snapshot()
     assert snap["histograms"]["t_test.step_seconds"]["count"] >= 3
     tps = snap["gauges"]["t_test.tokens_per_second"]
-    assert 0 < tps < 100 / 0.01 * 2
+    assert abs(tps - 100 / 0.01) < 1e-6
     assert timer.steps == 3
 
 

@@ -1,0 +1,46 @@
+"""graftlint — repo-native static analysis for distributed_llms_tpu.
+
+Five rule families, each born from a bug class this tree actually shipped
+and had to retrofit-fix:
+
+- GL1xx lock discipline (``locks``): ``# guarded-by:`` annotated shared
+  fields must be accessed under their lock / event-loop confinement.
+- GL2xx JAX hot-path hygiene (``hotpath``): no implicit host syncs or
+  Python control flow on traced values in ``ops/``, ``models/``,
+  ``runtime/sampling.py``.
+- GL3xx registry drift (``registry``): fault sites vs FAULT_SITES, metric
+  names vs METRIC_DOCS, dlt-serve flags vs RuntimeConfig, README tables
+  vs both registries.
+- GL401 blocking calls (``blocking``): nothing reachable from
+  ``ContinuousBatcher.run`` may sleep or touch sockets/files.
+- GL501 test hygiene (``testhygiene``): no wall-clock sleeps in fast
+  tests.
+
+Run as ``python -m tools.graftlint`` (exit 0 = no non-baselined findings)
+or through the tier-1 gate ``tests/tools/test_graftlint.py``.
+"""
+
+from __future__ import annotations
+
+from . import blocking, hotpath, locks, registry, testhygiene
+from .core import (BASELINE_NAME, Finding, Project, load_project,
+                   read_baseline, split_new, write_baseline)
+
+RULE_MODULES = (locks, hotpath, registry, blocking, testhygiene)
+
+
+def run_project(project: Project) -> list[Finding]:
+    findings: list[Finding] = []
+    for mod in RULE_MODULES:
+        findings.extend(mod.check(project))
+    return sorted(findings, key=lambda f: (f.path, f.line, f.rule, f.message))
+
+
+def run(root) -> list[Finding]:
+    return run_project(load_project(root))
+
+
+__all__ = [
+    "BASELINE_NAME", "Finding", "Project", "RULE_MODULES", "load_project",
+    "read_baseline", "run", "run_project", "split_new", "write_baseline",
+]

@@ -1,0 +1,80 @@
+"""CLI: ``python -m tools.graftlint [--root DIR]``.
+
+Exit status: 0 when every finding is either absent or baselined, 1 when
+NEW findings exist (the tier-1 gate mirrors this via
+tests/tools/test_graftlint.py), 2 on usage errors.
+
+- ``--baseline-write``: accept the current findings as debt (rewrites
+  ``graftlint_baseline.txt`` with normalized, line-number-free entries).
+- ``--write-docs``: regenerate the README fault-site/metric tables from
+  the code registries (the GL304 drift check compares against these).
+- ``--all``: print baselined findings too (marked), not just new ones.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from pathlib import Path
+
+from . import (load_project, read_baseline, run_project, split_new,
+               write_baseline)
+from .registry import write_docs
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.graftlint",
+        description="repo-native static analysis (see tools/graftlint/)",
+    )
+    ap.add_argument("--root", default=".", help="repo root to analyze")
+    ap.add_argument("--baseline-write", action="store_true",
+                    help="accept current findings into the baseline")
+    ap.add_argument("--write-docs", action="store_true",
+                    help="regenerate README registry tables, then exit")
+    ap.add_argument("--all", action="store_true",
+                    help="also print baselined (accepted) findings")
+    args = ap.parse_args(argv)
+
+    root = Path(args.root).resolve()
+    if not root.is_dir():
+        print(f"graftlint: --root {root} is not a directory", file=sys.stderr)
+        return 2
+    project = load_project(root)
+
+    if args.write_docs:
+        done = write_docs(project)
+        print(f"graftlint: rewrote README tables: {', '.join(done) or 'none'}")
+        return 0
+
+    findings = run_project(project)
+    if args.baseline_write:
+        path = write_baseline(root, findings)
+        print(f"graftlint: wrote {len(findings)} finding(s) to {path.name}")
+        return 0
+
+    baseline = read_baseline(root)
+    new, accepted = split_new(findings, baseline)
+    for f in new:
+        print(f.render())
+    if args.all:
+        for f in accepted:
+            print(f"{f.render()}  [baselined]")
+    counts: dict[str, int] = {}
+    for f in findings:
+        counts[f.normalized()] = counts.get(f.normalized(), 0) + 1
+    stale = {key for key, n in baseline.items() if n > counts.get(key, 0)}
+    summary = (f"graftlint: {len(new)} new finding(s), "
+               f"{len(accepted)} baselined, {len(stale)} stale baseline "
+               f"entr{'y' if len(stale) == 1 else 'ies'}")
+    print(summary, file=sys.stderr)
+    if stale:
+        print("graftlint: stale entries (fixed debt — run --baseline-write "
+              "to shrink the baseline):", file=sys.stderr)
+        for s in sorted(stale):
+            print(f"  {s}", file=sys.stderr)
+    return 1 if new else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,124 @@
+"""GL2xx — JAX hot-path hygiene.
+
+Scope: the modules whose code runs under ``jax.jit`` / ``shard_map`` —
+``ops/``, ``models/``, and ``runtime/sampling.py``.  Everything in these
+files is hot-path by policy (their functions are traced from jitted
+callers even when the ``@jax.jit`` decorator lives elsewhere, e.g.
+``models.model.forward`` traced by the batcher's admission programs), so
+the rules apply to every function body in scope.
+
+The failure mode is the silent host sync: an op that forces the traced
+value back to Python blocks dispatch, serializes the pipeline, and on a
+real TPU turns a microsecond step into a millisecond one — the exact bug
+class vLLM-style stacks lint for in CI.  Four shapes:
+
+- GL201 ``.item()`` — always a device->host sync.
+- GL202 ``float()/int()/bool()`` applied to an array-producing expression
+  (one containing a ``jnp.``/``lax.``/``jax.nn``-style call or an
+  ``.any()/.all()/.sum()``-style reduction).  Plain ``int(cfg.heads *
+  pct)`` on static config math is fine and not flagged.
+- GL203 ``np.asarray/np.array/np.frombuffer`` on such an expression —
+  numpy materializes, so a traced operand means a sync (static shape
+  math via ``np.zeros(x.shape, ...)`` stays legal).
+- GL204 Python ``if``/``while`` on such an expression — control flow on a
+  traced value either fails to trace or (under ``jit``-exempt paths)
+  syncs per step; use ``lax.cond``/``jnp.where``.
+
+Suppress a deliberate sync with ``# graftlint: ignore[GL20x](<reason>)``.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .core import Finding, Project, SourceFile, dotted_name
+
+RULE_ITEM = "GL201"
+RULE_CAST = "GL202"
+RULE_NUMPY = "GL203"
+RULE_BRANCH = "GL204"
+
+# Call roots that produce (or operate on) traced arrays.  Bare ``jax.`` is
+# deliberately absent: ``jax.default_backend()``, ``jax.devices()`` and
+# friends are host-side introspection.
+_ARRAY_ROOTS = ("jnp.", "lax.", "jax.numpy.", "jax.lax.", "jax.nn.",
+                "jax.random.", "jax.scipy.")
+_ARRAY_METHODS = {"any", "all", "sum", "max", "min", "mean", "prod",
+                  "argmax", "argmin", "astype", "reshape"}
+_NUMPY_MATERIALIZERS = {"np.asarray", "np.array", "np.frombuffer",
+                        "numpy.asarray", "numpy.array", "onp.asarray"}
+# Dtype metadata, evaluated at trace (or import) time — never a traced
+# array, so casting/branching on these is host-side and legal.
+_METADATA_CALLS = {"jnp.finfo", "jnp.iinfo", "jnp.dtype", "jnp.issubdtype",
+                   "jnp.result_type", "jax.numpy.finfo", "jax.numpy.iinfo",
+                   "jax.numpy.dtype", "jax.eval_shape"}
+
+
+def in_scope(rel: str) -> bool:
+    parts = rel.split("/")
+    return ("ops" in parts[:-1] or "models" in parts[:-1]
+            or rel.endswith("runtime/sampling.py") or rel == "sampling.py")
+
+
+def _is_array_call(node: ast.Call) -> bool:
+    name = dotted_name(node.func)
+    if name in _METADATA_CALLS:
+        return False
+    if name is not None and name.startswith(_ARRAY_ROOTS):
+        return True
+    return (isinstance(node.func, ast.Attribute)
+            and node.func.attr in _ARRAY_METHODS)
+
+
+def _contains_array_expr(node: ast.AST) -> bool:
+    return any(isinstance(n, ast.Call) and _is_array_call(n)
+               for n in ast.walk(node))
+
+
+def _check_tree(sf: SourceFile, tree: ast.AST) -> list[Finding]:
+    out: list[Finding] = []
+
+    def emit(rule: str, node: ast.AST, msg: str) -> None:
+        if not sf.suppressed(rule, node.lineno):
+            out.append(Finding(rule, sf.rel, node.lineno, msg))
+
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call):
+            f = node.func
+            if (isinstance(f, ast.Attribute) and f.attr == "item"
+                    and not node.args and not node.keywords):
+                emit(RULE_ITEM, node,
+                     "'.item()' forces a device->host sync in hot-path "
+                     "code; keep the value on device (or sync once, "
+                     "outside the step loop)")
+                continue
+            name = dotted_name(f)
+            if (isinstance(f, ast.Name) and f.id in ("float", "int", "bool")
+                    and node.args
+                    and _contains_array_expr(node.args[0])):
+                emit(RULE_CAST, node,
+                     f"'{f.id}()' on an array expression is an implicit "
+                     f"host sync; use jnp casts / keep it traced")
+            elif (name in _NUMPY_MATERIALIZERS
+                    and node.args and _contains_array_expr(node.args[0])):
+                emit(RULE_NUMPY, node,
+                     f"'{name}' on an array expression materializes on "
+                     f"host (sync); stay in jnp, or hoist the transfer "
+                     f"out of the hot path")
+        elif isinstance(node, (ast.If, ast.While)):
+            if _contains_array_expr(node.test):
+                kind = "if" if isinstance(node, ast.If) else "while"
+                emit(RULE_BRANCH, node,
+                     f"Python '{kind}' on an array expression — traced "
+                     f"values cannot drive host control flow; use "
+                     f"lax.cond/lax.while_loop or jnp.where")
+    return out
+
+
+def check(project: Project) -> list[Finding]:
+    findings: list[Finding] = []
+    for sf in project.package_files():
+        if not in_scope(sf.rel):
+            continue
+        findings.extend(_check_tree(sf, sf.tree))
+    return findings
