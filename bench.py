@@ -1419,6 +1419,31 @@ def _measure_overload_goodput(
     return out
 
 
+def _measure_compile_stability() -> dict:
+    """Compile-key stability of the serving entry points
+    (tools/graftcheck GC4, run as a MEASUREMENT): sweep the request-length
+    ladder through the real width policies, trace the real jitted
+    admission / decode / generate programs, and stamp how many distinct
+    compile-cache keys each produces against its declared bucket budget.
+    Pure tracing (jax.make_jaxpr) — zero FLOPs, identical on every
+    platform — so a recompile regression shows up in the perf trajectory
+    (this row) AND fails the gate (test_graftcheck)."""
+    from tools.graftcheck.contracts import recompile_scenarios
+    from tools.graftcheck.recompile import measure_keys
+
+    out: dict = {"preset": "llama-tiny", "platform": jax.devices()[0].platform}
+    t0 = time.perf_counter()
+    for sc in recompile_scenarios():
+        keys = measure_keys(sc)
+        tag = sc.name.rsplit(".", 1)[-1]
+        out[f"{tag}_keys"] = len(keys)
+        out[f"{tag}_declared"] = sc.max_keys
+        if len(keys) > sc.max_keys:  # the gate fails too; stamp it honestly
+            out["regressed"] = True
+    out["trace_wall_ms"] = round((time.perf_counter() - t0) * 1e3, 1)
+    return out
+
+
 def _measure_prefill_flash(
     preset: str = "tinyllama-1.1b", batch: int = 2, seq: int = 2048,
     dtype: str = "bfloat16", iters: int = 5, window: int | None = None,
@@ -1724,7 +1749,7 @@ def run_ladder(args, degraded: str | None) -> list[dict]:
             "prefill-flash-win-8192", "hop-latency",
             "spec-decode", "spec-decode-7b-int8", "spec-batching",
             "local-proc-batching", "chunked-prefill", "prefix-cache-ttft",
-            "fault-recovery", "overload-goodput",
+            "fault-recovery", "overload-goodput", "compile-stability",
         }
         unknown = only - known
         if unknown:  # a typo must not masquerade as a clean zero-row run
@@ -1857,6 +1882,11 @@ def run_ladder(args, degraded: str | None) -> list[dict]:
         # growth plane took — a host-scheduling effect, meaningful on any
         # platform.
         ("overload-goodput", lambda: _measure_overload_goodput(dtype=dtype)),
+        # Compile-key stability (tools/graftcheck GC4 as a measurement):
+        # distinct compile-cache keys per serving entry point across the
+        # request-length ladder vs the declared bucket budget — pure
+        # tracing, meaningful on any platform.
+        ("compile-stability", _measure_compile_stability),
     ]
     if not on_cpu:
         # Paged vs contiguous batching (pool at ~45% of contiguous KV

@@ -1,0 +1,111 @@
+"""JAX version-compat shims for the seq-parallel / Pallas stack.
+
+The package targets the jax >= 0.5 surface (``jax.shard_map``,
+``jax.lax.axis_size``, ``jax.lax.pcast`` vma tracking, ``jax.typeof``).
+Older runtimes (0.4.x) still ship everything we need — shard_map lives in
+``jax.experimental.shard_map`` and vma tracking simply does not exist —
+so each symbol here resolves to the native API when present and to a
+semantically-equivalent fallback otherwise:
+
+- :func:`shard_map`: native ``jax.shard_map`` (``axis_names=`` kwarg), or
+  the experimental one with ``axis_names`` translated to its ``auto=``
+  complement and replication checking off (pre-vma shard_map rejects
+  programs written for the explicit-pcast world).
+- :func:`axis_size`: ``lax.axis_size``, or the classic ``psum(1, axis)``
+  trick (statically evaluated to the bound axis size).
+- :func:`pcast`: identity when vma tracking doesn't exist — there is
+  nothing to cast.
+- :func:`vma_of`: the value's varying-manual-axes set (empty pre-vma).
+- :func:`shape_dtype_struct`: ``jax.ShapeDtypeStruct`` minus the ``vma=``
+  kwarg on runtimes whose constructor predates it.
+
+Every shim is exercised by tools/graftcheck, which traces the real ops
+under fake meshes on whatever JAX the image carries.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+
+__all__ = [
+    "HAS_VMA", "axis_size", "pcast", "shape_dtype_struct", "shard_map",
+    "vma_of",
+]
+
+# vma (varying manual axes) tracking arrived with the jax 0.6-era shard_map;
+# pcast is its cast operator, so its presence is the feature probe.
+HAS_VMA = hasattr(jax.lax, "pcast")
+
+
+if hasattr(jax.lax, "axis_size"):
+    axis_size = jax.lax.axis_size
+else:
+    def axis_size(axis_name) -> int:
+        # psum of a Python literal folds statically to the axis size (and
+        # raises NameError on an unbound axis, matching lax.axis_size).
+        return jax.lax.psum(1, axis_name)
+
+
+if HAS_VMA:
+    pcast = jax.lax.pcast
+else:
+    def pcast(x, axis_name, to="varying"):
+        del axis_name, to  # no vma types to move between
+        return x
+
+
+def vma_of(x) -> frozenset:
+    """Varying-manual-axes of a value (empty on pre-vma runtimes)."""
+    if hasattr(jax, "typeof"):
+        return frozenset(getattr(jax.typeof(x), "vma", frozenset()))
+    return frozenset()
+
+
+def shape_dtype_struct(shape, dtype, vma: frozenset = frozenset()):
+    if HAS_VMA:
+        return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
+    return jax.ShapeDtypeStruct(shape, dtype)
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, axis_names: Any = None,
+              check_vma: bool | None = None):
+    """``jax.shard_map`` with the jax < 0.5 experimental fallback.
+
+    ``axis_names`` follows the native semantics: the manual axes of the
+    body; every other mesh axis stays GSPMD-auto inside.  None = all axes
+    manual.  ``check_vma`` is forwarded only where the native API takes it
+    (pre-vma shard_map has check_rep instead, which rejects programs
+    written for the explicit-pcast world — the fallback disables it).
+    """
+    if hasattr(jax, "shard_map"):
+        import inspect
+
+        native = set(inspect.signature(jax.shard_map).parameters)
+        kw: dict[str, Any] = {}
+        if axis_names is not None:
+            kw["axis_names"] = set(axis_names)
+        if check_vma is not None and "check_vma" in native:
+            kw["check_vma"] = check_vma
+        return jax.shard_map(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kw
+        )
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    kw = {}
+    if axis_names is not None:
+        # Only axes that actually shard anything need to stay GSPMD-auto;
+        # size-1 axes are manual no-ops, and dropping them usually empties
+        # ``auto`` entirely (partial-auto is NotImplemented in the old
+        # shard_map for most collectives).
+        auto = frozenset(
+            a for a in mesh.axis_names
+            if a not in frozenset(axis_names) and dict(mesh.shape).get(a, 1) > 1
+        )
+        if auto:
+            kw["auto"] = auto
+    return _shard_map(
+        f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+        check_rep=False, **kw
+    )

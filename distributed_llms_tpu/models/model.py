@@ -20,6 +20,7 @@ from typing import Any
 import jax
 import jax.numpy as jnp
 
+from ..core import jaxcompat
 from ..core.config import ModelConfig
 from . import layers
 from .layers import Params
@@ -357,7 +358,7 @@ def _seq_cached_attention(
             "seq-parallel cached decode needs attn_mask=(prefill_mask, "
             "decode_mask) — ParallelModel.forward splits the global mask"
         )
-    t_pref_global = ck_pref.shape[1] * jax.lax.axis_size("seq")
+    t_pref_global = ck_pref.shape[1] * jaxcompat.axis_size("seq")
     di = cache_index - t_pref_global
     ck_dec = jax.lax.dynamic_update_slice(ck_dec, k.astype(ck_dec.dtype), (0, di, 0, 0))
     cv_dec = jax.lax.dynamic_update_slice(cv_dec, v.astype(cv_dec.dtype), (0, di, 0, 0))

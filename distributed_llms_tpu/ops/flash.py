@@ -44,6 +44,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from ..core import jaxcompat
+
 _NEG_INF = float(jnp.finfo(jnp.float32).min)
 
 
@@ -224,9 +226,7 @@ def _pad_to(x: jax.Array, axis: int, mult: int, value) -> jax.Array:
 def _flash(q, k, v, q_positions, k_positions, k_valid, causal, block_q, block_k, interpret, window):
     # Inside shard_map (e.g. the Ulysses body) the inputs carry varying
     # manual axes (vma); the output must declare the same set.
-    vma = frozenset().union(
-        *(getattr(jax.typeof(x), "vma", frozenset()) for x in (q, k, v))
-    )
+    vma = frozenset().union(*(jaxcompat.vma_of(x) for x in (q, k, v)))
     if interpret and vma:
         # The Pallas HLO *interpreter* (off-TPU test path) loses vma on its
         # internal dynamic_slices; run the numerically-identical dense
@@ -267,7 +267,7 @@ def _flash(q, k, v, q_positions, k_positions, k_valid, causal, block_q, block_k,
     ]
     q_spec = pl.BlockSpec((1, bq, d), lambda bi, hi, qi, ki: (bi * h + hi, qi, 0))
     o_spec = pl.BlockSpec((1, bq, d), lambda bi, hi, qi, ki: (bi * h + hi, qi, 0))
-    out_shape = jax.ShapeDtypeStruct((b * h, tq_p, d), q.dtype, vma=vma)
+    out_shape = jaxcompat.shape_dtype_struct((b * h, tq_p, d), q.dtype, vma=vma)
     args = (
         qt.reshape(b * h, tq_p, d),
         kt.reshape(b * kvh, s_p, d),
@@ -309,7 +309,7 @@ def _flash(q, k, v, q_positions, k_positions, k_valid, causal, block_q, block_k,
         # mesh axis; align them with q/k/v so vma tracking stays consistent
         # inside shard_map bodies (same trick as ops/ring.py).
         align = (
-            (lambda x: jax.lax.pcast(x, tuple(vma), to="varying")) if vma
+            (lambda x: jaxcompat.pcast(x, tuple(vma), to="varying")) if vma
             else (lambda x: x)
         )
         if q_positions is None:

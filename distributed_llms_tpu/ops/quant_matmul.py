@@ -32,6 +32,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from ..core import jaxcompat
+
 # Trace-time marker: "this contraction is being traced under a
 # GSPMD-partitioned jit" (tensor-parallel serving).  A plain pallas_call has
 # no SPMD partitioning rule there — XLA would all-gather the full weight,
@@ -124,11 +126,7 @@ def _quant_matmul_2d(
         _kernel, bits=bits, block=block, nk=grid[2], out_dtype=x.dtype
     )
     flops = 2 * m * k_dim * n
-    out_shape = (
-        jax.ShapeDtypeStruct((m, n), x.dtype, vma=vma)
-        if vma
-        else jax.ShapeDtypeStruct((m, n), x.dtype)
-    )
+    out_shape = jaxcompat.shape_dtype_struct((m, n), x.dtype, vma=vma)
     return pl.pallas_call(
         kernel,
         out_shape=out_shape,
@@ -213,9 +211,7 @@ def _qmm_flat(x2: jax.Array, q2: jax.Array, s2: jax.Array, *, bits: int,
         return x2 @ _dequant_flat(q2, s2, bits, x2.dtype)
     # Inside shard_map (the pipeline stage body) operands carry varying
     # manual axes; the kernel's out_shape must declare the same set.
-    vma = frozenset().union(
-        *(getattr(jax.typeof(a), "vma", frozenset()) for a in (x2, q2, s2))
-    )
+    vma = frozenset().union(*(jaxcompat.vma_of(a) for a in (x2, q2, s2)))
     if vma and interpret:
         # The Pallas HLO *interpreter* (off-TPU test path) loses vma on its
         # internal dynamic_slices (same limitation as ops/flash.py); run the

@@ -23,6 +23,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ..core import jaxcompat
 from ..core.config import MeshConfig, ModelConfig
 from ..models import model as model_lib
 from ..models.model import KVCache
@@ -310,12 +311,28 @@ class ParallelModel:
 
     # -- execution ---------------------------------------------------------
 
+    @staticmethod
+    def _require_native_seq() -> None:
+        """The seq-parallel schedules execute only on the jax >= 0.5
+        shard_map: under the 0.4.x experimental one (check_rep off, no vma
+        types) the compiled ring/merge programs abort XLA:CPU outright —
+        a hard process crash, not a failure — so refuse up front.  Abstract
+        tracing (tools/graftcheck) goes through ops.ring/ops.ulysses
+        directly and stays available on every runtime."""
+        if not hasattr(jax, "shard_map"):
+            raise RuntimeError(
+                "sequence-parallel execution requires jax >= 0.5 "
+                "(jax.shard_map); this runtime has only the experimental "
+                "shard_map, whose compiled seq schedules crash XLA:CPU"
+            )
+
     def _seq_forward(self, params, tokens, positions, remat):
         """Full forward under shard_map over {'seq'}: sequence axis sharded,
         global positions passed through so RoPE/causality stay correct;
         attention runs the ppermute ring (ops/ring.py) or, when the user set
         attn_impl='ulysses', the all-to-all head scatter (ops/ulysses.py);
         'data'/'model' axes remain GSPMD-auto inside the body."""
+        self._require_native_seq()
         cfg = _seq_cfg(self.cfg)
         b, t = tokens.shape
         if positions is None:
@@ -327,7 +344,7 @@ class ParallelModel:
             )
             return logits
 
-        return jax.shard_map(
+        return jaxcompat.shard_map(
             body,
             mesh=self.mesh,
             in_specs=(P(), P(None, "seq"), P(None, "seq")),
@@ -338,6 +355,7 @@ class ParallelModel:
     def _seq_prefill_cached(self, params, tokens, positions, cache, cache_index, remat):
         """Cached prefill under 'seq': tokens sharded over the sequence,
         each device writes its prefill-region KV block locally."""
+        self._require_native_seq()
         cfg = _seq_cfg(self.cfg)
         b, t = tokens.shape
         seq_ax = self.mesh.shape["seq"]
@@ -360,7 +378,7 @@ class ParallelModel:
             return logits, npk, npv, ndk, ndv
 
         seq_kv = P(None, None, "seq", None, None)
-        logits, npk, npv, ndk, ndv = jax.shard_map(
+        logits, npk, npv, ndk, ndv = jaxcompat.shard_map(
             body,
             mesh=self.mesh,
             in_specs=(P(), P(None, "seq"), P(None, "seq"), seq_kv, seq_kv, P(), P()),
@@ -372,6 +390,7 @@ class ParallelModel:
     def _seq_decode_cached(self, params, tokens, positions, cache, cache_index, attn_mask, remat):
         """Single-token decode over the seq-sharded cache: partial softmax
         stats merge across 'seq' with one psum; the query is replicated."""
+        self._require_native_seq()
         cfg = _seq_cfg(self.cfg)
         (pk, dk), (pv, dv) = cache.k, cache.v
         t_pref = pk.shape[2]
@@ -393,7 +412,7 @@ class ParallelModel:
             return logits, npk, npv, ndk, ndv
 
         seq_kv = P(None, None, "seq", None, None)
-        logits, npk, npv, ndk, ndv = jax.shard_map(
+        logits, npk, npv, ndk, ndv = jaxcompat.shard_map(
             body,
             mesh=self.mesh,
             in_specs=(P(), P(), P(), seq_kv, seq_kv, P(), P(),

@@ -36,7 +36,13 @@ def _axis_size(mesh: Mesh, name: str) -> int:
 def param_specs(cfg: ModelConfig, mesh: Mesh) -> Params:
     """PartitionSpec pytree matching models.model param trees."""
     tp = _axis_size(mesh, "model")
-    pipe = "pipe" if _axis_size(mesh, "pipe") > 1 else None
+    # The stacked layer axis shards over 'pipe' only when it divides evenly;
+    # an uneven split (e.g. 3 layers over pipe=2) would leave XLA padding a
+    # ragged shard on every block leaf — replicate instead and let the
+    # staged pipeline path (parallel.api) do its own stage packing.
+    # tools.graftcheck GC2 pins this for every preset x mesh.
+    pipe_sz = _axis_size(mesh, "pipe")
+    pipe = "pipe" if pipe_sz > 1 and cfg.num_layers % pipe_sz == 0 else None
     # Shard head axes only when divisible (e.g. GQA KV heads may be < tp).
     q_ax = "model" if cfg.num_heads % max(tp, 1) == 0 else None
     kv_ax = "model" if cfg.num_kv_heads % max(tp, 1) == 0 else None

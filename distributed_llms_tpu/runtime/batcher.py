@@ -64,6 +64,7 @@ from ..core.observability import METRICS, get_logger
 from ..models import model as model_lib
 from ..models.model import KVCache
 from . import sampling
+from .shapes import bucket_length as _bucket
 
 log = get_logger("batcher")
 
@@ -937,11 +938,9 @@ def _reset_count_row(counts, slot, tok):
     return counts.at[slot].set(row)
 
 
-def _bucket(n: int, floor: int = 8) -> int:
-    b = floor
-    while b < n:
-        b *= 2
-    return b
+# _bucket (runtime/shapes.py bucket_length): admission prompt/suffix widths
+# pad up the shared decode-shape ladder so compile keys stay bounded;
+# tools.graftcheck's GC4 gate traces this path against shapes.bucket_count.
 
 
 @dataclass(eq=False)  # identity equality: deque.remove/queue scans then

@@ -25,6 +25,7 @@ from ..core import profiling
 from ..models import model as model_lib
 from ..models.presets import get_preset
 from . import generate as gen_lib
+from . import shapes as shapes_lib
 from .tokenizer import get_tokenizer, pad_batch
 
 log = get_logger("engine")
@@ -273,6 +274,11 @@ class InferenceEngine:
             return self._speculative_result(
                 prompt_arr, lens, n_real, n_new, self.rt.spec_k
             )
+        # Bucket only on the PLAIN path, after the budget check (which must
+        # see the raw width, as before) and the spec-decode gate (whose
+        # near-cap predicate on the raw width must keep routing prompts the
+        # speculative loop can still fit).
+        prompt_arr = self._bucket_prompt(prompt_arr, n_new)
         rng = jax.random.key(seed if seed is not None else self.rt.seed)
 
         profile_ctx = (
@@ -303,6 +309,29 @@ class InferenceEngine:
             text=texts, tokens=out,
             prompt_tokens=int(lens[:n_real].sum()), generated_tokens=gen_count,
             seconds=dt,
+        )
+
+    def _bucket_prompt(self, prompt_arr, n_new: int):
+        """Pad the prompt width up the shared bucket ladder
+        (runtime/shapes.py) so generate_tokens compiles once per bucket
+        instead of once per distinct batch-max prompt length — the
+        "recompile every new seq length" serving bug tools.graftcheck's GC4
+        gate pins closed.  Exact by construction: pad slots carry pad_id,
+        sit to the RIGHT of every real token (causal prefill queries never
+        see them), and the decode mask admits only real prompt slots +
+        generated slots.  Skipped when the bucket would not fit the
+        sequence budget (keeps the pre-bucket error behavior) and under
+        seq-parallelism (T must stay a multiple of the seq axis)."""
+        if self.parallel is not None and self.parallel.seq_parallel:
+            return prompt_arr
+        t = int(prompt_arr.shape[1])
+        limit = min(self.rt.max_seq_len, self.cfg.max_seq_len)
+        target = shapes_lib.generate_pad_len(t, n_new, limit)
+        if target <= t:
+            return prompt_arr
+        return jnp.pad(
+            prompt_arr, ((0, 0), (0, target - t)),
+            constant_values=self.tokenizer.pad_id,
         )
 
     # -- sessions: KV persists across turns; host spill under kv_host_spill --

@@ -1,0 +1,86 @@
+"""Unified static-analysis front door: ``python -m tools.check``.
+
+Runs BOTH checkers over the repo and merges their exit codes:
+
+- graftlint (tools/graftlint) — AST rules GL1xx-GL5xx;
+- graftcheck (tools/graftcheck) — semantic contracts GC1xx-GC5xx + GCD.
+
+One deliberate escalation over running them separately: a STALE baseline
+entry (accepted debt whose finding no longer occurs) is an ERROR here, not
+a warning.  Debt that got fixed must leave the baseline in the same PR —
+run the matching ``--baseline-write`` to prune — or the baseline rots into
+a list nobody can audit.
+
+Exit status: 0 = both clean and no stale entries; 1 = new findings or
+stale entries anywhere; 2 = usage error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+from pathlib import Path
+
+
+def main(argv=None) -> int:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.check",
+        description="run graftlint + graftcheck with merged exit codes",
+    )
+    ap.add_argument("--root", default=".", help="repo root to analyze")
+    args = ap.parse_args(argv)
+    root = Path(args.root).resolve()
+    if not root.is_dir():
+        print(f"check: --root {root} is not a directory", file=sys.stderr)
+        return 2
+
+    rc = 0
+
+    # -- graftlint (AST) ---------------------------------------------------
+    from tools import graftlint
+    from tools.graftlint.core import stale_entries
+
+    project = graftlint.load_project(root)
+    lint_findings = graftlint.run_project(project)
+    lint_baseline = graftlint.read_baseline(root)
+    lint_new, lint_old = graftlint.split_new(lint_findings, lint_baseline)
+    for f in lint_new:
+        print(f.render())
+    lint_stale = stale_entries(lint_findings, lint_baseline)
+    print(f"check: graftlint: {len(lint_new)} new, {len(lint_old)} "
+          f"baselined, {len(lint_stale)} stale", file=sys.stderr)
+
+    # -- graftcheck (semantic) ---------------------------------------------
+    from tools import graftcheck
+
+    check_findings = graftcheck.run_all(root=root)
+    check_baseline = graftcheck.read_baseline(root)
+    check_new, check_old = graftcheck.split_new(
+        check_findings, check_baseline)
+    for f in check_new:
+        print(f.render())
+    check_stale = stale_entries(check_findings, check_baseline)
+    print(f"check: graftcheck: {len(check_new)} new, {len(check_old)} "
+          f"baselined, {len(check_stale)} stale", file=sys.stderr)
+
+    if lint_new or check_new:
+        rc = 1
+    if lint_stale or check_stale:
+        # Fixed debt MUST be pruned in the same change — stale entries are
+        # errors at the front door (the standalone CLIs only warn).
+        rc = 1
+        for s in lint_stale:
+            print(f"check: STALE graftlint baseline entry (fixed debt — "
+                  f"prune with python -m tools.graftlint --baseline-write):"
+                  f"\n  {s}", file=sys.stderr)
+        for s in check_stale:
+            print(f"check: STALE graftcheck baseline entry (fixed debt — "
+                  f"prune with python -m tools.graftcheck --baseline-write):"
+                  f"\n  {s}", file=sys.stderr)
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -61,6 +61,13 @@ def _result_cell(row: dict) -> str:
         ("offered_x", "offered load x"),
         ("shed_frac", "shed frac"),
         ("preemptions", "preemptions"),
+        ("admit_row_keys", "admit compile keys"),
+        ("admit_row_declared", "of declared"),
+        ("decode_chunk_keys", "decode compile keys"),
+        ("decode_chunk_declared", "of declared"),
+        ("generate_tokens_keys", "generate compile keys"),
+        ("generate_tokens_declared", "of declared"),
+        ("trace_wall_ms", "trace wall ms"),
     ):
         if row.get(k) is not None:
             v = row[k]
@@ -92,7 +99,7 @@ def generate(ladder_path: str) -> str:
         # Aux rows run_ladder appends after the decode configs.
         "serving-latency", "continuous-batching", "local-proc-batching",
         "chunked-prefill", "prefix-cache-ttft", "fault-recovery",
-        "overload-goodput",
+        "overload-goodput", "compile-stability",
         "ragged-decode-8k", "ragged-decode-win-8k", "quant-matmul-bw",
         "spec-decode", "spec-decode-7b-int8", "spec-batching",
         "paged-batching", "prefill-flash-2048", "prefill-flash-8192",

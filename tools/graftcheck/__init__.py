@@ -1,0 +1,59 @@
+"""graftcheck: abstract-interpretation contract checker.
+
+graftlint's semantic sibling — instead of reading the AST it traces the
+REAL code under abstract values (``jax.eval_shape`` / ``jax.make_jaxpr`` /
+``.lower()`` on fake meshes, zero FLOPs) and holds it to declared
+contracts:
+
+- GC1xx shape/dtype contracts       (tools/graftcheck/shapes.py)
+- GC2xx sharding-spec audit         (tools/graftcheck/sharding.py)
+- GC3xx dtype-promotion lint        (tools/graftcheck/dtypes.py)
+- GC4xx recompilation hazards       (tools/graftcheck/recompile.py)
+- GC5xx donation audit              (tools/graftcheck/donation.py)
+- GCD01 README contracts-table drift (tools/graftcheck/docs.py)
+
+Run as ``python -m tools.graftcheck`` (exit 0 = clean) or through the
+unified front door ``python -m tools.check``; the tier-1 pytest gate is
+tests/tools/test_graftcheck.py::test_repo_is_clean.  Accepted debt lives
+in ``graftcheck_baseline.txt`` (checked in EMPTY; graftlint's normalized
+line-free multiset format).
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+
+from .core import (BASELINE_NAME, Finding, read_baseline, split_new,
+                   write_baseline)
+
+FAMILIES = ("GC1", "GC2", "GC3", "GC4", "GC5", "GCD")
+
+
+def run_all(only: set[str] | None = None,
+            root: str | Path = ".") -> list[Finding]:
+    """Run every rule family (or the ``only`` subset of FAMILIES)."""
+    from . import docs, donation, dtypes, recompile, shapes, sharding
+
+    def want(fam: str) -> bool:
+        return only is None or fam in only
+
+    findings: list[Finding] = []
+    if want("GC1"):
+        findings += shapes.check()
+    if want("GC2"):
+        findings += sharding.check()
+    if want("GC3"):
+        findings += dtypes.check()
+    if want("GC4"):
+        findings += recompile.check()
+    if want("GC5"):
+        findings += donation.check()
+    if want("GCD"):
+        findings += docs.check_docs(Path(root))
+    return findings
+
+
+__all__ = [
+    "BASELINE_NAME", "FAMILIES", "Finding", "read_baseline", "run_all",
+    "split_new", "write_baseline",
+]

@@ -1,0 +1,94 @@
+"""CLI: ``python -m tools.graftcheck [--root DIR] [--only GC1,GC4]``.
+
+Exit status mirrors graftlint: 0 when every finding is absent or
+baselined, 1 when NEW findings exist, 2 on usage errors.
+
+- ``--only``: comma-separated rule families (GC1..GC5, GCD) — scoped runs
+  for fast iteration; the gate and the front door run everything.
+- ``--baseline-write``: accept current findings into
+  ``graftcheck_baseline.txt``.
+- ``--write-docs``: regenerate the README "Semantic checks" table.
+- ``--all``: also print baselined findings.
+
+Unlike graftlint (pure AST over ``--root``), graftcheck IMPORTS and traces
+the package on sys.path; ``--root`` locates the baseline and README.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+from pathlib import Path
+
+
+def main(argv=None) -> int:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.graftcheck",
+        description="semantic contract checker (see tools/graftcheck/)",
+    )
+    ap.add_argument("--root", default=".",
+                    help="repo root (baseline + README location)")
+    ap.add_argument("--only", default=None,
+                    help="comma-separated families, e.g. GC2,GC4")
+    ap.add_argument("--baseline-write", action="store_true",
+                    help="accept current findings into the baseline")
+    ap.add_argument("--write-docs", action="store_true",
+                    help="regenerate the README contracts table, then exit")
+    ap.add_argument("--all", action="store_true",
+                    help="also print baselined (accepted) findings")
+    args = ap.parse_args(argv)
+
+    root = Path(args.root).resolve()
+    if not root.is_dir():
+        print(f"graftcheck: --root {root} is not a directory",
+              file=sys.stderr)
+        return 2
+
+    from tools.graftcheck import (FAMILIES, read_baseline, run_all,
+                                  split_new, write_baseline)
+
+    only = None
+    if args.only:
+        only = {s.strip() for s in args.only.split(",") if s.strip()}
+        unknown = only - set(FAMILIES)
+        if unknown:
+            print(f"graftcheck: unknown families {sorted(unknown)}; "
+                  f"have {FAMILIES}", file=sys.stderr)
+            return 2
+
+    if args.write_docs:
+        from tools.graftcheck.docs import write_docs
+
+        done = write_docs(root)
+        print("graftcheck: rewrote README contracts table"
+              if done else "graftcheck: no contracts marker block found")
+        return 0
+
+    findings = run_all(only=only, root=root)
+    if args.baseline_write:
+        path = write_baseline(root, findings)
+        print(f"graftcheck: wrote {len(findings)} finding(s) to {path.name}")
+        return 0
+
+    baseline = read_baseline(root)
+    new, accepted = split_new(findings, baseline)
+    for f in new:
+        print(f.render())
+    if args.all:
+        for f in accepted:
+            print(f"{f.render()}  [baselined]")
+    from tools.graftlint.core import stale_entries
+
+    stale = stale_entries(findings, baseline)
+    print(f"graftcheck: {len(new)} new finding(s), {len(accepted)} "
+          f"baselined, {len(stale)} stale baseline "
+          f"entr{'y' if len(stale) == 1 else 'ies'}", file=sys.stderr)
+    for s in stale:
+        print(f"  stale: {s}", file=sys.stderr)
+    return 1 if new else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,868 @@
+"""The contract registry: what graftcheck holds the code to.
+
+Every rule module consumes a declarative registry built here — op
+shape/dtype contracts (GC1), preset x mesh sharding audits and collective
+audits (GC2), hot-function dtype contracts (GC3), recompilation scenarios
+(GC4), and donation contracts (GC5).  The registries are also the source of
+the README "Semantic checks" table (``python -m tools.graftcheck
+--write-docs``), so the docs can never drift from what is actually gated.
+
+Everything imports the REAL package lazily (inside builders) and traces the
+real functions — no mocks: a contract that passes here is a program XLA
+would accept with these shapes on hardware.
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass, field
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+
+# Repo-relative paths findings attribute to (line 0: semantic findings are
+# whole-file; the baseline format is line-free anyway).
+P_FLASH = "distributed_llms_tpu/ops/flash.py"
+P_RING = "distributed_llms_tpu/ops/ring.py"
+P_ULYSSES = "distributed_llms_tpu/ops/ulysses.py"
+P_DECODE = "distributed_llms_tpu/ops/decode_attn.py"
+P_QMM = "distributed_llms_tpu/ops/quant_matmul.py"
+P_MODEL = "distributed_llms_tpu/models/model.py"
+P_SPECS = "distributed_llms_tpu/parallel/specs.py"
+P_SAMPLING = "distributed_llms_tpu/runtime/sampling.py"
+P_BATCHER = "distributed_llms_tpu/runtime/batcher.py"
+P_ENGINE = "distributed_llms_tpu/runtime/engine.py"
+
+
+def sds(shape, dtype):
+    return jax.ShapeDtypeStruct(tuple(shape), dtype)
+
+
+def key_sds():
+    """Abstract typed PRNG key."""
+    return jax.eval_shape(lambda: jax.random.key(0))
+
+
+@functools.lru_cache(maxsize=None)
+def preset(name: str, **overrides):
+    from distributed_llms_tpu.models.presets import get_preset
+
+    return get_preset(name, **overrides)
+
+
+@functools.lru_cache(maxsize=None)
+def abstract_params(cfg):
+    from distributed_llms_tpu.models import model as model_lib
+
+    return jax.eval_shape(
+        lambda: model_lib.init_params(jax.random.key(0), cfg)
+    )
+
+
+def abstract_cache(cfg, batch: int, max_len: int):
+    from distributed_llms_tpu.models import model as model_lib
+
+    return jax.eval_shape(lambda: model_lib.init_cache(cfg, batch, max_len))
+
+
+def abstract_pool(cfg, num_pages: int, page_size: int):
+    from distributed_llms_tpu.runtime import batcher as batcher_lib
+
+    return jax.eval_shape(
+        lambda: batcher_lib._paged_pool(cfg, num_pages, page_size)
+    )
+
+
+def fake_mesh(**axes: int):
+    """AbstractMesh over the standard axis names — sharding semantics with
+    zero devices (jax.eval_shape/make_jaxpr accept it everywhere a real
+    mesh would go)."""
+    from jax.sharding import AbstractMesh
+
+    names = ("data", "pipe", "model", "seq", "expert")
+    return AbstractMesh(tuple((n, axes.get(n, 1)) for n in names))
+
+
+# ---------------------------------------------------------------------------
+# GC1 — op shape/dtype contracts
+# ---------------------------------------------------------------------------
+
+@dataclass(frozen=True)
+class OpCase:
+    label: str
+    fn: Callable        # callable over the abstract args
+    args: tuple         # abstract (or small concrete) argument pytrees
+    want: tuple         # ((shape, dtype-str), ...) for every output leaf
+
+
+@dataclass(frozen=True)
+class OpContract:
+    name: str           # e.g. "ops.flash.flash_attention"
+    path: str
+    doc: str            # one line for the README table
+    build: Callable[[], list[OpCase]]
+
+
+def _flash_cases() -> list[OpCase]:
+    from distributed_llms_tpu.ops import flash
+
+    cases = []
+    # (b, tq, s, h, kvh, d, window, dtype): batch 1, non-power-of-two
+    # lengths, GQA/MQA head ratios, windowed band, both serving dtypes.
+    for b, tq, s, h, kvh, d, win, dt in [
+        (1, 1, 1, 4, 4, 64, None, jnp.float32),
+        (2, 7, 7, 4, 2, 64, None, jnp.bfloat16),
+        (3, 33, 33, 8, 1, 64, None, jnp.bfloat16),
+        (2, 128, 128, 4, 4, 64, 16, jnp.bfloat16),
+        (2, 16, 48, 4, 2, 64, None, jnp.float32),  # prefill into longer cache
+    ]:
+        q = sds((b, tq, h, d), dt)
+        kv = sds((b, s, kvh, d), dt)
+        qp = sds((b, tq), jnp.int32)
+        kp = sds((b, s), jnp.int32)
+        kval = sds((b, s), jnp.bool_)
+        aligned = tq == s
+        fn = (
+            (lambda q, k, v: flash.flash_attention(q, k, v))
+            if aligned else
+            (lambda q, k, v, qp, kp, kval: flash.flash_attention(
+                q, k, v, q_positions=qp, k_positions=kp, k_valid=kval))
+        )
+        args = (q, kv, kv) if aligned else (q, kv, kv, qp, kp, kval)
+        if win is not None:
+            fn = functools.partial(
+                lambda w, q, k, v: flash.flash_attention(q, k, v, window=w),
+                win,
+            )
+            args = (q, kv, kv)
+        cases.append(OpCase(
+            label=f"b{b} tq{tq} s{s} h{h}/{kvh} d{d} win{win} {jnp.dtype(dt).name}",
+            fn=fn, args=args,
+            want=(((b, tq, h, d), jnp.dtype(dt).name),),
+        ))
+    return cases
+
+
+def _ring_cases() -> list[OpCase]:
+    import functools as ft
+
+    from distributed_llms_tpu.core import jaxcompat
+    from distributed_llms_tpu.ops import ring
+    from jax.sharding import PartitionSpec as P
+
+    cases = []
+    for seq, b, t, h, kvh, d, dt in [
+        (2, 1, 16, 4, 2, 64, jnp.bfloat16),
+        (4, 2, 32, 4, 4, 64, jnp.float32),
+        (4, 2, 96, 8, 2, 64, jnp.bfloat16),  # non-pow2 global length
+    ]:
+        mesh = fake_mesh(seq=seq)
+        body = ft.partial(ring.ring_attention, axis_name="seq")
+        sh, ps = P(None, "seq", None, None), P(None, "seq")
+
+        def fn(q, k, v, pos, body=body, mesh=mesh, sh=sh, ps=ps):
+            return jaxcompat.shard_map(
+                lambda q, k, v, p: body(q, k, v, p, p),
+                mesh=mesh, in_specs=(sh, sh, sh, ps), out_specs=sh,
+                axis_names={"seq"},
+            )(q, k, v, pos)
+
+        cases.append(OpCase(
+            label=f"seq{seq} b{b} t{t} h{h}/{kvh} {jnp.dtype(dt).name}",
+            fn=fn,
+            args=(sds((b, t, h, d), dt), sds((b, t, kvh, d), dt),
+                  sds((b, t, kvh, d), dt), sds((b, t), jnp.int32)),
+            want=(((b, t, h, d), jnp.dtype(dt).name),),
+        ))
+    return cases
+
+
+def _seq_decode_cases() -> list[OpCase]:
+    from distributed_llms_tpu.core import jaxcompat
+    from distributed_llms_tpu.ops import ring
+    from jax.sharding import PartitionSpec as P
+
+    cases = []
+    for seq, b, s_loc, n_dec, h, kvh, d in [(2, 2, 32, 8, 4, 2, 64),
+                                            (4, 1, 16, 4, 4, 4, 64)]:
+        mesh = fake_mesh(seq=seq)
+        seq_kv = P(None, "seq", None, None)
+
+        def fn(q, ck, cv, dk, dv, ml, md, mesh=mesh, seq_kv=seq_kv):
+            return jaxcompat.shard_map(
+                lambda q, ck, cv, dk, dv, ml, md:
+                    ring.seq_cached_decode_attention(
+                        q, ck, cv, dk, dv, ml, md, axis_name="seq"),
+                mesh=mesh,
+                in_specs=(P(), seq_kv, seq_kv, P(), P(), P(None, "seq"), P()),
+                out_specs=P(),
+                axis_names={"seq"},
+            )(q, ck, cv, dk, dv, ml, md)
+
+        dt = jnp.bfloat16
+        cases.append(OpCase(
+            label=f"seq{seq} b{b} sloc{s_loc} dec{n_dec} h{h}/{kvh}",
+            fn=fn,
+            args=(sds((b, 1, h, d), dt),
+                  sds((b, s_loc * seq, kvh, d), dt),
+                  sds((b, s_loc * seq, kvh, d), dt),
+                  sds((b, n_dec, kvh, d), dt), sds((b, n_dec, kvh, d), dt),
+                  sds((b, s_loc * seq), jnp.bool_),
+                  sds((b, n_dec), jnp.bool_)),
+            want=(((b, 1, h, d), "bfloat16"),),
+        ))
+    return cases
+
+
+def _ulysses_cases() -> list[OpCase]:
+    import functools as ft
+
+    from distributed_llms_tpu.core import jaxcompat
+    from distributed_llms_tpu.ops import ulysses
+    from jax.sharding import PartitionSpec as P
+
+    cases = []
+    for seq, b, t, h, kvh, d in [(2, 2, 16, 4, 2, 64), (4, 1, 32, 8, 4, 64)]:
+        mesh = fake_mesh(seq=seq)
+        sh, ps = P(None, "seq", None, None), P(None, "seq")
+        body = ft.partial(ulysses.ulysses_attention, axis_name="seq")
+
+        def fn(q, k, v, pos, body=body, mesh=mesh, sh=sh, ps=ps):
+            return jaxcompat.shard_map(
+                body, mesh=mesh, in_specs=(sh, sh, sh, ps), out_specs=sh,
+                axis_names={"seq"},
+            )(q, k, v, pos)
+
+        cases.append(OpCase(
+            label=f"seq{seq} b{b} t{t} h{h}/{kvh}",
+            fn=fn,
+            args=(sds((b, t, h, d), jnp.bfloat16),
+                  sds((b, t, kvh, d), jnp.bfloat16),
+                  sds((b, t, kvh, d), jnp.bfloat16),
+                  sds((b, t), jnp.int32)),
+            want=(((b, t, h, d), "bfloat16"),),
+        ))
+    return cases
+
+
+def _ragged_cases() -> list[OpCase]:
+    from distributed_llms_tpu.ops import decode_attn
+
+    cases = []
+    for b, s, h, kvh, d, win in [
+        (1, 128, 4, 2, 128, None),   # kernel-tileable width
+        (3, 384, 8, 2, 128, None),   # 128-multiple but not 512: block stepdown
+        (2, 40, 4, 4, 64, None),     # untileable -> dense fallback path
+        (2, 256, 4, 2, 128, 64),     # windowed band
+    ]:
+        dt = jnp.bfloat16
+        cases.append(OpCase(
+            label=f"b{b} s{s} h{h}/{kvh} d{d} win{win}",
+            fn=functools.partial(
+                lambda w, q, k, v, ln: decode_attn.ragged_decode_attention(
+                    q, k, v, ln, window=w), win),
+            args=(sds((b, 1, h, d), dt), sds((b, s, kvh, d), dt),
+                  sds((b, s, kvh, d), dt), sds((b,), jnp.int32)),
+            want=(((b, 1, h, d), "bfloat16"),),
+        ))
+    return cases
+
+
+def _paged_cases() -> list[OpCase]:
+    from distributed_llms_tpu.ops import decode_attn
+
+    cases = []
+    for b, nb, blk, p, h, kvh, d in [
+        (1, 16, 8, 4, 4, 2, 128),    # page-boundary: length can hit p*blk
+        (3, 8, 64, 2, 4, 4, 64),     # untileable d -> gather fallback
+        (2, 32, 16, 8, 8, 2, 128),
+    ]:
+        dt = jnp.bfloat16
+        cases.append(OpCase(
+            label=f"b{b} nb{nb} blk{blk} p{p} h{h}/{kvh} d{d}",
+            fn=decode_attn.paged_decode_attention,
+            args=(sds((b, 1, h, d), dt), sds((nb, blk, kvh, d), dt),
+                  sds((nb, blk, kvh, d), dt), sds((b,), jnp.int32),
+                  sds((b, p), jnp.int32)),
+            want=(((b, 1, h, d), "bfloat16"),),
+        ))
+    return cases
+
+
+def _quant_cases() -> list[OpCase]:
+    import numpy as np
+
+    from distributed_llms_tpu.checkpoint.quantize import quantize
+    from distributed_llms_tpu.ops import quant_matmul
+
+    cases = []
+    rng = np.random.default_rng(0)
+    for bits in (8, 4):
+        w = jnp.asarray(rng.standard_normal((64, 128)), jnp.float32)
+        qt = quantize(w, bits=bits, block=32)
+        m = 7  # non-power-of-two row count
+        cases.append(OpCase(
+            label=f"int{bits} k_lead1 m{m}",
+            fn=functools.partial(
+                lambda qt, x: quant_matmul.quant_contract(
+                    x, qt, k_lead=1, eq="mk,kn->mn"), qt),
+            args=(sds((m, 64), jnp.float32),),
+            want=(((m, 128), "float32"),),
+        ))
+    return cases
+
+
+def _forward_cases() -> list[OpCase]:
+    from distributed_llms_tpu.models import model as model_lib
+
+    cases = []
+    # Plain forward across families: logits [B, T, V] ALWAYS float32
+    # (unembed's preferred_element_type), whatever the param dtype.
+    for pname in ("llama-tiny", "gpt2-tiny", "neox-tiny", "moe-tiny"):
+        for b, t in [(1, 1), (2, 7), (3, 16)]:
+            cfg = preset(pname, dtype="bfloat16")
+            params = abstract_params(cfg)
+            cases.append(OpCase(
+                label=f"{pname} fwd b{b} t{t}",
+                fn=functools.partial(
+                    lambda cfg, p, tok: model_lib.forward(p, cfg, tok)[0],
+                    cfg),
+                args=(params, sds((b, t), jnp.int32)),
+                want=(((b, t, cfg.vocab_size), "float32"),),
+            ))
+    # Cached per-row decode (the continuous batcher's step): cache dtype is
+    # PRESERVED (kv_cache_dtype contract) and logits stay float32.
+    cfg = preset("llama-tiny", dtype="bfloat16")
+    params = abstract_params(cfg)
+    for b, s in [(2, 32), (1, 64), (3, 48)]:
+        cache = abstract_cache(cfg, b, s)
+        l, kvh, hd = cfg.num_layers, cfg.num_kv_heads, cfg.head_dim_
+        cases.append(OpCase(
+            label=f"llama-tiny rowdecode b{b} s{s}",
+            fn=functools.partial(
+                lambda cfg, p, tok, pos, c, ci, m: (
+                    lambda out: (out[0], out[1].k, out[1].v)
+                )(model_lib.forward(
+                    p, cfg, tok, positions=pos, cache=c, cache_index=ci,
+                    attn_mask=m)), cfg),
+            args=(params, sds((b, 1), jnp.int32), sds((b, 1), jnp.int32),
+                  cache, sds((b,), jnp.int32),
+                  sds((b, 1, 1, s), jnp.bool_)),
+            want=(((b, 1, cfg.vocab_size), "float32"),
+                  ((l, b, s, kvh, hd), "bfloat16"),
+                  ((l, b, s, kvh, hd), "bfloat16")),
+        ))
+    # Paged decode through a page table: pool shapes round-trip unchanged.
+    for b, nb, blk, p in [(2, 8, 8, 4), (1, 16, 8, 8)]:
+        pool = abstract_pool(cfg, nb, blk)
+        l, kvh, hd = cfg.num_layers, cfg.num_kv_heads, cfg.head_dim_
+        cases.append(OpCase(
+            label=f"llama-tiny pageddecode b{b} nb{nb} blk{blk}",
+            fn=functools.partial(
+                lambda cfg, prm, tok, pos, c, ci, tb: (
+                    lambda out: (out[0], out[1].k, out[1].v)
+                )(model_lib.forward(
+                    prm, cfg, tok, positions=pos, cache=c, cache_index=ci,
+                    kv_tables=tb)), cfg),
+            args=(params, sds((b, 1), jnp.int32), sds((b, 1), jnp.int32),
+                  pool, sds((b,), jnp.int32), sds((b, p), jnp.int32)),
+            want=(((b, 1, cfg.vocab_size), "float32"),
+                  ((l, nb, blk, kvh, hd), "bfloat16"),
+                  ((l, nb, blk, kvh, hd), "bfloat16")),
+        ))
+    return cases
+
+
+def _sampling_cases() -> list[OpCase]:
+    from distributed_llms_tpu.runtime import sampling
+
+    cases = []
+    for b, v in [(1, 256), (5, 1000)]:
+        cases.append(OpCase(
+            label=f"sample greedy b{b} v{v}",
+            fn=functools.partial(
+                lambda rng, lg: sampling.sample(rng, lg, 0.0)),
+            args=(key_sds(), sds((b, v), jnp.float32)),
+            want=(((b,), "int32"),),
+        ))
+        cases.append(OpCase(
+            label=f"sample_rows b{b} v{v}",
+            fn=lambda rng, lg, t, p, k: sampling.sample_rows(
+                rng, lg, t, top_p=p, top_k_rows=k),
+            args=(key_sds(), sds((b, v), jnp.float32),
+                  sds((b,), jnp.float32), sds((b,), jnp.float32),
+                  sds((b,), jnp.int32)),
+            want=(((b,), "int32"),),
+        ))
+    return cases
+
+
+def op_contracts() -> list[OpContract]:
+    return [
+        OpContract("ops.flash.flash_attention", P_FLASH,
+                   "out [B,Tq,H,D] in q.dtype across GQA/window/k_valid sweeps",
+                   _flash_cases),
+        OpContract("ops.ring.ring_attention", P_RING,
+                   "out [B,T,H,D] under shard_map('seq') on fake meshes",
+                   _ring_cases),
+        OpContract("ops.ring.seq_cached_decode_attention", P_RING,
+                   "psum-merged decode [B,1,H,D], replicated over 'seq'",
+                   _seq_decode_cases),
+        OpContract("ops.ulysses.ulysses_attention", P_ULYSSES,
+                   "all-to-all head scatter round-trips to [B,T,H,D]",
+                   _ulysses_cases),
+        OpContract("ops.decode_attn.ragged_decode_attention", P_DECODE,
+                   "[B,1,H,D] in q.dtype; tileable, stepdown, dense, window",
+                   _ragged_cases),
+        OpContract("ops.decode_attn.paged_decode_attention", P_DECODE,
+                   "[B,1,H,D] through page tables incl. page-boundary sizes",
+                   _paged_cases),
+        OpContract("ops.quant_matmul.quant_contract", P_QMM,
+                   "int8/int4 contraction keeps activation dtype and N axes",
+                   _quant_cases),
+        OpContract("models.model.forward", P_MODEL,
+                   "logits f32, cache dtype preserved: plain/row-decode/paged",
+                   _forward_cases),
+        OpContract("runtime.sampling", P_SAMPLING,
+                   "samplers return [B] int32 for static and per-row paths",
+                   _sampling_cases),
+    ]
+
+
+# ---------------------------------------------------------------------------
+# GC2 — sharding-spec audits
+# ---------------------------------------------------------------------------
+
+@dataclass(frozen=True)
+class SpecAudit:
+    name: str       # "llama-tiny@tp4"
+    path: str
+    build: Callable[[], tuple]  # -> (param_tree, spec_tree, mesh)
+
+
+@dataclass(frozen=True)
+class CollectiveAudit:
+    name: str
+    path: str
+    doc: str
+    build: Callable[[], tuple]  # -> (fn, args, mesh)
+
+
+MESH_LADDER: tuple[tuple[str, dict], ...] = (
+    ("tp2", dict(model=2)),
+    ("tp4", dict(model=4)),
+    ("tp8", dict(model=8)),
+    ("pp2", dict(pipe=2)),
+    ("pp2tp4", dict(pipe=2, model=4)),
+    ("ep2tp2", dict(expert=2, model=2)),
+)
+
+
+def spec_audits() -> list[SpecAudit]:
+    from distributed_llms_tpu.models.presets import PRESETS
+
+    out = []
+    for pname in sorted(PRESETS):
+        for mlabel, axes in MESH_LADDER:
+            def build(pname=pname, axes=axes):
+                from distributed_llms_tpu.parallel import specs as specs_lib
+
+                cfg = preset(pname)
+                mesh = fake_mesh(**axes)
+                return (abstract_params(cfg),
+                        specs_lib.param_specs(cfg, mesh), mesh)
+
+            out.append(SpecAudit(f"{pname}@{mlabel}", P_SPECS, build))
+    # Staged (pipelined) tree: blocks reshaped [L,...] -> [P, L/P, ...] must
+    # structure-match staged_param_specs on a divisible preset.
+    def build_staged():
+        from distributed_llms_tpu.parallel import api as api_lib
+        from distributed_llms_tpu.parallel import pipeline as pipeline_lib
+
+        cfg = preset("llama-tiny")
+        mesh = fake_mesh(pipe=2)
+        tree = dict(abstract_params(cfg))
+        tree["blocks"] = jax.eval_shape(
+            lambda b: pipeline_lib.split_stages(b, 2), tree["blocks"]
+        )
+        return tree, api_lib.staged_param_specs(cfg, mesh), mesh
+
+    out.append(SpecAudit("llama-tiny@staged-pp2",
+                         "distributed_llms_tpu/parallel/api.py",
+                         build_staged))
+    return out
+
+
+def collective_audits() -> list[CollectiveAudit]:
+    audits = []
+
+    def build_ring():
+        case = _ring_cases()[1]  # seq4 f32
+        return case.fn, case.args, fake_mesh(seq=4)
+
+    def build_ring_decode():
+        case = _seq_decode_cases()[0]  # seq2
+        return case.fn, case.args, fake_mesh(seq=2)
+
+    def build_ulysses():
+        case = _ulysses_cases()[1]  # seq4
+        return case.fn, case.args, fake_mesh(seq=4)
+
+    audits.append(CollectiveAudit(
+        "ops.ring.ring_attention", P_RING,
+        "ppermute rotation rides the mesh's 'seq' axis", build_ring))
+    audits.append(CollectiveAudit(
+        "ops.ring.seq_cached_decode_attention", P_RING,
+        "pmax/psum stat merge over 'seq'", build_ring_decode))
+    audits.append(CollectiveAudit(
+        "ops.ulysses.ulysses_attention", P_ULYSSES,
+        "all_to_all/all_gather over 'seq'", build_ulysses))
+    return audits
+
+
+# ---------------------------------------------------------------------------
+# GC3 — dtype-promotion contracts
+# ---------------------------------------------------------------------------
+
+@dataclass(frozen=True)
+class HotFnContract:
+    name: str
+    path: str
+    doc: str
+    build: Callable[[], tuple]      # -> (fn, args)
+    allow_upcast: frozenset = frozenset()  # function names allowed bf16->f32
+
+
+# Deliberate f32-stability upcasts in the model stack: norms compute in
+# f32, RoPE builds its rotation table in f32, the MoE router softmaxes in
+# f32.  Anything ELSE converting bf16 activations up is an accidental
+# double-width HBM bill and fails GC302.
+MODEL_UPCAST_ALLOW = frozenset(
+    {"rms_norm", "layer_norm", "apply_rope", "moe_swiglu"}
+)
+
+
+def hot_contracts() -> list[HotFnContract]:
+    from distributed_llms_tpu.models import model as model_lib
+
+    out = []
+    for pname in ("llama-tiny", "gpt2-tiny", "neox-tiny", "moe-tiny"):
+        def build_fwd(pname=pname):
+            cfg = preset(pname, dtype="bfloat16")
+            return (
+                functools.partial(
+                    lambda cfg, p, t: model_lib.forward(p, cfg, t)[0], cfg),
+                (abstract_params(cfg), sds((2, 8), jnp.int32)),
+            )
+
+        out.append(HotFnContract(
+            f"models.model.forward[{pname}]", P_MODEL,
+            "bf16 prefill upcasts only in norm/rope/router",
+            build_fwd, MODEL_UPCAST_ALLOW))
+
+    def build_decode():
+        cfg = preset("llama-tiny", dtype="bfloat16")
+        cache = abstract_cache(cfg, 2, 32)
+        return (
+            functools.partial(
+                lambda cfg, p, t, pos, c, ci, m: model_lib.forward(
+                    p, cfg, t, positions=pos, cache=c, cache_index=ci,
+                    attn_mask=m)[0], cfg),
+            (abstract_params(cfg), sds((2, 1), jnp.int32),
+             sds((2, 1), jnp.int32), cache, sds((2,), jnp.int32),
+             sds((2, 1, 1, 32), jnp.bool_)),
+        )
+
+    out.append(HotFnContract(
+        "models.model.forward[row-decode]", P_MODEL,
+        "bf16 cached decode step stays bf16 outside norm/rope",
+        build_decode, MODEL_UPCAST_ALLOW))
+
+    def build_sampling():
+        from distributed_llms_tpu.runtime import sampling
+
+        return (
+            lambda rng, lg, t, p, k: sampling.sample_rows(
+                rng, lg, t, top_p=p, top_k_rows=k),
+            (key_sds(), sds((4, 512), jnp.float32), sds((4,), jnp.float32),
+             sds((4,), jnp.float32), sds((4,), jnp.int32)),
+        )
+
+    out.append(HotFnContract(
+        "runtime.sampling.sample_rows", P_SAMPLING,
+        "no float64 anywhere in the per-row sampler",
+        build_sampling, frozenset()))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# GC4 — recompilation scenarios
+# ---------------------------------------------------------------------------
+
+@dataclass(frozen=True)
+class RecompileScenario:
+    name: str
+    path: str
+    doc: str
+    ladder: tuple[int, ...]             # raw request lengths swept
+    width_of: Callable[[int], int]      # raw length -> jit-visible width
+    allowed_widths: tuple[int, ...]     # the CLOSED ladder (GC402)
+    max_keys: int                       # declared compile-key bound (GC401)
+    trace: Callable[[int], str]         # width -> compile-cache key
+
+
+_GC4_LADDER = (1, 2, 3, 5, 7, 8, 9, 13, 16, 17, 24, 31, 32, 33, 47, 63,
+               64, 65, 100, 120)
+
+
+def recompile_scenarios() -> list[RecompileScenario]:
+    from distributed_llms_tpu.runtime import shapes as shapes_lib
+
+    from .core import jaxpr_hash
+
+    out = []
+    s_cap = 128  # tiny-config cache width the sweeps run against
+    cfg = preset("llama-tiny")
+
+    # -- batcher admission: prompt widths must walk the shared ladder, and
+    # each distinct width is ONE compiled program.
+    def admit_trace(width: int) -> str:
+        from distributed_llms_tpu.runtime import batcher as batcher_lib
+
+        params = abstract_params(cfg)
+        cache = abstract_cache(cfg, 4, s_cap)
+        return jaxpr_hash(
+            lambda p, c, slot, prompt, plen, rng: batcher_lib.admit_row(
+                p, cfg, c, slot, prompt, plen, rng),
+            params, cache, sds((), jnp.int32), sds((width,), jnp.int32),
+            sds((), jnp.int32), key_sds(),
+            statics={"cfg": cfg},
+        )
+
+    out.append(RecompileScenario(
+        name="batcher.admit_row", path=P_BATCHER,
+        doc="admission prefill compiles once per prompt bucket",
+        ladder=_GC4_LADDER,
+        width_of=lambda n: min(shapes_lib.bucket_length(n), s_cap),
+        allowed_widths=tuple(shapes_lib.bucket_ladder(s_cap)),
+        max_keys=shapes_lib.bucket_count(s_cap),
+        trace=admit_trace,
+    ))
+
+    # -- decode step: shapes are depth-independent, so the WHOLE ladder is
+    # one compile key (depths are traced values, not shapes).
+    def decode_trace(width: int) -> str:
+        from distributed_llms_tpu.runtime import batcher as batcher_lib
+
+        b = 4
+        params = abstract_params(cfg)
+        cache = abstract_cache(cfg, b, s_cap)
+        return jaxpr_hash(
+            lambda p, c, lt, rl, va, ac, bu, rng: batcher_lib.decode_chunk(
+                p, cfg, c, lt, rl, va, ac, bu, rng, chunk_steps=8),
+            params, cache, sds((b,), jnp.int32), sds((b,), jnp.int32),
+            sds((b, s_cap), jnp.bool_), sds((b,), jnp.bool_),
+            sds((b,), jnp.int32), key_sds(),
+            statics={"cfg": cfg, "chunk_steps": 8},
+        )
+
+    out.append(RecompileScenario(
+        name="batcher.decode_chunk", path=P_BATCHER,
+        doc="decode chunk is ONE program across every resident depth",
+        ladder=_GC4_LADDER,
+        width_of=lambda n: s_cap,
+        allowed_widths=(s_cap,),
+        max_keys=1,
+        trace=decode_trace,
+    ))
+
+    # -- whole-batch generate: the engine pads T up the ladder under the
+    # sequence budget; every padded width is one compile key.
+    n_new, limit = 8, s_cap
+
+    def generate_trace(width: int) -> str:
+        from distributed_llms_tpu.runtime import generate as gen_lib
+
+        params = abstract_params(cfg)
+        return jaxpr_hash(
+            lambda p, prompt, lens, rng: gen_lib.generate_tokens(
+                p, cfg, prompt, lens, rng, max_new_tokens=n_new),
+            params, sds((2, width), jnp.int32), sds((2,), jnp.int32),
+            key_sds(),
+            statics={"cfg": cfg, "max_new_tokens": n_new},
+        )
+
+    out.append(RecompileScenario(
+        name="engine.generate_tokens", path=P_ENGINE,
+        doc="whole-batch generate pads T up the ladder (budget-capped)",
+        ladder=tuple(n for n in _GC4_LADDER if n <= limit - n_new),
+        width_of=lambda n: shapes_lib.generate_pad_len(n, n_new, limit),
+        allowed_widths=tuple(shapes_lib.bucket_ladder(limit - n_new)),
+        max_keys=shapes_lib.bucket_count(limit - n_new),
+        trace=generate_trace,
+    ))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# GC5 — donation contracts
+# ---------------------------------------------------------------------------
+
+@dataclass(frozen=True)
+class DonationContract:
+    name: str
+    path: str
+    doc: str
+    build: Callable[[], tuple]   # -> (jitted_fn, [(argname, value), ...], kwargs)
+    must_donate: tuple[str, ...]
+    may_keep: tuple[str, ...] = ()   # argnames allowed large + non-donated
+    static_args: tuple[str, ...] = ("cfg",)  # dropped from Lowered.args_info
+    min_bytes: int = 128 * 1024      # "large" threshold for GC502
+
+
+def donation_contracts() -> list[DonationContract]:
+    cfg = preset("llama-tiny")
+    out = []
+
+    def build_admit():
+        from distributed_llms_tpu.runtime import batcher as batcher_lib
+
+        return (batcher_lib.admit_row, [
+            ("params", abstract_params(cfg)), ("cfg", cfg),
+            ("cache", abstract_cache(cfg, 4, 128)),
+            ("slot", sds((), jnp.int32)), ("prompt", sds((16,), jnp.int32)),
+            ("plen", sds((), jnp.int32)), ("rng", key_sds()),
+        ], {})
+
+    out.append(DonationContract(
+        "batcher.admit_row", P_BATCHER,
+        "admission splices in place: the shared KV cache is donated",
+        build_admit, must_donate=("cache",), may_keep=("params",)))
+
+    def build_decode():
+        from distributed_llms_tpu.runtime import batcher as batcher_lib
+
+        b = 4
+        return (batcher_lib.decode_chunk, [
+            ("params", abstract_params(cfg)), ("cfg", cfg),
+            ("cache", abstract_cache(cfg, b, 128)),
+            ("last_tok", sds((b,), jnp.int32)),
+            ("real_lens", sds((b,), jnp.int32)),
+            ("valid", sds((b, 128), jnp.bool_)),
+            ("active", sds((b,), jnp.bool_)),
+            ("budget", sds((b,), jnp.int32)), ("rng", key_sds()),
+        ], {"chunk_steps": 8})
+
+    out.append(DonationContract(
+        "batcher.decode_chunk", P_BATCHER,
+        "the decode carry (KV cache) never copies between chunks",
+        build_decode, must_donate=("cache",), may_keep=("params",)))
+
+    def build_admit_paged():
+        from distributed_llms_tpu.runtime import batcher as batcher_lib
+
+        return (batcher_lib.admit_row_paged, [
+            ("params", abstract_params(cfg)), ("cfg", cfg),
+            ("cache", abstract_pool(cfg, 32, 16)),
+            ("page_list", sds((8,), jnp.int32)),
+            ("prompt", sds((16,), jnp.int32)), ("plen", sds((), jnp.int32)),
+            ("rng", key_sds()),
+        ], {})
+
+    out.append(DonationContract(
+        "batcher.admit_row_paged", P_BATCHER,
+        "paged admission scatters into a donated pool",
+        build_admit_paged, must_donate=("cache",), may_keep=("params",)))
+
+    def build_auto_paged():
+        from distributed_llms_tpu.runtime import batcher as batcher_lib
+
+        return (batcher_lib.admit_row_auto_paged, [
+            ("params", abstract_params(cfg)), ("cfg", cfg),
+            ("cache", abstract_pool(cfg, 32, 16)),
+            ("read_list", sds((8,), jnp.int32)),
+            ("write_list", sds((8,), jnp.int32)),
+            ("prefix_len", sds((), jnp.int32)),
+            ("chunk", sds((16,), jnp.int32)), ("clen", sds((), jnp.int32)),
+            ("rng", key_sds()),
+        ], {})
+
+    out.append(DonationContract(
+        "batcher.admit_row_auto_paged", P_BATCHER,
+        "prefix-cache-hit admission gathers then scatters one donated pool",
+        build_auto_paged, must_donate=("cache",), may_keep=("params",)))
+
+    def build_chunk_step():
+        from distributed_llms_tpu.runtime import batcher as batcher_lib
+
+        l, kvh, hd = cfg.num_layers, cfg.num_kv_heads, cfg.head_dim_
+        row = sds((l, 1, 256, kvh, hd), jnp.float32)
+        return (batcher_lib.prefill_chunk_step, [
+            ("params", abstract_params(cfg)), ("cfg", cfg),
+            ("row_k", row), ("row_v", row), ("done", sds((), jnp.int32)),
+            ("chunk", sds((32,), jnp.int32)), ("clen", sds((), jnp.int32)),
+        ], {})
+
+    out.append(DonationContract(
+        "batcher.prefill_chunk_step", P_BATCHER,
+        "chunked prefill updates the transient row KV in place",
+        build_chunk_step, must_donate=("row_k", "row_v"),
+        may_keep=("params",)))
+
+    def build_spec_chunk():
+        from distributed_llms_tpu.runtime import batcher as batcher_lib
+
+        b, s = 2, 128
+        return (batcher_lib.spec_chunk, [
+            ("params", abstract_params(cfg)), ("cfg", cfg),
+            ("draft_params", abstract_params(cfg)), ("draft_cfg", cfg),
+            ("cache", abstract_cache(cfg, b, s)),
+            ("draft_cache", abstract_cache(cfg, b, s)),
+            ("last_tok", sds((b,), jnp.int32)),
+            ("real_lens", sds((b,), jnp.int32)),
+            ("valid", sds((b, s), jnp.bool_)),
+            ("active", sds((b,), jnp.bool_)),
+            ("budget", sds((b,), jnp.int32)),
+        ], {"k": 3})
+
+    out.append(DonationContract(
+        "batcher.spec_chunk", P_BATCHER,
+        "speculative round donates BOTH target and draft caches",
+        build_spec_chunk, must_donate=("cache", "draft_cache"),
+        may_keep=("params", "draft_params"),
+        static_args=("cfg", "draft_cfg")))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# README table (--write-docs)
+# ---------------------------------------------------------------------------
+
+DOC_BEGIN = "<!-- graftcheck:contracts:begin -->"
+DOC_END = "<!-- graftcheck:contracts:end -->"
+
+
+def contracts_table() -> str:
+    """Markdown table of every registered contract, grouped by family."""
+    rows = ["| family | contract | pins |", "|---|---|---|"]
+    for c in op_contracts():
+        rows.append(f"| GC1 | `{c.name}` | {c.doc} |")
+    presets = sorted({a.name.split("@")[0] for a in spec_audits()})
+    meshes = ", ".join(label for label, _ in MESH_LADDER)
+    rows.append(
+        f"| GC2 | `parallel.specs.param_specs` | tree structure, axis "
+        f"names, rank, divisibility over {len(presets)} presets x "
+        f"({meshes}) + staged blocks |"
+    )
+    for a in collective_audits():
+        rows.append(f"| GC2 | `{a.name}` | {a.doc} |")
+    for h in hot_contracts():
+        rows.append(f"| GC3 | `{h.name}` | {h.doc} |")
+    for s in recompile_scenarios():
+        rows.append(
+            f"| GC4 | `{s.name}` | {s.doc} (<= {s.max_keys} compile keys) |"
+        )
+    for d in donation_contracts():
+        rows.append(f"| GC5 | `{d.name}` | {d.doc} |")
+    return "\n".join(rows)

@@ -1,0 +1,54 @@
+"""GCD — README contracts-table generation and drift check.
+
+Mirrors graftlint's registry-table discipline: the "Semantic checks" table
+in README.md lives between ``<!-- graftcheck:contracts:begin/end -->``
+markers, is generated from the contract registries (``python -m
+tools.graftcheck --write-docs``), and a stale table is a finding — the
+docs can never quietly diverge from what the gate actually pins.
+"""
+
+from __future__ import annotations
+
+import re
+from pathlib import Path
+
+from .core import Finding
+from .contracts import DOC_BEGIN, DOC_END, contracts_table
+
+_MARKER_RE = re.compile(
+    re.escape(DOC_BEGIN) + r"\n(.*?)" + re.escape(DOC_END), re.S
+)
+
+
+def check_docs(root: Path) -> list[Finding]:
+    readme = Path(root) / "README.md"
+    if not readme.exists():
+        return []
+    text = readme.read_text(encoding="utf-8")
+    m = _MARKER_RE.search(text)
+    if m is None:
+        return [Finding(
+            "GCD01", "README.md", 1,
+            f"missing '{DOC_BEGIN}' block — run "
+            "python -m tools.graftcheck --write-docs")]
+    if m.group(1).strip() != contracts_table().strip():
+        return [Finding(
+            "GCD01", "README.md", text[: m.start()].count("\n") + 1,
+            "contracts table is stale vs the registry — run "
+            "python -m tools.graftcheck --write-docs")]
+    return []
+
+
+def write_docs(root: Path) -> bool:
+    """Regenerate the table in place; returns whether a block was found."""
+    readme = Path(root) / "README.md"
+    if not readme.exists():
+        return False
+    text = readme.read_text(encoding="utf-8")
+    if _MARKER_RE.search(text) is None:
+        return False
+    block = f"{DOC_BEGIN}\n{contracts_table()}\n{DOC_END}"
+    readme.write_text(
+        _MARKER_RE.sub(lambda _m: block, text), encoding="utf-8"
+    )
+    return True

@@ -1,0 +1,86 @@
+"""GC5 — buffer-donation audit over lowered jit entry points.
+
+A KV cache that stops being donated doubles its HBM footprint (old + new
+buffer live across the step) and nothing fails — serving just OOMs at half
+the batch it used to hold.  Each contract lowers the real jitted function
+with abstract arguments (no compile, no FLOPs) and reads the donation
+flags off ``Lowered.args_info``:
+
+- GC501: a leaf of a ``must_donate`` argument is not donated.
+- GC502: a large buffer (>= ``min_bytes``) outside ``must_donate`` and
+  ``may_keep`` is passed in non-donated — a persistent carry someone
+  forgot to alias.
+"""
+
+from __future__ import annotations
+
+import jax
+
+from .core import Finding
+
+
+def _leaf_bytes(info) -> int:
+    try:
+        import numpy as np
+
+        return int(np.prod(info.shape)) * info.dtype.itemsize
+    except Exception:
+        return 0
+
+
+def check(contracts=None) -> list[Finding]:
+    if contracts is None:
+        from .contracts import donation_contracts
+
+        contracts = donation_contracts()
+    findings: list[Finding] = []
+    for contract in contracts:
+        try:
+            fn, named_args, kwargs = contract.build()
+            lowered = fn.lower(
+                *(v for _, v in named_args), **kwargs
+            )
+            pos_info, kw_info = lowered.args_info
+        except Exception as exc:
+            findings.append(Finding(
+                "GC501", contract.path, 0,
+                f"{contract.name}: lowering failed: "
+                f"{type(exc).__name__}: {str(exc).splitlines()[0][:160]}"))
+            continue
+        # Static args (cfg etc.) are DROPPED from Lowered.args_info; the
+        # remaining positional entries keep their relative order, so zip
+        # the static-filtered names against them.
+        names = [n for n, _ in named_args if n not in contract.static_args]
+        if len(names) != len(pos_info):
+            findings.append(Finding(
+                "GC501", contract.path, 0,
+                f"{contract.name}: args_info arity {len(pos_info)} != "
+                f"{len(names)} non-static args — static_args declaration "
+                "drifted from the function signature"))
+            continue
+        for name, info_tree in zip(names, pos_info):
+            leaves = jax.tree.leaves(info_tree)
+            donated = [bool(getattr(l, "donated", False)) for l in leaves]
+            if name in contract.must_donate:
+                if not leaves:
+                    findings.append(Finding(
+                        "GC501", contract.path, 0,
+                        f"{contract.name}: {name} must donate but lowered "
+                        "with no array leaves (pruned as unused?)"))
+                elif not all(donated):
+                    kept = sum(1 for d in donated if not d)
+                    findings.append(Finding(
+                        "GC501", contract.path, 0,
+                        f"{contract.name}: {name} must be donated but "
+                        f"{kept}/{len(donated)} leaves are not "
+                        "(donate_argnames lost?)"))
+            elif name not in contract.may_keep:
+                for leaf, don in zip(leaves, donated):
+                    if not don and _leaf_bytes(leaf) >= contract.min_bytes:
+                        findings.append(Finding(
+                            "GC502", contract.path, 0,
+                            f"{contract.name}: large persistent buffer "
+                            f"{name} ({tuple(leaf.shape)} {leaf.dtype}, "
+                            f"{_leaf_bytes(leaf)} B) is not donated and "
+                            "not declared may_keep"))
+    return findings

@@ -1,0 +1,63 @@
+"""GC1 — shape/dtype contracts via ``jax.eval_shape``.
+
+Every public op and the model forward are traced under abstract values
+across a symbolic (batch, seq, heads, pages) sweep — edge sizes included
+(1, non-power-of-two, page-boundary) — and every output leaf must land on
+its DECLARED shape and dtype.  Because the trace runs the real code, a
+failure here is a real TPU bug: a kernel whose output silently changed
+dtype, a forward whose cache widened, a GQA ratio that stopped composing.
+
+- GC101: an output leaf's shape or dtype departs from the contract.
+- GC102: the contract case fails to trace at all (the op rejects shapes it
+  declares it supports).
+"""
+
+from __future__ import annotations
+
+import jax
+
+from .core import Finding
+
+
+def _leaves(out):
+    return jax.tree.leaves(
+        out, is_leaf=lambda x: hasattr(x, "shape") and hasattr(x, "dtype")
+    )
+
+
+def check(contracts=None) -> list[Finding]:
+    if contracts is None:
+        from .contracts import op_contracts
+
+        contracts = op_contracts()
+    findings: list[Finding] = []
+    for contract in contracts:
+        try:
+            cases = contract.build()
+        except Exception as exc:  # registry bug == finding, not crash
+            findings.append(Finding(
+                "GC102", contract.path, 0,
+                f"{contract.name}: contract cases failed to build: "
+                f"{type(exc).__name__}: {str(exc).splitlines()[0][:160]}"))
+            continue
+        for case in cases:
+            try:
+                out = jax.eval_shape(case.fn, *case.args)
+            except Exception as exc:
+                findings.append(Finding(
+                    "GC102", contract.path, 0,
+                    f"{contract.name}[{case.label}]: trace failed: "
+                    f"{type(exc).__name__}: "
+                    f"{str(exc).splitlines()[0][:160]}"))
+                continue
+            got = [
+                (tuple(leaf.shape), str(leaf.dtype))
+                for leaf in _leaves(out)
+            ]
+            want = [(tuple(s), str(d)) for s, d in case.want]
+            if got != want:
+                findings.append(Finding(
+                    "GC101", contract.path, 0,
+                    f"{contract.name}[{case.label}]: output contract "
+                    f"violated: declared {want}, traced {got}"))
+    return findings

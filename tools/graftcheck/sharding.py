@@ -1,0 +1,125 @@
+"""GC2 — sharding-spec audit over fake meshes.
+
+``parallel/specs.py:param_specs`` is the single source of placement truth;
+drift between it and the real param trees is the Megatron-class production
+failure (a preset whose tree grew a leaf the specs don't know, an axis name
+that no longer exists on the mesh, a dim that stopped dividing).  The audit
+structure-matches the spec pytree against ``jax.eval_shape``'d param trees
+for every preset x mesh in the ladder — zero FLOPs even for the 70B
+presets — and jaxpr-traces the collective ops to verify their axis names
+exist on the mesh they run under.
+
+- GC201: spec pytree structure != param tree structure.
+- GC202: a PartitionSpec names an axis the mesh does not have.
+- GC203: spec rank exceeds the array rank it applies to.
+- GC204: an axis shards a dim it does not divide.
+- GC205: a traced collective targets an axis missing from the mesh.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.tree_util as jtu
+
+from .core import Finding, collect_collectives
+
+
+def _keystr(kp) -> str:
+    return jtu.keystr(kp)
+
+
+def check_specs(audits=None) -> list[Finding]:
+    if audits is None:
+        from .contracts import spec_audits
+
+        audits = spec_audits()
+    findings: list[Finding] = []
+    for audit in audits:
+        try:
+            tree, specs, mesh = audit.build()
+        except Exception as exc:
+            findings.append(Finding(
+                "GC201", audit.path, 0,
+                f"{audit.name}: audit failed to build: "
+                f"{type(exc).__name__}: {str(exc).splitlines()[0][:160]}"))
+            continue
+        if jtu.tree_structure(tree) != jtu.tree_structure(specs):
+            tree_keys = {_keystr(k) for k, _ in
+                         jtu.tree_flatten_with_path(tree)[0]}
+            spec_keys = {_keystr(k) for k, _ in
+                         jtu.tree_flatten_with_path(specs)[0]}
+            only_params = sorted(tree_keys - spec_keys)[:4]
+            only_specs = sorted(spec_keys - tree_keys)[:4]
+            findings.append(Finding(
+                "GC201", audit.path, 0,
+                f"{audit.name}: spec tree drifted from the param tree "
+                f"(params-only: {only_params}, specs-only: {only_specs})"))
+            continue
+        mesh_shape = dict(mesh.shape)
+        for (kp, leaf), (_, spec) in zip(
+                jtu.tree_flatten_with_path(tree)[0],
+                jtu.tree_flatten_with_path(specs)[0]):
+            key = _keystr(kp)
+            if len(spec) > len(leaf.shape):
+                findings.append(Finding(
+                    "GC203", audit.path, 0,
+                    f"{audit.name}: {key}: spec rank {len(spec)} exceeds "
+                    f"array rank {len(leaf.shape)}"))
+                continue
+            for dim, entry in enumerate(spec):
+                if entry is None:
+                    continue
+                axes = entry if isinstance(entry, tuple) else (entry,)
+                for axis in axes:
+                    if axis not in mesh_shape:
+                        findings.append(Finding(
+                            "GC202", audit.path, 0,
+                            f"{audit.name}: {key}: unknown mesh axis "
+                            f"{axis!r}"))
+                        continue
+                    size = mesh_shape[axis]
+                    if size > 1 and leaf.shape[dim] % size != 0:
+                        findings.append(Finding(
+                            "GC204", audit.path, 0,
+                            f"{audit.name}: {key}: axis {axis!r} (size "
+                            f"{size}) shards non-divisible dim {dim} "
+                            f"(size {leaf.shape[dim]})"))
+    return findings
+
+
+def check_collectives(audits=None) -> list[Finding]:
+    if audits is None:
+        from .contracts import collective_audits
+
+        audits = collective_audits()
+    findings: list[Finding] = []
+    for audit in audits:
+        try:
+            fn, args, mesh = audit.build()
+            jaxpr = jax.make_jaxpr(fn)(*args)
+        except Exception as exc:
+            findings.append(Finding(
+                "GC205", audit.path, 0,
+                f"{audit.name}: collective audit failed to trace: "
+                f"{type(exc).__name__}: {str(exc).splitlines()[0][:160]}"))
+            continue
+        mesh_axes = set(mesh.axis_names)
+        prims = collect_collectives(jaxpr)
+        if not prims:
+            findings.append(Finding(
+                "GC205", audit.path, 0,
+                f"{audit.name}: no collectives in the traced jaxpr — the "
+                "audit is vacuous (op rewritten without collectives, or "
+                "traced outside shard_map)"))
+        for prim, axes in prims.items():
+            for axis in axes:
+                if axis not in mesh_axes:
+                    findings.append(Finding(
+                        "GC205", audit.path, 0,
+                        f"{audit.name}: {prim} targets axis {axis!r} "
+                        f"missing from the mesh {sorted(mesh_axes)}"))
+    return findings
+
+
+def check(audits=None, collectives=None) -> list[Finding]:
+    return check_specs(audits) + check_collectives(collectives)

@@ -60,10 +60,9 @@ def main(argv=None) -> int:
     if args.all:
         for f in accepted:
             print(f"{f.render()}  [baselined]")
-    counts: dict[str, int] = {}
-    for f in findings:
-        counts[f.normalized()] = counts.get(f.normalized(), 0) + 1
-    stale = {key for key, n in baseline.items() if n > counts.get(key, 0)}
+    from .core import stale_entries
+
+    stale = stale_entries(findings, baseline)
     summary = (f"graftlint: {len(new)} new finding(s), "
                f"{len(accepted)} baselined, {len(stale)} stale baseline "
                f"entr{'y' if len(stale) == 1 else 'ies'}")
@@ -71,7 +70,7 @@ def main(argv=None) -> int:
     if stale:
         print("graftlint: stale entries (fixed debt — run --baseline-write "
               "to shrink the baseline):", file=sys.stderr)
-        for s in sorted(stale):
+        for s in stale:
             print(f"  {s}", file=sys.stderr)
     return 1 if new else 0
 

@@ -207,9 +207,10 @@ def load_project(root: str | Path) -> Project:
 _BASELINE_COUNT_RE = re.compile(r"^(.*?)\s*\[x(\d+)\]$")
 
 
-def read_baseline(root: Path) -> dict[str, int]:
-    """Normalized entry -> accepted occurrence count."""
-    path = root / BASELINE_NAME
+def read_baseline(root: Path, name: str = BASELINE_NAME) -> dict[str, int]:
+    """Normalized entry -> accepted occurrence count.  ``name`` lets sibling
+    checkers (tools.graftcheck) share the format with their own file."""
+    path = root / name
     out: dict[str, int] = {}
     if not path.exists():
         return out
@@ -225,14 +226,15 @@ def read_baseline(root: Path) -> dict[str, int]:
     return out
 
 
-def write_baseline(root: Path, findings: list[Finding]) -> Path:
-    path = root / BASELINE_NAME
+def write_baseline(root: Path, findings: list[Finding],
+                   name: str = BASELINE_NAME, tool: str = "graftlint") -> Path:
+    path = root / name
     lines = [
-        "# graftlint accepted debt.  One normalized finding per line",
+        f"# {tool} accepted debt.  One normalized finding per line",
         "# (path: RULE message — no line numbers, so edits moving code",
         "# around never resurrect an entry; repeated identical findings",
         "# carry an [xN] count).  Regenerate deliberately with:",
-        "#   python -m tools.graftlint --baseline-write",
+        f"#   python -m tools.{tool} --baseline-write",
         "# Prefer fixing or suppressing-with-reason at the site over",
         "# baselining; every entry here should be a conscious debt note.",
     ]
@@ -243,6 +245,20 @@ def write_baseline(root: Path, findings: list[Finding]) -> Path:
               for key, n in sorted(counts.items())]
     path.write_text("\n".join(lines) + "\n", encoding="utf-8")
     return path
+
+
+def stale_entries(findings: list[Finding],
+                  baseline: dict[str, int]) -> list[str]:
+    """Baseline entries whose accepted count exceeds what still occurs —
+    fixed debt that should be pruned with --baseline-write.  Shared by both
+    checkers' CLIs and the tools.check front door (which escalates these
+    to errors)."""
+    counts: dict[str, int] = {}
+    for f in findings:
+        counts[f.normalized()] = counts.get(f.normalized(), 0) + 1
+    return sorted(
+        key for key, n in baseline.items() if n > counts.get(key, 0)
+    )
 
 
 def split_new(findings: list[Finding], baseline: dict[str, int]
