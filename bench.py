@@ -1325,6 +1325,135 @@ def _measure_fault_recovery(
     return out
 
 
+def _measure_replica_failover(
+    preset: str | None = None, dtype: str = "bfloat16",
+    replicas: int = 3, requests: int = 12, new_tokens: int = 24,
+    page_size: int = 16,
+) -> dict:
+    """Replica-fleet serving (runtime/router.py + cluster/fleet.py): N
+    full server/batcher replicas behind the health-aware router; one
+    replica is KILLED abruptly mid-storm.  Measured: failover recovery
+    latency (failure observed -> the re-placed request answered), goodput
+    through the storm, and the exactness count — every 200 is compared
+    byte-for-byte against an un-faulted reference run (temp-0 exact
+    failover is the contract, not best-effort).  A host-scheduling
+    effect, honestly measurable on any platform."""
+    import asyncio
+    import json as _json
+
+    from distributed_llms_tpu.cluster.fleet import ReplicaFleet
+    from distributed_llms_tpu.core.observability import METRICS
+    from distributed_llms_tpu.runtime.batcher import ContinuousBatcher
+    from distributed_llms_tpu.runtime.router import ReplicaRouter
+    from distributed_llms_tpu.runtime.server import InferenceServer
+    from distributed_llms_tpu.runtime.tokenizer import ByteTokenizer
+
+    preset = preset or ("gpt2-125m" if jax.devices()[0].platform == "cpu"
+                        else "tinyllama-1.1b")
+    cfg, params = _build_params(preset, dtype, None)
+    tok = ByteTokenizer()
+    max_len = 8 * page_size
+    slots = 2
+
+    def make_batcher():
+        return ContinuousBatcher(
+            cfg, params, tokenizer=tok, eos_id=tok.eos_id, pad_id=tok.pad_id,
+            batch_slots=slots, max_len=max_len, chunk_steps=4,
+            paged_pages=2 * slots * (max_len // page_size) + 1,
+            page_size=page_size, prefix_cache=True,
+        )
+
+    def make_server():
+        return InferenceServer(
+            make_batcher(), model_name="bench", host="127.0.0.1", port=0,
+            batcher_factory=make_batcher, watchdog_timeout_s=2.0,
+        )
+
+    prompts = [f"replica storm request {i:02d}" for i in range(requests)]
+    # Reference texts + jit warm-up in one go (the replicas share the
+    # compiled programs process-wide).
+    ref = make_batcher()
+    rids = [ref.submit(p, max_new_tokens=new_tokens) for p in prompts]
+    ref_res = ref.run()
+    wants = {p: tok.decode(ref_res[r]) for p, r in zip(prompts, rids)}
+
+    async def one_request(host, port, p):
+        reader, writer = await asyncio.open_connection(host, port)
+        body = _json.dumps({"prompt": p, "max_tokens": new_tokens}).encode()
+        writer.write(
+            f"POST /v1/completions HTTP/1.1\r\nHost: b\r\n"
+            f"Content-Length: {len(body)}\r\n\r\n".encode() + body
+        )
+        await writer.drain()
+        status = int((await reader.readline()).split()[1])
+        while (await reader.readline()) not in (b"\r\n", b"\n", b""):
+            pass
+        out = _json.loads(await reader.read())
+        writer.close()
+        return status, out
+
+    async def drive() -> dict:
+        fleet = ReplicaFleet([make_server] * replicas,
+                             probe_interval_s=0.05)
+        router = ReplicaRouter(fleet, host="127.0.0.1", port=0,
+                               tokenizer=tok, page_size=page_size)
+        await fleet.start()
+        host, port = await router.start()
+        assert await fleet.wait_healthy(timeout_s=60.0)
+        fo0 = METRICS.get_counter("router.failovers")
+        rec0 = METRICS.snapshot()["histograms"].get(
+            "router.failover_seconds", {}
+        ).get("count", 0)
+
+        async def staggered(i, p):
+            await asyncio.sleep(i * 0.05)
+            return await one_request(host, port, p)
+
+        t0 = time.perf_counter()
+        tasks = [asyncio.create_task(staggered(i, p))
+                 for i, p in enumerate(prompts)]
+        for _ in range(2000):  # kill r0 once real work is in flight on it
+            if fleet["r0"].inflight:
+                break
+            await asyncio.sleep(0.005)
+        await fleet.kill("r0")
+        outs = await asyncio.gather(*tasks)
+        wall = time.perf_counter() - t0
+        await router.stop()
+        await fleet.stop()
+        completed = [(p, out) for (status, out), p in zip(outs, prompts)
+                     if status == 200]
+        exact = sum(
+            1 for p, out in completed
+            if out["choices"][0]["text"] == wants[p]
+        )
+        good_tokens = sum(
+            out["usage"]["completion_tokens"] for _p, out in completed
+        )
+        hist = METRICS.snapshot()["histograms"].get(
+            "router.failover_seconds", {}
+        )
+        assert hist.get("count", 0) > rec0, "no failover was ever taken"
+        return {
+            "replicas": replicas,
+            "requests": requests,
+            "new_tokens": new_tokens,
+            "completed": len(completed),
+            "exact": exact,
+            "completed_frac": round(len(completed) / requests, 3),
+            "failovers": int(
+                METRICS.get_counter("router.failovers") - fo0
+            ),
+            "recovery_ms": round(hist["max"] * 1e3, 1),
+            "goodput_tok_per_s": round(good_tokens / wall, 1),
+            "wall_ms": round(wall * 1e3, 1),
+        }
+
+    out = asyncio.run(drive())
+    out.update({"preset": preset, "platform": jax.devices()[0].platform})
+    return out
+
+
 def _measure_overload_goodput(
     preset: str | None = None, dtype: str = "bfloat16",
     requests: int = 10, new_tokens: int = 48, page_size: int = 16,
@@ -1750,6 +1879,7 @@ def run_ladder(args, degraded: str | None) -> list[dict]:
             "spec-decode", "spec-decode-7b-int8", "spec-batching",
             "local-proc-batching", "chunked-prefill", "prefix-cache-ttft",
             "fault-recovery", "overload-goodput", "compile-stability",
+            "replica-failover",
         }
         unknown = only - known
         if unknown:  # a typo must not masquerade as a clean zero-row run
@@ -1882,6 +2012,12 @@ def run_ladder(args, degraded: str | None) -> list[dict]:
         # growth plane took — a host-scheduling effect, meaningful on any
         # platform.
         ("overload-goodput", lambda: _measure_overload_goodput(dtype=dtype)),
+        # Replica-fleet serving: N replicas behind the health-aware
+        # router, one killed abruptly mid-storm; stamps failover recovery
+        # latency, goodput, and the byte-exactness count of every
+        # completed request — a host-scheduling effect, meaningful on any
+        # platform.
+        ("replica-failover", lambda: _measure_replica_failover(dtype=dtype)),
         # Compile-key stability (tools/graftcheck GC4 as a measurement):
         # distinct compile-cache keys per serving entry point across the
         # request-length ladder vs the declared bucket budget — pure

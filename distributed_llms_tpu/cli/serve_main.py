@@ -45,10 +45,14 @@ _SERVER_ONLY_FLAGS = frozenset({
     "store", "preset", "config", "override", "host", "port", "model-name",
     "slots", "chunk-steps", "prefill-chunk", "prefill-concurrency",
     "max-pending", "drain-timeout", "watchdog-timeout", "platform",
+    "replicas", "probe-interval", "failover-retries",
 })
 
 
-def build_server(args) -> InferenceServer:
+def _build_engine(args):
+    """Shared boot: config + fault plane + engine.  Returns
+    (engine, default model name, runtime config, fault plane, fault
+    spec) — fleet mode re-parses the spec into a plane PER REPLICA."""
     cfg = load_config(args.config, args.override)
     rt = cfg.runtime
     # Parse the fault spec BEFORE the (slow) engine build: an operator's
@@ -83,8 +87,17 @@ def build_server(args) -> InferenceServer:
         default_name = args.preset
     else:
         raise SystemExit("one of --store or --preset is required")
+    return engine, default_name, rt, faults, fault_spec
+
+
+def _server_factory(args, engine, default_name, rt, faults, *,
+                    host=None, port=None):
+    """() -> a fresh, unstarted InferenceServer over a fresh batcher.
+    Replicas share the engine's weights by reference; each gets its own
+    pool/caches/supervisor."""
+
     def make_batcher():
-        # Called once now and again by the supervisor after an engine
+        # Called at boot and again by the supervisor after an engine
         # crash: a respawn must share the already-armed fault plane (rules
         # that fired stay fired) while rebuilding pool + caches fresh.
         return engine.continuous_batcher(
@@ -99,25 +112,75 @@ def build_server(args) -> InferenceServer:
             faults=faults,
         )
 
-    return InferenceServer(
-        make_batcher(),
-        model_name=args.model_name or default_name,
-        host=args.host,
-        port=args.port,
-        max_pending=args.max_pending,
-        batcher_factory=make_batcher,
-        request_timeout_s=(args.request_timeout
-                           if args.request_timeout is not None
-                           else rt.request_timeout_s),
-        watchdog_timeout_s=args.watchdog_timeout,
-        shed_cost_factor=(args.shed_cost_factor
-                          if args.shed_cost_factor is not None
-                          else rt.shed_cost_factor),
+    def make_server():
+        return InferenceServer(
+            make_batcher(),
+            model_name=args.model_name or default_name,
+            host=args.host if host is None else host,
+            port=args.port if port is None else port,
+            max_pending=args.max_pending,
+            batcher_factory=make_batcher,
+            request_timeout_s=(args.request_timeout
+                               if args.request_timeout is not None
+                               else rt.request_timeout_s),
+            watchdog_timeout_s=args.watchdog_timeout,
+            shed_cost_factor=(args.shed_cost_factor
+                              if args.shed_cost_factor is not None
+                              else rt.shed_cost_factor),
+        )
+
+    return make_server
+
+
+def build_server(args) -> InferenceServer:
+    engine, default_name, rt, faults, _spec = _build_engine(args)
+    return _server_factory(args, engine, default_name, rt, faults)()
+
+
+def build_fleet(args):
+    """``--replicas N`` (N >= 2): N full server/batcher stacks on
+    ephemeral local ports behind a health-aware ReplicaRouter on
+    --host/--port — exact failover, rolling drain/respawn (SIGHUP), and
+    replica-scoped chaos via the --fault spec.
+    Returns (fleet, router)."""
+    from ..cluster.fleet import ReplicaFleet
+    from ..runtime.router import ReplicaRouter
+
+    engine, default_name, rt, faults, fault_spec = _build_engine(args)
+
+    def replica_factory():
+        # Each replica gets its OWN plane parsed from the same spec: the
+        # batcher.*/server-side rule counters are traversed by that
+        # replica's engine thread alone (FaultPlane's thread contract),
+        # and @N windows count per replica — sharing the fleet's plane
+        # across N engine threads would race the counters and let a
+        # replica-scoped stall drill wedge whichever replica decodes
+        # next.  The shared ``faults`` plane keeps the replica.*/router.*
+        # sites, which only the event loop traverses.
+        plane = None
+        if fault_spec:
+            from ..runtime.faults import FaultPlane
+
+            plane = FaultPlane.parse(fault_spec, strict=True)
+        return _server_factory(args, engine, default_name, rt, plane,
+                               host="127.0.0.1", port=0)()
+
+    fleet = ReplicaFleet(
+        [replica_factory] * args.replicas,
+        probe_interval_s=args.probe_interval,
+        faults=faults,
     )
+    router = ReplicaRouter(
+        fleet, host=args.host, port=args.port,
+        tokenizer=engine.tokenizer,
+        page_size=(args.page_size or rt.page_size or 64),
+        max_failover_retries=args.failover_retries,
+        faults=faults,
+    )
+    return fleet, router
 
 
 async def _serve(args) -> None:
-    server = build_server(args)
     stop = asyncio.Event()
     force = asyncio.Event()
     loop = asyncio.get_running_loop()
@@ -128,6 +191,55 @@ async def _serve(args) -> None:
 
     for sig in (signal.SIGINT, signal.SIGTERM):
         loop.add_signal_handler(sig, on_signal)
+    if args.replicas > 1:
+        fleet, router = build_fleet(args)
+        await fleet.start()
+        host, port = await router.start()
+        # Replicas boot in state "starting" and only a healthy probe makes
+        # them routable — announce ready only once the fleet can actually
+        # place work, or the first requests shed 503 off an idle fleet.
+        if not await fleet.wait_healthy(timeout_s=60.0):
+            log.warning(
+                "not every replica probed healthy within 60s; serving with "
+                "%d routable", fleet.report()["healthy"],
+            )
+        # SIGHUP: zero-downtime rolling restart of the whole fleet, one
+        # replica at a time (config reload drills, binary swaps).  One
+        # restart at a time: a second SIGHUP mid-walk would interleave
+        # two drain/respawn passes over the same handles — overwriting
+        # h.server orphans a freshly-booted replica (leaked socket +
+        # engine thread + pool) and can leave every replica draining at
+        # once.  Failures must surface, not die as unretrieved task
+        # exceptions.
+        restart_task: list[asyncio.Task | None] = [None]
+
+        def on_hup():
+            t = restart_task[0]
+            if t is not None and not t.done():
+                log.warning("SIGHUP ignored: a rolling restart is "
+                            "already in progress")
+                return
+
+            async def run():
+                try:
+                    await fleet.rolling_restart(
+                        drain_timeout_s=args.drain_timeout
+                    )
+                    log.info("rolling restart complete")
+                except Exception:
+                    log.exception("rolling restart failed")
+
+            restart_task[0] = asyncio.ensure_future(run())
+
+        loop.add_signal_handler(signal.SIGHUP, on_hup)
+        log.info("fleet of %d ready on http://%s:%s (SIGHUP = rolling "
+                 "restart; Ctrl-C to stop)", args.replicas, host, port)
+        await stop.wait()
+        log.info("shutting down fleet...")
+        await router.stop()
+        await fleet.stop()
+        return
+    server = build_server(args)
     host, port = await server.start()
     log.info("ready on http://%s:%s (Ctrl-C to stop)", host, port)
     await stop.wait()
@@ -188,6 +300,21 @@ def main(argv=None) -> None:
                          "serializing (1 restores the old one-at-a-time "
                          "limit; per-round prefill work is bounded by "
                          "prefill-chunk x this)")
+    ap.add_argument("--replicas", type=int, default=1,
+                    help="replica-fleet serving: run N independent "
+                         "server/batcher stacks (each with its own "
+                         "supervisor and KV pool) behind a health-aware "
+                         "router on --port — exact failover on replica "
+                         "crash/stall/partition, SIGHUP = zero-downtime "
+                         "rolling restart (1 = single-server mode)")
+    ap.add_argument("--probe-interval", type=float, default=0.25,
+                    help="fleet health-probe interval in seconds "
+                         "(replica /healthz polling cadence)")
+    ap.add_argument("--failover-retries", type=int, default=2,
+                    help="router failover budget: how many other replicas "
+                         "a zero-streamed request may be re-sent to after "
+                         "a replica failure before answering 503 + "
+                         "Retry-After")
     ap.add_argument("--max-pending", type=int, default=256,
                     help="in-flight request cap before 429s")
     ap.add_argument("--drain-timeout", type=float, default=30.0,

@@ -76,20 +76,49 @@ class ServingClient:
     semantics; fine for the benches/tests this client drives, not for
     billing-sensitive traffic).  ``retries_taken`` counts backoff waits
     for tests/bench.
+
+    Multi-endpoint mode: construct with ``endpoints=[(host, port), ...]``
+    (every replica of a fleet, or several routers) and every failure
+    ROTATES to the next endpoint before retrying — a dead endpoint fails
+    over immediately to a not-yet-tried one, while 429/503 answers still
+    honor ``Retry-After`` before the rotated retry.  ``failovers`` counts
+    rotations.
     """
 
-    def __init__(self, host: str, port: int, max_retries: int = 4,
+    def __init__(self, host: str | None = None, port: int | None = None,
+                 max_retries: int = 4,
                  backoff_base_s: float = 0.25, backoff_cap_s: float = 8.0,
                  retry_after_cap_s: float | None = None,
-                 rng: random.Random | None = None) -> None:
-        self.host = host
-        self.port = port
+                 rng: random.Random | None = None,
+                 endpoints: "list[tuple[str, int]] | None" = None) -> None:
+        # Client-side failover: pass ``endpoints`` (a list of (host, port)
+        # pairs — e.g. every replica of a fleet, or several routers) and a
+        # connect error or 429/503 ROTATES to the next endpoint for the
+        # retry.  A fresh endpoint after a connection failure is tried
+        # immediately (the backoff sleep protects overloaded servers, not
+        # dead sockets); once every endpoint failed in the current
+        # rotation, the usual Retry-After-honoring jittered backoff
+        # applies.  ``host``/``port`` remain the single-endpoint spelling.
+        if endpoints:
+            self.endpoints = [(h, int(p)) for h, p in endpoints]
+        elif host is not None and port is not None:
+            self.endpoints = [(host, int(port))]
+        else:
+            raise ValueError("pass host+port or a non-empty endpoints list")
+        self.host, self.port = self.endpoints[0]
         self.max_retries = max_retries
         self.backoff_base_s = backoff_base_s
         self.backoff_cap_s = backoff_cap_s
         self.retry_after_cap_s = retry_after_cap_s
         self.retries_taken = 0
+        self.failovers = 0  # endpoint rotations taken (tests/bench)
+        self._ep = 0
         self._rng = rng if rng is not None else random.Random()
+
+    def _rotate(self) -> None:
+        self._ep = (self._ep + 1) % len(self.endpoints)
+        self.host, self.port = self.endpoints[self._ep]
+        self.failovers += 1
 
     async def _once(self, path: str, body: dict) -> tuple[int, dict, dict]:
         reader, writer = await asyncio.open_connection(self.host, self.port)
@@ -131,8 +160,12 @@ class ServingClient:
     ) -> tuple[int, dict]:
         """POST a completion request; returns (status, response body).
         Retries 429/503 (and connection failures) with Retry-After-honoring
-        jittered exponential backoff; any other status returns as-is."""
+        jittered exponential backoff, rotating through ``endpoints`` on
+        each failure; any other status returns as-is.  A dead endpoint
+        fails over to a not-yet-tried one IMMEDIATELY (no sleep) — the
+        backoff protects busy servers, not severed sockets."""
         attempt = 0
+        fresh = len(self.endpoints) - 1  # endpoints untried this rotation
         while True:
             headers: dict[str, str] = {}
             try:
@@ -143,6 +176,14 @@ class ServingClient:
                 return status, out
             if attempt >= self.max_retries:
                 return (status if status is not None else 599), out
-            await asyncio.sleep(self._delay_s(attempt, headers))
             attempt += 1
+            if len(self.endpoints) > 1:
+                self._rotate()
+            if status is None and fresh > 0:
+                # Connect failure with an untried endpoint left: fail over
+                # now instead of sleeping at a dead host.
+                fresh -= 1
+                continue
+            fresh = len(self.endpoints) - 1
+            await asyncio.sleep(self._delay_s(attempt - 1, headers))
             self.retries_taken += 1

@@ -234,6 +234,23 @@ METRIC_DOCS: dict[str, str] = {
     "*.step_seconds": "per-StepTimer step latency (histogram; name prefix "
                       "is the timer's, e.g. engine.generate)",
     "*.tokens_per_second": "per-StepTimer sliding-window throughput gauge",
+    # -- replica fleet router (runtime/router.py + cluster/fleet.py) --
+    "router.requests": "requests through the router front door",
+    "router.placements": "placement decisions onto a replica",
+    "router.affinity_hits": "placements that followed prefix-cache affinity",
+    "router.failovers": "zero-streamed requests re-placed after a replica "
+                        "failure (crash/stall/partition/drain straggler)",
+    "router.failover_seconds": "replica failure observed to the re-placed "
+                               "request answered (histogram)",
+    "router.retries_exhausted": "requests 503'd after the failover budget",
+    "router.failed_streamed": "partially-streamed requests failed with "
+                              "engine_error (deltas cannot be retracted)",
+    "router.replicas_healthy": "replicas currently routable (gauge)",
+    "router.committed_tokens.*": "router-side committed token mass per "
+                                 "replica (gauge; placement load signal)",
+    "router.replica_kills": "replicas killed (chaos or real death observed)",
+    "router.drains": "replica drains started (rolling restart)",
+    "router.respawns": "replica respawns completed",
     # -- cluster control plane --
     "coordinator.workers": "registered workers (gauge)",
     "coordinator.evictions": "workers evicted (heartbeat/connection loss)",

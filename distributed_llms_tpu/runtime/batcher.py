@@ -641,6 +641,25 @@ def finish_chunked_admission_paged(
     )
 
 
+@jax.jit
+def _gather_row_pages(cache: Any, read_list: jax.Array) -> tuple[jax.Array, jax.Array]:
+    """Gather a row's pages out of the pool into a transient contiguous
+    row cache ([L, 1, P*BLK, KVH, HD] k/v pair) — the chunked-prefill
+    analogue of admit_row_auto_paged's in-program gather.  A cache-hit
+    chunked admission seeds its transient row from the shared pages ONCE
+    (the "prefix" is then already resident, exactly as if those chunks had
+    run), and only the un-cached suffix chunks through the model.  The
+    outputs are fresh buffers, so every later prefill_chunk_step may
+    donate them."""
+    l, _, blk, kvh, hd = cache.k.shape
+    p = read_list.shape[0]
+
+    def gather(pool):
+        return pool[:, read_list].reshape(l, 1, p * blk, kvh, hd)
+
+    return gather(cache.k), gather(cache.v)
+
+
 def _paged_pool(cfg: ModelConfig, num_pages: int, page_size: int, dtype=None):
     """KV page pools [L, NB, BLK, KVH, HD] (distinct k/v buffers — the
     chunk fns donate the cache)."""
@@ -1264,6 +1283,15 @@ class _PendingPrefill:
     ids: list[int]      # the request's own ids (prefix KV pre-seeded)
     total_len: int      # prefix + prompt length
     last_logits: Any | None = None  # [1, V] after the latest chunk
+    # Automatic prefix-cache hit (paged mode): the cached page run seeding
+    # the transient row.  The pages are RETAINED for the whole prefill
+    # (mirrored into the reserving _RowState's ``pages`` so cancel/preempt
+    # release them and the pool audit sees the references); the finishing
+    # splice routes their positions to the scratch page — shared pages are
+    # never rewritten.
+    cached_pages: list[int] = field(default_factory=list)
+    cached_len: int = 0
+    digests: list = field(default_factory=list)
 
 
 @dataclass
@@ -2023,8 +2051,8 @@ class ContinuousBatcher:
         it already emitted, and requeue it for RECOMPUTE — the resume
         request prefills prompt + emitted prefix (cheap when the automatic
         prefix cache still holds the prompt pages; a resume long enough to
-        take the CHUNKED prefill path re-prefills in full — chunked paged
-        admission does not consult the cache yet) and its admission token
+        take the CHUNKED prefill path consults the cache too and chunks
+        only the un-cached suffix) and its admission token
         continues the sequence, so at temperature 0 the reunited stream is
         token-identical to an unpreempted run (pinned by
         tests/runtime/test_overload.py)."""
@@ -2393,21 +2421,65 @@ class ContinuousBatcher:
         this round).  Prefix-cached requests seed the transient row with a
         COPY of the registered prefix KV — one copy up front makes the
         buffers exclusively ours, so every chunk step can donate them
-        (update in place) instead of copying the row cache per chunk."""
+        (update in place) instead of copying the row cache per chunk.
+
+        AUTOMATIC prefix caching composes too (closes the PR-3 TODO): the
+        prompt's full pages are content-hashed, the longest cached run is
+        retained and gathered out of the pool into the transient row ONCE,
+        and only the un-cached suffix chunks through the model — the same
+        continuation math as the monolithic cache-hit admission, so tokens
+        stay temp-0 identical while a long shared prompt skips most of its
+        chunked prefill.  Hits are capped one page short of the prompt so
+        at least one real token prefills (the finish samples the first
+        token from its logits)."""
+        cached_pages: list[int] = []
+        cached_len = 0
+        digests: list[bytes] = []
+        pc = self.prefix_cache
         if pfx is not None:
             row_k, row_v, done = jnp.copy(pfx.k), jnp.copy(pfx.v), len(pfx.ids)
+            total_len = done + len(req.ids)
         else:
-            rc = model_lib.init_cache(self.cfg, 1, self.s,
-                                      dtype=self.cache.k.dtype)
-            row_k, row_v, done = rc.k, rc.v, 0
+            total_len = len(req.ids)
+            if pc is not None and req.prefix_cache:
+                blk = self.page_size
+                if req.digests is None:
+                    req.digests = PrefixCache.page_digests(
+                        req.ids, blk, len(req.ids) // blk
+                    )
+                digests = req.digests
+                cached_pages = pc.match(digests[: (len(req.ids) - 1) // blk])
+                cached_len = len(cached_pages) * blk
+                # Retain hits for the WHOLE prefill: eviction must never
+                # reclaim a run the pending chunks are continuing from.
+                for p in cached_pages:
+                    self._retain_page(p)
+                pc.record_lookup(cached_len, total_len - cached_len)
+            if cached_pages:
+                read_list = np.zeros((self.pages_per_row,), np.int32)
+                read_list[: len(cached_pages)] = cached_pages
+                row_k, row_v = _gather_row_pages(
+                    self.cache, jnp.asarray(read_list)
+                )
+                done = cached_len
+            else:
+                rc = model_lib.init_cache(self.cfg, 1, self.s,
+                                          dtype=self.cache.k.dtype)
+                row_k, row_v, done = rc.k, rc.v, 0
         self._admit_seq += 1
+        # The reserving row holds the cached pages so cancel_row /
+        # _preempt_row release them and the pool audit sees the references
+        # (a prefilling row stays inactive, so it is never a victim).
         self.rows[i] = _RowState(rid=req.rid, prefilling=True,
                                  remaining=req.max_new_tokens,
                                  req=req, priority=req.priority,
-                                 admit_seq=self._admit_seq)
+                                 admit_seq=self._admit_seq,
+                                 pages=list(cached_pages))
         self._prefills[i] = _PendingPrefill(
             req=req, row_k=row_k, row_v=row_v, done=done,
-            ids=list(req.ids), total_len=done + len(req.ids),
+            ids=list(req.ids), total_len=total_len,
+            cached_pages=cached_pages, cached_len=cached_len,
+            digests=digests,
         )
         self._advance_chunk(i)
 
@@ -2454,20 +2526,32 @@ class ContinuousBatcher:
             extra["topk_req"] = jnp.int32(req_k)
         if self.paged:
             blk = self.page_size
+            n_cached = len(pp.cached_pages)
             n_full = -(-(pp.total_len + req.max_new_tokens) // blk)
             n_init = min(n_full, -(-pp.total_len // blk) + 1)
-            if not self._ensure_pages(n_init, "admit",
+            if not self._ensure_pages(n_init - n_cached, "admit",
                                       below_priority=req.priority):
                 return  # retry the finish next round; prefill is kept
-            pages = self._alloc_pages(n_init)
+            pages = self._alloc_pages(n_init - n_cached)
             page_list = np.zeros((self.pages_per_row,), np.int32)
-            page_list[:n_init] = pages
+            page_list[:n_cached] = pp.cached_pages
+            page_list[n_cached:n_init] = pages
             self.tables[i] = page_list
+            # Cache-hit positions scatter to the scratch page: the shared
+            # pages already hold exactly that KV and other rows may be
+            # reading them (same write routing as admit_row_auto_paged).
+            write_list = page_list.copy()
+            write_list[:n_cached] = 0
             self.cache, tok, lp = finish_chunked_admission_paged(
-                self.cache, jnp.asarray(page_list), pp.row_k, pp.row_v,
+                self.cache, jnp.asarray(write_list), pp.row_k, pp.row_v,
                 pp.last_logits, self._split_rng(), **self.sampling, **extra,
             )
+            # Publish the freshly-written full prompt pages (first writer
+            # wins) — the cached run is already published.
+            for j in range(n_cached, len(pp.digests)):
+                self.pool.publish_prefix(int(page_list[j]), pp.digests[j])
             row_valid = np.arange(self.s) < pp.total_len
+            pages = pp.cached_pages + pages
         else:
             pages = []
             self.cache, tok, row_valid, lp = finish_chunked_admission(
@@ -2477,7 +2561,8 @@ class ContinuousBatcher:
             )
         del self._prefills[i]
         self._activate_row(i, req, tok, lp, row_valid, pp.total_len,
-                           req_t, req_p, pages=pages, req_k=req_k)
+                           req_t, req_p, pages=pages, req_k=req_k,
+                           cached_len=pp.cached_len)
 
     def _collect(
         self, toks: np.ndarray, was_active: np.ndarray,

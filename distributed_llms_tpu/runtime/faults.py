@@ -46,6 +46,14 @@ Sites wired in this tree (callers pass ``tag`` where noted):
 - ``worker.result``       a worker about to answer, tag = command type
 - ``worker.handle``       a command handler about to run, tag = command type
 - ``coordinator.dispatch``  a task about to be sent, tag = task type
+- ``router.place``        one hit per router placement decision, tag = the
+  chosen replica (``drop`` vetoes it — the router spills to the next-best
+  healthy replica)
+- ``replica.crash`` / ``replica.stall`` / ``replica.partition``  replica-
+  scoped chaos, fired once per fleet probe tick per replica with tag =
+  replica name (cluster/fleet.py): ``close`` kills the replica abruptly,
+  ``delay:<s>`` wedges its engine past the watchdog, ``drop[:<s>]``
+  partitions it from the router while it keeps running
 
 Actions ``raise`` (raises :class:`InjectedFault`) and ``stall`` (blocking
 sleep) are applied by :meth:`FaultPlane.fire` itself; the context-specific
@@ -94,6 +102,22 @@ FAULT_SITES: dict[str, str] = {
         "a worker command handler about to run (tag = command type)",
     "coordinator.dispatch":
         "a task about to be sent to a worker (tag = task type)",
+    "router.place":
+        "each router placement decision (tag = chosen replica name); "
+        "'drop' vetoes the choice and spills to the next-best replica",
+    "replica.crash":
+        "one fleet probe tick per replica (tag = replica name); 'close' "
+        "(or 'raise') kills the replica process-style — connections "
+        "severed unflushed, engine reaped, no drain",
+    "replica.stall":
+        "one fleet probe tick per replica (tag = replica name); "
+        "'delay:<s>' (or 'stall:<s>' — deferred, never blocks the fleet "
+        "loop) wedges the replica's engine for <s> seconds (one blocking "
+        "stall on its next decode chunk — the watchdog drill)",
+    "replica.partition":
+        "one fleet probe tick per replica (tag = replica name); "
+        "'drop[:<s>]' makes the replica unreachable from the router for "
+        "<s> seconds (no arg: until respawn) while it keeps running",
 }
 
 
@@ -220,13 +244,20 @@ class FaultPlane:
         self.rules.append(rule)
         return rule
 
-    def fire(self, site: str, tag: str | None = None) -> FaultRule | None:
+    def fire(self, site: str, tag: str | None = None,
+             defer_stall: bool = False) -> FaultRule | None:
         """Record a traversal of ``site`` and trigger the first due rule.
 
         ``raise`` rules raise :class:`InjectedFault`; ``stall`` rules sleep
         ``arg`` seconds here (blocking — they model a wedged device call).
         Every other action is returned as the rule for the call site to
         apply.  Returns ``None`` when nothing fired.
+
+        ``defer_stall=True`` returns a due ``stall`` rule instead of
+        sleeping — for sites traversed by an asyncio event loop (the
+        fleet's ``replica.*`` ticks), where a blocking sleep would freeze
+        every replica's probing and the router itself; the caller applies
+        the stall semantics non-blockingly.
         """
         hit: FaultRule | None = None
         for rule in self.rules:
@@ -247,7 +278,7 @@ class FaultPlane:
                 f"injected fault at {site}"
                 f"{'/' + tag if tag else ''} (rule {hit.describe()})"
             )
-        if hit.action == "stall":
+        if hit.action == "stall" and not defer_stall:
             # graftlint: ignore[GL401](stall deliberately blocks the engine thread — it models a wedged device call for the watchdog)
             time.sleep(hit.arg or 0.0)
         return hit

@@ -1,0 +1,549 @@
+"""Replica router: health-aware placement with EXACT failover over a
+fleet of independent serving replicas (cluster/fleet.py).
+
+PRs 2-3 made one engine crash-safe and overload-safe; this tier makes the
+SERVICE replica-safe.  N full server/batcher stacks (each with its own
+PR-2 supervisor, watchdog, and overload plane) sit behind one HTTP front
+door that:
+
+- **Places health-aware.**  Candidates are the replicas the fleet's
+  ``/healthz`` probes currently call routable.  Among them, placement
+  follows PREFIX AFFINITY first: the router hashes the request's prompt
+  with the same chained page digests the automatic prefix cache uses
+  (``PrefixCache.page_digests``), and a replica that recently served the
+  longest matching page-run gets the request — its pool already holds
+  those pages, so admission prefills only the suffix.  A sticky replica
+  substantially hotter than the least-loaded one is skipped (affinity
+  must not defeat load balancing); everything else goes LEAST COMMITTED
+  first, by the router's own token-mass accounting (prompt + budget per
+  in-flight request, the same estimate the server's cost gate uses).
+- **Fails over EXACTLY.**  A replica dying (connection reset), wedging
+  past its watchdog (probe 503 -> fleet aborts its in-flight proxies), or
+  partitioning mid-request fails the upstream leg.  If ZERO payload bytes
+  reached the client, the request is re-sent VERBATIM (same body bytes) to
+  another healthy replica — at temperature 0 the re-decode is
+  token-identical, the same recompute-is-exact contract the PR-2
+  supervisor pinned in-process, now one level up.  Retries are bounded
+  (``max_failover_retries``); exhaustion answers 503 + ``Retry-After``
+  with a structured ``engine_error``.  If bytes HAD streamed, the deltas
+  cannot be retracted: the stream ends with a structured ``engine_error``
+  event — the mailbox contract, mirrored at the fleet tier.  (SSE
+  responses hold the client's headers until the first upstream payload
+  byte, so "zero-streamed" stays decidable per request.)
+- **Sheds like the replicas do.**  A replica's own structured 429/503
+  (cost gate, queue full, queue-deadline shed — type ``overloaded_error``)
+  passes through untouched WITH its ``Retry-After``; an infrastructure 503
+  (draining / unhealthy gate) is a placement mistake and fails over
+  instead.  No routable replica at all answers 503 + ``Retry-After``.
+
+Rolling drain/respawn and replica-scoped chaos (``replica.crash`` /
+``replica.stall`` / ``replica.partition``) live with the fleet; the
+router's own injection site is ``router.place`` (tag = chosen replica;
+``drop`` vetoes the choice).  Everything here is event-loop confined —
+the router owns no engine thread.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import time
+
+from ..core.observability import METRICS, get_logger
+from .batcher import PrefixCache
+# One definition of the HTTP front-door limits/reasons/error shape for
+# both tiers — the router must shed/parse exactly like the replicas do.
+from .server import (
+    _MAX_BODY, _MAX_HEADERS, _MAX_REQUEST_LINE, _REASONS, _err_body,
+)
+
+log = get_logger("router")
+
+
+class _UpstreamFailed(Exception):
+    """One upstream leg failed (connection error, abort, infrastructure
+    503).  Whether the request may fail over is the caller's decision,
+    keyed on how many payload bytes already reached the client."""
+
+
+class _Inflight:
+    """One proxied request's registration on a replica handle: the fleet
+    sets ``abort`` when the replica stops being trustworthy; ``streamed``
+    flips once payload bytes reached the client (the point of no return
+    for failover)."""
+
+    __slots__ = ("abort", "streamed")
+
+    def __init__(self) -> None:
+        self.abort = asyncio.Event()
+        self.streamed = False
+
+
+class ReplicaRouter:
+    """HTTP front door over a :class:`cluster.fleet.ReplicaFleet`."""
+
+    def __init__(
+        self,
+        fleet,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        tokenizer=None,  # for prompt hashing/cost on text prompts
+        page_size: int = 64,  # affinity block size — match the replicas'
+        max_failover_retries: int = 2,
+        affinity_max: int = 4096,  # digest -> replica entries kept (LRU)
+        # Affinity yields to load balance once the sticky replica's
+        # committed mass exceeds spill_factor * least-loaded + request.
+        spill_factor: float = 2.0,
+        faults=None,
+    ) -> None:
+        self.fleet = fleet
+        self.host = host
+        self.port = port
+        self.tokenizer = tokenizer
+        self.page_size = page_size
+        self.max_failover_retries = max_failover_retries
+        self.affinity_max = affinity_max
+        self.spill_factor = spill_factor
+        self.faults = faults
+        # digest -> replica name, most-recently-used last; event-loop
+        # confined like every router/fleet structure (no engine thread
+        # ever touches it).
+        from collections import OrderedDict
+
+        self._affinity: "OrderedDict[bytes, str]" = OrderedDict()
+        self._server: asyncio.base_events.Server | None = None
+        self._loop: asyncio.AbstractEventLoop | None = None
+        self._conns: set[asyncio.StreamWriter] = set()
+
+    # -- lifecycle ---------------------------------------------------------
+
+    async def start(self) -> tuple[str, int]:
+        self._loop = asyncio.get_running_loop()
+        self._server = await asyncio.start_server(
+            self._handle, self.host, self.port
+        )
+        addr = self._server.sockets[0].getsockname()
+        log.info("router fronting %d replica(s) on http://%s:%s",
+                 len(self.fleet.replicas), addr[0], addr[1])
+        return addr[0], addr[1]
+
+    @property
+    def bound_port(self) -> int:
+        assert self._server is not None
+        return self._server.sockets[0].getsockname()[1]
+
+    async def stop(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            for w in list(self._conns):
+                w.close()
+            await self._server.wait_closed()
+
+    # -- placement ---------------------------------------------------------
+
+    def _digests(self, prompt_ids: list[int] | None) -> list[bytes]:
+        """Chained page digests of the prompt's FULL pages, capped one
+        page short (the replica-side cache caps hits the same way)."""
+        if not prompt_ids or self.page_size <= 0:
+            return []
+        n = max(0, (len(prompt_ids) - 1) // self.page_size)
+        return PrefixCache.page_digests(prompt_ids, self.page_size, n)
+
+    def _place(self, digests: list[bytes], est_tokens: int,
+               exclude: set) -> "object | None":
+        """Pick a replica: prefix affinity on the longest known digest run,
+        spilling to least-committed when the sticky replica runs hot; the
+        ``router.place`` fault site (tag = choice) can veto a pick.
+        Returns None when no routable replica remains."""
+        now = self._loop.time()
+        cands = [h for h in self.fleet.replicas
+                 if h.routable(now) and h.name not in exclude]
+        while cands:
+            pick, hit = None, False
+            for d in reversed(digests):  # longest cached run first
+                name = self._affinity.get(d)
+                if name is None:
+                    continue
+                h = next((c for c in cands if c.name == name), None)
+                if h is not None:
+                    pick, hit = h, True
+                    break
+            least = min(cands, key=lambda h: (h.committed_tokens, h.name))
+            if pick is None:
+                pick = least
+            elif (pick.committed_tokens
+                  > self.spill_factor * least.committed_tokens + est_tokens):
+                pick, hit = least, False  # affinity must not defeat balance
+            if self.faults is not None:
+                rule = self.faults.fire("router.place", tag=pick.name)
+                if rule is not None and rule.action == "drop":
+                    cands = [c for c in cands if c.name != pick.name]
+                    continue
+            METRICS.inc("router.placements")
+            if hit:
+                METRICS.inc("router.affinity_hits")
+            return pick
+        return None
+
+    def _record_affinity(self, digests: list[bytes], name: str) -> None:
+        for d in digests:
+            self._affinity[d] = name
+            self._affinity.move_to_end(d)
+        while len(self._affinity) > self.affinity_max:
+            self._affinity.popitem(last=False)
+
+    def _estimate(self, req: dict, chat: bool) -> tuple[list[int] | None, int]:
+        """(prompt token ids or None, estimated prompt+budget token mass).
+        Pure best-effort — bad fields fall back to coarse estimates and
+        the replica's own validation answers the client."""
+        ids: list[int] | None = None
+        try:
+            if chat:
+                msgs = req.get("messages")
+                text = " ".join(
+                    m.get("content", "") for m in msgs
+                ) if isinstance(msgs, list) else ""
+                if self.tokenizer is not None and text:
+                    ids = self.tokenizer.encode(text)
+                n_prompt = len(ids) if ids is not None else len(text) // 4
+            else:
+                prompt = req.get("prompt")
+                if isinstance(prompt, list):
+                    ids = [t for t in prompt if isinstance(t, int)]
+                    n_prompt = len(ids)
+                elif isinstance(prompt, str) and self.tokenizer is not None:
+                    ids = self.tokenizer.encode(prompt)
+                    n_prompt = len(ids)
+                else:
+                    n_prompt = len(prompt) // 4 if isinstance(prompt, str) else 0
+            budget = req.get(
+                "max_completion_tokens" if chat else "max_tokens", 16)
+            budget = budget if isinstance(budget, int) \
+                and not isinstance(budget, bool) and budget > 0 else 16
+        except (TypeError, AttributeError):
+            return None, 16
+        return ids, n_prompt + budget
+
+    # -- the proxy core ----------------------------------------------------
+
+    async def _proxy(self, writer, method: str, path: str, body: bytes,
+                     chat: bool) -> None:
+        try:
+            req = json.loads(body or b"{}")
+            req = req if isinstance(req, dict) else {}
+        except json.JSONDecodeError:
+            req = {}  # the replica answers the 400; placement needs no parse
+        prompt_ids, est = self._estimate(req, chat)
+        digests = self._digests(prompt_ids)
+        payload = (
+            f"{method} {path} HTTP/1.1\r\nHost: replica\r\n"
+            f"Content-Length: {len(body)}\r\n\r\n"
+        ).encode() + body
+        METRICS.inc("router.requests")
+        tried: set[str] = set()
+        attempts = 0
+        t_fail: float | None = None
+        while True:
+            h = self._place(digests, est, exclude=tried)
+            if h is None:
+                if attempts:
+                    # The request actually FAILED on a replica and no
+                    # healthy candidate remains: that is an engine
+                    # failure (the documented exhaustion contract), not
+                    # ordinary overload.
+                    await self._exhausted(
+                        writer, attempts,
+                        f"request failed on {attempts} replica(s) and no "
+                        "healthy replica remains; retry later",
+                    )
+                else:
+                    await self._shed(writer, "no healthy replica available")
+                return
+            rec = _Inflight()
+            h.inflight.add(rec)
+            h.committed_tokens += est
+            METRICS.set_gauge(
+                f"router.committed_tokens.{h.name}", h.committed_tokens
+            )
+            self._record_affinity(digests, h.name)
+            try:
+                await self._forward(writer, h, payload, rec)
+                if t_fail is not None:
+                    # Failover recovery latency: failure observed ->
+                    # re-placed request fully answered.
+                    METRICS.observe(
+                        "router.failover_seconds",
+                        time.perf_counter() - t_fail,
+                    )
+                return
+            except _UpstreamFailed as e:
+                if rec.streamed:
+                    # Deltas already reached the client — the PR-2
+                    # mailbox contract one level up: structured
+                    # engine_error, never a silent truncation.
+                    METRICS.inc("router.failed_streamed")
+                    await self._stream_error(writer)
+                    return
+                tried.add(h.name)
+                attempts += 1
+                if t_fail is None:
+                    t_fail = time.perf_counter()
+                METRICS.inc("router.failovers")
+                log.warning(
+                    "replica %s failed zero-streamed request (%s); "
+                    "failover attempt %d", h.name, e, attempts,
+                )
+                if attempts > self.max_failover_retries:
+                    await self._exhausted(
+                        writer, attempts,
+                        f"request failed on {attempts} replica(s); "
+                        "retry later",
+                    )
+                    return
+            finally:
+                h.inflight.discard(rec)
+                h.committed_tokens -= est
+                METRICS.set_gauge(
+                    f"router.committed_tokens.{h.name}", h.committed_tokens
+                )
+
+    async def _up(self, awaitable, rec: _Inflight):
+        """Await one upstream read, racing the replica's abort signal —
+        the fleet sets it when the replica dies, wedges past the watchdog,
+        partitions, or drains out from under us."""
+        read_t = asyncio.ensure_future(awaitable)
+        abort_t = asyncio.ensure_future(rec.abort.wait())
+        try:
+            done, _ = await asyncio.wait(
+                {read_t, abort_t}, return_when=asyncio.FIRST_COMPLETED
+            )
+        finally:
+            abort_t.cancel()
+        if read_t not in done:
+            read_t.cancel()
+            try:
+                await read_t
+            except (Exception, asyncio.CancelledError):
+                pass
+            raise _UpstreamFailed("replica became unhealthy mid-request")
+        try:
+            return read_t.result()
+        except (ConnectionError, OSError, EOFError,
+                asyncio.IncompleteReadError) as e:
+            raise _UpstreamFailed(f"{type(e).__name__}: {e}") from e
+
+    async def _forward(self, writer, h, payload: bytes,
+                       rec: _Inflight) -> None:
+        """One upstream leg.  Raises :class:`_UpstreamFailed` when the
+        replica failed us; client-side socket errors propagate as-is
+        (they must never trigger a failover re-send)."""
+        now = self._loop.time()
+        if not h.reachable(now) or rec.abort.is_set():
+            raise _UpstreamFailed("replica unreachable")
+        try:
+            up_r, up_w = await asyncio.open_connection(h.host, h.port)
+        except (ConnectionError, OSError) as e:
+            raise _UpstreamFailed(f"connect: {e}") from e
+        try:
+            up_w.write(payload)
+            await self._up(up_w.drain(), rec)
+            status_line = await self._up(up_r.readline(), rec)
+            try:
+                status = int(status_line.split()[1])
+            except (IndexError, ValueError) as e:
+                raise _UpstreamFailed("bad upstream status line") from e
+            raw_head = [status_line]
+            headers: dict[str, str] = {}
+            for _ in range(_MAX_HEADERS):
+                line = await self._up(up_r.readline(), rec)
+                raw_head.append(line)
+                if line in (b"\r\n", b"\n", b""):
+                    break
+                name, _, value = line.decode("latin-1", "replace").partition(":")
+                headers[name.strip().lower()] = value.strip()
+            head = b"".join(raw_head)
+            if "text/event-stream" in headers.get("content-type", ""):
+                # SSE: forward incrementally.  The client's headers are
+                # HELD until the first upstream payload byte, so a replica
+                # dying pre-first-token still fails over exactly.
+                first = True
+                while True:
+                    chunk = await self._up(up_r.read(65536), rec)
+                    if not chunk:
+                        if first:
+                            raise _UpstreamFailed("stream died before data")
+                        return
+                    if first:
+                        writer.write(head)
+                        first = False
+                    rec.streamed = True
+                    writer.write(chunk)
+                    await writer.drain()
+            clen = headers.get("content-length")
+            if clen is not None:
+                body = await self._up(up_r.readexactly(int(clen)), rec)
+            else:
+                body = await self._up(up_r.read(), rec)
+            if status == 503 and b"overloaded_error" not in body:
+                # Infrastructure 503 (draining / unhealthy gate): a
+                # placement mistake, not an answer — fail over.  A
+                # structured shed IS the replica's answer and passes
+                # through with its Retry-After.
+                raise _UpstreamFailed("replica not ready (503)")
+            if status == 500 and (b"engine_error" in body
+                                  or b"shutting down" in body):
+                # Dead supervisor / replica mid-shutdown: nothing streamed
+                # (buffered path), so the request is safe to re-place.
+                raise _UpstreamFailed("replica engine dead (500)")
+            writer.write(head + body)
+            await writer.drain()
+            rec.streamed = True
+        finally:
+            up_w.close()
+
+    async def _stream_error(self, writer) -> None:
+        """Terminate a partially-forwarded SSE stream with the structured
+        mid-stream error event (the replica server's own idiom)."""
+        try:
+            writer.write(
+                b"data: " + json.dumps(_err_body(
+                    "replica failed mid-stream; partial output could not "
+                    "be resumed", "engine_error",
+                )).encode() + b"\n\n"
+            )
+            await writer.drain()
+        except (ConnectionError, OSError):
+            pass
+
+    def _retry_after_s(self) -> int:
+        """Coarse back-off hint: one tick when replicas are merely busy,
+        scaling with how much of the fleet is unavailable."""
+        now = self._loop.time() if self._loop is not None else 0.0
+        total = max(1, len(self.fleet.replicas))
+        down = sum(1 for h in self.fleet.replicas if not h.routable(now))
+        return int(min(30, max(1, 1 + 4 * down * down / total)))
+
+    async def _shed(self, writer, msg: str) -> None:
+        await self._json(
+            writer, 503, _err_body(msg, "overloaded_error"),
+            headers={"Retry-After": str(self._retry_after_s())},
+        )
+
+    async def _exhausted(self, writer, attempts: int, msg: str) -> None:
+        """Failover budget (or candidate pool) exhausted on a request that
+        actually FAILED on >= 1 replica: structured, retryable
+        ``engine_error`` + Retry-After."""
+        METRICS.inc("router.retries_exhausted")
+        await self._json(
+            writer, 503, _err_body(msg, "engine_error"),
+            headers={"Retry-After": str(self._retry_after_s())},
+        )
+
+    # -- HTTP plumbing -----------------------------------------------------
+
+    async def _handle(self, reader: asyncio.StreamReader,
+                      writer: asyncio.StreamWriter) -> None:
+        self._conns.add(writer)
+        try:
+            parsed = await asyncio.wait_for(
+                self._read_request(writer, reader), 30.0
+            )
+            if parsed is None:
+                return
+            method, path, body = parsed
+            await self._route(writer, method, path, body)
+        except (asyncio.TimeoutError, ConnectionError, OSError, ValueError,
+                EOFError):
+            pass
+        finally:
+            self._conns.discard(writer)
+            writer.close()
+
+    async def _read_request(self, writer, reader):
+        line = await reader.readline()
+        if len(line) > _MAX_REQUEST_LINE:
+            await self._plain(writer, 431, "request line too long")
+            return None
+        parts = line.decode("latin-1", "replace").split()
+        if len(parts) < 2:
+            await self._plain(writer, 400, "bad request")
+            return None
+        method, path = parts[0], parts[1]
+        content_len = 0
+        for _ in range(_MAX_HEADERS):
+            h = await reader.readline()
+            if h in (b"\r\n", b"\n", b""):
+                break
+            name, _, value = h.decode("latin-1", "replace").partition(":")
+            hname = name.strip().lower()
+            if hname == "content-length":
+                try:
+                    content_len = int(value.strip())
+                except ValueError:
+                    await self._plain(writer, 400, "bad content-length")
+                    return None
+            elif hname == "transfer-encoding":
+                # Only Content-Length bodies are read (the replica server
+                # enforces the same): a chunked POST would forward an
+                # EMPTY body and surface as a misleading replica-side 400.
+                await self._plain(writer, 501, "chunked bodies not supported")
+                return None
+        else:
+            await self._plain(writer, 431, "too many headers")
+            return None
+        if content_len > _MAX_BODY:
+            await self._plain(writer, 413, "body too large")
+            return None
+        body = await reader.readexactly(content_len) if content_len else b""
+        return method, path, body
+
+    async def _route(self, writer, method: str, path: str,
+                     body: bytes) -> None:
+        if method == "GET" and path == "/healthz":
+            report = self.fleet.report()
+            code = 200 if report["healthy"] > 0 else 503
+            report["status"] = "ok" if code == 200 else "unhealthy"
+            await self._json(writer, code, report, headers=(
+                None if code == 200
+                else {"Retry-After": str(self._retry_after_s())}
+            ))
+        elif method == "GET" and path == "/metrics":
+            await self._respond(
+                writer, 200, "text/plain; version=0.0.4; charset=utf-8",
+                METRICS.prometheus_text().encode(),
+            )
+        elif method == "GET" and path == "/v1/models":
+            await self._proxy(writer, method, path, b"", chat=False)
+        elif method == "POST" and path in ("/v1/completions",
+                                           "/v1/chat/completions"):
+            await self._proxy(writer, method, path, body,
+                              chat="chat" in path)
+        elif method not in ("GET", "POST"):
+            await self._plain(writer, 405, "method not allowed")
+        else:
+            await self._plain(writer, 404, "not found")
+
+    async def _plain(self, writer, code: int, body: str) -> None:
+        await self._respond(writer, code, "text/plain", body.encode())
+
+    async def _json(self, writer, code: int, obj: dict,
+                    headers: dict[str, str] | None = None) -> None:
+        await self._respond(
+            writer, code, "application/json",
+            (json.dumps(obj) + "\n").encode(), headers=headers,
+        )
+
+    async def _respond(self, writer, code: int, ctype: str, payload: bytes,
+                       headers: dict[str, str] | None = None) -> None:
+        extra = "".join(f"{k}: {v}\r\n" for k, v in (headers or {}).items())
+        writer.write(
+            (
+                f"HTTP/1.1 {code} {_REASONS.get(code, '')}\r\n"
+                f"Content-Type: {ctype}\r\n"
+                f"Content-Length: {len(payload)}\r\n"
+                f"{extra}"
+                "Connection: close\r\n\r\n"
+            ).encode()
+            + payload
+        )
+        await writer.drain()

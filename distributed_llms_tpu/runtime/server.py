@@ -308,6 +308,29 @@ class InferenceServer:
         rows cancel at their next chunk instead of running to completion."""
         self._stopping = True
 
+    async def kill(self) -> None:
+        """Abrupt-death simulation (replica chaos drills, cluster/fleet.py):
+        sever every open connection WITHOUT flushing, stop accepting, and
+        reap the engine thread — the closest an in-process replica gets to
+        SIGKILL.  Unlike :meth:`stop`, nothing drains gracefully: clients
+        observe reset sockets mid-response, exactly what a crashed process
+        produces, so a fronting router exercises its real failover path."""
+        self._draining = True
+        self._stopping = True
+        if self._server is not None:
+            self._server.close()
+        for w in list(self._conns):
+            w.close()
+        with self._submit_lock:
+            for rid in list(self._requests):
+                self._cancelled.add(rid)
+        self._work.set()
+        if self._engine is not None:
+            # Cancel flags drain run() within one chunk; never block the loop.
+            await asyncio.to_thread(self._engine.join, 60.0)
+        if self._server is not None:
+            await self._server.wait_closed()
+
     # -- engine thread -----------------------------------------------------
 
     def _inflight(self) -> int:
@@ -643,6 +666,9 @@ class InferenceServer:
             "draining": self._draining,
             "inflight_requests": inflight,
             "engine_restarts": self._restarts,
+            # Queued + resident token mass: the load signal a fronting
+            # replica router reads for least-committed placement.
+            "committed_tokens": self._pending_token_mass(),
         }
 
     async def _route(self, writer, method: str, path: str, body: bytes,

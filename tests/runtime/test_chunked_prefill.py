@@ -84,6 +84,49 @@ def test_chunked_prefix_cached_matches(tiny):
     assert len(pb.run()[rid]) == 4
 
 
+def test_chunked_paged_auto_prefix_cache_hit_matches(tiny):
+    """Chunked prefill now CONSULTS the automatic prefix cache (the PR-3
+    TODO): a chunked admission whose prompt's leading pages are cached
+    seeds its transient row from the shared pages and chunks only the
+    un-cached suffix — tokens stay temp-0 identical to the monolithic
+    contiguous run, the hit is accounted, and the retained pages release
+    cleanly on completion AND on a mid-prefill cancel."""
+    from distributed_llms_tpu.core.observability import METRICS
+
+    cfg, params = tiny
+    shared = [((i * 37) % 450) + 1 for i in range(36)]
+    reqs = [
+        (shared + [7, 1, 9], 6, {}),      # publishes the shared pages
+        (shared + [4, 4, 2, 8], 5, {}),   # 40 tokens: 2 full cached pages
+    ]
+    _, rp, plain = _run(cfg, params, reqs)
+    b = ContinuousBatcher(
+        cfg, params, batch_slots=2, max_len=96, chunk_steps=4,
+        prefill_chunk=6, paged_pages=16, page_size=16, prefix_cache=True,
+    )
+    r1 = b.submit(reqs[0][0], max_new_tokens=6)
+    assert b.run()[r1] == plain[rp[0]]
+    assert b.prefix_cache.hit_tokens == 0  # first writer: all miss
+    chunks0 = METRICS.get_counter("batcher.prefill_chunks")
+    r2 = b.submit(reqs[1][0], max_new_tokens=5)
+    res = b.run()
+    assert res[r2] == plain[rp[1]]
+    # The cached run (2 full pages = 32 tokens) seeded the row; only the
+    # 8-token suffix chunked through the model (2 bites at chunk=6).
+    assert b.prefix_cache.hit_tokens == 32
+    assert b.prefix_cached_tokens[r2] == 32
+    assert METRICS.get_counter("batcher.prefill_chunks") - chunks0 == 2
+    b.assert_pool_consistent()
+    # Mid-prefill cancel: the reserving row holds the retained cached
+    # pages; cancel releases them and the allocator audits clean.
+    r3 = b.submit(shared + [9, 9, 9], max_new_tokens=4)
+    b._admit_pending()  # one 6-token bite of the 7-token suffix: pending
+    assert b.rows[0].prefilling and len(b.rows[0].pages) == 2
+    assert b.cancel_row(r3)
+    assert not b._prefills
+    b.assert_pool_consistent()
+
+
 def test_chunked_streaming_and_sampling(tiny):
     """Streaming reassembles exactly (first token streams at admission
     completion) and greedy rows stay bit-exact vs monolithic even while a

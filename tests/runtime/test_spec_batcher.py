@@ -96,6 +96,53 @@ def test_spec_batcher_prefix_caching(models):
         assert plain[a] == spec[b2]
 
 
+def test_spec_preemption_recompute_exact(models):
+    """ROADMAP item 5 corner (spec-decode x preemption): preempt a row
+    BETWEEN speculative rounds, mid-generation — the resume request
+    re-prefills prompt + emitted prefix into BOTH the target and draft
+    caches (admit_row + admit_row_kv) and the reunited stream is temp-0
+    bit-identical to the unpreempted plain run, with nothing re-delivered
+    and done fired exactly once across both residencies."""
+    cfg, params, dcfg, dparams = models
+    from distributed_llms_tpu.core.observability import METRICS
+
+    reqs = [([7, 1, 9, 4, 2], 12), ([4, 4, 4], 10)]
+    _, rp, plain = _run(cfg, params, reqs)
+    preempt0 = METRICS.get_counter("batcher.preemptions_total")
+    b = ContinuousBatcher(
+        cfg, params, batch_slots=2, max_len=64, chunk_steps=4,
+        draft_params=dparams, draft_cfg=dcfg, spec_k=3,
+    )
+    rids = [b.submit(ids, max_new_tokens=n) for ids, n in reqs]
+    streamed = {r: [] for r in rids}
+    dones = {r: 0 for r in rids}
+    state = {"preempted": False}
+
+    def cb(rid, toks, done, lps):
+        streamed[rid].extend(toks)
+        if done:
+            dones[rid] += 1
+        # Preempt rid[0]'s row once a few tokens streamed but well before
+        # its budget: the callback runs between device chunks — the
+        # documented safe point (the same contract cancel_row uses).
+        if (not state["preempted"] and rid == rids[0] and not done
+                and len(streamed[rids[0]]) >= 4):
+            slot = next(
+                i for i, r in enumerate(b.rows) if r.rid == rids[0]
+            )
+            if b.active[slot]:
+                b._preempt_row(slot, "spec-preemption drill")
+                state["preempted"] = True
+
+    res = b.run(on_tokens=cb)
+    assert state["preempted"], "preemption never fired"
+    assert METRICS.get_counter("batcher.preemptions_total") > preempt0
+    for a, c in zip(rp, rids):
+        assert plain[a] == res[c], (a, plain[a], res[c])
+        assert streamed[c] == res[c], "stream diverged across residencies"
+        assert dones[c] == 1
+
+
 def test_spec_batcher_near_capacity(models):
     """REGRESSION (r4 review): a request filling its slot exactly
     (prompt + max_new_tokens == max_len) makes the last verify write k+1

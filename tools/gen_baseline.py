@@ -57,6 +57,9 @@ def _result_cell(row: dict) -> str:
         ("completed_frac", "completed frac"),
         ("engine_restarts", "engine restarts"),
         ("requests_retried", "requests retried"),
+        ("replicas", "replicas"),
+        ("exact", "byte-exact"),
+        ("failovers", "failovers"),
         ("goodput_tok_per_s", "goodput tok/s"),
         ("offered_x", "offered load x"),
         ("shed_frac", "shed frac"),
@@ -99,7 +102,7 @@ def generate(ladder_path: str) -> str:
         # Aux rows run_ladder appends after the decode configs.
         "serving-latency", "continuous-batching", "local-proc-batching",
         "chunked-prefill", "prefix-cache-ttft", "fault-recovery",
-        "overload-goodput", "compile-stability",
+        "overload-goodput", "replica-failover", "compile-stability",
         "ragged-decode-8k", "ragged-decode-win-8k", "quant-matmul-bw",
         "spec-decode", "spec-decode-7b-int8", "spec-batching",
         "paged-batching", "prefill-flash-2048", "prefill-flash-8192",
