@@ -1222,6 +1222,28 @@ def _measure_prefix_cache_ttft(
     }
 
 
+async def _serving_post(host: str, port: int, req: dict):
+    """One raw POST /v1/completions against a serving replica/router —
+    the ONE mini-client every serving bench shares (status parse, header
+    skip, read-to-EOF body).  Returns (status, parsed JSON body)."""
+    import asyncio
+    import json as _json
+
+    reader, writer = await asyncio.open_connection(host, port)
+    body = _json.dumps(req).encode()
+    writer.write(
+        f"POST /v1/completions HTTP/1.1\r\nHost: b\r\n"
+        f"Content-Length: {len(body)}\r\n\r\n".encode() + body
+    )
+    await writer.drain()
+    status = int((await reader.readline()).split()[1])
+    while (await reader.readline()) not in (b"\r\n", b"\n", b""):
+        pass
+    out = _json.loads(await reader.read())
+    writer.close()
+    return status, out
+
+
 def _measure_fault_recovery(
     preset: str | None = None, dtype: str = "bfloat16",
     requests: int = 8, new_tokens: int = 24, page_size: int = 16,
@@ -1264,21 +1286,9 @@ def _measure_fault_recovery(
     warm.run()
 
     async def one_request(host, port, i):
-        reader, writer = await asyncio.open_connection(host, port)
-        body = _json.dumps({
+        return await _serving_post(host, port, {
             "prompt": f"request number {i}", "max_tokens": new_tokens,
-        }).encode()
-        writer.write(
-            f"POST /v1/completions HTTP/1.1\r\nHost: b\r\n"
-            f"Content-Length: {len(body)}\r\n\r\n".encode() + body
-        )
-        await writer.drain()
-        status = int((await reader.readline()).split()[1])
-        while (await reader.readline()) not in (b"\r\n", b"\n", b""):
-            pass
-        out = _json.loads(await reader.read())
-        writer.close()
-        return status, out
+        })
 
     async def drive() -> dict:
         plane = FaultPlane.parse("batcher.decode:raise@2")
@@ -1378,19 +1388,9 @@ def _measure_replica_failover(
     wants = {p: tok.decode(ref_res[r]) for p, r in zip(prompts, rids)}
 
     async def one_request(host, port, p):
-        reader, writer = await asyncio.open_connection(host, port)
-        body = _json.dumps({"prompt": p, "max_tokens": new_tokens}).encode()
-        writer.write(
-            f"POST /v1/completions HTTP/1.1\r\nHost: b\r\n"
-            f"Content-Length: {len(body)}\r\n\r\n".encode() + body
+        return await _serving_post(
+            host, port, {"prompt": p, "max_tokens": new_tokens}
         )
-        await writer.drain()
-        status = int((await reader.readline()).split()[1])
-        while (await reader.readline()) not in (b"\r\n", b"\n", b""):
-            pass
-        out = _json.loads(await reader.read())
-        writer.close()
-        return status, out
 
     async def drive() -> dict:
         fleet = ReplicaFleet([make_server] * replicas,
@@ -1447,6 +1447,187 @@ def _measure_replica_failover(
             "recovery_ms": round(hist["max"] * 1e3, 1),
             "goodput_tok_per_s": round(good_tokens / wall, 1),
             "wall_ms": round(wall * 1e3, 1),
+        }
+
+    out = asyncio.run(drive())
+    out.update({"preset": preset, "platform": jax.devices()[0].platform})
+    return out
+
+
+def _measure_disagg_handoff(
+    preset: str | None = None, dtype: str = "bfloat16",
+    shorts: int = 2, longs: int = 2, new_tokens: int = 48,
+    page_size: int = 16,
+) -> dict:
+    """Disaggregated prefill/decode (runtime/router.py handoff plane +
+    cluster/kv_transfer.py): short requests are mid-decode when LONG
+    prompts arrive — colocated, each long's monolithic prefill runs ON
+    the decoding engine and stalls every in-flight stream for its whole
+    forward; disaggregated, the prefill tier absorbs it and the decode
+    engine admits only a < 1-page suffix.  Stamped: the shorts'
+    completion time under that interference in both topologies (the
+    decode-tok/s interference the handoff exists to remove), the
+    verified handoff's latency (prefill + transfer + import), and the
+    fallback recovery time when the prefill tier is KILLED (the next
+    long request degrades to colocated prefill — byte-exact, just
+    slower).  Every 200 is byte-compared against an un-faulted
+    colocated reference.  A host-scheduling effect, honestly measurable
+    on any platform."""
+    import asyncio
+    import json as _json
+
+    from distributed_llms_tpu.cluster.fleet import ReplicaFleet
+    from distributed_llms_tpu.core.observability import METRICS
+    from distributed_llms_tpu.runtime.batcher import ContinuousBatcher
+    from distributed_llms_tpu.runtime.router import ReplicaRouter
+    from distributed_llms_tpu.runtime.server import InferenceServer
+    from distributed_llms_tpu.runtime.tokenizer import ByteTokenizer
+
+    preset = preset or ("gpt2-125m" if jax.devices()[0].platform == "cpu"
+                        else "tinyllama-1.1b")
+    cfg, params = _build_params(preset, dtype, None)
+    tok = ByteTokenizer()
+    max_len = 16 * page_size  # long prompts span ~14 full pages
+    slots = 4  # shorts keep decoding while longs admit beside them
+
+    def make_batcher():
+        return ContinuousBatcher(
+            cfg, params, tokenizer=tok, eos_id=tok.eos_id, pad_id=tok.pad_id,
+            batch_slots=slots, max_len=max_len, chunk_steps=4,
+            paged_pages=slots * (max_len // page_size) + 9,
+            page_size=page_size, prefix_cache=True,
+        )
+
+    def make_server(role):
+        return InferenceServer(
+            make_batcher(), model_name="bench", host="127.0.0.1", port=0,
+            batcher_factory=make_batcher, watchdog_timeout_s=10.0, role=role,
+        )
+
+    long_prompts = [
+        f"disaggregation long prompt {i:02d} " * 8 for i in range(longs)
+    ]
+    short_prompts = [f"short request {i:02d}" for i in range(shorts)]
+    # The fallback-recovery probe must be a FRESH prompt: a re-sent one
+    # would be affinity-warm on the decode replica and the router would
+    # (correctly) skip the handoff instead of degrading it.
+    fallback_prompt = "fallback recovery probe xx " * 8
+    reqs = [(p, 4) for p in long_prompts + [fallback_prompt]] \
+        + [(p, new_tokens) for p in short_prompts]
+    ref = make_batcher()
+    rids = [ref.submit(p, max_new_tokens=n) for p, n in reqs]
+    ref_res = ref.run()
+    wants = {p: tok.decode(ref_res[r]) for r, (p, n) in zip(rids, reqs)}
+    # Warm the CACHE-HIT admission program (admit_row_auto_paged at the
+    # long prompts' exact bucket shapes): a handed-off request admits
+    # through it on the decode tier, and only the disaggregated leg would
+    # otherwise pay its compile — which would bill XLA compile time as
+    # "interference" against exactly one leg of the comparison.
+    for p in long_prompts:
+        ref.submit(p, max_new_tokens=2)
+    ref.run()
+
+    async def one_request(host, port, p, n):
+        t0 = time.perf_counter()
+        status, out = await _serving_post(
+            host, port, {"prompt": p, "max_tokens": n}
+        )
+        return status, out, (time.perf_counter() - t0) * 1e3
+
+    async def drive_leg(roles, names, handoff):
+        fleet = ReplicaFleet(
+            [(lambda r: (lambda: make_server(r)))(r) for r in roles],
+            names=names, probe_interval_s=0.05,
+        )
+        router = ReplicaRouter(fleet, host="127.0.0.1", port=0,
+                               tokenizer=tok, page_size=page_size,
+                               handoff=handoff)
+        await fleet.start()
+        host, port = await router.start()
+        assert await fleet.wait_healthy(timeout_s=120.0)
+        t0 = time.perf_counter()
+        # Shorts first; the longs land once the shorts are decoding, so
+        # their prefills interfere (or, disaggregated, don't).
+        short_tasks = [
+            asyncio.create_task(one_request(host, port, p, new_tokens))
+            for p in short_prompts
+        ]
+        await asyncio.sleep(0.4)
+        long_tasks = [
+            asyncio.create_task(one_request(host, port, p, 4))
+            for p in long_prompts
+        ]
+        outs = await asyncio.gather(*short_tasks, *long_tasks)
+        wall = time.perf_counter() - t0
+        prompts = short_prompts + long_prompts
+        exact = completed = good_tokens = 0
+        short_ms = []
+        for p, (status, out, ms) in zip(prompts, outs):
+            if status != 200:
+                continue
+            completed += 1
+            exact += out["choices"][0]["text"] == wants[p]
+            good_tokens += out["usage"]["completion_tokens"]
+            if p in short_prompts:
+                short_ms.append(ms)
+        extra = {}
+        if handoff:
+            # Fallback recovery: kill the prefill tier, then time one
+            # more long request end to end — it degrades to colocated
+            # prefill on a decode replica, byte-exact.
+            fb0 = METRICS.get_counter("router.handoff_fallbacks")
+            await fleet.kill(names[0])
+            t1 = time.perf_counter()
+            status, out, _ms = await one_request(
+                host, port, fallback_prompt, 4
+            )
+            extra["fallback_recovery_ms"] = round(
+                (time.perf_counter() - t1) * 1e3, 1
+            )
+            assert status == 200
+            assert out["choices"][0]["text"] == wants[fallback_prompt]
+            assert METRICS.get_counter("router.handoff_fallbacks") > fb0
+            # The probe is a served, byte-checked request: count it.
+            completed += 1
+            exact += 1
+        await router.stop()
+        await fleet.stop()
+        return {
+            "completed": completed, "exact": exact,
+            "goodput_tok_per_s": round(good_tokens / wall, 1),
+            "short_ms_mean": round(sum(short_ms) / max(1, len(short_ms)), 1),
+            **extra,
+        }
+
+    async def drive() -> dict:
+        h0 = METRICS.snapshot()["histograms"].get(
+            "router.handoff_seconds", {}
+        ).get("count", 0)
+        colo = await drive_leg(["colocated"], ["c0"], handoff=False)
+        disagg = await drive_leg(
+            ["prefill", "decode"], ["p0", "d0"], handoff=True
+        )
+        hist = METRICS.snapshot()["histograms"].get(
+            "router.handoff_seconds", {}
+        )
+        assert hist.get("count", 0) > h0, "no handoff ever completed"
+        return {
+            # Both legs serve longs+shorts each; the disaggregated leg
+            # adds the fallback-recovery probe — completed/exact below
+            # count against exactly this total.
+            "requests": 2 * (longs + shorts) + 1,
+            "longs": longs, "shorts": shorts, "new_tokens": new_tokens,
+            "prompt_tokens_long": len(long_prompts[0]),
+            "completed": colo["completed"] + disagg["completed"],
+            "exact": colo["exact"] + disagg["exact"],
+            "short_ms_colocated": colo["short_ms_mean"],
+            "short_ms_disagg": disagg["short_ms_mean"],
+            "interference_speedup": round(
+                colo["short_ms_mean"] / max(1e-9, disagg["short_ms_mean"]), 2
+            ),
+            "handoff_ms_p50": round(hist["p50"] * 1e3, 1),
+            "fallback_recovery_ms": disagg["fallback_recovery_ms"],
+            "goodput_tok_per_s": disagg["goodput_tok_per_s"],
         }
 
     out = asyncio.run(drive())
@@ -1879,7 +2060,7 @@ def run_ladder(args, degraded: str | None) -> list[dict]:
             "spec-decode", "spec-decode-7b-int8", "spec-batching",
             "local-proc-batching", "chunked-prefill", "prefix-cache-ttft",
             "fault-recovery", "overload-goodput", "compile-stability",
-            "replica-failover",
+            "replica-failover", "disagg-handoff",
         }
         unknown = only - known
         if unknown:  # a typo must not masquerade as a clean zero-row run
@@ -2018,6 +2199,12 @@ def run_ladder(args, degraded: str | None) -> list[dict]:
         # completed request — a host-scheduling effect, meaningful on any
         # platform.
         ("replica-failover", lambda: _measure_replica_failover(dtype=dtype)),
+        # Disaggregated prefill/decode: the same long+short storm served
+        # colocated then disaggregated — short-request latency under
+        # long-prompt interference, verified-handoff latency, and the
+        # fallback-to-colocated recovery time when the prefill tier is
+        # killed.  A host-scheduling effect, meaningful on any platform.
+        ("disagg-handoff", lambda: _measure_disagg_handoff(dtype=dtype)),
         # Compile-key stability (tools/graftcheck GC4 as a measurement):
         # distinct compile-cache keys per serving entry point across the
         # request-length ladder vs the declared bucket budget — pure

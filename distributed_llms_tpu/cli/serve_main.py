@@ -46,6 +46,7 @@ _SERVER_ONLY_FLAGS = frozenset({
     "slots", "chunk-steps", "prefill-chunk", "prefill-concurrency",
     "max-pending", "drain-timeout", "watchdog-timeout", "platform",
     "replicas", "probe-interval", "failover-retries",
+    "disaggregate", "prefill-replicas", "decode-replicas",
 })
 
 
@@ -91,7 +92,7 @@ def _build_engine(args):
 
 
 def _server_factory(args, engine, default_name, rt, faults, *,
-                    host=None, port=None):
+                    host=None, port=None, role="colocated"):
     """() -> a fresh, unstarted InferenceServer over a fresh batcher.
     Replicas share the engine's weights by reference; each gets its own
     pool/caches/supervisor."""
@@ -127,6 +128,7 @@ def _server_factory(args, engine, default_name, rt, faults, *,
             shed_cost_factor=(args.shed_cost_factor
                               if args.shed_cost_factor is not None
                               else rt.shed_cost_factor),
+            role=role,
         )
 
     return make_server
@@ -138,17 +140,22 @@ def build_server(args) -> InferenceServer:
 
 
 def build_fleet(args):
-    """``--replicas N`` (N >= 2): N full server/batcher stacks on
-    ephemeral local ports behind a health-aware ReplicaRouter on
-    --host/--port — exact failover, rolling drain/respawn (SIGHUP), and
-    replica-scoped chaos via the --fault spec.
+    """``--replicas N`` (N >= 2) or ``--disaggregate``: full
+    server/batcher stacks on ephemeral local ports behind a health-aware
+    ReplicaRouter on --host/--port — exact failover, rolling
+    drain/respawn (SIGHUP), and replica-scoped chaos via the --fault
+    spec.  ``--disaggregate`` builds --prefill-replicas prefill-role +
+    --decode-replicas decode-role stacks instead of N colocated ones;
+    the router hands prompts to the prefill tier and ships finished KV
+    pages to the decode replica before forwarding (degrading to
+    colocated prefill whenever the handoff cannot complete).
     Returns (fleet, router)."""
     from ..cluster.fleet import ReplicaFleet
     from ..runtime.router import ReplicaRouter
 
     engine, default_name, rt, faults, fault_spec = _build_engine(args)
 
-    def replica_factory():
+    def replica_factory(role="colocated"):
         # Each replica gets its OWN plane parsed from the same spec: the
         # batcher.*/server-side rule counters are traversed by that
         # replica's engine thread alone (FaultPlane's thread contract),
@@ -163,10 +170,38 @@ def build_fleet(args):
 
             plane = FaultPlane.parse(fault_spec, strict=True)
         return _server_factory(args, engine, default_name, rt, plane,
-                               host="127.0.0.1", port=0)()
+                               host="127.0.0.1", port=0, role=role)()
 
+    if args.disaggregate:
+        if args.prefill_replicas < 1 or args.decode_replicas < 1:
+            raise SystemExit(
+                "--disaggregate needs --prefill-replicas >= 1 and "
+                "--decode-replicas >= 1"
+            )
+        paged = args.paged_pages if args.paged_pages is not None \
+            else rt.paged_pages
+        cache_on = args.prefix_cache if args.prefix_cache is not None \
+            else rt.prefix_cache
+        if not paged or not cache_on:
+            raise SystemExit(
+                "--disaggregate ships content-addressed KV pool pages: it "
+                "needs --paged-pages and --prefix-cache on every replica"
+            )
+        import functools
+
+        factories = (
+            [functools.partial(replica_factory, "prefill")]
+            * args.prefill_replicas
+            + [functools.partial(replica_factory, "decode")]
+            * args.decode_replicas
+        )
+        names = [f"p{i}" for i in range(args.prefill_replicas)] \
+            + [f"d{i}" for i in range(args.decode_replicas)]
+    else:
+        factories = [replica_factory] * args.replicas
+        names = None
     fleet = ReplicaFleet(
-        [replica_factory] * args.replicas,
+        factories, names=names,
         probe_interval_s=args.probe_interval,
         faults=faults,
     )
@@ -176,6 +211,7 @@ def build_fleet(args):
         page_size=(args.page_size or rt.page_size or 64),
         max_failover_retries=args.failover_retries,
         faults=faults,
+        handoff=bool(args.disaggregate),
     )
     return fleet, router
 
@@ -191,7 +227,7 @@ async def _serve(args) -> None:
 
     for sig in (signal.SIGINT, signal.SIGTERM):
         loop.add_signal_handler(sig, on_signal)
-    if args.replicas > 1:
+    if args.replicas > 1 or args.disaggregate:
         fleet, router = build_fleet(args)
         await fleet.start()
         host, port = await router.start()
@@ -233,7 +269,7 @@ async def _serve(args) -> None:
 
         loop.add_signal_handler(signal.SIGHUP, on_hup)
         log.info("fleet of %d ready on http://%s:%s (SIGHUP = rolling "
-                 "restart; Ctrl-C to stop)", args.replicas, host, port)
+                 "restart; Ctrl-C to stop)", len(fleet.replicas), host, port)
         await stop.wait()
         log.info("shutting down fleet...")
         await router.stop()
@@ -307,6 +343,20 @@ def main(argv=None) -> None:
                          "router on --port — exact failover on replica "
                          "crash/stall/partition, SIGHUP = zero-downtime "
                          "rolling restart (1 = single-server mode)")
+    ap.add_argument("--disaggregate", action="store_true",
+                    help="disaggregated serving: dedicated prefill-role "
+                         "replicas run admission/chunked prefill and ship "
+                         "finished KV pages to decode-role replicas over "
+                         "the verified KV-handoff plane; any handoff "
+                         "failure (prefill crash/stall, digest mismatch, "
+                         "retry exhaustion) degrades to colocated prefill "
+                         "on the decode replica, byte-exact either way.  "
+                         "Requires --paged-pages and --prefix-cache; "
+                         "ignores --replicas")
+    ap.add_argument("--prefill-replicas", type=int, default=1,
+                    help="prefill-role replicas under --disaggregate")
+    ap.add_argument("--decode-replicas", type=int, default=2,
+                    help="decode-role replicas under --disaggregate")
     ap.add_argument("--probe-interval", type=float, default=0.25,
                     help="fleet health-probe interval in seconds "
                          "(replica /healthz polling cadence)")

@@ -66,6 +66,16 @@ class ReplicaHandle:
         self.server = None
         self.host: str | None = None
         self.port: int | None = None
+        # Disaggregated serving: the replica's role ("colocated" /
+        # "prefill" / "decode") and, on decode replicas, where its KV
+        # import listener landed — both read off the server at boot.
+        self.role = "colocated"
+        self.kv_port: int | None = None
+        # Cache-lifetime epoch: bumped every (re)boot.  A respawned
+        # replica's pool and prefix cache are COLD — router-side prefix
+        # affinity entries recorded against an older epoch are stale and
+        # must not beat least-loaded placement.
+        self.epoch = 0
         # starting | healthy | unhealthy | draining | dead
         self.state = "starting"
         self.partitioned_until = 0.0  # loop-clock; math.inf = until respawn
@@ -130,10 +140,14 @@ class ReplicaFleet:
     async def _boot(self, h: ReplicaHandle) -> None:
         h.server = h.factory()
         h.host, h.port = await h.server.start()
+        h.role = getattr(h.server, "role", "colocated")
+        h.kv_port = getattr(h.server, "kv_bound_port", None)
+        h.epoch += 1  # fresh pool + prefix cache: older affinity is stale
         h.state = "starting"
         h.probe_failures = 0
         h.partitioned_until = 0.0
-        log.info("replica %s serving on %s:%s", h.name, h.host, h.port)
+        log.info("replica %s (%s) serving on %s:%s", h.name, h.role,
+                 h.host, h.port)
 
     async def stop(self) -> None:
         if self._probe_task is not None:
@@ -383,6 +397,7 @@ class ReplicaFleet:
             "replicas": {
                 h.name: {
                     "state": h.state,
+                    "role": h.role,
                     "routable": h.routable(now),
                     "partitioned": now < h.partitioned_until,
                     "committed_tokens": h.committed_tokens,

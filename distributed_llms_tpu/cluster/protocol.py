@@ -23,6 +23,9 @@ mesh runtime):
   REGISTER, REGISTER_ACK, HEARTBEAT, PLACE_SHARDS (was LOAD_SHARD),
   UNLOAD_SHARDS, GENERATE (was RUN_INFERENCE), SCHEDULE_COMPUTATION,
   RESULT, ERROR, GET_STATUS, GET_METRICS, SHUTDOWN
+plus the disaggregated-serving KV-handoff pair (cluster/kv_transfer.py):
+  KV_PAGES (prefill -> decode: page payload + chained digests + checksum),
+  KV_ACK   (decode -> prefill: verified import, or a structured NACK)
 """
 
 from __future__ import annotations
@@ -53,6 +56,14 @@ MESSAGE_TYPES = frozenset(
         "GET_METRICS",
         "SHUTDOWN",
         "BATCH",
+        # KV-handoff plane (cluster/kv_transfer.py): a prefill-role engine
+        # ships a finished row's KV pages (payload + chained page digests +
+        # checksum) to a decode-role engine, which verifies and acks.  The
+        # ONE exception to "nothing big belongs here": page payloads ride
+        # base64 in the JSON body, bounded by MAX_FRAME like every frame
+        # (an oversized handoff fails loudly at send time).
+        "KV_PAGES",
+        "KV_ACK",
     }
 )
 

@@ -209,6 +209,10 @@ METRIC_DOCS: dict[str, str] = {
     "batcher.prefix_cache.evicted_pages": "cached pages evicted under pressure",
     "batcher.pool.*": "KV page-pool occupancy gauges (free/cached/held/"
                       "total pages, min_available + peak_held watermarks)",
+    "batcher.kv_pages_exported": "KV pages gathered for handoff to a "
+                                 "decode-role engine",
+    "batcher.kv_pages_imported": "handed-off KV pages adopted into the "
+                                 "pool (decode-role engine)",
     # -- serving gateway (runtime/server.py) --
     "server.requests": "completion requests accepted past the shed gates",
     "server.disconnects": "requests whose client went away mid-serve",
@@ -222,6 +226,8 @@ METRIC_DOCS: dict[str, str] = {
     "server.requests_retried": "zero-streamed requests re-admitted on restart",
     "server.recovery_seconds": "crash to tokens-flowing-again (histogram)",
     "server.engine_last_chunk_age_s": "watchdog: seconds since last delivery",
+    "server.prefill_requests": "prefill-role handoff requests served "
+                               "(/v1/prefill)",
     # -- engine / sessions / profiling --
     "engine.generated_tokens": "tokens generated by engine entry points",
     "engine.generate_seconds": "wall seconds per generate call (histogram)",
@@ -251,6 +257,27 @@ METRIC_DOCS: dict[str, str] = {
     "router.replica_kills": "replicas killed (chaos or real death observed)",
     "router.drains": "replica drains started (rolling restart)",
     "router.respawns": "replica respawns completed",
+    # -- disaggregated prefill/decode (router + cluster/kv_transfer.py) --
+    "router.handoffs": "prefill handoffs attempted (disaggregated mode)",
+    "router.handoff_skips": "handoffs skipped because the decode replica "
+                            "already holds the prompt's page run "
+                            "(epoch-valid affinity)",
+    "router.handoff_fallbacks": "handoffs degraded to colocated prefill",
+    "router.handoff_fallbacks.*": "handoff fallbacks by reason (timeout, "
+                                  "error, rejected, digest_mismatch, "
+                                  "no_prefill_replica, no_kv_target)",
+    "router.handoff_seconds": "prefill + verified transfer latency, "
+                              "handoff start to pages landed (histogram)",
+    "router.handoff_bytes": "KV payload bytes shipped by completed "
+                            "handoffs",
+    "xfer.sends": "KV transfer attempts (sender side)",
+    "xfer.retries": "KV transfer attempts retried after timeout/NACK",
+    "xfer.bytes": "KV transfer frame bytes written to the wire",
+    "xfer.send_seconds": "one transfer's send->ack latency incl. retries "
+                         "(histogram)",
+    "xfer.verify_failures": "KV payloads rejected by checksum/digest "
+                            "verification",
+    "xfer.dup_deliveries": "duplicate KV deliveries absorbed idempotently",
     # -- cluster control plane --
     "coordinator.workers": "registered workers (gauge)",
     "coordinator.evictions": "workers evicted (heartbeat/connection loss)",

@@ -642,6 +642,23 @@ def finish_chunked_admission_paged(
 
 
 @jax.jit
+def _import_pages(cache: Any, page_list: jax.Array, k_pages: jax.Array,
+                  v_pages: jax.Array) -> Any:
+    """Scatter HANDED-OFF KV pages into the pool (disaggregated serving:
+    a prefill-role engine shipped a finished row's pages over
+    cluster/kv_transfer.py and this decode-role engine adopts them).
+    ``k_pages``/``v_pages`` are [L, P, BLK, KVH, HD] page stacks in pool
+    layout; ``page_list`` [P] names the freshly allocated destination
+    pages.  The cache is NOT donated: import is a rare, off-hot-path
+    event and the caller reuses the returned pool exactly like the
+    admission splices do."""
+    return KVCache(
+        k=cache.k.at[:, page_list].set(k_pages.astype(cache.k.dtype)),
+        v=cache.v.at[:, page_list].set(v_pages.astype(cache.v.dtype)),
+    )
+
+
+@jax.jit
 def _gather_row_pages(cache: Any, read_list: jax.Array) -> tuple[jax.Array, jax.Array]:
     """Gather a row's pages out of the pool into a transient contiguous
     row cache ([L, 1, P*BLK, KVH, HD] k/v pair) — the chunked-prefill
@@ -1645,6 +1662,12 @@ class ContinuousBatcher:
         self._rng = jax.random.key(seed)
         self._next_rid = 0  # guarded-by: self._lock
         self._on_tokens = None  # set per run() call (streaming callback)
+        # KV-handoff plane (disaggregated serving): verified transfers
+        # queued by the serving loop thread, adopted by the ENGINE thread
+        # at the next scheduling-round boundary — the pool scatter is a
+        # device call and the pool/prefix-cache bookkeeping is
+        # engine-owned, exactly like admission.
+        self._kv_imports: deque = deque()  # guarded-by: self._lock
 
     # -- prefix caching ------------------------------------------------------
 
@@ -1718,6 +1741,123 @@ class ContinuousBatcher:
             self.pool.assert_consistent(
                 [r.pages for r in self.rows if r.pages]
             )
+
+    # -- KV handoff (disaggregated prefill/decode) -------------------------
+
+    def export_prefix_pages(
+        self, ids: list[int]
+    ) -> "tuple[list[bytes], np.ndarray, np.ndarray] | None":
+        """ENGINE THREAD: gather the prompt's longest cached full-page run
+        out of the pool for handoff to a decode-role engine.  Returns
+        (chained page digests, k pages [L, P, BLK, KVH, HD], v pages) in
+        host numpy, or None when nothing exportable is resident (prompt
+        shorter than a page, caching off, or the run was evicted).  The
+        run is capped one page short of the prompt — the importer's
+        matcher caps hits the same way, so shipping the last partial page
+        would be dead weight.  Pages are retained across the gather so
+        pool pressure cannot reclaim them mid-export."""
+        pc = self.prefix_cache
+        if self.pool is None or pc is None:
+            return None
+        blk = self.page_size
+        n = (len(ids) - 1) // blk
+        if n < 1:
+            return None
+        digests = PrefixCache.page_digests(ids, blk, n)
+        pages = pc.match(digests)
+        if not pages:
+            return None
+        for p in pages:
+            self._retain_page(p)
+        try:
+            row_k, row_v = _gather_row_pages(
+                self.cache, jnp.asarray(np.asarray(pages, np.int32))
+            )
+            l, _one, _w, kvh, hd = row_k.shape
+            k = np.asarray(row_k).reshape(l, len(pages), blk, kvh, hd)
+            v = np.asarray(row_v).reshape(l, len(pages), blk, kvh, hd)
+        finally:
+            self._release_pages(pages)
+        METRICS.inc("batcher.kv_pages_exported", len(pages))
+        return digests[: len(pages)], k, v
+
+    def has_kv_imports(self) -> bool:
+        """Whether a verified handoff awaits adoption (any thread)."""
+        with self._lock:
+            return bool(self._kv_imports)
+
+    def submit_kv_import(self, digests: list[bytes], k_pages, v_pages,
+                         on_done) -> None:
+        """Queue a VERIFIED transfer's pages for adoption (any thread —
+        the decode server's KV listener calls this from the event loop).
+        The engine thread applies it at its next round boundary and calls
+        ``on_done(ok, reason)`` from there; the caller is responsible for
+        waking the engine."""
+        with self._lock:
+            self._kv_imports.append((digests, k_pages, v_pages, on_done))
+
+    def _drain_kv_imports(self) -> None:
+        """ENGINE THREAD, at a scheduling-round boundary: adopt every
+        queued handoff into the pool.  Device work and pool bookkeeping
+        happen outside the submission lock (the lock is host-bookkeeping
+        only, never held across a device call)."""
+        while True:
+            with self._lock:
+                if not self._kv_imports:
+                    return
+                digests, k_pages, v_pages, on_done = \
+                    self._kv_imports.popleft()
+            ok, reason = self._import_kv_pages(digests, k_pages, v_pages)
+            try:
+                on_done(ok, reason)
+            except Exception:
+                log.exception("kv-import completion callback raised")
+
+    def _import_kv_pages(self, digests, k_pages, v_pages):
+        """Adopt one transfer: allocate pool pages, scatter the payload,
+        publish the digests, and park the pages in the prefix-cache LRU —
+        content-addressed and unreferenced, exactly like a completed local
+        prompt's pages.  The handed-off request's admission then RETAINS
+        them through the ordinary cache-hit path (refcounted on its
+        _RowState, released on completion/cancel/preempt), and only its
+        un-shipped suffix prefills.  Idempotent: digests already resident
+        ack "duplicate" without touching the pool."""
+        pc = self.prefix_cache
+        if self.pool is None or pc is None:
+            return False, "not a decode-role engine"
+        l, _nb, blk, kvh, hd = self.cache.k.shape
+        if (k_pages.shape != (l, len(digests), blk, kvh, hd)
+                or v_pages.shape != k_pages.shape):
+            return False, "pool shape mismatch"
+        # Import only the pages whose content is NOT already addressable:
+        # a duplicate delivery (retry racing a delayed ack) acks without
+        # touching the pool, and a PARTIAL overlap (another transfer or a
+        # local prompt already published a prefix of this chain) neither
+        # demands capacity for pages it does not need nor pays a scatter
+        # for content that would lose first-writer-wins anyway.
+        missing = [i for i, d in enumerate(digests) if d not in pc.by_hash]
+        if not missing:
+            return True, "duplicate"
+        if self._pages_available() < len(missing):
+            return False, "no capacity"
+        pages = self._alloc_pages(len(missing))
+        # The scatter's page count is a compile dimension; distinct
+        # overlap widths compile distinct (tiny) programs — bounded by
+        # pages_per_row, and imports sit far off the decode hot path.
+        self.cache = _import_pages(
+            self.cache, jnp.asarray(np.asarray(pages, np.int32)),
+            jnp.asarray(np.ascontiguousarray(k_pages[:, missing])),
+            jnp.asarray(np.ascontiguousarray(v_pages[:, missing])),
+        )
+        for p, i in zip(pages, missing):
+            # First writer wins: a digest published since the scan above
+            # leaves ours private (it frees on the release below).
+            self.pool.publish_prefix(p, digests[i])
+        self._release_pages(pages)
+        METRICS.inc("batcher.kv_pages_imported", len(pages))
+        log.info("imported %d handed-off KV page(s) (%d already resident)",
+                 len(pages), len(digests) - len(pages))
+        return True, "imported"
 
     # -- crash recovery ----------------------------------------------------
 
@@ -2215,6 +2355,9 @@ class ContinuousBatcher:
         if self.faults is not None:
             # Injection site "batcher.admit": one hit per admission round.
             self.faults.fire("batcher.admit")
+        # Adopt handed-off KV pages FIRST: a transfer that raced this
+        # round's admissions should be matchable by them.
+        self._drain_kv_imports()
         self._shed_expired_queued()
         # Advance every pending chunked prefill one chunk per round — up to
         # prefill_concurrency in flight, so the round's prefill work is at
@@ -2648,7 +2791,7 @@ class ContinuousBatcher:
         # Publish any 1-token requests finished by admission alone.
         while self.has_queued() or bool(self.active.any()) or any(
             r.rid is not None for r in self.rows
-        ):
+        ) or self.has_kv_imports():
             self._admit_pending()
             if self.paged:
                 # Chunk-boundary growth: rows about to write past their
@@ -2663,9 +2806,8 @@ class ContinuousBatcher:
                 self._collect(
                     np.zeros((self.b, 0), np.int32), was_active
                 )
-                if not self.has_queued() and all(
-                    r.rid is None for r in self.rows
-                ):
+                if not self.has_queued() and not self.has_kv_imports() \
+                        and all(r.rid is None for r in self.rows):
                     break
                 continue
             if self.faults is not None:

@@ -54,12 +54,19 @@ Sites wired in this tree (callers pass ``tag`` where noted):
   replica name (cluster/fleet.py): ``close`` kills the replica abruptly,
   ``delay:<s>`` wedges its engine past the watchdog, ``drop[:<s>]``
   partitions it from the router while it keeps running
+- ``xfer.send`` / ``xfer.recv`` / ``xfer.verify``  the KV-handoff plane
+  (cluster/kv_transfer.py): drop a transfer frame, corrupt its payload,
+  deliver it twice, stall it, or force the receiver's verification to
+  fail — the disaggregated prefill/decode drill set
+- ``prefill.crash``  a prefill-role replica about to serve a handoff
+  (``close``/``raise`` kills it mid-handoff)
 
 Actions ``raise`` (raises :class:`InjectedFault`) and ``stall`` (blocking
 sleep) are applied by :meth:`FaultPlane.fire` itself; the context-specific
-actions (``exhaust``, ``drop``, ``delay``, ``close``) are returned to the
-caller, which knows what "dropping" means at its site (``delay`` is returned
-rather than slept so async call sites can ``await`` it).
+actions (``exhaust``, ``drop``, ``delay``, ``close``, ``corrupt``, ``dup``)
+are returned to the caller, which knows what "dropping" (or corrupting, or
+duplicating) means at its site (``delay`` is returned rather than slept so
+async call sites can ``await`` it).
 """
 
 from __future__ import annotations
@@ -71,7 +78,8 @@ from ..core.observability import METRICS, get_logger
 
 log = get_logger("faults")
 
-ACTIONS = frozenset({"raise", "exhaust", "stall", "drop", "delay", "close"})
+ACTIONS = frozenset({"raise", "exhaust", "stall", "drop", "delay", "close",
+                     "corrupt", "dup"})
 # Actions fire() applies itself; the rest are returned for the call site.
 _SELF_APPLIED = frozenset({"raise", "stall"})
 
@@ -118,6 +126,28 @@ FAULT_SITES: dict[str, str] = {
         "one fleet probe tick per replica (tag = replica name); "
         "'drop[:<s>]' makes the replica unreachable from the router for "
         "<s> seconds (no arg: until respawn) while it keeps running",
+    "xfer.send":
+        "one KV-handoff transfer attempt about to be sent "
+        "(cluster/kv_transfer.py): 'drop' swallows the frame (the sender "
+        "waits out its ack deadline and retries), 'corrupt' flips payload "
+        "bytes in flight (the receiver's verify rejects), 'dup' delivers "
+        "the frame twice (the import must be idempotent), 'delay:<s>' "
+        "stalls the attempt",
+    "xfer.recv":
+        "one KV_PAGES frame just received by a decode-role engine: 'drop' "
+        "ignores it (no ack — the sender times out and retries), "
+        "'corrupt' mangles the payload before verification, 'delay:<s>' "
+        "stalls the receive path",
+    "xfer.verify":
+        "KV-handoff payload verification (checksum + chained page "
+        "digests): 'corrupt' forces a verification failure — the "
+        "receiver NACKs and the sender retries or degrades to colocated "
+        "prefill",
+    "prefill.crash":
+        "a prefill-role replica about to serve a /v1/prefill handoff "
+        "request: 'close' (or 'raise') kills the replica abruptly "
+        "mid-handoff — the router's degradation ladder must fall back to "
+        "colocated prefill on the decode replica",
 }
 
 

@@ -36,6 +36,24 @@ door that:
   (draining / unhealthy gate) is a placement mistake and fails over
   instead.  No routable replica at all answers 503 + ``Retry-After``.
 
+- **Disaggregates prefill from decode** (``handoff=True``).  With a
+  prefill tier in the fleet (replicas of role ``"prefill"``), a request
+  whose prompt spans at least one full page is first handed to the
+  least-loaded prefill replica (``POST /v1/prefill``): that replica runs
+  the prompt through its own admission, exports the finished KV pages,
+  and ships them to the chosen DECODE replica's KV listener over
+  ``cluster/kv_transfer.py`` (verified, deadline'd, retried).  The decode
+  replica's admission then prefix-cache-hits the imported pages and
+  decodes immediately — a long prompt never stalls another request's
+  decode tokens on the decode tier.  The DEGRADATION LADDER makes the
+  handoff safe: a prefill replica crash/stall/partition mid-handoff, a
+  digest mismatch, transfer-retry exhaustion, a handoff deadline, or an
+  empty prefill tier all fall back to COLOCATED prefill — the request is
+  forwarded to the decode replica verbatim, which prefills it itself,
+  byte-exact either way (imported pages hold exactly the content their
+  digests commit to; a miss just recomputes it).  Completions never
+  place on prefill-role replicas.
+
 Rolling drain/respawn and replica-scoped chaos (``replica.crash`` /
 ``replica.stall`` / ``replica.partition``) live with the fleet; the
 router's own injection site is ``router.place`` (tag = chosen replica;
@@ -95,6 +113,13 @@ class ReplicaRouter:
         # committed mass exceeds spill_factor * least-loaded + request.
         spill_factor: float = 2.0,
         faults=None,
+        # Disaggregated prefill/decode: hand prompts to the fleet's
+        # prefill tier and ship finished KV pages to the decode replica
+        # before forwarding (module docstring).  ``handoff_deadline_s``
+        # bounds the WHOLE prefill+transfer leg — past it the request
+        # degrades to colocated prefill.
+        handoff: bool = False,
+        handoff_deadline_s: float = 15.0,
     ) -> None:
         self.fleet = fleet
         self.host = host
@@ -105,12 +130,18 @@ class ReplicaRouter:
         self.affinity_max = affinity_max
         self.spill_factor = spill_factor
         self.faults = faults
-        # digest -> replica name, most-recently-used last; event-loop
-        # confined like every router/fleet structure (no engine thread
-        # ever touches it).
+        self.handoff = handoff
+        self.handoff_deadline_s = handoff_deadline_s
+        # digest -> (replica name, replica epoch), most-recently-used
+        # last; event-loop confined like every router/fleet structure (no
+        # engine thread ever touches it).  The epoch pins the entry to
+        # ONE cache lifetime: a drained/respawned replica comes back with
+        # a cold pool under a bumped epoch, so its stale entries read as
+        # misses instead of steering traffic at a cache that no longer
+        # holds the pages.
         from collections import OrderedDict
 
-        self._affinity: "OrderedDict[bytes, str]" = OrderedDict()
+        self._affinity: "OrderedDict[bytes, tuple[str, int]]" = OrderedDict()
         self._server: asyncio.base_events.Server | None = None
         self._loop: asyncio.AbstractEventLoop | None = None
         self._conns: set[asyncio.StreamWriter] = set()
@@ -149,19 +180,37 @@ class ReplicaRouter:
         n = max(0, (len(prompt_ids) - 1) // self.page_size)
         return PrefixCache.page_digests(prompt_ids, self.page_size, n)
 
+    def _affinity_lookup(self, d: bytes) -> str | None:
+        """The replica a digest is sticky to — IF that replica's cache
+        lifetime still matches.  An entry recorded against an older epoch
+        (the replica drained/respawned since: fresh pool, cold cache) is
+        dropped here, so stale affinity can never beat least-loaded
+        placement."""
+        got = self._affinity.get(d)
+        if got is None:
+            return None
+        name, epoch = got
+        h = self.fleet._by_name.get(name)
+        if h is None or h.epoch != epoch:
+            del self._affinity[d]
+            return None
+        return name
+
     def _place(self, digests: list[bytes], est_tokens: int,
                exclude: set) -> "object | None":
-        """Pick a replica: prefix affinity on the longest known digest run,
-        spilling to least-committed when the sticky replica runs hot; the
-        ``router.place`` fault site (tag = choice) can veto a pick.
-        Returns None when no routable replica remains."""
+        """Pick a DECODE-CAPABLE replica (prefill-role replicas never
+        serve completions): prefix affinity on the longest known digest
+        run, spilling to least-committed when the sticky replica runs
+        hot; the ``router.place`` fault site (tag = choice) can veto a
+        pick.  Returns None when no routable replica remains."""
         now = self._loop.time()
         cands = [h for h in self.fleet.replicas
-                 if h.routable(now) and h.name not in exclude]
+                 if h.routable(now) and h.name not in exclude
+                 and h.role != "prefill"]
         while cands:
             pick, hit = None, False
             for d in reversed(digests):  # longest cached run first
-                name = self._affinity.get(d)
+                name = self._affinity_lookup(d)
                 if name is None:
                     continue
                 h = next((c for c in cands if c.name == name), None)
@@ -185,9 +234,9 @@ class ReplicaRouter:
             return pick
         return None
 
-    def _record_affinity(self, digests: list[bytes], name: str) -> None:
+    def _record_affinity(self, digests: list[bytes], h) -> None:
         for d in digests:
-            self._affinity[d] = name
+            self._affinity[d] = (h.name, h.epoch)
             self._affinity.move_to_end(d)
         while len(self._affinity) > self.affinity_max:
             self._affinity.popitem(last=False)
@@ -223,6 +272,153 @@ class ReplicaRouter:
         except (TypeError, AttributeError):
             return None, 16
         return ids, n_prompt + budget
+
+    # -- disaggregated prefill handoff -------------------------------------
+
+    def _pick_prefill(self, exclude: set) -> "object | None":
+        """Least-committed routable prefill-role replica (None = the
+        prefill tier is empty, dead, or partitioned — serve colocated)."""
+        now = self._loop.time()
+        cands = [h for h in self.fleet.replicas
+                 if h.routable(now) and h.role == "prefill"
+                 and h.name not in exclude]
+        if not cands:
+            return None
+        return min(cands, key=lambda h: (h.committed_tokens, h.name))
+
+    def _handoff_fallback(self, reason: str, detail: str) -> bool:
+        METRICS.inc("router.handoff_fallbacks")
+        METRICS.inc(f"router.handoff_fallbacks.{reason}")
+        log.warning("prefill handoff degraded to colocated (%s): %s",
+                    reason, detail)
+        return False
+
+    async def _handoff(self, decode_h, prompt_ids: list[int] | None,
+                       digests: list[bytes]) -> bool:
+        """One prefill handoff for the request about to be forwarded to
+        ``decode_h``: pick a prefill replica, POST it /v1/prefill (the
+        decode replica's KV listener coordinates as the transfer target),
+        and verify END-TO-END that the digests it shipped are a prefix of
+        the digests THIS router computed from the prompt — a prefill-tier
+        hashing bug must not poison the decode cache.  Returns True when
+        pages landed; every failure (crash, stall past the deadline,
+        partition, digest mismatch, retry exhaustion, no prefill tier,
+        no KV listener) returns False — the caller serves the request
+        colocated on the decode replica, byte-exact regardless."""
+        import uuid
+
+        if prompt_ids is None or decode_h.kv_port is None:
+            return self._handoff_fallback(
+                "no_kv_target",
+                f"decode replica {decode_h.name} has no KV listener"
+                if decode_h.kv_port is None else "prompt not tokenizable",
+            )
+        p = self._pick_prefill(exclude={decode_h.name})
+        if p is None:
+            return self._handoff_fallback(
+                "no_prefill_replica", "prefill tier empty or unhealthy"
+            )
+        METRICS.inc("router.handoffs")
+        transfer_id = uuid.uuid4().hex[:16]
+        body = json.dumps({
+            "prompt": list(prompt_ids),
+            "kv_host": decode_h.host,
+            "kv_port": decode_h.kv_port,
+            "transfer_id": transfer_id,
+        }).encode()
+        t0 = time.perf_counter()
+        # The prefill tier does prompt + 1 token of work — charging the
+        # request's full decode budget would let a huge max_tokens field
+        # steer prefill placement away from the replica doing the LEAST
+        # prefill work.
+        charge = len(prompt_ids) + 1
+        p.committed_tokens += charge
+        METRICS.set_gauge(
+            f"router.committed_tokens.{p.name}", p.committed_tokens
+        )
+        try:
+            out = await asyncio.wait_for(
+                self._prefill_rpc(p, body), self.handoff_deadline_s
+            )
+        except asyncio.TimeoutError:
+            return self._handoff_fallback(
+                "timeout",
+                f"prefill replica {p.name} exceeded the "
+                f"{self.handoff_deadline_s:g}s handoff deadline",
+            )
+        except (ConnectionError, OSError, EOFError, ValueError, IndexError,
+                asyncio.IncompleteReadError) as e:
+            # Crash / partition / kill mid-handoff all surface here as a
+            # severed or unreachable connection (an empty status line
+            # from a half-dead socket parses as IndexError/ValueError).
+            return self._handoff_fallback(
+                "error", f"prefill replica {p.name}: "
+                f"{type(e).__name__}: {e}",
+            )
+        finally:
+            p.committed_tokens -= charge
+            METRICS.set_gauge(
+                f"router.committed_tokens.{p.name}", p.committed_tokens
+            )
+        status, resp = out
+        if status != 200 or not isinstance(resp, dict):
+            return self._handoff_fallback(
+                "rejected", f"prefill replica {p.name} answered {status}"
+            )
+        if not resp.get("ok"):
+            return self._handoff_fallback(
+                "rejected",
+                f"prefill replica {p.name}: "
+                f"{resp.get('reason') or resp.get('error', 'rejected')}",
+            )
+        shipped = resp.get("digests") or []
+        want = [d.hex() for d in digests[: len(shipped)]]
+        if not shipped or shipped != want:
+            # The transfer itself verified on the decode side, but it does
+            # not commit to the prompt THIS router hashed: stale pages
+            # under our digests would be worse than no pages.
+            return self._handoff_fallback(
+                "digest_mismatch",
+                f"prefill replica {p.name} shipped {len(shipped)} page(s) "
+                "whose digests diverge from the request's",
+            )
+        el = time.perf_counter() - t0
+        METRICS.observe("router.handoff_seconds", el)
+        METRICS.inc("router.handoff_bytes", int(resp.get("bytes", 0)))
+        log.info(
+            "handoff %s: %d page(s), %d token(s) prefilled on %s -> %s "
+            "in %.1f ms (%d transfer attempt(s))", transfer_id,
+            int(resp.get("pages", 0)), int(resp.get("tokens", 0)),
+            p.name, decode_h.name, el * 1e3, int(resp.get("attempts", 1)),
+        )
+        return True
+
+    async def _prefill_rpc(self, p, body: bytes) -> tuple[int, dict]:
+        """POST /v1/prefill to a prefill replica; returns (status, JSON)."""
+        reader, writer = await asyncio.open_connection(p.host, p.port)
+        try:
+            writer.write(
+                f"POST /v1/prefill HTTP/1.1\r\nHost: router\r\n"
+                f"Content-Length: {len(body)}\r\n\r\n".encode() + body
+            )
+            await writer.drain()
+            status = int((await reader.readline()).split()[1])
+            clen = 0
+            for _ in range(_MAX_HEADERS):
+                line = await reader.readline()
+                if line in (b"\r\n", b"\n", b""):
+                    break
+                name, _, value = line.decode("latin-1", "replace").partition(":")
+                if name.strip().lower() == "content-length":
+                    clen = int(value.strip())
+            raw = await reader.readexactly(clen) if clen else b""
+            try:
+                resp = json.loads(raw) if raw else {}
+            except json.JSONDecodeError:
+                resp = {}
+            return status, resp if isinstance(resp, dict) else {}
+        finally:
+            writer.close()
 
     # -- the proxy core ----------------------------------------------------
 
@@ -265,8 +461,30 @@ class ReplicaRouter:
             METRICS.set_gauge(
                 f"router.committed_tokens.{h.name}", h.committed_tokens
             )
-            self._record_affinity(digests, h.name)
+            # Does the chosen replica already hold this prompt's full
+            # page run (epoch-valid affinity — recorded whether the pages
+            # arrived by handoff OR by a colocated prefill there)?  Then
+            # shipping it again would only earn a "duplicate" ack for a
+            # multi-MB transfer.  Read BEFORE recording this placement,
+            # which would trivially satisfy the check.
+            warm = bool(digests) and \
+                self._affinity_lookup(digests[-1]) == h.name
+            self._record_affinity(digests, h)
             try:
+                if self.handoff and digests and method == "POST" \
+                        and not chat:
+                    # Disaggregated prefill: best-effort BY DESIGN — every
+                    # failure mode inside degrades to colocated prefill on
+                    # the decode replica; the verbatim forward below is
+                    # identical either way (byte-exact both paths).  Chat
+                    # requests skip the plane: the replica tokenizes them
+                    # through its chat template, so router-side ids (and
+                    # therefore the shipped digests) would never match
+                    # the admission's — pages would import dead.
+                    if warm:
+                        METRICS.inc("router.handoff_skips")
+                    else:
+                        await self._handoff(h, prompt_ids, digests)
                 await self._forward(writer, h, payload, rec)
                 if t_fail is not None:
                     # Failover recovery latency: failure observed ->

@@ -131,10 +131,16 @@ class _Mailbox:
     live on the mailbox so their lifetime IS the request's: once the
     handler pops ``_requests[rid]`` nothing else needs cleanup, and an
     engine-thread write racing that pop mutates a garbage object instead
-    of resurrecting a side-table entry."""
+    of resurrecting a side-table entry.
+    ``export_ids``/``export_result`` serve the prefill-role handoff: a
+    /v1/prefill request sets ``export_ids`` so the engine thread gathers
+    the prompt's cached KV pages at the done delivery (the one thread
+    that may touch the device) and stashes them in ``export_result``
+    BEFORE the done notify — the handler reads them only after done."""
 
     __slots__ = ("queue", "finished", "t0", "first_seen", "cached_tokens",
-                 "deadline", "meta", "delivered", "retries")
+                 "deadline", "meta", "delivered", "retries",
+                 "export_ids", "export_result")
 
     def __init__(self) -> None:
         self.queue: asyncio.Queue = asyncio.Queue()
@@ -146,6 +152,8 @@ class _Mailbox:
         self.meta: dict | None = None
         self.delivered = 0
         self.retries = 0
+        self.export_ids: list[int] | None = None
+        self.export_result: tuple | None = None  # ("done", payload|None)
 
 
 class BadRequest(ValueError):
@@ -198,6 +206,21 @@ class InferenceServer:
         # at the front door, instead of queueing work that will time out
         # doomed.  None/0 disables the gate (queue-full still 429s).
         shed_cost_factor: float | None = 2.0,
+        # Disaggregated serving role: "colocated" (the default: prefill
+        # and decode in one engine), "prefill" (serves /v1/prefill handoff
+        # requests and ships finished KV pages to decode engines over
+        # cluster/kv_transfer.py), or "decode" (additionally listens for
+        # KV_PAGES transfers and adopts verified pages into its pool).
+        # Both disaggregated roles require a paged batcher with the
+        # automatic prefix cache — the handoff plane IS page content
+        # addressing.
+        role: str = "colocated",
+        # Sender-side transfer hardening (prefill role): per-attempt
+        # deadline, bounded jittered-exponential retries, and a cap on
+        # concurrent in-flight transfers.
+        xfer_attempt_s: float = 5.0,
+        xfer_max_retries: int = 3,
+        max_inflight_transfers: int = 4,
     ) -> None:
         if batcher.tokenizer is None:
             raise ValueError(
@@ -207,6 +230,19 @@ class InferenceServer:
         if request_timeout_s is not None and request_timeout_s <= 0:
             raise ValueError(
                 f"request_timeout_s must be > 0, got {request_timeout_s}"
+            )
+        if role not in ("colocated", "prefill", "decode"):
+            raise ValueError(
+                f"role must be colocated/prefill/decode, got {role!r}"
+            )
+        if role != "colocated" and (
+            getattr(batcher, "pool", None) is None
+            or getattr(batcher, "prefix_cache", None) is None
+        ):
+            raise ValueError(
+                f"role {role!r} needs a paged batcher with the automatic "
+                "prefix cache (paged_pages= + prefix_cache=True) — the "
+                "KV handoff ships content-addressed pool pages"
             )
         self.batcher = batcher
         self.model_name = model_name
@@ -218,6 +254,15 @@ class InferenceServer:
         self.watchdog_timeout_s = watchdog_timeout_s
         self.max_request_retries = max_request_retries
         self.shed_cost_factor = shed_cost_factor
+        self.role = role
+        self.xfer_attempt_s = xfer_attempt_s
+        self.xfer_max_retries = xfer_max_retries
+        self.max_inflight_transfers = max_inflight_transfers
+        self._xfer_sem: asyncio.Semaphore | None = None  # made on start()
+        self._kv_server: asyncio.base_events.Server | None = None
+        from ..cluster.kv_transfer import ReceiverStats
+
+        self.kv_stats = ReceiverStats()  # decode role: import accounting
         # Serializes (next_rid + submit) on the loop thread against the
         # supervisor's batcher swap on the engine thread: without it a
         # submit could land in the dying batcher's queue after the
@@ -249,16 +294,32 @@ class InferenceServer:
     async def start(self) -> tuple[str, int]:
         self._loop = asyncio.get_running_loop()
         self._server = await asyncio.start_server(self._handle, self.host, self.port)
+        self._xfer_sem = asyncio.Semaphore(self.max_inflight_transfers)
+        if self.role == "decode":
+            # The KV import listener: prefill-role peers ship finished
+            # pages here over cluster/kv_transfer.py framing (always an
+            # ephemeral port; the fleet records where it landed).
+            self._kv_server = await asyncio.start_server(
+                self._handle_kv, self.host, 0
+            )
         self._engine = threading.Thread(
             target=self._engine_loop, name="dlt-serve-engine", daemon=True
         )
         self._engine.start()
         addr = self._server.sockets[0].getsockname()
         log.info(
-            "serving %s on http://%s:%s/v1/completions",
-            self.model_name, addr[0], addr[1],
+            "serving %s (%s) on http://%s:%s/v1/completions",
+            self.model_name, self.role, addr[0], addr[1],
         )
         return addr[0], addr[1]
+
+    @property
+    def kv_bound_port(self) -> int | None:
+        """Where the decode role's KV import listener landed (None on
+        other roles)."""
+        if self._kv_server is None:
+            return None
+        return self._kv_server.sockets[0].getsockname()[1]
 
     @property
     def bound_port(self) -> int:
@@ -297,11 +358,20 @@ class InferenceServer:
             deadline = self._loop.time() + 5.0
             while self._inflight() and self._loop.time() < deadline:
                 await asyncio.sleep(0.02)
+        if self._kv_server is not None:
+            self._kv_server.close()
         if self._server is not None:
             self._server.close()
-            for w in list(self._conns):
-                w.close()
+        # Sever every open connection (HTTP and KV — both register in
+        # _conns) BEFORE awaiting wait_closed: on Pythons where
+        # wait_closed waits for active handlers, an open KV connection
+        # from a stalled prefill peer would otherwise hold shutdown.
+        for w in list(self._conns):
+            w.close()
+        if self._server is not None:
             await self._server.wait_closed()
+        if self._kv_server is not None:
+            await self._kv_server.wait_closed()
 
     def force_stop(self) -> None:
         """Cut a graceful drain short (second SIGTERM/Ctrl-C): in-flight
@@ -319,6 +389,8 @@ class InferenceServer:
         self._stopping = True
         if self._server is not None:
             self._server.close()
+        if self._kv_server is not None:
+            self._kv_server.close()
         for w in list(self._conns):
             w.close()
         with self._submit_lock:
@@ -342,8 +414,11 @@ class InferenceServer:
         b = self.batcher
         # b.rows is engine-owned; this loop-thread probe only snapshot-
         # iterates and reads immutable attributes (the documented healthz
-        # contract).  The queue read goes through the batcher's lock.
-        return b.has_queued() or any(r.rid is not None for r in list(b.rows))
+        # contract).  The queue read goes through the batcher's lock, and
+        # a verified KV handoff awaiting adoption counts as work too (the
+        # engine must wake to import it).
+        return (b.has_queued() or b.has_kv_imports()
+                or any(r.rid is not None for r in list(b.rows)))
 
     def _pending_token_mass(self) -> int:
         """Estimated token mass the engine still has to absorb: every
@@ -472,6 +547,19 @@ class InferenceServer:
                 self._cancelled.discard(rid)
                 self._notify(rid, [], True, err=_RESTART_ERR)
             new._next_rid = old._next_rid  # rid continuity across the swap
+            # Transplant VERIFIED KV imports awaiting adoption: their
+            # payloads are host-side (no device state lost in the crash)
+            # and their on_done callbacks have KV-listener coroutines
+            # waiting — leaving them on the dying batcher would strand
+            # each one for the full import timeout.  Under _submit_lock,
+            # so the loop thread cannot submit into `old` mid-move (lock
+            # order _submit_lock -> batcher._lock, the submit path's).
+            with old._lock:
+                pending_imports = list(old._kv_imports)
+                old._kv_imports.clear()
+            if pending_imports:
+                with new._lock:
+                    new._kv_imports.extend(pending_imports)
             self.batcher = new
         self._restarts += 1
         if retried:
@@ -517,6 +605,26 @@ class InferenceServer:
         # empty 200.  Engine thread owns batcher.shed; popped exactly once.
         shed = self.batcher.shed.pop(rid, None) if done else None
         err = (_SHED_ERR + shed) if shed is not None else None
+        if done:
+            # Prefill-role handoff: gather the finished prompt's cached
+            # pages HERE, on the engine thread (the only thread that may
+            # touch the device), OUTSIDE the submission lock (a device
+            # gather must never ride a host-bookkeeping lock), and stash
+            # the payload before the done notify is queued — the handler
+            # coroutine reads it strictly after done.
+            with self._submit_lock:
+                mb = self._requests.get(rid)
+                export_ids = mb.export_ids if mb is not None else None
+            if export_ids is not None and err is None:
+                try:
+                    payload = self.batcher.export_prefix_pages(export_ids)
+                except Exception:
+                    log.exception("kv page export failed for rid %d", rid)
+                    payload = None
+                with self._submit_lock:
+                    mb = self._requests.get(rid)
+                    if mb is not None:
+                        mb.export_result = ("done", payload)
         with self._submit_lock:
             mbox = self._requests.get(rid)
             if mbox is not None and toks:
@@ -660,6 +768,10 @@ class InferenceServer:
                   else "unhealthy")
         return (200 if healthy else 503), {
             "status": status,
+            # Disaggregated serving: the router places completions only on
+            # decode-capable replicas and handoffs only on prefill ones —
+            # the role rides the same probe that carries health.
+            "role": self.role,
             "engine_alive": alive,
             "engine_stalled": stalled,
             "seconds_since_last_chunk": round(age, 3),
@@ -711,6 +823,20 @@ class InferenceServer:
                     raise BadRequest("request body must be a JSON object")
                 await self._completions(writer, req, chat="chat" in path,
                                         t0=t0)
+            except (BadRequest, json.JSONDecodeError) as e:
+                await self._json(writer, 400, _err_body(str(e)))
+        elif method == "POST" and path == "/v1/prefill":
+            if self.role != "prefill":
+                await self._json(writer, 404, _err_body(
+                    "this replica does not serve prefill handoffs "
+                    f"(role {self.role!r})"
+                ))
+                return
+            try:
+                req = json.loads(body or b"{}")
+                if not isinstance(req, dict):
+                    raise BadRequest("request body must be a JSON object")
+                await self._prefill(writer, req)
             except (BadRequest, json.JSONDecodeError) as e:
                 await self._json(writer, 400, _err_body(str(e)))
         elif method not in ("GET", "POST"):
@@ -992,6 +1118,195 @@ class InferenceServer:
                     else:
                         self._cancelled.add(rid)
                     self._requests.pop(rid, None)
+
+    # -- disaggregated serving: prefill handoff + KV import ---------------
+
+    async def _prefill(self, writer, req: dict) -> None:
+        """Prefill-role front door (``POST /v1/prefill``): run the
+        prompt through this engine's ordinary admission (max_new_tokens=1,
+        automatic prefix caching ON — the prompt's full pages publish
+        content-addressed), export the cached run, and SHIP it to the
+        requesting decode engine's KV listener over cluster/kv_transfer.py
+        — per-attempt deadline, bounded jittered-exponential retries,
+        bounded in-flight transfers.  Every outcome is a structured JSON
+        answer; the router treats anything but ``ok: true`` as a handoff
+        failure and degrades to colocated prefill."""
+        from ..cluster import kv_transfer
+        from .faults import InjectedFault
+
+        plane = self.batcher.faults
+        if plane is not None:
+            # Injection site "prefill.crash": the mid-handoff death drill.
+            # close/raise = abrupt replica death (sockets severed
+            # unflushed) — the router observes a reset, not an answer.
+            try:
+                rule = plane.fire("prefill.crash", defer_stall=True)
+            except InjectedFault:
+                rule = None
+                await self.kill()
+                return
+            if rule is not None and rule.action == "close":
+                await self.kill()
+                return
+            if rule is not None and rule.action in ("delay", "stall"):
+                await asyncio.sleep(rule.arg or 0.0)
+        prompt_ids, _ = self._parse_prompt(req, chat=False)
+        kv_host = req.get("kv_host")
+        kv_port = req.get("kv_port")
+        transfer_id = req.get("transfer_id")
+        if not isinstance(kv_host, str) or not kv_host:
+            raise BadRequest("'kv_host' must be a non-empty string")
+        if (isinstance(kv_port, bool) or not isinstance(kv_port, int)
+                or not 0 < kv_port < 65536):
+            raise BadRequest("'kv_port' must be a TCP port")
+        if not isinstance(transfer_id, str) or not transfer_id:
+            raise BadRequest("'transfer_id' must be a non-empty string")
+        if self._inflight() + 1 > self.max_pending:
+            await self._shed_json(
+                writer, 429, "server request queue is full", "queue_full"
+            )
+            return
+        if self._draining and not self._stopping:
+            await self._json(
+                writer, 503, _err_body("server is draining"),
+                headers={"Retry-After": str(self._retry_after_s())},
+            )
+            return
+        if self._stopping:
+            await self._json(writer, 500, _err_body("server is shutting down"))
+            return
+        if self._engine_dead:
+            await self._json(
+                writer, 500, _err_body("engine unrecoverable", "engine_error")
+            )
+            return
+        METRICS.inc("server.prefill_requests")
+        meta = dict(ids=list(prompt_ids), max_new_tokens=1,
+                    prefix_cache=True)
+        with self._submit_lock:
+            rid = self.batcher.next_rid
+            mbox = _Mailbox()
+            mbox.meta = meta
+            mbox.export_ids = list(prompt_ids)
+            self._requests[rid] = mbox
+            try:
+                got = self.batcher.submit(
+                    prompt_ids, max_new_tokens=1, prefix_cache=True
+                )
+                assert got == rid
+            except (ValueError, KeyError) as e:
+                self._requests.pop(rid, None)
+                await self._json(writer, 400, _err_body(str(e)))
+                return
+            except BaseException:
+                self._requests.pop(rid, None)
+                raise
+        self._work.set()
+        try:
+            fail = None
+            while True:
+                try:
+                    _toks, done, err, _lps = await asyncio.wait_for(
+                        mbox.queue.get(), 60.0
+                    )
+                except asyncio.TimeoutError:
+                    fail = "prefill timed out"
+                    break
+                if done:
+                    mbox.finished = True
+                    if err is not None:
+                        fail = err
+                    break
+        finally:
+            with self._submit_lock:
+                if not mbox.finished:
+                    self._cancelled.add(rid)
+                self._requests.pop(rid, None)
+        if fail is not None:
+            await self._json(writer, 500, _err_body(fail, _err_type(fail)))
+            return
+        export = mbox.export_result
+        payload = export[1] if export is not None else None
+        if payload is None:
+            # Nothing shipped: prompt under one full page, caching off,
+            # or the run was evicted before the gather.  Not an error —
+            # the router simply serves the request colocated.
+            await self._json(writer, 200, {
+                "ok": False, "reason": "nothing to export", "pages": 0,
+            })
+            return
+        digests, k_pages, v_pages = payload
+        # b64 of a multi-MB payload runs off the loop: this same loop
+        # answers the fleet's /healthz probes.
+        msg = await asyncio.to_thread(
+            kv_transfer.encode_kv_pages, kv_transfer.KVTransferPayload(
+                transfer_id=transfer_id,
+                token_ids=list(
+                    prompt_ids[: len(digests) * self.batcher.page_size]
+                ),
+                page_size=self.batcher.page_size,
+                digests=digests, k_pages=k_pages, v_pages=v_pages,
+            ),
+        )
+        async with self._xfer_sem:
+            res = await kv_transfer.send_kv_pages(
+                kv_host, kv_port, msg, faults=plane,
+                attempt_s=self.xfer_attempt_s,
+                max_retries=self.xfer_max_retries,
+            )
+        await self._json(writer, 200, {
+            "ok": res.ok, "reason": res.reason, "attempts": res.attempts,
+            "pages": len(digests),
+            "tokens": len(digests) * self.batcher.page_size,
+            "bytes": res.bytes_sent,
+            "digests": [d.hex() for d in digests],
+        })
+
+    async def _handle_kv(self, reader: asyncio.StreamReader,
+                         writer: asyncio.StreamWriter) -> None:
+        """Decode-role KV listener: verify each KV_PAGES frame (checksum +
+        digest-chain recompute, the ``xfer.recv``/``xfer.verify`` sites)
+        and hand verified payloads to the engine thread for adoption."""
+        from ..cluster import kv_transfer
+        from .batcher import PrefixCache
+
+        self._conns.add(writer)
+        try:
+            await kv_transfer.handle_kv_connection(
+                reader, writer,
+                page_digests_fn=PrefixCache.page_digests,
+                import_fn=self._kv_import,
+                faults=self.batcher.faults,
+                stats=self.kv_stats,
+            )
+        except (ConnectionError, OSError):
+            pass
+        finally:
+            self._conns.discard(writer)
+            writer.close()
+
+    async def _kv_import(self, payload) -> tuple[bool, str]:
+        """Bridge one verified transfer to the engine thread: queue it on
+        the batcher (under the submit lock, so the supervisor's batcher
+        swap cannot strand it unseen), wake the engine, await the
+        engine-side completion."""
+        loop = asyncio.get_running_loop()
+        fut: asyncio.Future = loop.create_future()
+
+        def on_done(ok: bool, reason: str) -> None:
+            # Engine thread -> loop: same crossing as mailbox deliveries.
+            def settle() -> None:
+                if not fut.done():
+                    fut.set_result((ok, reason))
+
+            loop.call_soon_threadsafe(settle)
+
+        with self._submit_lock:
+            self.batcher.submit_kv_import(
+                payload.digests, payload.k_pages, payload.v_pages, on_done
+            )
+        self._work.set()
+        return await fut
 
     async def _collect_until_done(self, mbox, rid, stop, need_text=True):
         """Drain the mailbox; yield (text_so_far, ids_so_far, done, err).

@@ -28,6 +28,161 @@ def fast_cfg(**kw):
 
 
 # ---------------------------------------------------------------------------
+# KV-handoff frames (cluster/kv_transfer.py over KV_PAGES / KV_ACK)
+# ---------------------------------------------------------------------------
+
+import numpy as np
+
+from distributed_llms_tpu.cluster import kv_transfer
+from distributed_llms_tpu.runtime.batcher import PrefixCache
+
+
+def _kv_payload(page_size=4, n_pages=2, tid="tx1"):
+    ids = list(range(1, page_size * n_pages + 3))  # a few suffix tokens too
+    digests = PrefixCache.page_digests(ids, page_size, n_pages)
+    shape = (2, n_pages, page_size, 1, 2)  # [L, P, BLK, KVH, HD]
+    k = np.arange(np.prod(shape), dtype=np.float32).reshape(shape)
+    return kv_transfer.KVTransferPayload(
+        transfer_id=tid, token_ids=ids[: page_size * n_pages],
+        page_size=page_size, digests=digests, k_pages=k, v_pages=k + 1.0,
+    )
+
+
+async def _kv_receiver(faults=None):
+    """A minimal decode-side listener: verified payloads land in
+    ``imported``; duplicates dedup on digests exactly like the batcher's
+    import does.  Returns (server, port, stats, imported)."""
+    stats = kv_transfer.ReceiverStats()
+    imported: list = []
+    resident: set = set()
+
+    async def import_fn(payload):
+        if all(d in resident for d in payload.digests):
+            return True, "duplicate"
+        resident.update(payload.digests)
+        imported.append(payload)
+        return True, "imported"
+
+    async def handle(reader, writer):
+        await kv_transfer.handle_kv_connection(
+            reader, writer, page_digests_fn=PrefixCache.page_digests,
+            import_fn=import_fn, faults=faults, stats=stats,
+        )
+
+    server = await asyncio.start_server(handle, "127.0.0.1", 0)
+    return server, server.sockets[0].getsockname()[1], stats, imported
+
+
+@pytest.mark.asyncio
+async def test_kv_frame_roundtrip_and_dup_delivery_idempotent():
+    """A KV_PAGES frame round-trips verified; re-delivering the SAME
+    transfer (a retry racing a delayed ack) acks ok WITHOUT re-importing
+    — idempotence via the digest check, the dup-safety the sender's
+    retry loop leans on."""
+    server, port, stats, imported = await _kv_receiver()
+    try:
+        msg = kv_transfer.encode_kv_pages(_kv_payload())
+        r1 = await kv_transfer.send_kv_pages("127.0.0.1", port, msg,
+                                             attempt_s=5.0)
+        assert r1.ok and r1.reason == "imported" and r1.attempts == 1
+        r2 = await kv_transfer.send_kv_pages("127.0.0.1", port, msg,
+                                             attempt_s=5.0)
+        assert r2.ok and r2.reason == "duplicate"
+        assert len(imported) == 1  # the payload landed exactly once
+        assert stats.duplicates == 1
+        got = imported[0]
+        np.testing.assert_array_equal(got.k_pages, _kv_payload().k_pages)
+        np.testing.assert_array_equal(got.v_pages, _kv_payload().v_pages)
+    finally:
+        server.close()
+
+
+@pytest.mark.asyncio
+async def test_kv_frame_drop_times_out_then_retry_succeeds():
+    """A dropped frame (receiver pretends it was lost; no ack) times the
+    sender out; the jittered retry delivers."""
+    plane = FaultPlane()
+    rule = plane.add("xfer.recv", "drop", when="1")
+    server, port, stats, imported = await _kv_receiver(faults=plane)
+    try:
+        msg = kv_transfer.encode_kv_pages(_kv_payload(tid="txdrop"))
+        res = await kv_transfer.send_kv_pages(
+            "127.0.0.1", port, msg, attempt_s=0.3, max_retries=3,
+            backoff_base_s=0.01,
+        )
+        assert res.ok and res.attempts == 2
+        assert rule.fired == 1
+        assert len(imported) == 1
+    finally:
+        server.close()
+
+
+@pytest.mark.asyncio
+async def test_kv_corrupt_payload_rejected_then_clean_retry_succeeds():
+    """An in-flight bit-flip fails the receiver's checksum verify and is
+    NACKed (never imported); the clean retry succeeds."""
+    plane = FaultPlane()
+    rule = plane.add("xfer.send", "corrupt", when="1")
+    server, port, stats, imported = await _kv_receiver()
+    try:
+        msg = kv_transfer.encode_kv_pages(_kv_payload(tid="txcorrupt"))
+        res = await kv_transfer.send_kv_pages(
+            "127.0.0.1", port, msg, faults=plane, attempt_s=5.0,
+            max_retries=2, backoff_base_s=0.01,
+        )
+        assert res.ok and res.attempts == 2
+        assert rule.fired == 1
+        assert stats.rejected == 1
+        assert stats.last_reason == "imported"
+        assert len(imported) == 1
+    finally:
+        server.close()
+
+
+def test_kv_digest_chain_mismatch_rejected():
+    """A frame whose digests do not commit to its carried tokens (a
+    sender-side hashing bug: checksum INTACT, chain wrong) must be
+    rejected — publishing those pages would serve wrong KV to every
+    later prefix match."""
+    p = _kv_payload()
+    wrong = _kv_payload()
+    wrong.token_ids = [t + 1 for t in wrong.token_ids]  # different prompt,
+    #   digests left as the original prompt's — checksum recomputed clean
+    msg = kv_transfer.encode_kv_pages(wrong)
+    msg["payload"]["digests"] = [d.hex() for d in p.digests]
+    import base64 as _b64
+    kb = _b64.b64decode(msg["payload"]["k"])
+    vb = _b64.b64decode(msg["payload"]["v"])
+    msg["payload"]["checksum"] = kv_transfer.checksum(
+        wrong.token_ids, p.digests, kb, vb
+    )
+    got, reason = kv_transfer.verify_and_decode(
+        msg, PrefixCache.page_digests
+    )
+    assert got is None and reason == "digest mismatch"
+
+
+@pytest.mark.asyncio
+async def test_kv_oversized_frame_rejected_at_send(monkeypatch):
+    """A transfer exceeding MAX_FRAME fails LOUDLY at the sender with a
+    permanent (non-retried) failure — never a silent connection drop or
+    a half-written stream."""
+    server, port, stats, imported = await _kv_receiver()
+    try:
+        monkeypatch.setattr(protocol, "MAX_FRAME", 4096)
+        msg = kv_transfer.encode_kv_pages(
+            _kv_payload(page_size=16, n_pages=8, tid="txbig")
+        )
+        res = await kv_transfer.send_kv_pages("127.0.0.1", port, msg,
+                                              max_retries=3)
+        assert not res.ok and res.attempts == 0
+        assert "frame too large" in res.reason
+        assert not imported
+    finally:
+        server.close()
+
+
+# ---------------------------------------------------------------------------
 # protocol framing
 # ---------------------------------------------------------------------------
 

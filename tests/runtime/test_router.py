@@ -191,6 +191,47 @@ def test_placement_prefix_affinity_and_least_load(warmed):
     run_with_fleet(tiny, 2, fn)
 
 
+def test_affinity_invalidated_after_respawn(warmed):
+    tiny = warmed
+    """Affinity hygiene: a drained/respawned replica comes back with a
+    COLD pool and prefix cache — affinity entries recorded against its
+    previous life (epoch) must read as misses, so stale stickiness can
+    never beat least-loaded placement at a cache that no longer holds
+    the pages."""
+    shared = "sticky system prompt!! " * 2  # > 1 full 16-token page
+    reqs = [(shared + "aaa", 4), (shared + "bbb", 4)]
+    wants = expected_texts(tiny, reqs)
+
+    async def fn(host, port, fleet, router):
+        status, _, raw = await _request(
+            host, port, "POST", "/v1/completions",
+            {"prompt": reqs[0][0], "max_tokens": 4},
+        )
+        assert status == 200
+        assert json.loads(raw)["choices"][0]["text"] == wants[reqs[0][0]]
+        digests = router._digests(ByteTokenizer().encode(reqs[1][0]))
+        sticky = {router._affinity_lookup(d) for d in digests} - {None}
+        assert sticky, "placement never recorded affinity"
+        (name,) = sticky
+        # Drain + respawn the sticky replica: fresh pool, bumped epoch.
+        await fleet.drain(name, drain_timeout_s=15.0)
+        assert fleet[name].restarts == 1
+        # Every entry pointing at the old life now reads as a miss (and
+        # is dropped), rather than steering traffic at a cold cache.
+        assert all(router._affinity_lookup(d) is None for d in digests)
+        hits0 = METRICS.get_counter("router.affinity_hits")
+        status, _, raw = await _request(
+            host, port, "POST", "/v1/completions",
+            {"prompt": reqs[1][0], "max_tokens": 4},
+        )
+        assert status == 200
+        assert json.loads(raw)["choices"][0]["text"] == wants[reqs[1][0]]
+        # The shared-prefix request placed WITHOUT a (stale) affinity hit.
+        assert METRICS.get_counter("router.affinity_hits") == hits0
+
+    run_with_fleet(tiny, 2, fn)
+
+
 def test_router_place_drop_vetoes_choice(warmed):
     tiny = warmed
     """A ``router.place ... drop`` rule vetoes the chosen replica: the

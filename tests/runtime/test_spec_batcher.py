@@ -143,6 +143,35 @@ def test_spec_preemption_recompute_exact(models):
         assert dones[c] == 1
 
 
+def test_spec_windowed_target_exact(models):
+    """ROADMAP item-5's last untested corner (spec-decode x windowed
+    attention): a sliding-window TARGET in the speculative batcher stays
+    temp-0 bit-identical to the plain windowed batcher ACROSS the window
+    boundary.  Budgets are sized so every row's generation slides the
+    readable window well past its prompt — the verify forward's masks,
+    the committed-slot bookkeeping, and the draft backfill must all
+    stay consistent with the target's sliding reads round after round
+    (contiguous layout: slot == position, so the slot-space band equals
+    the position-space window exactly).  Runs with an unwindowed draft
+    (caches deliberately shaped differently) AND as windowed self-draft
+    (every round fully accepts, hammering the backfill at the
+    boundary)."""
+    _, _, dcfg, dparams = models
+    tcfg = presets.get_preset("llama-tiny", vocab_size=512, sliding_window=8)
+    tparams = model_lib.init_params(jax.random.key(0), tcfg)
+    # 7 + 16 and 3 + 14 both cross the window=8 boundary mid-generation;
+    # the third row finishes before the boundary (mixed-regime batch).
+    reqs = [([7, 1, 9, 4, 2, 8, 3], 16), ([4, 4, 4], 14), ([11, 12], 4)]
+    _, rp, plain = _run(tcfg, tparams, reqs)
+    _, rs, spec = _run(tcfg, tparams, reqs, spec=(dcfg, dparams))
+    for a, b in zip(rp, rs):
+        assert plain[a] == spec[b], (a, plain[a], spec[b])
+    _, rs2, spec2 = _run(tcfg, tparams, reqs, spec=(tcfg, tparams),
+                         spec_k=4)
+    for a, b in zip(rp, rs2):
+        assert plain[a] == spec2[b], (a, plain[a], spec2[b])
+
+
 def test_spec_batcher_near_capacity(models):
     """REGRESSION (r4 review): a request filling its slot exactly
     (prompt + max_new_tokens == max_len) makes the last verify write k+1

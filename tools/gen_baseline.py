@@ -60,6 +60,11 @@ def _result_cell(row: dict) -> str:
         ("replicas", "replicas"),
         ("exact", "byte-exact"),
         ("failovers", "failovers"),
+        ("short_ms_colocated", "short-req ms (colocated)"),
+        ("short_ms_disagg", "short-req ms (disagg)"),
+        ("interference_speedup", "interference speedup"),
+        ("handoff_ms_p50", "handoff p50 ms"),
+        ("fallback_recovery_ms", "prefill-kill fallback ms"),
         ("goodput_tok_per_s", "goodput tok/s"),
         ("offered_x", "offered load x"),
         ("shed_frac", "shed frac"),
@@ -102,7 +107,8 @@ def generate(ladder_path: str) -> str:
         # Aux rows run_ladder appends after the decode configs.
         "serving-latency", "continuous-batching", "local-proc-batching",
         "chunked-prefill", "prefix-cache-ttft", "fault-recovery",
-        "overload-goodput", "replica-failover", "compile-stability",
+        "overload-goodput", "replica-failover", "disagg-handoff",
+        "compile-stability",
         "ragged-decode-8k", "ragged-decode-win-8k", "quant-matmul-bw",
         "spec-decode", "spec-decode-7b-int8", "spec-batching",
         "paged-batching", "prefill-flash-2048", "prefill-flash-8192",

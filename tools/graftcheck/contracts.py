@@ -374,6 +374,44 @@ def _forward_cases() -> list[OpCase]:
     return cases
 
 
+def _kv_transfer_cases() -> list[OpCase]:
+    """Disaggregated KV handoff: the export gather pulls a page run out of
+    the pool into row layout ([L, 1, P*BLK, KVH, HD], pool dtype), and the
+    import scatter adopts a page stack ([L, P, BLK, KVH, HD]) back into a
+    pool whose shape/dtype must round-trip UNCHANGED — a widened pool or a
+    silently-promoted dtype would corrupt every later admission."""
+    import jax.numpy as jnp
+
+    from distributed_llms_tpu.runtime import batcher as batcher_lib
+
+    cfg = preset("llama-tiny", dtype="bfloat16")
+    l, kvh, hd = cfg.num_layers, cfg.num_kv_heads, cfg.head_dim_
+    cases = []
+    # (pool pages, page size, pages in transit) incl. 1-page and
+    # non-power-of-two transfers.
+    for nb, blk, p in [(8, 8, 1), (16, 16, 3), (12, 8, 7)]:
+        pool = abstract_pool(cfg, nb, blk)
+        cases.append(OpCase(
+            label=f"export gather nb{nb} blk{blk} p{p}",
+            fn=batcher_lib._gather_row_pages,
+            args=(pool, sds((p,), jnp.int32)),
+            want=(((l, 1, p * blk, kvh, hd), "bfloat16"),
+                  ((l, 1, p * blk, kvh, hd), "bfloat16")),
+        ))
+        cases.append(OpCase(
+            label=f"import scatter nb{nb} blk{blk} p{p}",
+            fn=lambda c, pl, k, v: (
+                lambda out: (out.k, out.v)
+            )(batcher_lib._import_pages(c, pl, k, v)),
+            args=(pool, sds((p,), jnp.int32),
+                  sds((l, p, blk, kvh, hd), jnp.float32),  # host payload
+                  sds((l, p, blk, kvh, hd), jnp.float32)),
+            want=(((l, nb, blk, kvh, hd), "bfloat16"),
+                  ((l, nb, blk, kvh, hd), "bfloat16")),
+        ))
+    return cases
+
+
 def _sampling_cases() -> list[OpCase]:
     from distributed_llms_tpu.runtime import sampling
 
@@ -427,6 +465,10 @@ def op_contracts() -> list[OpContract]:
         OpContract("runtime.sampling", P_SAMPLING,
                    "samplers return [B] int32 for static and per-row paths",
                    _sampling_cases),
+        OpContract("batcher.kv_page_transfer", P_BATCHER,
+                   "handoff export/import: pool shape+dtype round-trip, "
+                   "payload cast to pool dtype",
+                   _kv_transfer_cases),
     ]
 
 
