@@ -1754,6 +1754,42 @@ def _measure_compile_stability() -> dict:
     return out
 
 
+def _measure_analysis_wall() -> dict:
+    """Wall time of the full tier-1 static-analysis gate (graftlint AST +
+    graftcheck abstract tracing + graftflow CFG/dataflow), each run as a
+    fresh subprocess the way the pytest gates pay for it.  The gate's
+    cost must stay visible in BASELINE.md: every PR adds rules, and a
+    multi-minute gate is a gate people stop running.  Each tool must
+    exit 0 — a dirty tree makes the timing meaningless and fails loudly
+    here instead of stamping a lie."""
+    import subprocess
+
+    repo = os.path.dirname(os.path.abspath(__file__))
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    out: dict = {"platform": jax.devices()[0].platform}
+    total = 0.0
+    for tool in ("graftlint", "graftcheck", "graftflow"):
+        t0 = time.perf_counter()
+        r = subprocess.run(
+            [sys.executable, "-m", f"tools.{tool}", "--root", repo],
+            capture_output=True, text=True, cwd=repo, env=env,
+        )
+        wall = time.perf_counter() - t0
+        if r.returncode != 0:
+            # Dirty tree OR tool crash — either way the timing would be a
+            # lie; surface whichever stream actually says why.
+            detail = (r.stdout.strip().splitlines()
+                      or r.stderr.strip().splitlines() or ["<no output>"])
+            raise RuntimeError(
+                f"{tool} exited {r.returncode} (findings, usage error, or "
+                f"crash): {detail[0][:200]}"
+            )
+        out[f"{tool}_wall_ms"] = round(wall * 1e3, 1)
+        total += wall
+    out["analysis_wall_ms"] = round(total * 1e3, 1)
+    return out
+
+
 def _measure_prefill_flash(
     preset: str = "tinyllama-1.1b", batch: int = 2, seq: int = 2048,
     dtype: str = "bfloat16", iters: int = 5, window: int | None = None,
@@ -2060,7 +2096,7 @@ def run_ladder(args, degraded: str | None) -> list[dict]:
             "spec-decode", "spec-decode-7b-int8", "spec-batching",
             "local-proc-batching", "chunked-prefill", "prefix-cache-ttft",
             "fault-recovery", "overload-goodput", "compile-stability",
-            "replica-failover", "disagg-handoff",
+            "replica-failover", "disagg-handoff", "analysis-wall",
         }
         unknown = only - known
         if unknown:  # a typo must not masquerade as a clean zero-row run
@@ -2210,6 +2246,10 @@ def run_ladder(args, degraded: str | None) -> list[dict]:
         # request-length ladder vs the declared bucket budget — pure
         # tracing, meaningful on any platform.
         ("compile-stability", _measure_compile_stability),
+        # Static-analysis gate wall time (graftlint + graftcheck +
+        # graftflow as subprocesses): the tier-1 gate's own cost, stamped
+        # so rule growth that slows every CI run shows in the trajectory.
+        ("analysis-wall", _measure_analysis_wall),
     ]
     if not on_cpu:
         # Paged vs contiguous batching (pool at ~45% of contiguous KV

@@ -491,6 +491,37 @@ class Coordinator:
             timeout=timeout,
         )
 
+    async def schedule_computation(
+        self, payload: dict, worker_id: str | None = None,
+        timeout: float | None = None,
+    ) -> Any:
+        """Dispatch one SCHEDULE_COMPUTATION task (the reference's generic
+        compute verb, kept wire-compatible: workers serve it through the
+        same engine path as GENERATE).  Declared-frame liveness is gated —
+        graftflow's GF401 fails the tree when a MESSAGE_TYPES entry has
+        handlers but no sender, which is exactly what this method closes."""
+        return await self.submit("SCHEDULE_COMPUTATION", payload,
+                                 worker_id=worker_id, timeout=timeout)
+
+    async def shutdown_workers(self, timeout: float | None = None) -> dict:
+        """Broadcast SHUTDOWN to every registered worker: each one answers
+        ``{"ok": True}`` and stops its loops (graceful fleet retirement —
+        the wire half the worker handler always implemented but nothing
+        sent).  Returns {worker_id: reply-or-error-string}; a worker that
+        died before answering reports its error instead of failing the
+        whole broadcast."""
+        wids = list(self.workers)
+        results = await asyncio.gather(
+            *(self.submit("SHUTDOWN", {}, worker_id=w, timeout=timeout)
+              for w in wids),
+            return_exceptions=True,
+        )
+        return {
+            w: (f"{type(r).__name__}: {r}" if isinstance(r, BaseException)
+                else r)
+            for w, r in zip(wids, results)
+        }
+
     # graftlint: holds(event-loop)
     def _spmd_pool(self) -> bool:
         """True when registered workers are controllers of one multi-process
@@ -614,8 +645,14 @@ class Coordinator:
             task.assigned_to = info.worker_id
             info.status = "busy"
             if self.faults is not None:
+                # defer_stall: the dispatch loop runs ON the event loop —
+                # a stall rule is awaited here, never slept (sleeping
+                # would freeze heartbeat handling and every other task).
                 rule = self.faults.fire("coordinator.dispatch",
-                                        tag=task.payload["type"])
+                                        tag=task.payload["type"],
+                                        defer_stall=True)
+                if rule is not None and rule.action in ("delay", "stall"):
+                    await asyncio.sleep(rule.arg or 0.0)
                 if rule is not None and rule.action == "drop":
                     # The dispatch vanished in flight: task stays assigned
                     # and unanswered until the submitter's timeout fires.

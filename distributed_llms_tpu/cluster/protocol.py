@@ -103,12 +103,15 @@ async def _apply_frame_fault(site: str, msg: dict,
     stream and raises so both peers observe a real connection failure."""
     if _FAULTS is None:
         return None
-    rule = _FAULTS.fire(site, tag=msg.get("type"))
+    # defer_stall: this function runs ON the event loop — a stall rule
+    # gets awaited-delay semantics instead of a blocking sleep (which
+    # would freeze every peer sharing the loop, /healthz included).
+    rule = _FAULTS.fire(site, tag=msg.get("type"), defer_stall=True)
     if rule is None:
         return None
     if rule.action == "drop":
         return "drop"
-    if rule.action == "delay":
+    if rule.action in ("delay", "stall"):
         await asyncio.sleep(rule.arg or 0.0)
         return "delay"
     if rule.action == "close":
@@ -121,9 +124,8 @@ async def _apply_frame_fault(site: str, msg: dict,
     return rule.action
 
 
-def encode(msg: dict[str, Any], compress: bool | None = None) -> bytes:
-    """Frame one message.  ``compress=None`` auto-compresses bodies >=
-    COMPRESS_MIN when it actually shrinks them."""
+def _dump_body(msg: dict[str, Any]) -> bytes:
+    """Validate + JSON-encode one message body (no compression)."""
     if msg.get("type") not in MESSAGE_TYPES:
         raise ProtocolError(f"unknown message type {msg.get('type')!r}")
     body = json.dumps(msg, separators=(",", ":")).encode("utf-8")
@@ -132,16 +134,47 @@ def encode(msg: dict[str, Any], compress: bool | None = None) -> bytes:
         # same bound post-decompression, so an over-limit-but-compressible
         # frame must fail at send time, not as a silent connection drop.
         raise ProtocolError(f"frame too large ({len(body)} bytes)")
-    flags = 0
-    if compress is None:
-        compress = len(body) >= COMPRESS_MIN
-    if compress:
-        packed = zlib.compress(body, 6)
-        if len(packed) < len(body):
-            body, flags = packed, _FLAG_ZLIB
+    return body
+
+
+def _frame(body: bytes, flags: int) -> bytes:
     if len(body) > MAX_FRAME:
         raise ProtocolError(f"frame too large ({len(body)} bytes)")
     return struct.pack(">Q", (flags << 56) | len(body)) + body
+
+
+def _compress_frame(body: bytes) -> bytes:
+    """zlib the body and frame whichever representation is smaller.
+    CPU-bound (hundreds of ms on a multi-MB KV payload): event-loop
+    senders reach this only through :func:`encode_on_loop`'s
+    ``asyncio.to_thread`` hop — graftflow's GF201 pins that."""
+    packed = zlib.compress(body, 6)
+    if len(packed) < len(body):
+        return _frame(packed, _FLAG_ZLIB)
+    return _frame(body, 0)
+
+
+def encode(msg: dict[str, Any], compress: bool | None = None) -> bytes:
+    """Frame one message (synchronous).  ``compress=None`` auto-compresses
+    bodies >= COMPRESS_MIN when it actually shrinks them.  Event-loop
+    callers must use :func:`encode_on_loop` (or wrap this in
+    ``asyncio.to_thread``, as cluster/kv_transfer.py does): the zlib pass
+    over a large frame would stall the same loop that answers /healthz."""
+    body = _dump_body(msg)
+    if compress is None:
+        compress = len(body) >= COMPRESS_MIN
+    if compress:
+        return _compress_frame(body)
+    return _frame(body, 0)
+
+
+async def encode_on_loop(msg: dict[str, Any]) -> bytes:
+    """Event-loop-side encode: the WHOLE pass (json dump + zlib + frame)
+    runs off the loop.  A message's size is unknowable before it is
+    dumped, and json.dumps of a near-MAX_FRAME payload stalls the loop
+    just like the zlib pass PR 7 shipped — so neither gets to run there;
+    the ~100 us thread hop is noise against control-plane RTTs."""
+    return await asyncio.to_thread(encode, msg)
 
 
 def decode_header(header: bytes) -> tuple[int, int]:
@@ -153,11 +186,25 @@ def decode_header(header: bytes) -> tuple[int, int]:
     return n, flags
 
 
+def _inflate(body: bytes) -> bytes:
+    """Bounded inflate: cap the output BEFORE allocating it, so a
+    decompression bomb can't balloon past MAX_FRAME.  CPU-bound — the
+    receive path runs it through ``asyncio.to_thread``."""
+    try:
+        d = zlib.decompressobj()
+        out = d.decompress(body, MAX_FRAME + 1)
+    except zlib.error as e:
+        raise ProtocolError(f"bad compressed frame: {e}") from e
+    if len(out) > MAX_FRAME or d.unconsumed_tail:
+        raise ProtocolError("decompressed frame too large")
+    return out
+
+
 async def send_message(writer: asyncio.StreamWriter, msg: dict[str, Any]) -> None:
     if _FAULTS is not None:
         if await _apply_frame_fault("proto.send", msg, writer) == "drop":
             return  # frame swallowed: the wire never sees it
-    writer.write(encode(msg))
+    writer.write(await encode_on_loop(msg))
     await writer.drain()
 
 
@@ -177,15 +224,10 @@ async def receive_message(
             n, flags = decode_header(header)
             body = await reader.readexactly(n)
             if flags & _FLAG_ZLIB:
-                # Bounded inflate: cap the output BEFORE allocating it, so a
-                # decompression bomb can't balloon past MAX_FRAME.
-                try:
-                    d = zlib.decompressobj()
-                    body = d.decompress(body, MAX_FRAME + 1)
-                except zlib.error as e:
-                    raise ProtocolError(f"bad compressed frame: {e}") from e
-                if len(body) > MAX_FRAME or d.unconsumed_tail:
-                    raise ProtocolError("decompressed frame too large")
+                # Inflate OFF the loop: compressed frames are >= COMPRESS_MIN
+                # by construction and can inflate to MAX_FRAME — a receive
+                # path must never stall the loop it shares with /healthz.
+                body = await asyncio.to_thread(_inflate, body)
             try:
                 msg = json.loads(body.decode("utf-8"))
             except (UnicodeDecodeError, json.JSONDecodeError) as e:
@@ -239,5 +281,5 @@ async def send_messages(writer: asyncio.StreamWriter, msgs: list[dict]) -> None:
     if len(msgs) == 1:
         await send_message(writer, msgs[0])
         return
-    writer.write(encode(batch(msgs)))
+    writer.write(await encode_on_loop(batch(msgs)))
     await writer.drain()

@@ -24,7 +24,7 @@ from typing import Any
 import jax
 
 from ..core.config import ClusterConfig, MeshConfig, RuntimeConfig
-from ..core.observability import get_logger
+from ..core.observability import METRICS, get_logger
 from . import protocol
 
 log = get_logger("worker")
@@ -153,7 +153,12 @@ class WorkerHost:
         while not self._stop.is_set():
             await asyncio.sleep(interval)
             if self.faults is not None:
-                rule = self.faults.fire("worker.heartbeat")
+                # defer_stall: event-loop site — stall rules are awaited,
+                # never slept (a blocking sleep would wedge every
+                # coroutine this worker runs, the serve loop included).
+                rule = self.faults.fire("worker.heartbeat", defer_stall=True)
+                if rule is not None and rule.action in ("delay", "stall"):
+                    await asyncio.sleep(rule.arg or 0.0)
                 if rule is not None and rule.action == "drop":
                     # Deterministic liveness fault: the worker stays alive
                     # but its heartbeats stop — the coordinator's deadline
@@ -176,7 +181,11 @@ class WorkerHost:
                     if msg_id is not None:
                         if self.faults is not None:
                             rule = self.faults.fire("worker.result",
-                                                    tag=msg["type"])
+                                                    tag=msg["type"],
+                                                    defer_stall=True)
+                            if rule is not None \
+                                    and rule.action in ("delay", "stall"):
+                                await asyncio.sleep(rule.arg or 0.0)
                             if rule is not None and rule.action == "drop":
                                 continue  # reply lost in flight
                             if rule is not None and rule.action == "close":
@@ -199,6 +208,10 @@ class WorkerHost:
                 except Exception as e:  # report, don't die (coordinator retries)
                     log.exception("command %s failed", msg["type"])
                     if msg_id is not None:
+                        # Counted refusal (graftflow GF402): the ERROR
+                        # reply is the coordinator's retry trigger — it
+                        # must leave a metric trail, not just a log line.
+                        METRICS.inc("worker.errors")
                         await protocol.send_message(
                             writer,
                             protocol.message("ERROR", {"error": str(e)}, msg_id=msg_id),
@@ -209,8 +222,12 @@ class WorkerHost:
         payload = msg.get("payload") or {}
         if self.faults is not None:
             # "raise" here surfaces as an ERROR reply -> coordinator retry:
-            # the deterministic task-failure fault.
-            self.faults.fire("worker.handle", tag=mtype)
+            # the deterministic task-failure fault.  defer_stall: this is
+            # an event-loop site — a stall rule is awaited, not slept.
+            rule = self.faults.fire("worker.handle", tag=mtype,
+                                    defer_stall=True)
+            if rule is not None and rule.action in ("delay", "stall"):
+                await asyncio.sleep(rule.arg or 0.0)
         if mtype == "PLACE_SHARDS":
             store_dir = payload["store_dir"]
             shards = payload["shards"]

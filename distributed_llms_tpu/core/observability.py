@@ -279,6 +279,8 @@ METRIC_DOCS: dict[str, str] = {
                             "verification",
     "xfer.dup_deliveries": "duplicate KV deliveries absorbed idempotently",
     # -- cluster control plane --
+    "worker.errors": "commands answered with a structured ERROR reply "
+                     "(the coordinator's task-retry trigger)",
     "coordinator.workers": "registered workers (gauge)",
     "coordinator.evictions": "workers evicted (heartbeat/connection loss)",
     "coordinator.tasks_dispatched": "tasks sent to workers",

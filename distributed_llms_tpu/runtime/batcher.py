@@ -2314,9 +2314,14 @@ class ContinuousBatcher:
             return None
         if auto:
             pc.record_lookup(cached_len, total_len - cached_len)
-        pages = self._alloc_pages(need)
+        # Build the table BEFORE allocating: the allocation is the last
+        # thing that can raise, so no exception path exists between the
+        # pool handing out pages and the row table owning them (graftflow
+        # GF301 — the refcount-leak shape the pool audit only catches
+        # after the fact).
         page_list = np.zeros((self.pages_per_row,), np.int32)
         page_list[: len(cached_pages)] = cached_pages
+        pages = self._alloc_pages(need)
         page_list[len(cached_pages): n_init] = pages  # + scratch pad
         self.tables[i] = page_list
         return page_list, pages, cached_pages, cached_len, digests
@@ -2675,9 +2680,12 @@ class ContinuousBatcher:
             if not self._ensure_pages(n_init - n_cached, "admit",
                                       below_priority=req.priority):
                 return  # retry the finish next round; prefill is kept
-            pages = self._alloc_pages(n_init - n_cached)
+            # Table first, allocation last (graftflow GF301): nothing
+            # between the pool handing out pages and the table owning
+            # them may raise.
             page_list = np.zeros((self.pages_per_row,), np.int32)
             page_list[:n_cached] = pp.cached_pages
+            pages = self._alloc_pages(n_init - n_cached)
             page_list[n_cached:n_init] = pages
             self.tables[i] = page_list
             # Cache-hit positions scatter to the scratch page: the shared

@@ -151,6 +151,29 @@ FAULT_SITES: dict[str, str] = {
 }
 
 
+# THE declared lock hierarchy, outermost first (FAULT_SITES-style: name ->
+# one-line doc; dict order IS the order).  Every nested acquisition in the
+# serving core must follow it — tools/graftflow's GF102 builds the global
+# lock-acquisition graph (with-nesting + holds() annotations, propagated
+# over the call graph) and fails the gate on any edge that contradicts
+# this registry, GF101 on any cycle, GF103 on an entry naming a lock no
+# class declares.  The order was previously prose ("lock order is
+# _submit_lock -> batcher._lock, everywhere", runtime/server.py) — a new
+# call path nesting the other way is a deadlock no unit test will find.
+LOCK_ORDER: dict[str, str] = {
+    "InferenceServer._submit_lock":
+        "serving gateway: mailbox registry + cancel flags + the "
+        "supervisor's batcher swap (loop and engine threads)",
+    "ContinuousBatcher._lock":
+        "engine submission queue, rid counter, pending KV imports",
+    "PagePool._lock":
+        "KV page allocator free list/refcounts + prefix-cache LRU",
+    "Metrics._lock":
+        "process-wide metrics registry (universal leaf: safe under any "
+        "of the above, never holds anything itself)",
+}
+
+
 class InjectedFault(RuntimeError):
     """Raised by a ``raise`` rule.  Deliberately its own type so recovery
     tests can assert the injected path (and only it) was taken."""

@@ -224,7 +224,13 @@ class ReplicaRouter:
                   > self.spill_factor * least.committed_tokens + est_tokens):
                 pick, hit = least, False  # affinity must not defeat balance
             if self.faults is not None:
-                rule = self.faults.fire("router.place", tag=pick.name)
+                # defer_stall: placement runs on the event loop (inside
+                # _proxy).  The site's documented action is 'drop' (veto);
+                # a stall/delay rule is returned un-slept and ignored here
+                # — this sync helper cannot await, and blocking would
+                # freeze routing and failure detection at once.
+                rule = self.faults.fire("router.place", tag=pick.name,
+                                        defer_stall=True)
                 if rule is not None and rule.action == "drop":
                     cands = [c for c in cands if c.name != pick.name]
                     continue

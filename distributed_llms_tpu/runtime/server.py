@@ -273,6 +273,10 @@ class InferenceServer:
         # only for host bookkeeping (never across an await or a device
         # call); lock order is _submit_lock -> batcher._lock, everywhere.
         self._submit_lock = threading.Lock()
+        # A mailbox registered here and then stranded by an exception is
+        # the PR-3 _Mailbox leak class (its handler coroutine blocks
+        # forever); GF303 demands a pop on every raising path.
+        # graftflow: cleanup-required
         self._requests: dict[int, _Mailbox] = {}  # guarded-by: self._submit_lock
         self._cancelled: set[int] = set()  # guarded-by: self._submit_lock
         # Supervisor per-request state (meta/delivered/retries) rides on
@@ -1038,13 +1042,20 @@ class InferenceServer:
         )
         subs: list[tuple[int, int, _Mailbox]] = []  # (choice index, rid, mbox)
         sub_err: Exception | None = None
+        # Construct every mailbox BEFORE the first registration (graftflow
+        # GF303): once choice 0's mailbox is in _requests, nothing on the
+        # path to the cleanup handlers may raise — a failing construction
+        # for choice 2 must not strand choice 1's registered entry.
+        mboxes: list[_Mailbox] = []
+        for _ in range(n):
+            mbox = _Mailbox()
+            mbox.t0 = t0  # latency clocks run from request receipt
+            mbox.deadline = deadline
+            mbox.meta = meta
+            mboxes.append(mbox)
         with self._submit_lock:
-            for idx in range(n):
+            for idx, mbox in enumerate(mboxes):
                 rid = self.batcher.next_rid
-                mbox = _Mailbox()
-                mbox.t0 = t0  # latency clocks run from request receipt
-                mbox.deadline = deadline
-                mbox.meta = meta
                 self._requests[rid] = mbox
                 try:
                     got = self.batcher.submit(
@@ -1084,9 +1095,13 @@ class InferenceServer:
             return
         self._work.set()
         METRICS.inc("server.requests")
-        oid = ("chatcmpl-" if chat else "cmpl-") + uuid.uuid4().hex[:24]
-        created = int(time.time())
         try:
+            # Inside the try on purpose (graftflow GF303): everything
+            # between the mailbox registrations and this finally must be
+            # unable to raise, or the registered mailboxes leak — the id
+            # mint and clock read ride the same cleanup as the serve path.
+            oid = ("chatcmpl-" if chat else "cmpl-") + uuid.uuid4().hex[:24]
+            created = int(time.time())
             if stream:
                 await self._serve_stream(
                     writer, subs, stop, chat, oid, created, want_lp

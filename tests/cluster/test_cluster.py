@@ -522,6 +522,33 @@ async def test_task_retry_on_injected_handler_fault(tmp_path):
 
 
 @pytest.mark.asyncio
+async def test_schedule_computation_and_shutdown_broadcast(tmp_path):
+    """The two frame types that had handlers but no sender until
+    graftflow's GF401 flagged them: SCHEDULE_COMPUTATION dispatches
+    through the same engine path as GENERATE, and shutdown_workers
+    broadcasts SHUTDOWN — every worker answers ``{"ok": True}`` and
+    stops its loops (graceful fleet retirement), with per-worker
+    error strings instead of a failed broadcast when one is gone."""
+    coord = Coordinator(fast_cfg())
+    await coord.start()
+    try:
+        w, wt = await start_worker(coord)
+        coord.plan_shards(1, store_dir=str(tmp_path))
+        await coord.place_shards()
+        out = await asyncio.wait_for(
+            coord.schedule_computation(
+                {"prompts": ["z"], "max_new_tokens": 2}), timeout=15
+        )
+        assert out["text"] == ["z!"]
+        replies = await asyncio.wait_for(coord.shutdown_workers(), timeout=15)
+        assert replies == {w.worker_id: {"ok": True}}
+        # The worker's run loop really exits (stop() flips its event).
+        await asyncio.wait_for(wt, timeout=10)
+    finally:
+        await coord.stop()
+
+
+@pytest.mark.asyncio
 async def test_generate_without_placement_errors_then_retries_exhaust(tmp_path):
     coord = Coordinator(fast_cfg())
     await coord.start()

@@ -41,13 +41,13 @@ def tiny():
     return cfg, params
 
 
-def _replica_batcher(tiny, pages=12):
+def _replica_batcher(tiny, pages=12, **bkw):
     cfg, params = tiny
     tok = ByteTokenizer()
     return ContinuousBatcher(
         cfg, params, tokenizer=tok, eos_id=tok.eos_id, pad_id=tok.pad_id,
         batch_slots=2, max_len=96, chunk_steps=4,
-        paged_pages=pages, page_size=PAGE, prefix_cache=True,
+        paged_pages=pages, page_size=PAGE, prefix_cache=True, **bkw,
     )
 
 
@@ -65,13 +65,15 @@ def warmed(tiny):
     return tiny
 
 
-def role_factory(tiny, role, **srv_kw):
+def role_factory(tiny, role, batcher_kw=None, **srv_kw):
     srv_kw.setdefault("watchdog_timeout_s", 2.0)
+    bkw = batcher_kw or {}
 
     def make_server():
         return InferenceServer(
-            _replica_batcher(tiny), model_name="tiny", host="127.0.0.1",
-            port=0, batcher_factory=lambda: _replica_batcher(tiny),
+            _replica_batcher(tiny, **bkw), model_name="tiny",
+            host="127.0.0.1", port=0,
+            batcher_factory=lambda: _replica_batcher(tiny, **bkw),
             role=role, **srv_kw,
         )
 
@@ -79,7 +81,7 @@ def role_factory(tiny, role, **srv_kw):
 
 
 def run_with_disagg_fleet(tiny, n_prefill, n_decode, fn, faults=None,
-                          srv_kw=None, router_kw=None):
+                          srv_kw=None, router_kw=None, batcher_kw=None):
     """Boot an (n_prefill prefill + n_decode decode)-role fleet behind a
     handoff-enabled router, wait healthy, run ``fn``, tear down.  The
     shared ``faults`` plane serves the event-loop sites (xfer.*,
@@ -89,8 +91,10 @@ def run_with_disagg_fleet(tiny, n_prefill, n_decode, fn, faults=None,
 
     async def driver():
         factories = (
-            [role_factory(tiny, "prefill", **(srv_kw or {}))] * n_prefill
-            + [role_factory(tiny, "decode", **(srv_kw or {}))] * n_decode
+            [role_factory(tiny, "prefill", batcher_kw=batcher_kw,
+                          **(srv_kw or {}))] * n_prefill
+            + [role_factory(tiny, "decode", batcher_kw=batcher_kw,
+                            **(srv_kw or {}))] * n_decode
         )
         names = [f"p{i}" for i in range(n_prefill)] \
             + [f"d{i}" for i in range(n_decode)]
@@ -230,6 +234,73 @@ def test_disagg_roundtrip_exact_and_offloads_prefill(warmed):
         _audit_all(fleet)
 
     run_with_disagg_fleet(tiny, 1, 1, fn)
+
+
+def test_chunked_prefill_on_prefill_role_exports_complete_pages(warmed):
+    """The chunked-prefill x disaggregation corner: a prefill-role
+    replica whose admission takes the CHUNKED path (prompt >
+    prefill_chunk, consumed in bites across scheduling rounds) must
+    still publish the prompt's FULL digest-chained page run and export
+    every full page for the handoff — and the decode replica must serve
+    the forwarded request from those imported pages, byte-exact vs a
+    monolithic colocated reference."""
+    tiny = warmed
+    prompt = LONG + "tail one"
+    reqs = [(prompt, 8)]
+    wants = expected_texts(tiny, reqs)
+    tok_ids = ByteTokenizer().encode(prompt)
+    n_exportable = (len(tok_ids) - 1) // PAGE  # capped one page short
+    assert n_exportable >= 2  # the corner needs a multi-page chunked run
+
+    # Warm the CHUNKED program shapes (prefill_chunk_step + chunked
+    # finish + cache-hit chunked continuation) before any watchdog is
+    # armed — the jit cache is process-wide, so the fleet's replicas
+    # never mistake a cold compile for a wedged engine.
+    b = _replica_batcher(tiny, prefill_chunk=PAGE)
+    for _ in range(2):  # second pass takes the cache-hit chunked path
+        b.submit(prompt, max_new_tokens=2)
+        b.run()
+
+    async def fn(host, port, fleet, router):
+        exp0 = METRICS.get_counter("batcher.kv_pages_exported")
+        imp0 = METRICS.get_counter("batcher.kv_pages_imported")
+        h0 = METRICS.get_counter("router.handoffs")
+        ch0 = METRICS.get_counter("batcher.prefill_chunks")
+        status, _, raw = await _request(
+            host, port, "POST", "/v1/completions",
+            {"prompt": prompt, "max_tokens": 8},
+        )
+        body = json.loads(raw)
+        assert status == 200, body
+        # Byte-exact vs the monolithic colocated reference: chunked
+        # prefill, the handoff, AND the imported-page continuation all
+        # compose without changing a single token.
+        assert body["choices"][0]["text"] == wants[prompt]
+        assert METRICS.get_counter("router.handoffs") > h0
+        # The prefill replica exported the COMPLETE run (every full page
+        # the chunked finish published), not just a prefix of it ...
+        assert METRICS.get_counter("batcher.kv_pages_exported") - exp0 \
+            == n_exportable
+        # ... the decode replica adopted them ...
+        assert METRICS.get_counter("batcher.kv_pages_imported") - imp0 \
+            == n_exportable
+        # ... and its (also chunked) admission served the prompt from
+        # the imported pages rather than re-prefilling it.
+        cached = body["usage"]["prompt_tokens_details"]["cached_tokens"]
+        assert cached >= n_exportable * PAGE, body["usage"]
+        # The CHUNKED path really ran (not a silent monolithic
+        # fallback): the prefill replica bit the uncached prompt off in
+        # PAGE-sized chunks (ceil(len/PAGE) bites) — a regression to
+        # monolithic admission would leave the counter flat.
+        bites = METRICS.get_counter("batcher.prefill_chunks") - ch0
+        assert bites >= -(-len(tok_ids) // PAGE), bites
+        _audit_all(fleet)
+
+    run_with_disagg_fleet(
+        tiny, 1, 1, fn,
+        batcher_kw={"prefill_chunk": PAGE},
+        srv_kw={"watchdog_timeout_s": 10.0},
+    )
 
 
 # -- transfer-level faults heal in place ------------------------------------

@@ -1,0 +1,490 @@
+"""graftflow self-tests: every rule family proven to fire on a seeded
+violation, suppressions honored only with a reason, and THE tier-1 gate —
+the repo itself must be clean modulo the checked-in (EMPTY) baseline.
+
+Fixture trees use the real scope suffixes (pkg/runtime/batcher.py,
+pkg/cluster/protocol.py, ...) so the analyzers treat them exactly like
+the shipped package.
+"""
+
+from __future__ import annotations
+
+import subprocess
+import sys
+from pathlib import Path
+
+ROOT = Path(__file__).resolve().parents[2]
+if str(ROOT) not in sys.path:
+    sys.path.insert(0, str(ROOT))
+
+from tools.graftflow import (  # noqa: E402
+    load_project, read_baseline, run_project, split_new,
+)
+from tools.graftflow import (  # noqa: E402
+    eventloop, lockorder, protocolflow, resources,
+)
+
+
+def _project(tmp_path: Path, files: dict[str, str]):
+    for rel, text in files.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(text, encoding="utf-8")
+    return load_project(tmp_path)
+
+
+def _rules(findings):
+    return sorted(f.rule for f in findings)
+
+
+# -- GF1xx lock order -------------------------------------------------------
+
+CYCLE_SRC = '''
+import threading
+
+class ContinuousBatcher:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.pool = None
+
+    def fwd(self):
+        with self._lock:
+            with self.pool._lock:
+                pass
+
+class PagePool:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.batcher = None
+
+    def rev(self):
+        with self._lock:
+            with self.batcher._lock:
+                pass
+'''
+
+
+def test_lockorder_cycle_fires(tmp_path):
+    findings = lockorder.check(
+        _project(tmp_path, {"pkg/runtime/batcher.py": CYCLE_SRC}))
+    assert _rules(findings) == ["GF101", "GF101"]
+    assert any("PagePool._lock" in f.message for f in findings)
+
+
+LOCK_REGISTRY = '''
+LOCK_ORDER: dict[str, str] = {
+    "ContinuousBatcher._lock": "outer",
+    "PagePool._lock": "inner leaf",
+}
+'''
+
+ORDER_VIOLATION_SRC = '''
+import threading
+
+class ContinuousBatcher:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+class PagePool:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.batcher = None
+
+    def rev(self):
+        with self._lock:
+            with self.batcher._lock:   # inner acquires the OUTER lock
+                pass
+'''
+
+
+def test_lockorder_declared_order_violation(tmp_path):
+    findings = lockorder.check(_project(tmp_path, {
+        "pkg/runtime/faults.py": LOCK_REGISTRY,
+        "pkg/runtime/batcher.py": ORDER_VIOLATION_SRC,
+    }))
+    assert _rules(findings) == ["GF102"]
+    assert "LOCK_ORDER" in findings[0].message
+
+
+INTERPROC_SRC = '''
+import threading
+
+class ContinuousBatcher:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+class PagePool:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.batcher = None
+
+    def outer(self):
+        with self._lock:
+            self._grab()
+
+    def _grab(self):
+        with self.batcher._lock:
+            pass
+'''
+
+
+def test_lockorder_violation_through_the_call_graph(tmp_path):
+    """The bad nesting spans a CALL: outer() holds PagePool._lock and
+    _grab() acquires the batcher lock — only held-set propagation over
+    the call graph sees the edge."""
+    findings = lockorder.check(_project(tmp_path, {
+        "pkg/runtime/faults.py": LOCK_REGISTRY,
+        "pkg/runtime/batcher.py": INTERPROC_SRC,
+    }))
+    assert _rules(findings) == ["GF102"]
+    assert "_grab" in findings[0].message
+
+
+def test_lockorder_registry_drift(tmp_path):
+    findings = lockorder.check(_project(tmp_path, {
+        "pkg/runtime/faults.py": (
+            'LOCK_ORDER: dict[str, str] = {\n'
+            '    "ContinuousBatcher._lock": "real",\n'
+            '    "Ghost._lock": "nothing declares this",\n'
+            '}\n'
+        ),
+        "pkg/runtime/batcher.py": (
+            "import threading\n"
+            "class ContinuousBatcher:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.Lock()\n"
+        ),
+    }))
+    assert _rules(findings) == ["GF103"]
+    assert "Ghost._lock" in findings[0].message
+
+
+# -- GF2xx event-loop blocking ----------------------------------------------
+
+def test_eventloop_blocking_direct(tmp_path):
+    findings = eventloop.check(_project(tmp_path, {
+        "pkg/runtime/server.py": (
+            "import time\n"
+            "class S:\n"
+            "    async def handler(self):\n"
+            "        time.sleep(0.1)\n"
+        ),
+    }))
+    assert _rules(findings) == ["GF201"]
+    assert "time.sleep" in findings[0].message
+
+
+def test_eventloop_blocking_transitive(tmp_path):
+    """The blocking call hides one sync hop below the coroutine — the
+    exact PR-7 shape (zlib inside a helper the send path calls)."""
+    findings = eventloop.check(_project(tmp_path, {
+        "pkg/cluster/protocol.py": (
+            "import zlib\n"
+            "def pack(b):\n"
+            "    return zlib.compress(b)\n"
+            "async def send(w, b):\n"
+            "    w.write(pack(b))\n"
+        ),
+    }))
+    assert _rules(findings) == ["GF201"]
+    assert "via pack" in findings[0].message
+
+
+def test_eventloop_to_thread_is_off_loop(tmp_path):
+    findings = eventloop.check(_project(tmp_path, {
+        "pkg/cluster/protocol.py": (
+            "import asyncio, zlib\n"
+            "def pack(b):\n"
+            "    return zlib.compress(b)\n"
+            "async def send(w, b):\n"
+            "    w.write(await asyncio.to_thread(pack, b))\n"
+        ),
+    }))
+    assert findings == []
+
+
+def test_eventloop_fire_requires_defer_stall(tmp_path):
+    findings = eventloop.check(_project(tmp_path, {
+        "pkg/runtime/server.py": (
+            "class S:\n"
+            "    def __init__(self):\n"
+            "        self.faults = None\n"
+            "    async def handler(self):\n"
+            "        self.faults.fire('x.y')\n"                  # GF202
+            "        self.faults.fire('x.y', defer_stall=True)\n"  # ok
+        ),
+    }))
+    assert _rules(findings) == ["GF202"]
+    assert "defer_stall" in findings[0].message
+
+
+# -- GF3xx resource pairing -------------------------------------------------
+
+def test_pages_leak_on_early_return(tmp_path):
+    findings = resources.check(_project(tmp_path, {
+        "pkg/runtime/batcher.py": (
+            "class B:\n"
+            "    def admit(self, n, ok):\n"
+            "        pages = self.pool.alloc(n)\n"
+            "        if not ok:\n"
+            "            return None\n"      # leak: pages forgotten
+            "        self.rows[0] = pages\n"
+        ),
+    }))
+    assert _rules(findings) == ["GF301"]
+    assert "normal exit" in findings[0].message
+
+
+def test_pages_leak_on_exception_path_and_finally_is_safe(tmp_path):
+    findings = resources.check(_project(tmp_path, {
+        "pkg/runtime/batcher.py": (
+            "class B:\n"
+            "    def grow(self, n):\n"
+            "        pages = self.pool.alloc(n)\n"
+            "        self.audit()\n"          # raises -> leak path
+            "        self.rows[1] = pages\n"
+            "    def safe(self, n):\n"
+            "        pages = self.pool.alloc(n)\n"
+            "        try:\n"
+            "            self.audit()\n"
+            "        finally:\n"
+            "            self.pool.release(pages)\n"
+        ),
+    }))
+    assert _rules(findings) == ["GF301"]
+    assert "exception exit" in findings[0].message
+    assert findings[0].line == 3  # grow's alloc, not safe's
+
+
+def test_bare_acquire_needs_release_on_all_paths(tmp_path):
+    findings = resources.check(_project(tmp_path, {
+        "pkg/runtime/server.py": (
+            "class W:\n"
+            "    def bad(self):\n"
+            "        self._sem.acquire()\n"
+            "        self.work()\n"           # raises past the release
+            "        self._sem.release()\n"
+            "    def good(self):\n"
+            "        self._sem.acquire()\n"
+            "        try:\n"
+            "            self.work()\n"
+            "        finally:\n"
+            "            self._sem.release()\n"
+        ),
+    }))
+    assert _rules(findings) == ["GF302"]
+    assert findings[0].line == 3
+
+
+def test_registry_cleanup_required_on_exception_paths(tmp_path):
+    findings = resources.check(_project(tmp_path, {
+        "pkg/runtime/server.py": (
+            "class S:\n"
+            "    def __init__(self):\n"
+            "        # graftflow: cleanup-required\n"
+            "        self.reg = {}\n"
+            "    def bad(self, k, v):\n"
+            "        self.reg[k] = v\n"
+            "        self.submit(v)\n"        # raises -> entry stranded
+            "    def good(self, k, v):\n"
+            "        self.reg[k] = v\n"
+            "        try:\n"
+            "            self.submit(v)\n"
+            "        except Exception:\n"
+            "            self.reg.pop(k)\n"
+            "            raise\n"
+        ),
+    }))
+    assert _rules(findings) == ["GF303"]
+    assert findings[0].line == 6  # bad's registration, not good's
+
+
+# -- GF4xx protocol completeness --------------------------------------------
+
+def test_frame_without_handler(tmp_path):
+    findings = protocolflow.check_frames(_project(tmp_path, {
+        "pkg/cluster/protocol.py": (
+            'MESSAGE_TYPES = frozenset({"PING", "PONG"})\n'
+            "def message(t, payload=None):\n"
+            "    return {'type': t, 'payload': payload}\n"
+            "def send(w):\n"
+            "    w.write(message('PING'))\n"
+            "def pong(w):\n"
+            "    w.write(message('PONG'))\n"
+            "def handle(msg):\n"
+            "    return msg.get('type') == 'PING'\n"
+        ),
+    }))
+    assert _rules(findings) == ["GF401"]
+    assert "'PONG' has no handler" in findings[0].message
+
+
+def test_frame_without_sender_and_undeclared_type(tmp_path):
+    findings = protocolflow.check_frames(_project(tmp_path, {
+        "pkg/cluster/protocol.py": (
+            'MESSAGE_TYPES = frozenset({"PING", "LOST"})\n'
+            "def message(t, payload=None):\n"
+            "    return {'type': t, 'payload': payload}\n"
+            "def send(w):\n"
+            "    w.write(message('PING'))\n"
+            "    w.write(message('PINGG'))\n"   # typo'd type
+            "def handle(msg):\n"
+            "    t = msg.get('type')\n"
+            "    return t == 'PING' or t == 'LOST'\n"
+        ),
+    }))
+    assert _rules(findings) == ["GF401", "GF401"]
+    assert any("'LOST' has no sender" in f.message for f in findings)
+    assert any("'PINGG'" in f.message for f in findings)
+
+
+def test_nack_without_metric(tmp_path):
+    findings = protocolflow.check_nacks(_project(tmp_path, {
+        "pkg/cluster/kv_transfer.py": (
+            "def message(t, p):\n"
+            "    return {'type': t, 'payload': p}\n"
+            "def refuse(w):\n"
+            "    w.write(message('KV_ACK', {'ok': False, 'reason': 'no'}))\n"
+            "def refuse_counted(w):\n"
+            "    METRICS.inc('xfer.nacks')\n"
+            "    w.write(message('KV_ACK', {'ok': False, 'reason': 'no'}))\n"
+        ),
+    }))
+    assert _rules(findings) == ["GF402"]
+    assert "refuse" in findings[0].message
+    assert "refuse_counted" not in findings[0].message
+
+
+def test_unbounded_retry_loop(tmp_path):
+    findings = protocolflow.check_retries(_project(tmp_path, {
+        "pkg/cluster/client.py": (
+            "async def pump(reader):\n"
+            "    while True:\n"
+            "        try:\n"
+            "            await read_once(reader)\n"
+            "        except ConnectionError:\n"
+            "            continue\n"                     # forever
+            "async def bounded(reader, n):\n"
+            "    while True:\n"
+            "        try:\n"
+            "            await read_once(reader)\n"
+            "        except ConnectionError:\n"
+            "            n += 1\n"
+            "            if n > 3:\n"
+            "                return\n"
+            "            continue\n"
+        ),
+    }))
+    assert _rules(findings) == ["GF403"]
+    assert "pump" in findings[0].message
+
+
+def test_fault_site_fired_only_from_dead_code(tmp_path):
+    files = {
+        "pkg/runtime/faults.py": (
+            'FAULT_SITES: dict[str, str] = {"x.y": "a drill"}\n'
+        ),
+        "pkg/runtime/batcher.py": (
+            "def _dead(plane):\n"
+            "    plane.fire('x.y')\n"
+        ),
+    }
+    findings = protocolflow.check_fire_liveness(_project(tmp_path, files))
+    assert _rules(findings) == ["GF404"]
+    assert "x.y" in findings[0].message
+    # A single reference anywhere makes the drill live again.
+    files["pkg/runtime/batcher.py"] += "def boot(p):\n    _dead(p)\n"
+    assert protocolflow.check_fire_liveness(_project(tmp_path, files)) == []
+
+
+# -- suppressions -----------------------------------------------------------
+
+def test_suppressions_require_a_reason(tmp_path):
+    """# graftflow: ok(<reason>) suppresses on the line; an EMPTY reason
+    is inert; rule-scoped ignore[GFxxx] only matches its rule —
+    graftlint's escape semantics, verbatim."""
+    findings = eventloop.check(_project(tmp_path, {
+        "pkg/runtime/server.py": (
+            "import time\n"
+            "class S:\n"
+            "    async def a(self):\n"
+            "        time.sleep(0)  # graftflow: ok(GIL yield, sub-us)\n"
+            "    async def b(self):\n"
+            "        time.sleep(0)  # graftflow: ok()\n"
+            "    async def c(self):\n"
+            "        time.sleep(0)  # graftflow: ignore[GF201](yield)\n"
+            "    async def d(self):\n"
+            "        time.sleep(0)  # graftflow: ignore[GF202](wrong rule)\n"
+        ),
+    }))
+    assert [f.line for f in findings] == [6, 10]  # b (no reason), d (wrong rule)
+
+
+# -- THE tier-1 gate --------------------------------------------------------
+
+def test_repo_is_clean():
+    """Zero non-baselined findings over the real tree.  A new lock-order
+    hazard, event-loop block, leak path, or protocol gap fails tier-1
+    right here."""
+    project = load_project(ROOT)
+    findings = run_project(project)
+    new, _accepted = split_new(findings, read_baseline(ROOT))
+    assert not new, "new graftflow findings:\n" + "\n".join(
+        f.render() for f in new
+    )
+
+
+def test_pr7_bug_is_now_a_gate():
+    """The PR-7 review catch — a multi-MB zlib running ON the event loop
+    inside the KV send path — reproduced as source and caught by GF2
+    (the regression this whole tool exists to make structural)."""
+    import tempfile
+
+    src = (
+        "import zlib\n"
+        "async def send_kv_pages(writer, msg):\n"
+        "    frame = zlib.compress(msg)\n"   # the PR-7 bug, verbatim shape
+        "    writer.write(frame)\n"
+    )
+    with tempfile.TemporaryDirectory() as td:
+        p = Path(td) / "pkg" / "cluster" / "kv_transfer.py"
+        p.parent.mkdir(parents=True)
+        p.write_text(src, encoding="utf-8")
+        findings = eventloop.check(load_project(td))
+    assert _rules(findings) == ["GF201"]
+    assert "zlib.compress" in findings[0].message
+
+
+def test_cli_exit_codes(tmp_path):
+    # Dirty fixture tree -> exit 1 and the finding on stdout ...
+    mod = tmp_path / "pkg" / "runtime" / "server.py"
+    mod.parent.mkdir(parents=True)
+    mod.write_text(
+        "import time\n"
+        "class S:\n"
+        "    async def h(self):\n"
+        "        time.sleep(1)\n", encoding="utf-8")
+    r = subprocess.run(
+        [sys.executable, "-m", "tools.graftflow", "--root", str(tmp_path)],
+        capture_output=True, text=True, cwd=ROOT,
+    )
+    assert r.returncode == 1
+    assert "GF201" in r.stdout
+    # ... --baseline-write accepts the debt, after which the gate passes.
+    subprocess.run(
+        [sys.executable, "-m", "tools.graftflow", "--root", str(tmp_path),
+         "--baseline-write"],
+        capture_output=True, text=True, cwd=ROOT, check=True,
+    )
+    r2 = subprocess.run(
+        [sys.executable, "-m", "tools.graftflow", "--root", str(tmp_path)],
+        capture_output=True, text=True, cwd=ROOT,
+    )
+    assert r2.returncode == 0, r2.stdout + r2.stderr
+    # --only scoping rejects unknown families.
+    r3 = subprocess.run(
+        [sys.executable, "-m", "tools.graftflow", "--root", str(tmp_path),
+         "--only", "GF9"],
+        capture_output=True, text=True, cwd=ROOT,
+    )
+    assert r3.returncode == 2

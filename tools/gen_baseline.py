@@ -76,6 +76,10 @@ def _result_cell(row: dict) -> str:
         ("generate_tokens_keys", "generate compile keys"),
         ("generate_tokens_declared", "of declared"),
         ("trace_wall_ms", "trace wall ms"),
+        ("graftlint_wall_ms", "graftlint ms"),
+        ("graftcheck_wall_ms", "graftcheck ms"),
+        ("graftflow_wall_ms", "graftflow ms"),
+        ("analysis_wall_ms", "combined analysis ms"),
     ):
         if row.get(k) is not None:
             v = row[k]
@@ -108,7 +112,7 @@ def generate(ladder_path: str) -> str:
         "serving-latency", "continuous-batching", "local-proc-batching",
         "chunked-prefill", "prefix-cache-ttft", "fault-recovery",
         "overload-goodput", "replica-failover", "disagg-handoff",
-        "compile-stability",
+        "compile-stability", "analysis-wall",
         "ragged-decode-8k", "ragged-decode-win-8k", "quant-matmul-bw",
         "spec-decode", "spec-decode-7b-int8", "spec-batching",
         "paged-batching", "prefill-flash-2048", "prefill-flash-8192",

@@ -1,0 +1,71 @@
+"""graftflow — interprocedural concurrency & resource-safety checker.
+
+The third static-analysis tier: graftlint (PR 4) checks statements,
+graftcheck (PR 5) traces tensor contracts, graftflow checks the
+*interactions* the distributed serving layer lives or dies by — built on
+per-function control-flow graphs (with exception edges) and an
+intra-repo call graph (tools/graftflow/core.py):
+
+- GF1xx lock-order audit          (tools/graftflow/lockorder.py)
+- GF2xx event-loop blocking       (tools/graftflow/eventloop.py)
+- GF3xx resource pairing          (tools/graftflow/resources.py)
+- GF4xx protocol completeness     (tools/graftflow/protocolflow.py)
+- GFD01 README rules-table drift  (tools/graftflow/docs.py)
+
+Run as ``python -m tools.graftflow`` (exit 0 = clean) or through the
+unified front door ``python -m tools.check``; the tier-1 pytest gate is
+tests/tools/test_graftflow.py::test_repo_is_clean.  Accepted debt lives
+in ``graftflow_baseline.txt`` (checked in EMPTY; graftlint's normalized
+line-free multiset format).
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+
+from .core import BASELINE_NAME, Finding, Project, load_project, split_new
+from tools.graftlint.core import read_baseline as _read_baseline
+from tools.graftlint.core import write_baseline as _write_baseline
+
+FAMILIES = ("GF1", "GF2", "GF3", "GF4", "GFD")
+
+
+def write_baseline(root, findings):
+    return _write_baseline(Path(root), findings, name=BASELINE_NAME,
+                           tool="graftflow")
+
+
+def read_baseline(root):
+    return _read_baseline(Path(root), name=BASELINE_NAME)
+
+
+def run_project(project: Project,
+                only: set[str] | None = None) -> list[Finding]:
+    """Run every rule family (or the ``only`` subset of FAMILIES)."""
+    from . import docs, eventloop, lockorder, protocolflow, resources
+
+    def want(fam: str) -> bool:
+        return only is None or fam in only
+
+    findings: list[Finding] = []
+    if want("GF1"):
+        findings += lockorder.check(project)
+    if want("GF2"):
+        findings += eventloop.check(project)
+    if want("GF3"):
+        findings += resources.check(project)
+    if want("GF4"):
+        findings += protocolflow.check(project)
+    if want("GFD"):
+        findings += docs.check_docs(project.root)
+    return sorted(findings, key=lambda f: (f.path, f.line, f.rule, f.message))
+
+
+def run(root, only: set[str] | None = None) -> list[Finding]:
+    return run_project(load_project(root), only=only)
+
+
+__all__ = [
+    "BASELINE_NAME", "FAMILIES", "Finding", "Project", "load_project",
+    "read_baseline", "run", "run_project", "split_new", "write_baseline",
+]

@@ -1,0 +1,108 @@
+"""GFD01 — README rule-table drift.
+
+The README's "Dataflow checks" section carries a generated table of the
+GF rule families between ``<!-- graftflow:rules:begin/end -->`` markers
+(the graftlint/graftcheck convention): ``python -m tools.graftflow
+--write-docs`` regenerates it, and GFD01 fails the gate when the table
+diverges from :data:`RULE_DOCS` — the one place each rule's one-line
+contract lives.
+"""
+
+from __future__ import annotations
+
+import re
+from pathlib import Path
+
+from .core import Finding
+
+RULE_DRIFT = "GFD01"
+
+# rule id -> (family, one-line contract).  The README table renders from
+# this dict; keep entries in rule order.
+RULE_DOCS: dict[str, tuple[str, str]] = {
+    "GF101": ("GF1 lock order",
+              "no cycle in the global lock-acquisition graph (with-nesting "
+              "+ holds() annotations, propagated over the call graph)"),
+    "GF102": ("GF1 lock order",
+              "every nested acquisition follows the declared LOCK_ORDER "
+              "registry (runtime/faults.py, outermost first)"),
+    "GF103": ("GF1 lock order",
+              "every LOCK_ORDER entry names a lock some class in scope "
+              "actually declares"),
+    "GF201": ("GF2 event loop",
+              "no blocking call (zlib/pickle/socket/file I/O/time.sleep/"
+              "subprocess) reachable from a coroutine outside "
+              "asyncio.to_thread"),
+    "GF202": ("GF2 event loop",
+              "every FaultPlane.fire reachable from a coroutine passes "
+              "defer_stall=True (a stall rule must never block the loop)"),
+    "GF301": ("GF3 resources",
+              "allocated KV pages reach a release/store/handoff on every "
+              "CFG path, exception edges included"),
+    "GF302": ("GF3 resources",
+              "every bare .acquire() pairs with .release() on all paths "
+              "(prefer 'with')"),
+    "GF303": ("GF3 resources",
+              "cleanup-required registries (# graftflow: cleanup-required) "
+              "never strand an entry on an exception path"),
+    "GF401": ("GF4 protocol",
+              "every MESSAGE_TYPES frame has a sender and a handler; no "
+              "frame is built with an undeclared type"),
+    "GF402": ("GF4 protocol",
+              "every NACK/ERROR frame send increments a metric"),
+    "GF403": ("GF4 protocol",
+              "no unbounded transport-error retry loop (while True + "
+              "except + continue with no bounded exit)"),
+    "GF404": ("GF4 protocol",
+              "every fault site is fired from code something actually "
+              "references (no drills wired into dead functions)"),
+}
+
+_MARKER_RE = re.compile(
+    r"<!-- graftflow:rules:begin -->\n(.*?)<!-- graftflow:rules:end -->",
+    re.S,
+)
+
+
+def render_table() -> str:
+    lines = ["| rule | family | checks |", "| --- | --- | --- |"]
+    lines += [f"| {rule} | {fam} | {doc} |"
+              for rule, (fam, doc) in RULE_DOCS.items()]
+    return "\n".join(lines)
+
+
+def check_docs(root: Path) -> list[Finding]:
+    readme = root / "README.md"
+    if not readme.exists():
+        return []
+    text = readme.read_text(encoding="utf-8")
+    m = _MARKER_RE.search(text)
+    if m is None:
+        return [Finding(
+            RULE_DRIFT, "README.md", 1,
+            "missing '<!-- graftflow:rules:begin/end -->' block — run "
+            "python -m tools.graftflow --write-docs",
+        )]
+    if m.group(1).strip() != render_table().strip():
+        line = text[: m.start()].count("\n") + 1
+        return [Finding(
+            RULE_DRIFT, "README.md", line,
+            "GF rules table is stale vs tools/graftflow/docs.py — run "
+            "python -m tools.graftflow --write-docs",
+        )]
+    return []
+
+
+def write_docs(root: Path) -> bool:
+    readme = root / "README.md"
+    if not readme.exists():
+        return False
+    text = readme.read_text(encoding="utf-8")
+    if _MARKER_RE.search(text) is None:
+        return False
+    block = (f"<!-- graftflow:rules:begin -->\n{render_table()}\n"
+             f"<!-- graftflow:rules:end -->")
+    # Callable replacement: table text must never be read as re escapes.
+    readme.write_text(_MARKER_RE.sub(lambda _m: block, text),
+                      encoding="utf-8")
+    return True

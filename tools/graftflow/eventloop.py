@@ -1,0 +1,174 @@
+"""GF2xx — event-loop blocking audit.
+
+One asyncio event loop per process answers /healthz probes, routes
+requests, runs the fleet's failure detection, and shuttles KV handoffs.
+A single synchronous zlib/pickle/socket/file call anywhere in a
+coroutine's CALL GRAPH stalls all of it at once — PR 7 shipped exactly
+this bug (multi-MB zlib inside the KV send path wedging the same loop the
+fleet probes) and it was found by review, not by a gate.  GF2 is that
+gate:
+
+- **GF201**: a blocking call (``time.sleep``, zlib/pickle, sockets,
+  subprocess, file I/O, requests/urllib) lexically inside an ``async
+  def`` in scope, or inside a SYNC function transitively reachable from
+  one over the intra-repo call graph.  Work wrapped in
+  ``asyncio.to_thread(fn, ...)`` is off the loop and is never traversed
+  (the function is an argument there, not a call).
+- **GF202**: a ``FaultPlane.fire(...)`` call reachable from a coroutine
+  without ``defer_stall=True``.  ``fire`` applies ``stall`` rules with a
+  blocking sleep by design (it models a wedged device call for the
+  engine-thread sites); event-loop call sites must ask for the rule back
+  and await it instead — a drill armed at such a site would otherwise
+  freeze the whole loop, failure detection included.
+
+Findings land on the blocking call's line; deliberate blocks carry
+``# graftflow: ok(<reason>)`` there.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .core import (Finding, FnInfo, FnKey, Project, collect_functions,
+                   dotted_name, local_aliases, resolve_call, scope_files,
+                   suppressed)
+
+RULE_BLOCKING = "GF201"
+RULE_FIRE = "GF202"
+
+_BLOCKING_DOTTED = frozenset({
+    "time.sleep",
+    "zlib.compress", "zlib.decompress",
+    "pickle.dumps", "pickle.loads", "pickle.dump", "pickle.load",
+    "socket.socket", "socket.create_connection",
+    "os.system", "os.popen",
+})
+_BLOCKING_PREFIXES = ("subprocess.", "requests.", "urllib.request.")
+_BLOCKING_METHODS = frozenset({
+    "read_text", "write_text", "read_bytes", "write_bytes",
+    # zlib (de)compression objects: d.compress/.decompress — the exact
+    # PR-7 pattern once the one-liner is split into an object form.
+    "compress", "decompress",
+})
+
+
+def _blocking_name(call: ast.Call) -> str | None:
+    name = dotted_name(call.func)
+    if name in _BLOCKING_DOTTED:
+        return name
+    if name is not None and name.startswith(_BLOCKING_PREFIXES):
+        return name
+    if isinstance(call.func, ast.Name) and call.func.id == "open":
+        return "open"
+    if isinstance(call.func, ast.Attribute) \
+            and call.func.attr in _BLOCKING_METHODS:
+        return f"<..>.{call.func.attr}"
+    return None
+
+
+def _is_to_thread(call: ast.Call) -> bool:
+    return dotted_name(call.func) in ("asyncio.to_thread",
+                                      "anyio.to_thread.run_sync")
+
+
+def _is_fire(call: ast.Call) -> bool:
+    """A FaultPlane.fire site: ``.fire('<site>', ...)`` (GL301's shape),
+    or ``.fire(<expr>, ...)`` on a receiver that is recognizably a fault
+    plane (``self.faults``, ``plane``, ``_FAULTS`` — protocol.py passes
+    its site as a variable)."""
+    if not (isinstance(call.func, ast.Attribute)
+            and call.func.attr == "fire" and bool(call.args)):
+        return False
+    if isinstance(call.args[0], ast.Constant) \
+            and isinstance(call.args[0].value, str):
+        return True
+    recv = (dotted_name(call.func.value) or "").lower()
+    return "fault" in recv or "plane" in recv
+
+
+def _fire_site(call: ast.Call) -> str:
+    first = call.args[0]
+    if isinstance(first, ast.Constant) and isinstance(first.value, str):
+        return repr(first.value)
+    return "<dynamic site>"
+
+
+def _fire_deferred(call: ast.Call) -> bool:
+    return any(kw.arg == "defer_stall"
+               and isinstance(kw.value, ast.Constant) and kw.value.value is True
+               for kw in call.keywords)
+
+
+def _scan_fn(info: FnInfo, entry: FnKey, fns: dict[FnKey, FnInfo],
+             findings: list[Finding], seen_sites: set,
+             reach: list[tuple[FnKey, FnKey]]) -> None:
+    """Flag blocking calls in one function and queue sync callees.
+    ``entry`` is the coroutine this function is reachable from (for the
+    message); nested defs are included in the walk (a closure defined in
+    a coroutine typically runs on the loop — call_soon, callbacks)."""
+    aliases = local_aliases(info.node)
+    for node in ast.walk(info.node):
+        if not isinstance(node, ast.Call):
+            continue
+        if _is_to_thread(node):
+            continue  # arguments are references, not calls: off-loop
+        if _is_fire(node):
+            if not _fire_deferred(node):
+                site = (info.sf.rel, node.lineno, RULE_FIRE)
+                if site not in seen_sites:
+                    seen_sites.add(site)
+                    if not suppressed(info.sf, RULE_FIRE, node.lineno):
+                        findings.append(Finding(
+                            RULE_FIRE, info.sf.rel, node.lineno,
+                            f"FaultPlane.fire({_fire_site(node)}) without "
+                            f"defer_stall=True in {info.key.pretty()} is "
+                            f"reachable from the event loop (async "
+                            f"{entry.pretty()}) — a stall rule here would "
+                            f"block the loop, failure detection included",
+                        ))
+            continue  # fire's own guarded sleep is the deferral's job
+        what = _blocking_name(node)
+        if what is not None:
+            site = (info.sf.rel, node.lineno, RULE_BLOCKING)
+            if site in seen_sites:
+                continue
+            seen_sites.add(site)
+            if not suppressed(info.sf, RULE_BLOCKING, node.lineno):
+                via = ("" if info.key == entry
+                       else f" via {info.key.pretty()}")
+                findings.append(Finding(
+                    RULE_BLOCKING, info.sf.rel, node.lineno,
+                    f"blocking call '{what}' runs on the event loop: "
+                    f"reachable from async {entry.pretty()}{via} — wrap "
+                    f"the work in asyncio.to_thread or move it off the "
+                    f"coroutine path",
+                ))
+            continue
+        for callee in resolve_call(node, info.key, aliases, fns):
+            target = fns.get(callee)
+            if target is not None and not target.is_async:
+                reach.append((callee, entry))
+
+
+def check(project: Project) -> list[Finding]:
+    files = scope_files(project)
+    fns = collect_functions(files)
+    findings: list[Finding] = []
+    seen_sites: set = set()
+    # A function is scanned ONCE, attributed to the first coroutine that
+    # reached it (seen_sites additionally dedupes the finding lines).
+    done: set[FnKey] = set()
+    # Every coroutine in scope is an entry point: handlers, probe loops,
+    # transfer paths — anything awaited eventually runs on the loop.
+    work: list[tuple[FnKey, FnKey]] = sorted(
+        ((k, k) for k, info in fns.items() if info.is_async),
+        key=lambda kk: (kk[0].rel, kk[0].cls or "", kk[0].name),
+        reverse=True,  # popped in order: deterministic attribution
+    )
+    while work:
+        key, entry = work.pop()
+        if key in done:
+            continue
+        done.add(key)
+        _scan_fn(fns[key], entry, fns, findings, seen_sites, work)
+    return sorted(findings, key=lambda f: (f.path, f.line, f.rule))

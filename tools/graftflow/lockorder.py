@@ -1,0 +1,270 @@
+"""GF1xx — interprocedural lock-order audit.
+
+The serving core holds real ``threading.Lock``\\ s on three layers —
+``InferenceServer._submit_lock`` (loop-side submission/registry),
+``ContinuousBatcher._lock`` (queue + kv-import handoff), and
+``PagePool._lock`` (allocator + prefix-cache LRU), with the process-wide
+``Metrics._lock`` as the universal leaf — and the documented acquisition
+order (server.py: "lock order is _submit_lock -> batcher._lock,
+everywhere") lived only in comments.  A new call path that nests the
+other way is a deadlock that no unit test will find (it needs two threads
+to interleave exactly wrong).  Linux lockdep mechanizes exactly this
+class at runtime; GF1 mechanizes it statically:
+
+- the checker builds the GLOBAL lock-acquisition graph: an edge A -> B
+  for every site that acquires B (lexical ``with <lock>:``) while holding
+  A (an enclosing ``with``, a ``# graftlint: holds(<lock>)`` annotation,
+  or a lock held by a CALLER, propagated over the intra-repo call graph);
+- **GF101**: any cycle in that graph (including A -> A: these are
+  non-reentrant locks);
+- **GF102**: any edge that contradicts the declared ``LOCK_ORDER``
+  registry in ``runtime/faults.py`` (outermost first, FAULT_SITES-style
+  name -> one-line doc);
+- **GF103**: a ``LOCK_ORDER`` entry naming a lock no class in scope
+  declares — registry drift, the dead-entry class GL305 pins for fault
+  sites.
+
+Lock identity is ``Class.field`` (``with self._lock:`` in PagePool is
+``PagePool._lock``; ``with self.pool._lock:`` in the batcher resolves
+through the collaborator field map).  Only attributes whose name contains
+``lock`` participate — asyncio semaphores and other ``with`` contexts are
+not mutual-exclusion order hazards between threads.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .core import (FIELD_CLASSES, Finding, FnInfo, FnKey, GLOBAL_CLASSES,
+                   Project, collect_functions, literal_strdict, local_aliases,
+                   resolve_call, scope_files, suppressed)
+
+RULE_CYCLE = "GF101"
+RULE_ORDER = "GF102"
+RULE_DRIFT = "GF103"
+
+REGISTRY_MODULE = "runtime/faults.py"
+REGISTRY_NAME = "LOCK_ORDER"
+
+
+def _lockish(name: str) -> bool:
+    return "lock" in name.lower()
+
+
+def lock_of_expr(expr: ast.expr, cls: str | None,
+                 aliases: dict[str, str]) -> str | None:
+    """Canonical ``Class.field`` name of a lock expression, or None."""
+    if isinstance(expr, ast.Attribute) and _lockish(expr.attr):
+        v = expr.value
+        if isinstance(v, ast.Name):
+            if v.id == "self" and cls is not None:
+                return f"{cls}.{expr.attr}"
+            if v.id in aliases:
+                return f"{aliases[v.id]}.{expr.attr}"
+            if v.id in GLOBAL_CLASSES:
+                return f"{GLOBAL_CLASSES[v.id]}.{expr.attr}"
+        elif (isinstance(v, ast.Attribute)
+                and isinstance(v.value, ast.Name) and v.value.id == "self"
+                and v.attr in FIELD_CLASSES):
+            return f"{FIELD_CLASSES[v.attr]}.{expr.attr}"
+    return None
+
+
+def _holds_of(info: FnInfo) -> set[str]:
+    """holds() annotations translated to canonical lock names."""
+    out: set[str] = set()
+    for text in info.sf.holds_locks(info.node):
+        # normalized "self._lock" / "self.pool._lock" strings
+        try:
+            expr = ast.parse(text, mode="eval").body
+        except SyntaxError:
+            continue
+        lock = lock_of_expr(expr, info.key.cls, {})
+        if lock is not None:
+            out.add(lock)
+    return out
+
+
+class _Acquisition:
+    __slots__ = ("held", "lock", "rel", "line", "where")
+
+    def __init__(self, held: frozenset, lock: str, rel: str, line: int,
+                 where: str) -> None:
+        self.held = held
+        self.lock = lock
+        self.rel = rel
+        self.line = line
+        self.where = where
+
+
+class _FnWalk(ast.NodeVisitor):
+    """One pass over one function body with a given entry-held set:
+    records lock acquisitions (with the locks held at that point) and
+    call sites (with the held set to propagate to callees)."""
+
+    def __init__(self, info: FnInfo, entry_held: frozenset,
+                 fns: dict[FnKey, FnInfo]) -> None:
+        self.info = info
+        self.fns = fns
+        self.aliases = local_aliases(info.node)
+        self.held: list[str] = sorted(entry_held)
+        self.acquisitions: list[_Acquisition] = []
+        self.calls: list[tuple[FnKey, frozenset]] = []
+
+    def run(self) -> None:
+        for stmt in self.info.node.body:
+            self.visit(stmt)
+
+    # with-blocks do not cross function boundaries: a nested def runs
+    # whenever it is CALLED, not where it is defined.
+    def visit_FunctionDef(self, node) -> None:  # noqa: N802
+        pass
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def _visit_with(self, node) -> None:
+        got: list[str] = []
+        for item in node.items:
+            lock = lock_of_expr(item.context_expr, self.info.key.cls,
+                                self.aliases)
+            if lock is not None:
+                self.acquisitions.append(_Acquisition(
+                    frozenset(self.held + got), lock, self.info.sf.rel,
+                    node.lineno, self.info.key.pretty(),
+                ))
+                got.append(lock)
+        self.held += got
+        self.generic_visit(node)
+        if got:
+            del self.held[len(self.held) - len(got):]
+
+    visit_With = _visit_with
+    visit_AsyncWith = _visit_with
+
+    def visit_Call(self, node: ast.Call) -> None:  # noqa: N802
+        for callee in resolve_call(node, self.info.key, self.aliases,
+                                   self.fns):
+            self.calls.append((callee, frozenset(self.held)))
+        self.generic_visit(node)
+
+
+def build_acquisition_graph(
+    fns: dict[FnKey, FnInfo],
+) -> list[_Acquisition]:
+    """Interprocedural fixpoint: run every function under every distinct
+    entry-held set that reaches it (holds() annotations seed; call sites
+    propagate)."""
+    acquisitions: list[_Acquisition] = []
+    done: set[tuple[FnKey, frozenset]] = set()
+    work: list[tuple[FnKey, frozenset]] = [
+        (k, frozenset(_holds_of(info))) for k, info in fns.items()
+    ]
+    while work:
+        key, entry = work.pop()
+        if (key, entry) in done or key not in fns:
+            continue
+        done.add((key, entry))
+        walk = _FnWalk(fns[key], entry | _holds_of(fns[key]), fns)
+        walk.run()
+        acquisitions.extend(walk.acquisitions)
+        for callee, held in walk.calls:
+            if held and (callee, held) not in done:
+                work.append((callee, held))
+    return acquisitions
+
+
+def _cycle_edges(edges: dict[tuple[str, str], _Acquisition]
+                 ) -> list[tuple[str, str]]:
+    """Edges that sit on a cycle: (a, b) where b reaches a."""
+    adj: dict[str, set[str]] = {}
+    for a, b in edges:
+        adj.setdefault(a, set()).add(b)
+
+    def reaches(src: str, dst: str) -> bool:
+        seen, stack = set(), [src]
+        while stack:
+            n = stack.pop()
+            if n == dst:
+                return True
+            if n in seen:
+                continue
+            seen.add(n)
+            stack.extend(adj.get(n, ()))
+        return False
+
+    return [(a, b) for (a, b) in edges if reaches(b, a)]
+
+
+def _declared_locks_exist(project: Project, registry: dict[str, str]
+                          ) -> dict[str, bool]:
+    """lock name -> whether some class in scope assigns that attribute."""
+    assigned: set[str] = set()
+    for sf in scope_files(project):
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.ClassDef):
+                continue
+            for sub in ast.walk(node):
+                targets = (sub.targets if isinstance(sub, ast.Assign)
+                           else [sub.target]
+                           if isinstance(sub, (ast.AnnAssign, ast.AugAssign))
+                           else [])
+                for t in targets:
+                    if (isinstance(t, ast.Attribute)
+                            and isinstance(t.value, ast.Name)
+                            and t.value.id == "self"):
+                        assigned.add(f"{node.name}.{t.attr}")
+    return {lock: lock in assigned for lock in registry}
+
+
+def check(project: Project) -> list[Finding]:
+    files = scope_files(project)
+    if not files:
+        return []
+    reg_file = next(
+        (f for f in files if f.rel.endswith(REGISTRY_MODULE)), None)
+    registry = (literal_strdict(reg_file, REGISTRY_NAME)
+                if reg_file is not None else None) or {}
+    order = {lock: i for i, lock in enumerate(registry)}
+
+    fns = collect_functions(files)
+    acquisitions = build_acquisition_graph(fns)
+
+    # Collapse to one witness per directed edge (first by file/line).
+    edges: dict[tuple[str, str], _Acquisition] = {}
+    for acq in sorted(acquisitions, key=lambda a: (a.rel, a.line)):
+        for held in acq.held:
+            edges.setdefault((held, acq.lock), acq)
+
+    findings: list[Finding] = []
+    on_cycle = set(_cycle_edges(edges))
+    for (a, b), acq in sorted(edges.items()):
+        sf = next(f for f in files if f.rel == acq.rel)
+        if (a, b) in on_cycle:
+            if not suppressed(sf, RULE_CYCLE, acq.line):
+                findings.append(Finding(
+                    RULE_CYCLE, acq.rel, acq.line,
+                    f"lock-order cycle: {acq.where} acquires '{b}' while "
+                    f"holding '{a}', and '{b}' is (transitively) held "
+                    f"around '{a}' elsewhere — two threads interleaving "
+                    f"these paths deadlock",
+                ))
+            continue
+        if a in order and b in order and order[a] > order[b]:
+            if not suppressed(sf, RULE_ORDER, acq.line):
+                findings.append(Finding(
+                    RULE_ORDER, acq.rel, acq.line,
+                    f"{acq.where} acquires '{b}' while holding '{a}' — "
+                    f"LOCK_ORDER ({REGISTRY_MODULE}) ranks '{b}' before "
+                    f"'{a}'; nest the other way or split the critical "
+                    f"section",
+                ))
+    if reg_file is not None and registry:
+        for lock, exists in sorted(
+                _declared_locks_exist(project, registry).items()):
+            if not exists:
+                findings.append(Finding(
+                    RULE_DRIFT, reg_file.rel, 1,
+                    f"LOCK_ORDER entry '{lock}' names a lock no class in "
+                    f"scope declares — registry drift",
+                ))
+    return findings

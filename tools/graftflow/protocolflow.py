@@ -1,0 +1,299 @@
+"""GF4xx — protocol and drill-plane completeness.
+
+The control plane is string-keyed three times over: frame types
+(``MESSAGE_TYPES`` in cluster/protocol.py), NACK/error reasons, and
+fault-injection sites (``FAULT_SITES``).  graftlint's GL301/GL305 pin the
+*names*; GF4 pins the *flow* — every declared frame must actually move
+and be understood, every refusal must be observable, every retry must
+terminate, and every drill must sit on a live path:
+
+- **GF401** frame coverage: every ``MESSAGE_TYPES`` entry has at least
+  one sender (the type literal built into a frame / passed to a send
+  helper) AND at least one handler (the literal compared or matched on a
+  receive path) in the package — an unsent type is dead protocol
+  surface, an unhandled one is a peer that answers ``invalid message``
+  in production only.  A ``message("TYPO")`` literal absent from
+  MESSAGE_TYPES is the same finding from the other side.
+- **GF402** NACK accounting: a function that sends a structured refusal
+  (a frame whose payload carries ``"ok": False``, or an ``ERROR`` frame)
+  must increment a metric — refusals that leave no counter trail are
+  invisible exactly when the fleet needs them (the PR-7 NACK ladder is
+  only debuggable because each reason counts).
+- **GF403** bounded retry: a ``while True:`` loop whose except-handler
+  catches transport errors (ConnectionError/OSError/Timeout/EOF/
+  IncompleteRead/ProtocolError) and ``continue``\\ s, with no
+  break/return/raise in that handler, retries forever — every retry site
+  must bound its attempts (a counted loop condition, or a guarded exit
+  in the handler).
+- **GF404** drill liveness: every ``FAULT_SITES`` entry fired in the
+  package must have at least one fire site inside a REACHABLE function
+  (referenced by name somewhere else in the tree) — a drill wired only
+  into dead code passes GL305 yet can never actually fire.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .core import (Finding, Project, collect_functions, literal_strdict,
+                   scope_files, suppressed)
+
+RULE_FRAMES = "GF401"
+RULE_NACK = "GF402"
+RULE_RETRY = "GF403"
+RULE_DEAD_FIRE = "GF404"
+
+PROTOCOL_MODULE = "cluster/protocol.py"
+FAULTS_MODULE = "runtime/faults.py"
+
+_NETWORK_EXCS = frozenset({
+    "ConnectionError", "ConnectionResetError", "BrokenPipeError",
+    "OSError", "TimeoutError", "EOFError", "IncompleteReadError",
+    "ProtocolError",
+})
+
+
+# graftlint's parser for the ``NAME = frozenset({...})`` literal idiom —
+# one definition, so MESSAGE_TYPES reads identically in both tools.
+from tools.graftlint.registry import _literal_strset  # noqa: E402
+
+
+# -- GF401 ------------------------------------------------------------------
+
+def _is_message_call(call: ast.Call) -> bool:
+    f = call.func
+    name = f.id if isinstance(f, ast.Name) else (
+        f.attr if isinstance(f, ast.Attribute) else None)
+    return name == "message"
+
+
+def check_frames(project: Project) -> list[Finding]:
+    files = scope_files(project)
+    proto = next((f for f in files if f.rel.endswith(PROTOCOL_MODULE)), None)
+    if proto is None:
+        return []
+    types = _literal_strset(proto, "MESSAGE_TYPES")
+    if not types:
+        return [Finding(RULE_FRAMES, proto.rel, 1,
+                        "no MESSAGE_TYPES literal declared")]
+    senders: dict[str, int] = {t: 0 for t in types}
+    handlers: dict[str, int] = {t: 0 for t in types}
+    findings: list[Finding] = []
+    for sf in files:
+        for node in ast.walk(sf.tree):
+            if isinstance(node, ast.Call):
+                lits = [a.value for a in list(node.args)
+                        + [kw.value for kw in node.keywords]
+                        if isinstance(a, ast.Constant)
+                        and isinstance(a.value, str)]
+                for v in lits:
+                    if v in types:
+                        senders[v] += 1
+                if _is_message_call(node) and node.args:
+                    first = node.args[0]
+                    if (isinstance(first, ast.Constant)
+                            and isinstance(first.value, str)
+                            and first.value not in types
+                            and not suppressed(sf, RULE_FRAMES, node.lineno)):
+                        findings.append(Finding(
+                            RULE_FRAMES, sf.rel, node.lineno,
+                            f"frame type {first.value!r} built here is not "
+                            f"in MESSAGE_TYPES ({proto.rel}) — "
+                            f"protocol.encode will refuse it at runtime",
+                        ))
+            elif isinstance(node, ast.Compare):
+                for side in [node.left] + list(node.comparators):
+                    consts = ([side] if isinstance(side, ast.Constant)
+                              else [n for n in ast.walk(side)
+                                    if isinstance(n, ast.Constant)])
+                    for c in consts:
+                        if isinstance(c.value, str) and c.value in types:
+                            handlers[c.value] += 1
+            elif isinstance(node, ast.MatchValue) \
+                    and isinstance(node.value, ast.Constant) \
+                    and isinstance(node.value.value, str) \
+                    and node.value.value in types:
+                handlers[node.value.value] += 1
+    # The declaration site itself is neither a sender nor a handler; the
+    # MESSAGE_TYPES literal lives outside any Call/Compare so it never
+    # counted above.  BATCH frames are expanded by unbatch() on receive.
+    for t in sorted(types):
+        if senders[t] == 0 and not suppressed(proto, RULE_FRAMES, 1):
+            findings.append(Finding(
+                RULE_FRAMES, proto.rel, 1,
+                f"frame type '{t}' has no sender in the package — dead "
+                f"protocol surface (or the sender builds its type "
+                f"dynamically from an unchecked string)",
+            ))
+        if handlers[t] == 0 and not suppressed(proto, RULE_FRAMES, 1):
+            findings.append(Finding(
+                RULE_FRAMES, proto.rel, 1,
+                f"frame type '{t}' has no handler in the package — a "
+                f"peer sending it gets silence or 'invalid message'",
+            ))
+    return findings
+
+
+# -- GF402 ------------------------------------------------------------------
+
+def _sends_nack(call: ast.Call) -> bool:
+    """A message(...) construction carrying {"ok": False, ...} or type
+    'ERROR'."""
+    if not _is_message_call(call) or not call.args:
+        return False
+    first = call.args[0]
+    if isinstance(first, ast.Constant) and first.value == "ERROR":
+        return True
+    for arg in list(call.args) + [kw.value for kw in call.keywords]:
+        if isinstance(arg, ast.Dict):
+            for k, v in zip(arg.keys, arg.values):
+                if (isinstance(k, ast.Constant) and k.value == "ok"
+                        and isinstance(v, ast.Constant)
+                        and v.value is False):
+                    return True
+    return False
+
+
+def _has_metric_inc(fn: ast.AST) -> bool:
+    for node in ast.walk(fn):
+        if (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in ("inc", "observe")
+                and isinstance(node.func.value, ast.Name)
+                and node.func.value.id == "METRICS"):
+            return True
+    return False
+
+
+def check_nacks(project: Project) -> list[Finding]:
+    findings: list[Finding] = []
+    for info in collect_functions(scope_files(project)).values():
+        nack_lines = [
+            node.lineno for node in ast.walk(info.node)
+            if isinstance(node, ast.Call) and _sends_nack(node)
+        ]
+        if not nack_lines or _has_metric_inc(info.node):
+            continue
+        line = min(nack_lines)
+        if suppressed(info.sf, RULE_NACK, line):
+            continue
+        findings.append(Finding(
+            RULE_NACK, info.sf.rel, line,
+            f"{info.key.pretty()} sends a NACK/error frame but increments "
+            f"no metric — structured refusals must leave a counter trail "
+            f"(register one in METRIC_DOCS and inc it)",
+        ))
+    return findings
+
+
+# -- GF403 ------------------------------------------------------------------
+
+def _catches_network(handler: ast.ExceptHandler) -> bool:
+    t = handler.type
+    if t is None:
+        return True  # bare except swallows transport errors too
+    names = {n.attr if isinstance(n, ast.Attribute) else getattr(n, "id", "")
+             for n in ([t] if not isinstance(t, ast.Tuple) else t.elts)}
+    return bool(names & _NETWORK_EXCS)
+
+
+def check_retries(project: Project) -> list[Finding]:
+    findings: list[Finding] = []
+    for info in collect_functions(scope_files(project)).values():
+        for node in ast.walk(info.node):
+            if not (isinstance(node, ast.While)
+                    and isinstance(node.test, ast.Constant)
+                    and bool(node.test.value)):
+                continue
+            for sub in ast.walk(node):
+                if not isinstance(sub, ast.ExceptHandler) \
+                        or not _catches_network(sub):
+                    continue
+                has_continue = any(isinstance(s, ast.Continue)
+                                   for s in ast.walk(sub))
+                has_exit = any(isinstance(s, (ast.Break, ast.Return,
+                                              ast.Raise))
+                               for s in ast.walk(sub))
+                if has_continue and not has_exit \
+                        and not suppressed(info.sf, RULE_RETRY, sub.lineno):
+                    findings.append(Finding(
+                        RULE_RETRY, info.sf.rel, sub.lineno,
+                        f"unbounded retry in {info.key.pretty()}: 'while "
+                        f"True' catches a transport error and continues "
+                        f"with no break/return/raise in the handler — "
+                        f"bound the attempts or make the loop condition "
+                        f"count them",
+                    ))
+    return findings
+
+
+# -- GF404 ------------------------------------------------------------------
+
+def _referenced_names(project: Project) -> set[str]:
+    """Every function/method name referenced anywhere in the tree other
+    than as its own def — calls AND bare references (thread targets,
+    callbacks, handler registration)."""
+    out: set[str] = set()
+    for sf in project.files:
+        for node in ast.walk(sf.tree):
+            if isinstance(node, ast.Attribute):
+                out.add(node.attr)
+            elif isinstance(node, ast.Name):
+                out.add(node.id)
+    return out
+
+
+def check_fire_liveness(project: Project) -> list[Finding]:
+    files = scope_files(project)
+    faults = next((f for f in files if f.rel.endswith(FAULTS_MODULE)), None)
+    if faults is None:
+        return []
+    registry = literal_strdict(faults, "FAULT_SITES")
+    if not registry:
+        return []
+    refs = _referenced_names(project)
+    fns = collect_functions(files)
+    # site -> list of (fn_key, line, reachable)
+    sites: dict[str, list[tuple]] = {}
+    for info in fns.values():
+        for node in ast.walk(info.node):
+            if (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "fire" and node.args
+                    and isinstance(node.args[0], ast.Constant)
+                    and isinstance(node.args[0].value, str)):
+                site = node.args[0].value
+                reachable = (
+                    info.key.name in refs
+                    or info.key.name.startswith("__")
+                    or info.key.name in ("main", "run")
+                )
+                sites.setdefault(site, []).append(
+                    (info, node.lineno, reachable))
+        # module-level fire calls (outside any def) are always live; they
+        # are not collected here, so sites fired only there stay silent —
+        # acceptable: the tree has none.
+    findings: list[Finding] = []
+    for site, uses in sorted(sites.items()):
+        if site not in registry:
+            continue  # GL301's finding, not ours
+        if any(reachable for _info, _ln, reachable in uses):
+            continue
+        info, line, _ = uses[0]
+        if suppressed(info.sf, RULE_DEAD_FIRE, line):
+            continue
+        findings.append(Finding(
+            RULE_DEAD_FIRE, info.sf.rel, line,
+            f"fault site '{site}' is fired only from "
+            f"{info.key.pretty()}, which nothing in the tree references "
+            f"— the drill is wired into dead code and can never fire",
+        ))
+    return findings
+
+
+def check(project: Project) -> list[Finding]:
+    return sorted(
+        check_frames(project) + check_nacks(project)
+        + check_retries(project) + check_fire_liveness(project),
+        key=lambda f: (f.path, f.line, f.rule, f.message),
+    )

@@ -1,0 +1,289 @@
+"""GF3xx — path-sensitive resource pairing.
+
+The KV pool is refcounted by hand, mailboxes are registered by hand, and
+semaphores are acquired by hand — and the leak class PRs 2–3 fixed
+repeatedly was always the same shape: the pairing held on the happy path
+and broke on ONE path (an early return, or an exception thrown between
+acquire and release).  GF3 walks every function's CFG — including the
+exception edges — and demands the pairing on all of them:
+
+- **GF301** page-pool pairing: pages obtained via ``x = <..>.alloc(...)``
+  (or the batcher's ``_alloc_pages`` wrapper) must be released, stored,
+  returned, or handed to another owner on EVERY path from the allocation
+  to function exit, exception exits included.  The first statement that
+  mentions ``x`` again counts as the sink (conservative: the checker
+  cannot see whether a callee keeps the reference), so what this rule
+  pins is the canonical leak — an alloc followed by a path (a guard
+  return, a raising call) that forgets the pages entirely.  An
+  intervening raising statement needs a ``try/finally`` release to be
+  safe.
+- **GF302** explicit ``<recv>.acquire()`` (lock/semaphore) must have a
+  ``<recv>.release()`` on every path to exit — i.e. in a ``finally`` (or
+  the code between them cannot raise or return).  Prefer ``with recv:``.
+- **GF303** registry cleanup: a mapping/set field whose ``__init__``
+  declaration carries ``# graftflow: cleanup-required`` (the serving
+  gateway's ``_requests`` mailbox registry) must not strand entries on
+  exception paths: after ``self.f[k] = v`` / ``self.f.add(k)``, every
+  path to an EXCEPTION exit must pass a cleanup (``pop``/``del``/
+  ``discard``/``remove``/``clear`` on the same field, or a same-class
+  helper that performs one).  Normal returns are exempt — outliving the
+  function is what a registry is for.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from .core import (Finding, FnInfo, Project, build_cfg, collect_functions,
+                   exec_parts, expr_text, leaky_paths, mentions_name,
+                   scope_files, suppressed)
+
+RULE_PAGES = "GF301"
+RULE_ACQUIRE = "GF302"
+RULE_REGISTRY = "GF303"
+
+_ALLOC_METHODS = frozenset({"alloc", "_alloc_pages"})
+_CLEANUP_METHODS = frozenset({"pop", "discard", "remove", "clear"})
+_CLEANUP_RE = re.compile(r"#\s*graftflow:\s*cleanup-required\b")
+
+
+# -- GF301: page allocations -----------------------------------------------
+
+def _alloc_target(stmt: ast.stmt) -> str | None:
+    """Local name bound to an allocation: ``x = <recv>.alloc(n)``."""
+    if not (isinstance(stmt, ast.Assign) and len(stmt.targets) == 1
+            and isinstance(stmt.targets[0], ast.Name)
+            and isinstance(stmt.value, ast.Call)
+            and isinstance(stmt.value.func, ast.Attribute)
+            and stmt.value.func.attr in _ALLOC_METHODS):
+        return None
+    return stmt.targets[0].id
+
+
+def _check_pages(info: FnInfo, findings: list[Finding]) -> None:
+    cfg = build_cfg(info.node)
+    for node in cfg.nodes:
+        if node.stmt is None:
+            continue
+        x = _alloc_target(node.stmt)
+        if x is None:
+            continue
+        line = node.stmt.lineno
+        if suppressed(info.sf, RULE_PAGES, line):
+            continue
+
+        def clears(n, x=x):
+            return mentions_name(n.stmt, x)
+
+        hit = leaky_paths(node, clears, (cfg.exit, cfg.raise_exit))
+        if hit is not None:
+            how = ("an exception exit" if hit is cfg.raise_exit
+                   else "a normal exit")
+            findings.append(Finding(
+                RULE_PAGES, info.sf.rel, line,
+                f"pages allocated into '{x}' in {info.key.pretty()} can "
+                f"reach {how} with no release/store on that path — a "
+                f"refcount leak the pool audit only catches after the "
+                f"fact; release in a finally or store before anything "
+                f"can raise",
+            ))
+
+
+# -- GF302: bare acquire/release -------------------------------------------
+
+def _check_acquire(info: FnInfo, findings: list[Finding]) -> None:
+    cfg = build_cfg(info.node)
+    for node in cfg.nodes:
+        stmt = node.stmt
+        if not (isinstance(stmt, ast.Expr)
+                and isinstance(stmt.value, ast.Call)
+                and isinstance(stmt.value.func, ast.Attribute)
+                and stmt.value.func.attr == "acquire"):
+            continue
+        recv = expr_text(stmt.value.func.value)
+        line = stmt.lineno
+        if suppressed(info.sf, RULE_ACQUIRE, line):
+            continue
+
+        def clears(n, recv=recv):
+            for part in exec_parts(n.stmt):
+                for sub in ast.walk(part):
+                    if (isinstance(sub, ast.Call)
+                            and isinstance(sub.func, ast.Attribute)
+                            and sub.func.attr == "release"
+                            and expr_text(sub.func.value) == recv):
+                        return True
+            return False
+
+        hit = leaky_paths(node, clears, (cfg.exit, cfg.raise_exit))
+        if hit is not None:
+            how = ("an exception exit" if hit is cfg.raise_exit
+                   else "a normal exit")
+            findings.append(Finding(
+                RULE_ACQUIRE, info.sf.rel, line,
+                f"'{recv}.acquire()' in {info.key.pretty()} can reach "
+                f"{how} without '{recv}.release()' on that path — use "
+                f"'with {recv}:' or release in a finally",
+            ))
+
+
+# -- GF303: annotated registry cleanup -------------------------------------
+
+def _annotated_registries(info_sf, cls: ast.ClassDef) -> set[str]:
+    """Fields whose declaration carries ``# graftflow: cleanup-required``."""
+    out: set[str] = set()
+    for node in ast.walk(cls):
+        targets = (node.targets if isinstance(node, ast.Assign)
+                   else [node.target]
+                   if isinstance(node, (ast.AnnAssign, ast.AugAssign))
+                   else [])
+        for t in targets:
+            if (isinstance(t, ast.Attribute)
+                    and isinstance(t.value, ast.Name)
+                    and t.value.id == "self"
+                    and _CLEANUP_RE.search(info_sf._comment_for(node.lineno))):
+                out.add(t.attr)
+    return out
+
+
+def _is_cleanup(stmt: ast.stmt, field: str) -> bool:
+    if isinstance(stmt, (ast.For, ast.AsyncFor, ast.While)):
+        # A sweep loop ("for rid in subs: self.f.pop(rid)") is the
+        # standard cleanup idiom; the CFG's zero-iteration edge would
+        # otherwise read it as skippable.  Trusting the subtree here is a
+        # deliberate under-approximation of leaks.
+        return _expr_cleans(stmt, field)
+    for part in exec_parts(stmt):
+        if _expr_cleans(part, field):
+            return True
+    return False
+
+
+def _expr_cleans(tree: ast.AST, field: str) -> bool:
+    for sub in ast.walk(tree):
+        if (isinstance(sub, ast.Call)
+                and isinstance(sub.func, ast.Attribute)
+                and sub.func.attr in _CLEANUP_METHODS
+                and isinstance(sub.func.value, ast.Attribute)
+                and sub.func.value.attr == field
+                and isinstance(sub.func.value.value, ast.Name)
+                and sub.func.value.value.id == "self"):
+            return True
+        if isinstance(sub, ast.Delete):
+            for tgt in sub.targets:
+                if (isinstance(tgt, ast.Subscript)
+                        and isinstance(tgt.value, ast.Attribute)
+                        and tgt.value.attr == field
+                        and isinstance(tgt.value.value, ast.Name)
+                        and tgt.value.value.id == "self"):
+                    return True
+    return False
+
+
+def _registration(stmt: ast.stmt, field: str) -> bool:
+    """``self.f[k] = v`` or ``self.f.add(k)`` (sets)."""
+    if isinstance(stmt, ast.Assign):
+        for t in stmt.targets:
+            if (isinstance(t, ast.Subscript)
+                    and isinstance(t.value, ast.Attribute)
+                    and t.value.attr == field
+                    and isinstance(t.value.value, ast.Name)
+                    and t.value.value.id == "self"):
+                return True
+    if (isinstance(stmt, ast.Expr) and isinstance(stmt.value, ast.Call)
+            and isinstance(stmt.value.func, ast.Attribute)
+            and stmt.value.func.attr == "add"
+            and isinstance(stmt.value.func.value, ast.Attribute)
+            and stmt.value.func.value.attr == field
+            and isinstance(stmt.value.func.value.value, ast.Name)
+            and stmt.value.func.value.value.id == "self"):
+        return True
+    return False
+
+
+def _calls_helper(tree: ast.AST, helpers: set[str]) -> bool:
+    for sub in ast.walk(tree):
+        if (isinstance(sub, ast.Call)
+                and isinstance(sub.func, ast.Attribute)
+                and sub.func.attr in helpers
+                and isinstance(sub.func.value, ast.Name)
+                and sub.func.value.id == "self"):
+            return True
+    return False
+
+
+def _clears_registry(stmt: ast.stmt, field: str, helpers: set[str]) -> bool:
+    """Whether this CFG node discharges the registration obligation: a
+    cleanup of the field, or a call to a same-class helper that performs
+    one (loops get subtree trust — see :func:`_is_cleanup`)."""
+    if _is_cleanup(stmt, field):
+        return True
+    if isinstance(stmt, (ast.For, ast.AsyncFor, ast.While)):
+        return _calls_helper(stmt, helpers)
+    return any(_calls_helper(part, helpers) for part in exec_parts(stmt))
+
+
+def _cleanup_helpers(sf, cls: ast.ClassDef, field: str) -> set[str]:
+    """Same-class methods that (directly) perform a cleanup of ``field``
+    — calling one counts as cleaning up (the interprocedural hop the
+    serving handlers actually use)."""
+    out: set[str] = set()
+    for sub in cls.body:
+        if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            if any(_is_cleanup(s, field) for s in ast.walk(sub)
+                   if isinstance(s, ast.stmt)):
+                out.add(sub.name)
+    return out
+
+
+def _check_registries(project: Project, findings: list[Finding]) -> None:
+    for sf in scope_files(project):
+        for cls in sf.tree.body:
+            if not isinstance(cls, ast.ClassDef):
+                continue
+            fields = _annotated_registries(sf, cls)
+            if not fields:
+                continue
+            for field in sorted(fields):
+                helpers = _cleanup_helpers(sf, cls, field)
+                for fn in cls.body:
+                    if not isinstance(fn, (ast.FunctionDef,
+                                           ast.AsyncFunctionDef)):
+                        continue
+                    if fn.name == "__init__":
+                        continue  # construction: nothing shared yet
+                    cfg = build_cfg(fn)
+                    for node in cfg.nodes:
+                        if node.stmt is None \
+                                or not _registration(node.stmt, field):
+                            continue
+                        line = node.stmt.lineno
+                        if suppressed(sf, RULE_REGISTRY, line):
+                            continue
+
+                        def clears(n, field=field, helpers=helpers):
+                            return _clears_registry(n.stmt, field, helpers)
+
+                        if leaky_paths(node, clears,
+                                       (cfg.raise_exit,)) is not None:
+                            findings.append(Finding(
+                                RULE_REGISTRY, sf.rel, line,
+                                f"an exception path after registering "
+                                f"into 'self.{field}' "
+                                f"({cls.name}.{fn.name}) strands the "
+                                f"entry — the field is marked "
+                                f"cleanup-required; pop it in an "
+                                f"except/finally on every raising path",
+                            ))
+    return
+
+
+def check(project: Project) -> list[Finding]:
+    findings: list[Finding] = []
+    fns = collect_functions(scope_files(project))
+    for info in fns.values():
+        _check_pages(info, findings)
+        _check_acquire(info, findings)
+    _check_registries(project, findings)
+    return sorted(findings, key=lambda f: (f.path, f.line, f.rule))

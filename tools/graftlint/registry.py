@@ -56,7 +56,9 @@ def _find_module(project: Project, suffix: str) -> SourceFile | None:
 
 
 def _literal_dict(sf: SourceFile, name: str) -> dict[str, str] | None:
-    """A module-level ``NAME = {str: str}`` dict literal, else None."""
+    """A module-level ``NAME = {str: str}`` dict literal, else None.
+    Also imported by tools/graftflow (LOCK_ORDER / FAULT_SITES reads) —
+    the registry idiom must parse identically across the tools."""
     for node in sf.tree.body:
         targets = (node.targets if isinstance(node, ast.Assign)
                    else [node.target] if isinstance(node, ast.AnnAssign)
@@ -76,7 +78,8 @@ def _literal_dict(sf: SourceFile, name: str) -> dict[str, str] | None:
 
 
 def _literal_strset(sf: SourceFile, name: str) -> set[str] | None:
-    """A module-level ``NAME = frozenset({...})`` / set / tuple of str."""
+    """A module-level ``NAME = frozenset({...})`` / set / tuple of str.
+    Also imported by tools/graftflow (MESSAGE_TYPES reads)."""
     for node in sf.tree.body:
         targets = (node.targets if isinstance(node, ast.Assign)
                    else [node.target] if isinstance(node, ast.AnnAssign)
