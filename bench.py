@@ -1729,6 +1729,133 @@ def _measure_overload_goodput(
     return out
 
 
+def _measure_kv_tiering(
+    preset: str | None = None, dtype: str = "bfloat16", page_size: int = 16,
+) -> dict:
+    """KV memory tiering (PR 9), three numbers on any platform:
+
+    (a) **capacity factor** — concurrent rows admitted at FIXED pool
+        bytes, int8 pages vs bf16 pages (the pool is the binding resource
+        for concurrency; >= 1.8x is the acceptance floor at head_dim 64);
+    (b) **swap-restore vs recompute** — wall time to bring a preempted
+        >= 4-page-prefix victim back to decoding, host-tier raw-page
+        restore vs exact prefix recompute;
+    (c) **spill-hit TTFT** — time to the first token of a shared-prefix
+        request whose cached run was LRU-evicted, host-tier restore vs
+        cold re-prefill.
+    """
+    import statistics
+
+    from distributed_llms_tpu.runtime.batcher import (ContinuousBatcher,
+                                                      pool_page_bytes)
+    from distributed_llms_tpu.runtime.tokenizer import ByteTokenizer
+
+    preset = preset or ("gpt2-125m" if jax.devices()[0].platform == "cpu"
+                        else "tinyllama-1.1b")
+    cfg, params = _build_params(preset, dtype, None)
+    tok = ByteTokenizer()
+    blk = page_size
+    max_len = 8 * blk
+
+    def mk(pages, **kw):
+        kw.setdefault("batch_slots", 16)
+        return ContinuousBatcher(
+            cfg, params, tokenizer=tok, eos_id=tok.eos_id, pad_id=tok.pad_id,
+            max_len=max_len, chunk_steps=4, page_size=blk,
+            paged_pages=pages, **kw,
+        )
+
+    # (a) capacity at fixed pool bytes: every request reserves exactly
+    # prompt (1 page) + 1 decode page; count rows resident after one
+    # admission round.  The full-width leg is pinned to bf16 pages
+    # (kv_dtype knob) so the factor means the same thing on every
+    # platform — a CPU f32 compute dtype must not inflate it.
+    bytes16 = pool_page_bytes(cfg, blk, 16, "bfloat16")
+    bytes8 = pool_page_bytes(cfg, blk, 8)
+    pages16 = 13  # 12 usable
+    budget_bytes = pages16 * bytes16
+    pages8 = budget_bytes // bytes8
+    prompt_ids = list(range(2, 2 + blk))  # exactly one full page
+
+    def concurrent_rows(bits, pages):
+        b = mk(int(pages), kv_bits=bits, kv_dtype="bfloat16",
+               batch_slots=32)
+        for _ in range(32):
+            b.submit(prompt_ids, max_new_tokens=2 * blk)
+        b._admit_pending()
+        rows = sum(1 for r in b.rows if r.rid is not None)
+        b.assert_pool_consistent()
+        return rows
+
+    rows16 = concurrent_rows(16, pages16)
+    rows8 = concurrent_rows(8, pages8)
+    capacity_factor = rows8 / max(rows16, 1)
+
+    # (b) swap-restore vs recompute for a >= 4-page-prefix victim.
+    victim_prompt = list(range(2, 2 + 4 * blk))  # 4 full pages
+
+    def restore_ms(host_pages):
+        b = mk(13, batch_slots=2, host_pages=host_pages)
+        times = []
+        b.submit(victim_prompt, max_new_tokens=8)
+        b._admit_pending()  # warm the admission path
+        for it in range(4):
+            i = next(j for j in range(b.b) if b.rows[j].rid is not None)
+            t0 = time.perf_counter()
+            b._preempt_row(i, "bench")
+            b._admit_pending()  # swap restore OR recompute prefill
+            times.append((time.perf_counter() - t0) * 1e3)
+        b.run()
+        b.assert_pool_consistent()
+        return statistics.median(times[1:])  # drop the compile-warm lap
+
+    swap_ms = restore_ms(host_pages=16)
+    recompute_ms = restore_ms(host_pages=0)
+
+    # (c) spill-hit TTFT vs cold re-prefill after eviction.
+    shared = list(range(2, 2 + 3 * blk)) + [7, 8, 9]
+
+    def ttft_after_eviction_ms(host_pages):
+        b = mk(13, batch_slots=2, prefix_cache=True, host_pages=host_pages)
+        b.submit(shared, max_new_tokens=4)
+        b.run()  # warm + publish the shared pages
+
+        def evict_then_hit():
+            for i in range(3):  # evict the shared run
+                b.submit([90 + i] * (3 * blk) + [i], max_new_tokens=4)
+            b.run()
+            first = []
+            rid = b.submit(shared, max_new_tokens=4)
+            t0 = time.perf_counter()
+            b.run(on_tokens=lambda r, t, d, l: first.append(
+                time.perf_counter()) if r == rid and t and not first
+                else None)
+            return (first[0] - t0) * 1e3
+
+        evict_then_hit()  # compile-warm lap (restore + hit-admission jits)
+        out = evict_then_hit()
+        b.assert_pool_consistent()
+        return out
+
+    spill_ttft_ms = ttft_after_eviction_ms(host_pages=32)
+    cold_ttft_ms = ttft_after_eviction_ms(host_pages=0)
+
+    return {
+        "preset": preset,
+        "platform": jax.devices()[0].platform,
+        "page_size": blk,
+        "pool_bytes_mb": round(budget_bytes / 2**20, 2),
+        "rows_bf16": rows16,
+        "rows_int8": rows8,
+        "capacity_factor_int8": round(capacity_factor, 2),
+        "swap_restore_ms": round(swap_ms, 1),
+        "recompute_restore_ms": round(recompute_ms, 1),
+        "swap_speedup": round(recompute_ms / max(swap_ms, 1e-9), 2),
+        "spill_hit_ttft_ms": round(spill_ttft_ms, 1),
+        "cold_ttft_ms": round(cold_ttft_ms, 1),
+    }
+
+
 def _measure_compile_stability() -> dict:
     """Compile-key stability of the serving entry points
     (tools/graftcheck GC4, run as a MEASUREMENT): sweep the request-length
@@ -2097,6 +2224,7 @@ def run_ladder(args, degraded: str | None) -> list[dict]:
             "local-proc-batching", "chunked-prefill", "prefix-cache-ttft",
             "fault-recovery", "overload-goodput", "compile-stability",
             "replica-failover", "disagg-handoff", "analysis-wall",
+            "kv-tiering",
         }
         unknown = only - known
         if unknown:  # a typo must not masquerade as a clean zero-row run
@@ -2229,6 +2357,12 @@ def run_ladder(args, degraded: str | None) -> list[dict]:
         # growth plane took — a host-scheduling effect, meaningful on any
         # platform.
         ("overload-goodput", lambda: _measure_overload_goodput(dtype=dtype)),
+        # KV memory tiering: concurrent capacity per pool byte at int8 vs
+        # bf16, swap-restore vs recompute latency for a long-prefix
+        # preemption victim, and spill-hit TTFT after eviction — memory
+        # accounting + host-scheduling effects, meaningful on any
+        # platform.
+        ("kv-tiering", lambda: _measure_kv_tiering(dtype=dtype)),
         # Replica-fleet serving: N replicas behind the health-aware
         # router, one killed abruptly mid-storm; stamps failover recovery
         # latency, goodput, and the byte-exactness count of every

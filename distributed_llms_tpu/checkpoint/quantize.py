@@ -187,6 +187,45 @@ def dequantize_tree(params: Any, dtype: Any = None) -> Any:
     )
 
 
+# ---------------------------------------------------------------------------
+# KV-cache quantization (int8 KV pages, runtime/batcher.py PagePool tiering)
+#
+# The same absmax scheme as quantize()/dequantize() above, specialised to the
+# KV layout: one float32 scale per head-dim VECTOR (block == head_dim along
+# the last axis — the finest block the weight path supports), so the decode
+# kernel can fold the scale into the attention contraction itself:
+# score = (q . k_int8) * k_scale and out = sum((p * v_scale) . v_int8) —
+# per-(slot, head) scales sit OUTSIDE the head-dim dot product, which is what
+# lets ops/decode_attn.py read the pool at 1 byte/elem and never materialize
+# a dequantized page in HBM.
+# ---------------------------------------------------------------------------
+
+KV_QMAX = 127.0  # int8 absmax grid, the quantize() scheme's 8-bit constant
+
+
+def kv_quantize(x: "jax.Array") -> tuple["jax.Array", "jax.Array"]:
+    """Quantize KV vectors to int8 with one absmax scale per trailing
+    head-dim vector.  ``x`` is [..., HD]; returns (data int8 [..., HD],
+    scale float32 [...]).  Exact round-trip property: quantizing the
+    output of :func:`kv_dequantize` reproduces the identical int8 data and
+    scales (the dequantized absmax IS qmax * scale), which is what makes
+    re-quantizing a dequantized handoff payload byte-stable."""
+    xf = x.astype(jnp.float32)
+    absmax = jnp.max(jnp.abs(xf), axis=-1)
+    scale = jnp.where(absmax > 0, absmax / KV_QMAX, 1.0)
+    data = jnp.clip(
+        jnp.round(xf / scale[..., None]), -KV_QMAX, KV_QMAX
+    ).astype(jnp.int8)
+    return data, scale
+
+
+def kv_dequantize(data: "jax.Array", scale: "jax.Array", dtype: Any) -> "jax.Array":
+    """Restore int8 KV vectors: ``f32(data) * scale`` cast to ``dtype`` —
+    the exact numerics :func:`dequantize` uses, and the reference the
+    fused decode-attention int8 leg must match."""
+    return (data.astype(jnp.float32) * scale[..., None]).astype(dtype)
+
+
 def tree_bytes(params: Any) -> int:
     total = 0
     for leaf in jax.tree.leaves(params, is_leaf=lambda x: isinstance(x, QuantizedTensor)):

@@ -35,6 +35,8 @@ _RUNTIME_FLAGS: dict[str, str] = {
     "paged-pages": "paged_pages",
     "page-size": "page_size",
     "prefix-cache": "prefix_cache",
+    "kv-bits": "kv_bits",
+    "host-pages": "host_pages",
     "request-timeout": "request_timeout_s",
     "shed-cost-factor": "shed_cost_factor",
     "fault": "faults",
@@ -110,6 +112,8 @@ def _server_factory(args, engine, default_name, rt, faults, *,
             paged_pages=args.paged_pages,
             page_size=args.page_size,
             prefix_cache=args.prefix_cache,
+            kv_bits=args.kv_bits,
+            host_pages=args.host_pages,
             faults=faults,
         )
 
@@ -212,6 +216,10 @@ def build_fleet(args):
         max_failover_retries=args.failover_retries,
         faults=faults,
         handoff=bool(args.disaggregate),
+        # Affinity/handoff digests must match the fleet's pool digests,
+        # which are salted by the KV width (--kv-bits) — a mismatched
+        # salt would read as a digest mismatch on every handoff.
+        kv_bits=(args.kv_bits if args.kv_bits is not None else rt.kv_bits),
     )
     return fleet, router
 
@@ -317,6 +325,17 @@ def main(argv=None) -> None:
     ap.add_argument("--page-size", type=int, default=None,
                     help="paged KV: tokens per page (default: "
                          "runtime.page_size)")
+    ap.add_argument("--kv-bits", type=int, default=None, choices=[16, 8],
+                    help="KV pool width: 8 stores pages as int8 with "
+                         "blockwise absmax scales (~1.9x concurrent rows "
+                         "per pool byte; greedy outputs parity-bounded, "
+                         "not bit-exact).  Needs --paged-pages.  Default: "
+                         "runtime.kv_bits (16)")
+    ap.add_argument("--host-pages", type=int, default=None,
+                    help="host-RAM KV tier size in pages: preemption swaps "
+                         "rows out (byte-exact restore) and cold cached "
+                         "pages spill before eviction.  Needs "
+                         "--paged-pages.  Default: runtime.host_pages (0)")
     ap.add_argument("--prefix-cache", action=argparse.BooleanOptionalAction,
                     default=None,
                     help="automatic prefix caching over the paged pool: "

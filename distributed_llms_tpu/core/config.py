@@ -240,6 +240,19 @@ class RuntimeConfig:
     # templates, multi-turn history — prefill only their un-cached
     # suffix.  Requires paged_pages; ignored (with a warning) otherwise.
     prefix_cache: bool = False
+    # KV memory tiering (runtime/batcher.py, paged mode only):
+    # kv_bits=8 stores pool pages as int8 with blockwise absmax scales —
+    # roughly half the KV bytes per token, so ~1.9x concurrent rows per
+    # pool byte; dequant fuses into the decode-attention read and greedy
+    # outputs are parity-bounded (not bit-exact) vs bf16 pages.  16 = the
+    # full-width kv_cache_dtype pool.
+    kv_bits: int = 16
+    # Host-RAM tier behind the paged pool, in pages: preemption SWAPS
+    # victim rows out (byte-exact restore instead of prefix recompute;
+    # exact-recompute fallback when the budget is dry) and cold
+    # prefix-cache pages spill there before LRU eviction (a later hit
+    # restores instead of re-prefilling).  0 disables.
+    host_pages: int = 0
     # Speculative decoding (runtime/speculative.py).  With spec_decode=True
     # on a single-device full-precision engine, generate_text transparently
     # routes greedy requests through the speculative loop (results are

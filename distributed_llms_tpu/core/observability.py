@@ -213,6 +213,25 @@ METRIC_DOCS: dict[str, str] = {
                                  "decode-role engine",
     "batcher.kv_pages_imported": "handed-off KV pages adopted into the "
                                  "pool (decode-role engine)",
+    # -- KV memory tiering (int8 pages + host-RAM tier) --
+    "batcher.kv_swaps.out": "preemption victims swapped to the host tier "
+                            "(raw pages parked instead of recomputed)",
+    "batcher.kv_swaps.in": "swapped rows restored to device pages "
+                           "(byte-exact, no recompute)",
+    "batcher.kv_swaps.fallback": "swap/restore attempts degraded to exact "
+                                 "recompute (host budget dry, drop drill, "
+                                 "or checksum mismatch)",
+    "batcher.host_tier.spilled_pages": "cold cached pages captured to host "
+                                       "RAM ahead of LRU eviction",
+    "batcher.host_tier.restored_pages": "host-spilled pages scattered back "
+                                        "into the pool on a prefix-cache "
+                                        "hit",
+    "batcher.host_tier.hits": "prefix-cache lookups extended by a "
+                              "host-tier restore",
+    "batcher.host_tier.spill_evictions": "host-spilled pages dropped for "
+                                         "tier budget pressure",
+    "batcher.host_tier.*": "host-tier occupancy gauges (budget/used pages, "
+                           "swap parcels, spill entries)",
     # -- serving gateway (runtime/server.py) --
     "server.requests": "completion requests accepted past the shed gates",
     "server.disconnects": "requests whose client went away mid-serve",

@@ -49,6 +49,38 @@ class KVCache:
         return self.k.shape[2]
 
 
+@dataclass
+class QuantKVCache:
+    """Int8-quantized KV page pool (``--kv-bits 8`` tiering): ``k``/``v``
+    hold the pool pages at int8 ([L, NB, BLK, KVH, HD]) and
+    ``k_scale``/``v_scale`` one float32 absmax scale per head-dim vector
+    ([L, NB, BLK, KVH] — checkpoint.quantize.kv_quantize's layout).  Pages
+    are quantized ONCE at the write (admission splice / decode-step
+    scatter) and dequantized inside the attention read (the decode
+    kernel's int8 leg folds the scales into the contraction), so pool
+    storage is never materialized full-width.  ``row_dtype`` names the
+    dequantized dtype transient row caches (and gathers) restore to —
+    static metadata, so jit keys stay stable.
+
+    Decode-only through :func:`forward` (requires ``kv_tables``): the
+    contiguous per-row and prefill paths keep full-width caches."""
+
+    k: Any
+    v: Any
+    k_scale: Any
+    v_scale: Any
+    row_dtype: str = "bfloat16"
+
+
+# data/scales are pytree children; row_dtype is static metadata (hashable,
+# part of the jit key — exactly how QuantizedTensor registers its bits).
+jax.tree_util.register_dataclass(
+    QuantKVCache,
+    data_fields=["k", "v", "k_scale", "v_scale"],
+    meta_fields=["row_dtype"],
+)
+
+
 def init_cache(
     cfg: ModelConfig, batch: int, max_len: int, dtype: Any = None,
     prompt_len: int | None = None,
@@ -132,6 +164,34 @@ def _attention(
                 "cannot honor sliding_window"
             )
         from ..ops import decode_attn
+
+        if len(layer_cache) == 4:
+            # Int8-quantized pool (QuantKVCache per layer): quantize this
+            # step's single K/V vector per (row, head) with the absmax
+            # scale machinery (checkpoint.quantize.kv_quantize), scatter
+            # int8 data + f32 scale, and hand the kernel the scales — the
+            # int8 leg folds them into the attention contraction, so the
+            # pool is read at 1 byte/elem and never dequantized in HBM.
+            from ..checkpoint.quantize import kv_quantize
+
+            ck, cv, sk, sv = layer_cache
+            blk = ck.shape[1]
+            rows = jnp.arange(x.shape[0], dtype=jnp.int32)
+            page = kv_tables[rows, cache_index // blk]
+            off = cache_index % blk
+            kq, ks = kv_quantize(k[:, 0])  # [B, KVH, HD] i8, [B, KVH] f32
+            vq, vs = kv_quantize(v[:, 0])
+            # Same duplicate-tolerant scatter contract as the full-width
+            # branch below (freed rows share the scratch page).
+            ck = ck.at[page, off].set(kq)
+            cv = cv.at[page, off].set(vq)
+            sk = sk.at[page, off].set(ks)
+            sv = sv.at[page, off].set(vs)
+            out = decode_attn.paged_decode_attention(
+                q, ck, cv, cache_index + 1, kv_tables,
+                k_scale=sk, v_scale=sv,
+            )
+            return layers.out_project(out, p), (ck, cv, sk, sv)
 
         ck, cv = layer_cache  # [NB, BLK, KVH, HD] page pools
         blk = ck.shape[1]
@@ -428,7 +488,11 @@ def run_blocks(
     std_layout: bool = False,
     kv_tables: jax.Array | None = None,
     key_positions: jax.Array | None = None,  # see _attention
-) -> tuple[jax.Array, tuple[jax.Array, jax.Array] | None, jax.Array]:
+    cache_sk: jax.Array | None = None,  # [L, NB, BLK, KVH] f32 absmax
+    #   scales of an int8 page pool (QuantKVCache); layer_cache becomes a
+    #   4-tuple per layer and the paged decode reads/writes quantized
+    cache_sv: jax.Array | None = None,
+) -> tuple[jax.Array, tuple | None, jax.Array]:
     """Scan the stacked blocks over x.  Used both for the whole model and for
     a single pipeline stage (blocks then hold only the stage's layer slice).
     Returns (x, caches, aux) — aux sums the MoE load-balance terms.
@@ -449,6 +513,19 @@ def run_blocks(
             body = jax.checkpoint(body)
         x, auxs = jax.lax.scan(body, x, blocks)
         return x, None, jnp.sum(auxs)
+
+    if cache_sk is not None:
+        def body_q(carry, xs):
+            layer_params, ck, cv, sk, sv = xs
+            y, new_cache, aux = block_fn(carry, layer_params, cfg, positions, (ck, cv, sk, sv), cache_index, attn_mask, std_layout, kv_tables, key_positions)
+            return y, (new_cache, aux)
+
+        if remat:
+            body_q = jax.checkpoint(body_q)
+        x, (new_cache, auxs) = jax.lax.scan(
+            body_q, x, (blocks, cache_k, cache_v, cache_sk, cache_sv)
+        )
+        return x, new_cache, jnp.sum(auxs)
 
     def body(carry, xs):
         layer_params, ck, cv = xs
@@ -532,6 +609,23 @@ def forward(
     if cache is None:
         x, _, aux = run_blocks(x, params["blocks"], cfg, positions, None, None, None, remat, attn_mask, std_layout)
         out = (unembed(params, cfg, x), None)
+    elif isinstance(cache, QuantKVCache):
+        # Int8 page pool: decode-only (the per-step quantized write and the
+        # scale-fused attention read both live on the kv_tables path).
+        if kv_tables is None:
+            raise ValueError(
+                "QuantKVCache serves paged decode only (pass kv_tables); "
+                "prefill runs against full-width transient rows"
+            )
+        x, (new_k, new_v, new_sk, new_sv), aux = run_blocks(
+            x, params["blocks"], cfg, positions, cache.k, cache.v,
+            cache_index, remat, attn_mask, std_layout, kv_tables,
+            key_positions, cache_sk=cache.k_scale, cache_sv=cache.v_scale,
+        )
+        out = (unembed(params, cfg, x), QuantKVCache(
+            k=new_k, v=new_v, k_scale=new_sk, v_scale=new_sv,
+            row_dtype=cache.row_dtype,
+        ))
     else:
         x, (new_k, new_v), aux = run_blocks(
             x, params["blocks"], cfg, positions, cache.k, cache.v, cache_index, remat, attn_mask, std_layout, kv_tables, key_positions

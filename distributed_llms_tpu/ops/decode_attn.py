@@ -55,11 +55,8 @@ def _kernel(
     q_ref,  # [1, KVH*Gp, D] — per-kv-head query groups, sublane-padded
     k_ref,  # [1, bk, KVH, D] — a block of the cache in its NATIVE layout
     v_ref,  # [1, bk, KVH, D]
-    o_ref,  # [1, KVH*Gp, D]
-    acc_ref,  # VMEM [KVH*Gp, D] f32
-    m_ref,  # VMEM [KVH*Gp, 128] f32
-    l_ref,  # VMEM [KVH*Gp, 128] f32
-    *,
+    *rest,  # int8 leg: [ks_ref [1, bk, KVH] f32, vs_ref], then o_ref and
+    #   the three VMEM scratch refs (acc [KVH*Gp, D], m/l [KVH*Gp, 128])
     scale: float,
     block_k: int,
     num_k_blocks: int,
@@ -68,7 +65,16 @@ def _kernel(
     window: int | None = None,  # row b reads [length - window, length)
     #   instead of [0, length) — exact under the contract layout
     #   (slot == position), where the query sits at position length - 1
+    quant: bool = False,  # int8 K/V blocks + per-(slot, head) absmax
+    #   scales: score = (q . k_i8) * k_scale and out folds v_scale into
+    #   the softmax weights — the dequant never materializes in VMEM
+    #   beyond one cast block
 ):
+    if quant:
+        ks_ref, vs_ref, o_ref, acc_ref, m_ref, l_ref = rest
+    else:
+        ks_ref = vs_ref = None
+        o_ref, acc_ref, m_ref, l_ref = rest
     bi, ji = pl.program_id(0), pl.program_id(1)
     length = lengths_ref[bi]
     last_needed = jax.lax.div(jnp.maximum(length - 1, 0), block_k)
@@ -98,6 +104,9 @@ def _kernel(
             # Per-head cast to the compute dtype: the cache may live at a
             # different dtype (kv_dtype knob) and casting here keeps the
             # HBM read at the cache's width — never a full-cache copy.
+            # Int8 leg: the cast is the only widening (one block in VMEM);
+            # the absmax scales fold into the contraction below instead of
+            # dequantizing the block.
             kb = k_ref[0, :, hh, :].astype(q_ref.dtype)
             vb = v_ref[0, :, hh, :].astype(q_ref.dtype)
             s = (
@@ -107,6 +116,11 @@ def _kernel(
                 )
                 * scale
             )  # [Gp, bk] f32
+            if quant:
+                # score = (q . k_i8) * k_scale — per-(slot, head) scales
+                # sit outside the head-dim dot product by construction
+                # (checkpoint.quantize.kv_quantize blocks on HD).
+                s = s * ks_ref[0, :, hh][None, :]
             keep = key_pos < length
             if window is not None:
                 # layers.and_window in slot space: keys in
@@ -120,6 +134,10 @@ def _kernel(
             p = jnp.exp(s - safe[:, None])
             alpha = jnp.exp(m_prev - safe)
             l_ref[r0:r1, 0] = l_prev * alpha + jnp.sum(p, axis=-1)
+            if quant:
+                # out = sum_i p_i * (v_scale_i * v_i8_i): fold the scale
+                # into the softmax weights (f32) before the value matmul.
+                p = p * vs_ref[0, :, hh][None, :]
             acc_ref[r0:r1, :] = acc_ref[r0:r1, :] * alpha[
                 :, None
             ] + jax.lax.dot_general(
@@ -140,6 +158,27 @@ def _kernel_paged(lengths_ref, tables_ref, *rest, **kw):
     the compute body is identical to the contiguous kernel."""
     del tables_ref
     return _kernel(lengths_ref, *rest, **kw)
+
+
+def _dequant(k, v, k_scale, v_scale, dtype):
+    """Restore int8 K/V to the compute dtype for the dense fallback —
+    checkpoint.quantize.kv_dequantize numerics (f32(data) * scale), the
+    reference the fused kernel leg is parity-tested against."""
+    from ..checkpoint.quantize import kv_dequantize
+
+    return kv_dequantize(k, k_scale, dtype), kv_dequantize(v, v_scale, dtype)
+
+
+def _check_quant(k, k_scale, v_scale):
+    """Validate the int8 leg's argument contract (both scales or neither;
+    int8 data when scales are present)."""
+    if (k_scale is None) != (v_scale is None):
+        raise ValueError("pass both k_scale and v_scale, or neither")
+    if k_scale is not None and k.dtype != jnp.int8:
+        raise ValueError(
+            f"KV scales given but pages are {k.dtype}, not int8"
+        )
+    return k_scale is not None
 
 
 def _dense_reference(q, k, v, lengths, window=None):
@@ -181,11 +220,16 @@ def ragged_decode_attention(
     #   [lengths[b] - window, lengths[b]) — the index maps clamp the DMA
     #   walk into that band, so windowed long-context decode reads
     #   O(window) KV bytes per row instead of O(length)
+    k_scale: jax.Array | None = None,  # [B, S, KVH] f32 absmax scales —
+    #   int8 leg: k/v are int8 and the kernel folds the per-(slot, head)
+    #   scales into the attention contraction (q.k_i8 * scale)
+    v_scale: jax.Array | None = None,
 ) -> jax.Array:
     """Returns [B, 1, H, D] in q.dtype.  Inference-only (no VJP)."""
     mode = _mode()
     b, t, h, d = q.shape
     assert t == 1, "ragged decode attention is single-token by construction"
+    quant = _check_quant(k, k_scale, v_scale)
     s, kvh = k.shape[1], k.shape[2]
     g = h // kvh
     # Largest K block that tiles the cache width exactly — a width that is a
@@ -202,6 +246,8 @@ def ragged_decode_attention(
     )
     tileable = bk is not None and d % 128 == 0
     if mode == "fallback" or not tileable:
+        if quant:
+            k, v = _dequant(k, v, k_scale, v_scale, q.dtype)
         return _dense_reference(q, k, v, lengths, window)
 
     gp = _round_up(g, 8)  # sublane-pad the per-kv-head query group
@@ -226,19 +272,32 @@ def ragged_decode_attention(
             kk = jnp.maximum(kk, first)
         return (bi, kk, 0, 0)
 
+    def scale_index(bi, ji, lengths_ref):
+        # Same DMA walk as the K/V blocks, one axis shorter ([B, S, KVH]).
+        return kv_index(bi, ji, lengths_ref)[:3]
+
+    in_specs = [
+        pl.BlockSpec((1, kvh * gp, d), lambda bi, ji, L: (bi, 0, 0)),
+        pl.BlockSpec((1, bk, kvh, d), kv_index),
+        pl.BlockSpec((1, bk, kvh, d), kv_index),
+    ]
+    operands = [lengths.astype(jnp.int32), qt.reshape(b, kvh * gp, d), k, v]
+    if quant:
+        in_specs += [
+            pl.BlockSpec((1, bk, kvh), scale_index),
+            pl.BlockSpec((1, bk, kvh), scale_index),
+        ]
+        operands += [k_scale.astype(jnp.float32),
+                     v_scale.astype(jnp.float32)]
     out = pl.pallas_call(
         functools.partial(
             _kernel, scale=d**-0.5, block_k=bk, num_k_blocks=nk,
-            kvh=kvh, gp=gp, window=window,
+            kvh=kvh, gp=gp, window=window, quant=quant,
         ),
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=1,
             grid=(b, nk),
-            in_specs=[
-                pl.BlockSpec((1, kvh * gp, d), lambda bi, ji, L: (bi, 0, 0)),
-                pl.BlockSpec((1, bk, kvh, d), kv_index),
-                pl.BlockSpec((1, bk, kvh, d), kv_index),
-            ],
+            in_specs=in_specs,
             out_specs=pl.BlockSpec(
                 (1, kvh * gp, d), lambda bi, ji, L: (bi, 0, 0)
             ),
@@ -250,12 +309,7 @@ def ragged_decode_attention(
         ),
         out_shape=jax.ShapeDtypeStruct((b, kvh * gp, d), q.dtype),
         interpret=mode == "interpret",
-    )(
-        lengths.astype(jnp.int32),
-        qt.reshape(b, kvh * gp, d),
-        k,
-        v,
-    )
+    )(*operands)
     out = out.reshape(b, kvh, gp, d)[:, :, :g]  # [B, KVH, G, D]
     return out.reshape(b, 1, h, d)
 
@@ -275,6 +329,12 @@ def paged_decode_attention(
     #                     depth may be arbitrary (never dereferenced by the
     #                     kernel: the index map clamps to the last needed
     #                     page; the fallback masks their scores)
+    k_scale: jax.Array | None = None,  # [NB, BLK, KVH] f32 absmax scales —
+    #                     int8 leg: pages are int8 (QuantKVCache pools) and
+    #                     the kernel fuses scale into the contraction, so
+    #                     the pool reads 1 byte/elem and a dequantized page
+    #                     never exists in HBM
+    v_scale: jax.Array | None = None,
 ) -> jax.Array:
     """Paged variant of :func:`ragged_decode_attention`: the KV cache lives
     as pool pages indexed per row through a block table (vLLM-style memory
@@ -285,6 +345,7 @@ def paged_decode_attention(
     mode = _mode()
     b, t, h, d = q.shape
     assert t == 1, "paged decode attention is single-token by construction"
+    quant = _check_quant(k_pages, k_scale, v_scale)
     nb, blk, kvh = k_pages.shape[0], k_pages.shape[1], k_pages.shape[2]
     p = tables.shape[1]
     g = h // kvh
@@ -293,9 +354,17 @@ def paged_decode_attention(
     )
     if mode == "fallback" or not tileable:
         # Gather the rows' pages into contiguous [B, P*BLK] caches (the
-        # fallback materializes; the kernel never does).
+        # fallback materializes; the kernel never does).  Int8 pools
+        # dequantize the gathered rows at kv_dequantize numerics.
         k_rows = k_pages[tables].reshape(b, p * blk, kvh, d)
         v_rows = v_pages[tables].reshape(b, p * blk, kvh, d)
+        if quant:
+            k_rows, v_rows = _dequant(
+                k_rows, v_rows,
+                k_scale[tables].reshape(b, p * blk, kvh),
+                v_scale[tables].reshape(b, p * blk, kvh),
+                q.dtype,
+            )
         return _dense_reference(q, k_rows, v_rows, lengths)
 
     gp = _round_up(g, 8)
@@ -307,21 +376,36 @@ def paged_decode_attention(
         last = jax.lax.div(jnp.maximum(lengths_ref[bi] - 1, 0), blk)
         return (tables_ref[bi, jnp.minimum(ji, last)], 0, 0, 0)
 
+    def scale_index(bi, ji, lengths_ref, tables_ref):
+        return kv_index(bi, ji, lengths_ref, tables_ref)[:3]
+
+    in_specs = [
+        pl.BlockSpec(
+            (1, kvh * gp, d), lambda bi, ji, L, T: (bi, 0, 0)
+        ),
+        pl.BlockSpec((1, blk, kvh, d), kv_index),
+        pl.BlockSpec((1, blk, kvh, d), kv_index),
+    ]
+    operands = [
+        lengths.astype(jnp.int32), tables.astype(jnp.int32),
+        qt.reshape(b, kvh * gp, d), k_pages, v_pages,
+    ]
+    if quant:
+        in_specs += [
+            pl.BlockSpec((1, blk, kvh), scale_index),
+            pl.BlockSpec((1, blk, kvh), scale_index),
+        ]
+        operands += [k_scale.astype(jnp.float32),
+                     v_scale.astype(jnp.float32)]
     out = pl.pallas_call(
         functools.partial(
             _kernel_paged, scale=d**-0.5, block_k=blk, num_k_blocks=p,
-            kvh=kvh, gp=gp,
+            kvh=kvh, gp=gp, quant=quant,
         ),
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=2,
             grid=(b, p),
-            in_specs=[
-                pl.BlockSpec(
-                    (1, kvh * gp, d), lambda bi, ji, L, T: (bi, 0, 0)
-                ),
-                pl.BlockSpec((1, blk, kvh, d), kv_index),
-                pl.BlockSpec((1, blk, kvh, d), kv_index),
-            ],
+            in_specs=in_specs,
             out_specs=pl.BlockSpec(
                 (1, kvh * gp, d), lambda bi, ji, L, T: (bi, 0, 0)
             ),
@@ -333,12 +417,6 @@ def paged_decode_attention(
         ),
         out_shape=jax.ShapeDtypeStruct((b, kvh * gp, d), q.dtype),
         interpret=mode == "interpret",
-    )(
-        lengths.astype(jnp.int32),
-        tables.astype(jnp.int32),
-        qt.reshape(b, kvh * gp, d),
-        k_pages,
-        v_pages,
-    )
+    )(*operands)
     out = out.reshape(b, kvh, gp, d)[:, :, :g]
     return out.reshape(b, 1, h, d)

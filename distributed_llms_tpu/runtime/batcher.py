@@ -62,7 +62,7 @@ import numpy as np
 from ..core.config import ModelConfig
 from ..core.observability import METRICS, get_logger
 from ..models import model as model_lib
-from ..models.model import KVCache
+from ..models.model import KVCache, QuantKVCache
 from . import sampling
 from .shapes import bucket_length as _bucket
 
@@ -649,13 +649,61 @@ def _import_pages(cache: Any, page_list: jax.Array, k_pages: jax.Array,
     cluster/kv_transfer.py and this decode-role engine adopts them).
     ``k_pages``/``v_pages`` are [L, P, BLK, KVH, HD] page stacks in pool
     layout; ``page_list`` [P] names the freshly allocated destination
-    pages.  The cache is NOT donated: import is a rare, off-hot-path
-    event and the caller reuses the returned pool exactly like the
-    admission splices do."""
+    pages.  An int8 pool re-quantizes the full-width payload on the way in
+    — byte-stable when the payload was itself dequantized from int8 pages
+    (kv_quantize's exact round-trip property), which is how a kv-bits-8
+    fleet ships pages without a second lossy step.  The cache is NOT
+    donated: import is a rare, off-hot-path event and the caller reuses
+    the returned pool exactly like the admission splices do."""
+    if isinstance(cache, QuantKVCache):
+        from ..checkpoint.quantize import kv_quantize
+
+        kq, ks = kv_quantize(k_pages)
+        vq, vs = kv_quantize(v_pages)
+        return QuantKVCache(
+            k=cache.k.at[:, page_list].set(kq),
+            v=cache.v.at[:, page_list].set(vq),
+            k_scale=cache.k_scale.at[:, page_list].set(ks),
+            v_scale=cache.v_scale.at[:, page_list].set(vs),
+            row_dtype=cache.row_dtype,
+        )
     return KVCache(
         k=cache.k.at[:, page_list].set(k_pages.astype(cache.k.dtype)),
         v=cache.v.at[:, page_list].set(v_pages.astype(cache.v.dtype)),
     )
+
+
+@jax.jit
+def _export_pages_raw(cache: Any, page_list: jax.Array) -> tuple:
+    """Gather pages VERBATIM in pool layout and pool dtype — (k, v) page
+    stacks, plus the scale stacks on an int8 pool.  This is the host-tier
+    parcel format (swap-preemption, prefix-cache spill): re-importing the
+    exact bytes via :func:`_import_pages_raw` restores the pool state
+    bit-for-bit, which is what makes a swap-restored row's stream
+    byte-exact against its never-preempted run at EITHER kv width."""
+    if isinstance(cache, QuantKVCache):
+        return (cache.k[:, page_list], cache.v[:, page_list],
+                cache.k_scale[:, page_list], cache.v_scale[:, page_list])
+    return (cache.k[:, page_list], cache.v[:, page_list])
+
+
+@jax.jit
+def _import_pages_raw(cache: Any, page_list: jax.Array, k_pages: jax.Array,
+                      v_pages: jax.Array, k_scale: jax.Array | None = None,
+                      v_scale: jax.Array | None = None) -> Any:
+    """Scatter a raw host-tier parcel (``_export_pages_raw`` layout) back
+    into freshly allocated pool pages, verbatim — no quantize/dequantize
+    hop, so restore is exact by construction."""
+    if isinstance(cache, QuantKVCache):
+        return QuantKVCache(
+            k=cache.k.at[:, page_list].set(k_pages),
+            v=cache.v.at[:, page_list].set(v_pages),
+            k_scale=cache.k_scale.at[:, page_list].set(k_scale),
+            v_scale=cache.v_scale.at[:, page_list].set(v_scale),
+            row_dtype=cache.row_dtype,
+        )
+    return KVCache(k=cache.k.at[:, page_list].set(k_pages),
+                   v=cache.v.at[:, page_list].set(v_pages))
 
 
 @jax.jit
@@ -671,19 +719,68 @@ def _gather_row_pages(cache: Any, read_list: jax.Array) -> tuple[jax.Array, jax.
     l, _, blk, kvh, hd = cache.k.shape
     p = read_list.shape[0]
 
+    if isinstance(cache, QuantKVCache):
+        # Int8 pool: dequantize the gathered pages to the declared
+        # full-width dtype — transient rows always run full-width; only
+        # POOL storage is quantized.
+        from ..checkpoint.quantize import kv_dequantize
+
+        dt = jnp.dtype(cache.row_dtype)
+
+        def gather_q(pool, scale):
+            full = kv_dequantize(pool[:, read_list], scale[:, read_list], dt)
+            return full.reshape(l, 1, p * blk, kvh, hd)
+
+        return (gather_q(cache.k, cache.k_scale),
+                gather_q(cache.v, cache.v_scale))
+
     def gather(pool):
         return pool[:, read_list].reshape(l, 1, p * blk, kvh, hd)
 
     return gather(cache.k), gather(cache.v)
 
 
-def _paged_pool(cfg: ModelConfig, num_pages: int, page_size: int, dtype=None):
+def _paged_pool(cfg: ModelConfig, num_pages: int, page_size: int, dtype=None,
+                kv_bits: int = 16):
     """KV page pools [L, NB, BLK, KVH, HD] (distinct k/v buffers — the
-    chunk fns donate the cache)."""
+    chunk fns donate the cache).  ``kv_bits=8`` builds an int8
+    :class:`~..models.model.QuantKVCache` pool (data int8 + one f32 absmax
+    scale per head-dim vector) at roughly half the bytes per token; the
+    full-width dtype survives as ``row_dtype`` so gathers/transient rows
+    restore to it."""
     l, kvh, hd = cfg.num_layers, cfg.num_kv_heads, cfg.head_dim_
     dt = jnp.dtype(dtype) if dtype else jnp.dtype(cfg.dtype)
     shape = (l, num_pages, page_size, kvh, hd)
+    if kv_bits == 8:
+        sshape = (l, num_pages, page_size, kvh)
+        return QuantKVCache(
+            k=jnp.zeros(shape, jnp.int8), v=jnp.zeros(shape, jnp.int8),
+            k_scale=jnp.ones(sshape, jnp.float32),
+            v_scale=jnp.ones(sshape, jnp.float32),
+            row_dtype=dt.name,
+        )
     return KVCache(k=jnp.zeros(shape, dt), v=jnp.zeros(shape, dt))
+
+
+def _row_dtype_of(cache) -> Any:
+    """Dtype transient single-row caches (and pool gathers) use: the
+    pool's own dtype, or the declared full-width dtype of an int8 pool.
+    Safe inside jit — the pytree TYPE of ``cache`` is static."""
+    if isinstance(cache, QuantKVCache):
+        return jnp.dtype(cache.row_dtype)
+    return cache.k.dtype
+
+
+def pool_page_bytes(cfg: ModelConfig, page_size: int, kv_bits: int = 16,
+                    dtype=None) -> int:
+    """Bytes one pool page costs (k + v + scales) — the denominator of the
+    capacity-per-byte comparison bench.py's kv-tiering row stamps."""
+    l, kvh, hd = cfg.num_layers, cfg.num_kv_heads, cfg.head_dim_
+    elems = l * page_size * kvh * hd
+    if kv_bits == 8:
+        return 2 * (elems + l * page_size * kvh * 4)
+    dt = jnp.dtype(dtype) if dtype else jnp.dtype(cfg.dtype)
+    return 2 * elems * dt.itemsize
 
 
 def _paged_splice(cache, page_list, row_cache, logits, last_idx, rng,
@@ -702,6 +799,24 @@ def _paged_splice(cache, page_list, row_cache, logits, last_idx, rng,
                             temp_req, topp_req, topk_req)
     p = page_list.shape[0]
     blk = cache.k.shape[2]
+
+    if isinstance(cache, QuantKVCache):
+        # Quantize ONCE at the write: each page's head-dim vectors get
+        # int8 data + one f32 absmax scale (checkpoint.quantize
+        # machinery); pool storage never sees the full-width row again.
+        from ..checkpoint.quantize import kv_quantize
+
+        def qsplice(pool, spool, row):
+            l, _, _, kvh, hd = row.shape
+            pages = row[:, 0].reshape(l, p, blk, kvh, hd)
+            data, scale = kv_quantize(pages)
+            return (pool.at[:, page_list].set(data),
+                    spool.at[:, page_list].set(scale))
+
+        k, sk = qsplice(cache.k, cache.k_scale, row_cache.k)
+        v, sv = qsplice(cache.v, cache.v_scale, row_cache.v)
+        return QuantKVCache(k=k, v=v, k_scale=sk, v_scale=sv,
+                            row_dtype=cache.row_dtype), tok, lp
 
     def splice(pool, row):  # row: [L, 1, P*BLK, KVH, HD]
         l, _, _, kvh, hd = row.shape
@@ -738,7 +853,7 @@ def admit_row_paged(
     cache, then scatter its pages into the pool.
     Returns (cache', tok, logprob)."""
     logits, row_cache = _prefill_row(
-        _fwd(None), params, cfg, cache.k.dtype,
+        _fwd(None), params, cfg, _row_dtype_of(cache),
         page_list.shape[0] * cache.k.shape[2], prompt,
     )
     return _paged_splice(
@@ -813,15 +928,12 @@ def admit_row_auto_paged(
     the result scatters back through ``write_list`` — cached positions land
     in the scratch page, so a shared page is never rewritten.  The gather
     reads the pool BEFORE the splice updates it, all inside one donated
-    program.  Returns (cache', tok, logprob)."""
-    l, _, blk, kvh, hd = cache.k.shape
-    p = read_list.shape[0]
-
-    def gather(pool):  # [L, NB, BLK, KVH, HD] -> [L, 1, P*BLK, KVH, HD]
-        return pool[:, read_list].reshape(l, 1, p * blk, kvh, hd)
-
+    program (an int8 pool dequantizes the gathered run to row_dtype — the
+    suffix continues from the same values decode attends to).
+    Returns (cache', tok, logprob)."""
+    row_k, row_v = _gather_row_pages(cache, read_list)
     logits, row_cache = _prefill_row_with_prefix(
-        _fwd(None), params, cfg, gather(cache.k), gather(cache.v),
+        _fwd(None), params, cfg, row_k, row_v,
         prefix_len, chunk,
     )
     return _paged_splice(
@@ -1009,6 +1121,18 @@ class _Request:
     # and the admission token CONTINUES the sequence (temp-0 exact).
     resume_emitted: list[int] | None = None
     resume_lps: list[float] | None = None
+    # Swap-preemption state (host-RAM KV tier): the victim's raw pages are
+    # parked in the HostTier under ``swap_handle`` and restore scatters
+    # them back instead of recomputing — ``swap_pages``/``swap_last_tok``/
+    # ``swap_pos`` rebuild the row's device scheduling state verbatim, and
+    # ``max_new_tokens`` already holds the remaining budget (no admission
+    # token is sampled on restore).  A failed restore (budget dry, drop
+    # drill, checksum mismatch) clears swap_handle and falls through to
+    # the recompute path above — ``ids`` is prompt + emitted either way.
+    swap_handle: int | None = None
+    swap_pages: int = 0
+    swap_last_tok: int = 0
+    swap_pos: int = 0
 
 
 @dataclass
@@ -1043,11 +1167,18 @@ class PrefixCache:
         self.evictions = 0
 
     @staticmethod
-    def page_digests(ids: list[int], page_size: int, n_pages: int) -> list[bytes]:
+    def page_digests(ids: list[int], page_size: int, n_pages: int,
+                     kv_bits: int = 16) -> list[bytes]:
         """Chained blake2b digests of the first ``n_pages`` full pages:
-        digest_i = H(digest_{i-1} || tokens of page i)."""
+        digest_i = H(digest_{i-1} || tokens of page i).  ``kv_bits`` salts
+        the chain seed: a page's stored bytes are a deterministic function
+        of (token prefix, kv width), so folding the width into the digest
+        keeps sharing content-addressed over the QUANTIZED bytes — an int8
+        page can never alias a bf16 page (locally, across a handoff, or in
+        router affinity), while all default-width digests stay unchanged."""
         digests: list[bytes] = []
-        prev = b"dlt-prefix-cache-v1"
+        prev = (b"dlt-prefix-cache-v1" if kv_bits == 16
+                else b"dlt-prefix-cache-v1:kv%d" % kv_bits)
         for i in range(n_pages):
             h = hashlib.blake2b(prev, digest_size=16)
             h.update(np.asarray(
@@ -1100,6 +1231,266 @@ class PrefixCache:
             )
 
 
+@dataclass
+class _HostEntry:
+    """One host-tier parcel: ``future`` resolves (on the tier's worker
+    thread) to ``(arrays, checksum)`` — an INDEPENDENT host-numpy copy of
+    a raw page export plus its blake2b checksum.  Swap parcels hold a
+    whole row (``index`` None); a spill entry holds exactly one page
+    (``index`` records which slice of the gathered stack it copied out —
+    every entry owns its own bytes, so eviction frees them)."""
+
+    n_pages: int
+    future: Any
+    index: int | None = None
+
+
+class HostTier:
+    """Host-RAM KV page tier behind the :class:`PagePool` (``--host-pages``).
+
+    Two kinds of parcels, one page budget:
+
+    - **swap parcels**: a preempted row's pages, raw pool bytes, keyed by
+      an opaque handle carried on the requeued request — restore scatters
+      them back instead of recomputing the prefix;
+    - **spilled pages**: cold prefix-cache pages captured just before LRU
+      eviction, keyed by content digest — a later cache hit restores them
+      instead of re-prefilling.
+
+    Swaps outrank spills: parking a swap may evict spilled pages (they are
+    only a cache), never the other way.  Device-to-host copies and
+    checksumming run on a single worker thread (``park_*`` merely submits
+    the already-dispatched device gather), so the engine loop never blocks
+    on a D2H transfer at preemption time; ``take_*`` joins the future and
+    VERIFIES the checksum — a corrupted parcel degrades to exact recompute
+    / cold prefill rather than poisoning the cache.
+
+    Thread contract: park/take/drop run under ``_lock`` (engine thread,
+    plus the serving thread's cancel path); the worker thread touches only
+    its own future's payload."""
+
+    def __init__(self, pages: int) -> None:
+        if pages < 1:
+            raise ValueError(f"host tier needs >= 1 page, got {pages}")
+        self.pages = pages
+        self._lock = threading.Lock()
+        # graftflow: cleanup-required
+        self._swaps: dict[int, _HostEntry] = {}  # guarded-by: self._lock
+        self._spills: OrderedDict[bytes, _HostEntry] = OrderedDict()  # guarded-by: self._lock
+        self.used = 0  # guarded-by: self._lock
+        self._next_handle = 0  # guarded-by: self._lock
+        self._workers = None  # lazy single-thread executor
+
+    # graftlint: holds(self._lock)
+    def _executor(self):
+        if self._workers is None:
+            import concurrent.futures
+
+            self._workers = concurrent.futures.ThreadPoolExecutor(
+                max_workers=1, thread_name_prefix="kv-host-tier"
+            )
+        return self._workers
+
+    @staticmethod
+    def _checksum(arrays) -> bytes:
+        h = hashlib.blake2b(digest_size=16)
+        for a in arrays:
+            h.update(np.ascontiguousarray(a).tobytes())
+        return h.digest()
+
+    @staticmethod
+    def _flip_byte(arrays) -> tuple:
+        """Corrupt a parcel in host storage (the ``corrupt`` fault drill):
+        flip the first byte of the first array — checksum verification at
+        take time must catch it."""
+        raw = bytearray(np.ascontiguousarray(arrays[0]).tobytes())
+        raw[0] ^= 0xFF
+        bad = np.frombuffer(bytes(raw), dtype=arrays[0].dtype).reshape(
+            arrays[0].shape
+        )
+        return (bad,) + tuple(arrays[1:])
+
+    @classmethod
+    def _to_host(cls, payload, corrupt: bool):
+        """WORKER THREAD: device arrays -> host numpy + checksum.  The
+        np.asarray calls are the actual D2H transfers."""
+        arrays = tuple(np.asarray(a) for a in payload)
+        checksum = cls._checksum(arrays)
+        if corrupt:
+            arrays = cls._flip_byte(arrays)
+        return arrays, checksum
+
+    @classmethod
+    def _to_host_page(cls, payload, i: int, corrupt: bool):
+        """WORKER THREAD: spill variant — ONE page's slices copied out
+        independently (np.ascontiguousarray detaches from the stacked
+        gather), so each spill entry owns exactly its own bytes: evicting
+        it frees them, and the `pages` budget really bounds host RAM."""
+        arrays = tuple(
+            np.ascontiguousarray(np.asarray(a[:, i])) for a in payload
+        )
+        checksum = cls._checksum(arrays)
+        if corrupt:
+            arrays = cls._flip_byte(arrays)
+        return arrays, checksum
+
+    # graftlint: holds(self._lock)
+    def _fit_locked(self, n: int) -> bool:
+        """Make room for ``n`` pages, evicting spilled pages (oldest
+        first) if needed — spills are only a cache.  Swap parcels are
+        never evicted: their content is the ONLY copy of a live request's
+        KV."""
+        while self.pages - self.used < n and self._spills:
+            self._spills.popitem(last=False)
+            self.used -= 1
+            METRICS.inc("batcher.host_tier.spill_evictions")
+        return self.pages - self.used >= n
+
+    def can_fit(self, n: int) -> bool:
+        """Whether ``n`` pages could be parked right now (spills count as
+        evictable).  Engine-thread advisory — the authoritative check is
+        park's own."""
+        with self._lock:
+            return self.pages - self.used + len(self._spills) >= n
+
+    def park_swap(self, payload, n_pages: int,
+                  corrupt: bool = False) -> int | None:
+        """Park a preempted row's raw page export; returns the handle the
+        resume request carries, or None when the budget cannot fit it
+        (the caller falls back to exact recompute)."""
+        with self._lock:
+            if not self._fit_locked(n_pages):
+                return None
+            fut = self._executor().submit(self._to_host, payload, corrupt)
+            handle = self._next_handle
+            self._next_handle += 1
+            self.used += n_pages
+            self._swaps[handle] = _HostEntry(n_pages, fut)
+        return handle
+
+    def take_swap(self, handle: int, corrupt: bool = False):
+        """Resolve and REMOVE a swap parcel: returns the raw page arrays,
+        or None when the handle is unknown or the checksum fails (the
+        caller falls back to exact recompute either way).  Budget is
+        released even on verification failure — the parcel is gone."""
+        with self._lock:
+            entry = self._swaps.pop(handle, None)
+            if entry is None:
+                return None
+            self.used -= entry.n_pages
+        try:
+            arrays, checksum = entry.future.result()
+        except Exception:
+            # A failed D2H (host OOM, device error surfacing on the copy)
+            # must degrade to exact recompute, not crash the engine —
+            # the same contract as a checksum mismatch.
+            log.exception("host-tier swap parcel %d copy failed", handle)
+            return None
+        if corrupt:
+            arrays = self._flip_byte(arrays)
+        if self._checksum(arrays) != checksum:
+            log.warning("host-tier swap parcel %d failed verification", handle)
+            return None
+        return arrays
+
+    def drop_swap(self, handle: int) -> None:
+        """Free a swap parcel whose request will never resume (cancelled
+        or shed while queued)."""
+        with self._lock:
+            entry = self._swaps.pop(handle, None)
+            if entry is not None:
+                self.used -= entry.n_pages
+
+    def park_spill(self, digests: list[bytes], payload,
+                   corrupt: bool = False) -> int:
+        """Park soon-to-be-evicted cached pages (stacked raw export, one
+        digest per page).  Best-effort: parks the prefix that fits after
+        evicting older spills; returns how many pages were parked.  Each
+        page gets its OWN worker task and host copy (never a shared
+        stack), so the budget bounds actual host bytes: evicting an
+        entry frees its pages."""
+        with self._lock:
+            room = 0
+            for _ in digests:
+                if not self._fit_locked(1):
+                    break
+                self.used += 1
+                room += 1
+            for i, d in enumerate(digests[:room]):
+                fut = self._executor().submit(
+                    self._to_host_page, payload, i, corrupt and i == 0
+                )
+                # Re-spilling content already parked would double-count
+                # its budget page: drop the stale entry (its budget page
+                # transfers to the fresh one reserved above).
+                if d in self._spills:
+                    self._spills.pop(d)
+                    self.used -= 1
+                self._spills[d] = _HostEntry(1, fut, index=i)
+        return room
+
+    def has_spill(self, digest: bytes) -> bool:
+        with self._lock:
+            return digest in self._spills
+
+    def take_spill(self, digest: bytes):
+        """Resolve and REMOVE one spilled page: returns its raw arrays
+        ([L, BLK, ...] slices), or None when absent or corrupted (the
+        caller prefillls cold — correct, just slower)."""
+        with self._lock:
+            entry = self._spills.pop(digest, None)
+            if entry is None:
+                return None
+            self.used -= 1
+        try:
+            page, checksum = entry.future.result()
+        except Exception:
+            log.exception("host-tier spilled page copy failed")
+            return None
+        if self._checksum(page) != checksum:
+            log.warning("host-tier spilled page failed verification")
+            return None
+        return page
+
+    def stats(self) -> dict[str, int]:
+        # Key names become batcher.host_tier.* GAUGES on /metrics
+        # (publish_gauges): none may collide with a same-named counter —
+        # "spill_entries" here vs the "spilled_pages" cumulative counter,
+        # or the exposition renders one series under two TYPEs and the
+        # whole scrape fails to parse.
+        with self._lock:
+            return {
+                "pages": self.pages,
+                "used": self.used,
+                "swap_parcels": len(self._swaps),
+                "spill_entries": len(self._spills),
+            }
+
+    def assert_consistent(self, swap_handles=()) -> None:
+        """Audit the tier: budget accounting must equal the parcels held,
+        and every parked swap handle must be owned by exactly one queued
+        resume request (``swap_handles``) — a handle nobody will ever
+        restore or free is a host-RAM leak, the tier's analogue of the
+        pool's dangling refcount."""
+        with self._lock:
+            swaps = {h: e.n_pages for h, e in self._swaps.items()}
+            spills = len(self._spills)
+            used = self.used
+        expect = set(swap_handles)
+        held = set(swaps)
+        assert used == sum(swaps.values()) + spills, (
+            f"host tier budget diverged: used={used}, swaps={swaps}, "
+            f"spilled={spills}"
+        )
+        assert used <= self.pages, (
+            f"host tier over budget: {used} > {self.pages}"
+        )
+        assert held == expect, (
+            f"host-tier swap handles diverge from queued resume requests: "
+            f"parked={sorted(held)} expected={sorted(expect)}"
+        )
+
+
 class PagePool:
     """Refcounted KV page allocator for paged mode.  Owns the free list and
     per-page refcounts, and cooperates with an optional :class:`PrefixCache`
@@ -1114,8 +1505,15 @@ class PagePool:
     runs the audit after every engine restart."""
 
     def __init__(self, num_pages: int,
-                 prefix_cache: "PrefixCache | None" = None) -> None:
+                 prefix_cache: "PrefixCache | None" = None,
+                 host_tier: "HostTier | None" = None) -> None:
         self.num_pages = num_pages
+        # Optional host-RAM tier BEHIND the pool (KV tiering): the batcher
+        # spills eviction candidates into it before alloc reclaims them,
+        # and swap-preemption parks whole rows there.  The pool itself
+        # only audits and reports it — all data movement is the batcher's
+        # (device calls never run under the allocator lock).
+        self.host_tier = host_tier
         # Allocator lock: mutation happens on the engine thread, but the
         # occupancy view (stats/publish_gauges behind /metrics, the
         # supervisor's audit) reads from the serving loop thread — PR 3
@@ -1163,10 +1561,33 @@ class PagePool:
 
     def publish_gauges(self) -> None:
         """Mirror the occupancy view into the process-wide METRICS registry
-        (rendered as batcher_pool_* on the gateway's /metrics)."""
+        (rendered as batcher_pool_* on the gateway's /metrics); the host
+        tier's occupancy rides along as batcher_host_tier_*."""
         METRICS.set_gauges({
             f"batcher.pool.{k}": float(v) for k, v in self.stats().items()
         })
+        if self.host_tier is not None:
+            METRICS.set_gauges({
+                f"batcher.host_tier.{k}": float(v)
+                for k, v in self.host_tier.stats().items()
+            })
+
+    def eviction_candidates(self, n: int) -> list[tuple[int, bytes]]:
+        """The (page, digest) pairs :meth:`alloc`\\ (n) would evict from
+        the LRU, oldest first — the spill plane reads these BEFORE the
+        alloc so their content can move to the host tier.  Engine thread
+        only: nothing may mutate the pool between this and the alloc."""
+        pc = self.prefix_cache
+        with self._lock:
+            if pc is None:
+                return []
+            m = max(0, n - len(self.free_pages))
+            out: list[tuple[int, bytes]] = []
+            for p in pc.lru:
+                if len(out) >= m:
+                    break
+                out.append((p, pc.page_hash[p]))
+            return out
 
     # graftlint: holds(self._lock)
     def _available_locked(self) -> int:
@@ -1238,14 +1659,21 @@ class PagePool:
         with self._lock:
             self.prefix_cache.register(page, digest)
 
-    def assert_consistent(self, live_rows=()) -> None:
+    def assert_consistent(self, live_rows=(), swap_handles=()) -> None:
         """Audit the allocator's partition invariants; AssertionError on
         the first violation.  ``live_rows`` is the page lists of currently
         resident rows — every reference comes from exactly one row hold,
         so per-page refcounts must EQUAL the row-hold counts (a dangling
         ref or a pinned cache page after a crashed run fails here).
+        With a host tier attached the audit extends across tiers:
+        ``swap_handles`` is the swap handles of queued resume requests,
+        and every parked parcel must be owned by exactly one of them
+        (:meth:`HostTier.assert_consistent`) — a stranded handle is the
+        host-RAM analogue of a dangling refcount.
         Takes one consistent snapshot under the allocator lock; callable
         from any thread."""
+        if self.host_tier is not None:
+            self.host_tier.assert_consistent(swap_handles)
         pc = self.prefix_cache
         with self._lock:
             lru = set(pc.lru) if pc is not None else set()
@@ -1416,6 +1844,18 @@ class ContinuousBatcher:
         # crash, stall, or dry-pool the engine at an exact chunk.  None
         # disables (zero overhead beyond one attribute check per round).
         faults: Any = None,
+        # KV memory tiering (paged mode): kv_bits=8 stores pool pages as
+        # int8 with blockwise absmax scales (half the bytes/token -> ~1.9x
+        # concurrent rows per pool byte; dequant fuses into the decode
+        # attention read, greedy outputs are parity-bounded vs bf16, not
+        # bit-exact).  host_pages > 0 arms a host-RAM tier behind the
+        # pool: preemption SWAPS victims' raw pages out (restore is
+        # byte-exact, cheaper than recompute for long prefixes; falls back
+        # to exact recompute when the budget is dry) and the prefix-cache
+        # LRU spills cold pages there before hard-evicting (a later hit
+        # restores instead of re-prefilling).
+        kv_bits: int = 16,
+        host_pages: int = 0,
     ) -> None:
         # Snapshot the constructor arguments FIRST (before any local
         # variables or normalization appear) so respawn() can rebuild an
@@ -1427,6 +1867,19 @@ class ContinuousBatcher:
         if max_len > cfg.max_seq_len:
             raise ValueError(
                 f"max_len {max_len} exceeds model max_seq_len {cfg.max_seq_len}"
+            )
+        if kv_bits not in (16, 8):
+            raise ValueError(f"kv_bits must be 16 or 8, got {kv_bits}")
+        if kv_bits == 8 and paged_pages is None:
+            raise ValueError(
+                "int8 KV pages live in the paged pool; pass paged_pages "
+                "(contiguous caches stay full-width)"
+            )
+        if host_pages < 0:
+            raise ValueError(f"host_pages must be >= 0, got {host_pages}")
+        if host_pages and paged_pages is None:
+            raise ValueError(
+                "the host-RAM KV tier backs the paged pool; pass paged_pages"
             )
         if paged_pages is not None:
             if parallel is not None:
@@ -1581,6 +2034,7 @@ class ContinuousBatcher:
             self.cache = _paged_pool(
                 cfg, paged_pages, page_size,
                 dtype=jnp.dtype(kv_dtype) if kv_dtype else None,
+                kv_bits=kv_bits,
             )
         else:
             self.cache = model_lib.init_cache(
@@ -1594,16 +2048,21 @@ class ContinuousBatcher:
             )
         self.page_size = page_size
         self.paged = paged_pages is not None
+        self.kv_bits = kv_bits
         self.prefix_cache: PrefixCache | None = None
         self.pool: PagePool | None = None
+        self.host_tier: HostTier | None = None
         self.faults = faults  # FaultPlane | None (runtime/faults.py)
         if self.paged:
             self.pages_per_row = max_len // page_size
             if prefix_cache:
                 self.prefix_cache = PrefixCache()
+            if host_pages:
+                self.host_tier = HostTier(host_pages)
             # Page 0 is the permanent scratch page: fixed-shape admissions
             # pad their page lists with it, and no row ever reads it.
-            self.pool = PagePool(paged_pages, prefix_cache=self.prefix_cache)
+            self.pool = PagePool(paged_pages, prefix_cache=self.prefix_cache,
+                                 host_tier=self.host_tier)
             self.tables = np.zeros((batch_slots, self.pages_per_row), np.int32)
         # Scheduling state lives as HOST numpy mirrors: every process holds
         # the same values (the jitted chunk fns return them constrained
@@ -1690,7 +2149,7 @@ class ContinuousBatcher:
         # size, and its admission scatters by pages, not a splice).
         width = self.s if self.paged else self.cache.k.shape[-3]
         row_cache = model_lib.init_cache(
-            self.cfg, 1, width, dtype=self.cache.k.dtype
+            self.cfg, 1, width, dtype=_row_dtype_of(self.cache)
         )
         positions = jnp.arange(len(ids), dtype=jnp.int32)[None, :]
         _, row_cache = _fwd(self.pm)(
@@ -1715,7 +2174,62 @@ class ContinuousBatcher:
         return self.pool.available()
 
     def _alloc_pages(self, n: int) -> list[int]:
+        """Pool allocation with the spill tier in front: any LRU-cached
+        page this alloc would hard-evict first has its content moved to
+        the host tier (content-addressed by digest), so a later
+        prefix-cache hit restores it instead of re-prefilling.  Best
+        effort: a dry host budget (or a kv.spill drop drill) degrades to
+        plain eviction — correct, just cold."""
+        if self.host_tier is not None and n:
+            self._spill_cold_pages(n)
         return self.pool.alloc(n)
+
+    def _spill_cold_pages(self, n: int) -> None:
+        """ENGINE THREAD, immediately before an alloc(n): park the
+        eviction candidates' raw page bytes in the host tier.  The device
+        gather is dispatched here; the D2H copy runs on the tier's worker
+        thread — the pressure path never blocks on a host transfer."""
+        cand = self.pool.eviction_candidates(n)
+        if not cand:
+            return
+        if not self.host_tier.can_fit(1):
+            # Saturated with swap parcels (never evicted for spills):
+            # don't pay the device gather just for park_spill to refuse.
+            return
+        rule = (self.faults.fire("kv.spill", tag="out")
+                if self.faults is not None else None)
+        if rule is not None and rule.action == "drop":
+            return
+        corrupt = rule is not None and rule.action == "corrupt"
+        pages = [p for p, _ in cand]
+        payload = _export_pages_raw(
+            self.cache, jnp.asarray(self._padded_page_list(pages))
+        )
+        parked = self.host_tier.park_spill(
+            [d for _, d in cand], payload, corrupt=corrupt
+        )
+        if parked:
+            METRICS.inc("batcher.host_tier.spilled_pages", parked)
+
+    def _page_digests(self, ids: list[int], n_pages: int) -> list[bytes]:
+        """This pool's content digests: chained over token ids AND the KV
+        width (kv_bits salts the chain), so an int8 page can never alias
+        a bf16 page across engines or tiers."""
+        return PrefixCache.page_digests(ids, self.page_size, n_pages,
+                                        kv_bits=self.kv_bits)
+
+    def _padded_page_list(self, pages: list[int]) -> np.ndarray:
+        """Pages padded with the scratch page 0 up the shared bucket
+        ladder — the raw export/import jits take the padded width as a
+        compile dimension, so page counts must walk the same closed
+        ladder prompt lengths do (graftcheck GC4's discipline): a
+        preemption storm over varied row lengths must never pay a fresh
+        XLA compile per count on the engine thread.  Padded slots gather
+        /scatter the scratch page, which no live row ever reads."""
+        nb = min(_bucket(len(pages)), self.pages_per_row)
+        out = np.zeros((nb,), np.int32)
+        out[: len(pages)] = pages
+        return out
 
     def _retain_page(self, p: int) -> None:
         self.pool.retain(p)
@@ -1733,13 +2247,20 @@ class ContinuousBatcher:
 
     def assert_pool_consistent(self) -> None:
         """Audit the page pool against the resident rows (no-op in
-        contiguous mode).  The serving supervisor runs this after every
-        engine restart; paged tests run it after each workload — a failure
-        means refcounts or cache pins leaked, the recovery-path bug class
-        this audit exists to catch."""
+        contiguous mode), and the host tier against the queued resume
+        requests when one is armed — every swap parcel must be owned by
+        exactly one queued request, or host RAM leaked.  The serving
+        supervisor runs this after every engine restart; paged tests run
+        it after each workload — a failure means refcounts, cache pins,
+        or host parcels leaked, the recovery-path bug class this audit
+        exists to catch."""
         if self.pool is not None:
             self.pool.assert_consistent(
-                [r.pages for r in self.rows if r.pages]
+                [r.pages for r in self.rows if r.pages],
+                swap_handles=[
+                    r.swap_handle for r in self.queue_snapshot()
+                    if r.swap_handle is not None
+                ],
             )
 
     # -- KV handoff (disaggregated prefill/decode) -------------------------
@@ -1763,7 +2284,7 @@ class ContinuousBatcher:
         n = (len(ids) - 1) // blk
         if n < 1:
             return None
-        digests = PrefixCache.page_digests(ids, blk, n)
+        digests = self._page_digests(ids, n)
         pages = pc.match(digests)
         if not pages:
             return None
@@ -2028,6 +2549,13 @@ class ContinuousBatcher:
             ))
         return rid
 
+    def _drop_req_swap(self, req: "_Request") -> None:
+        """Free a queued resume request's host swap parcel (cancel/shed:
+        nothing will ever restore it)."""
+        if req.swap_handle is not None and self.host_tier is not None:
+            self.host_tier.drop_swap(req.swap_handle)
+            req.swap_handle = None
+
     def cancel_row(self, rid: int) -> bool:
         """Cancel a submitted request (serving front-ends: client went away,
         or a stop sequence hit mid-row).  A queued request is dropped; an
@@ -2055,7 +2583,10 @@ class ContinuousBatcher:
                 self.queue.remove(dropped)
         if dropped is not None:
             # A preempted request waiting for recompute already emitted
-            # (and streamed) a prefix — that IS its partial result.
+            # (and streamed) a prefix — that IS its partial result.  A
+            # swap-preempted one also frees its host parcel (nothing will
+            # ever restore it — the tier audit would catch the leak).
+            self._drop_req_swap(dropped)
             self.results[rid] = list(dropped.resume_emitted or [])
             self.result_logprobs[rid] = list(dropped.resume_lps or [])
             METRICS.inc("batcher.cancelled")
@@ -2139,6 +2670,7 @@ class ContinuousBatcher:
                 self.queue.remove(req)
                 expired.append(req)
         for req in expired:
+            self._drop_req_swap(req)
             self.results[req.rid] = list(req.resume_emitted or [])
             self.result_logprobs[req.rid] = list(req.resume_lps or [])
             if req.resume_emitted:
@@ -2222,6 +2754,17 @@ class ContinuousBatcher:
                 deadline=req.deadline, resume_emitted=list(row.emitted),
                 resume_lps=list(row.lps),
             )
+            # SWAP tier (host_pages): park the victim's raw pages on the
+            # host instead of throwing the prefix away — restore scatters
+            # them back (byte-exact, no recompute).  A dry host budget or
+            # a kv.swap_out drill leaves swap_handle None and the request
+            # takes the recompute path above unchanged.
+            handle = self._swap_out_row(i, row)
+            if handle is not None:
+                resume.swap_handle = handle
+                resume.swap_pages = len(row.pages)
+                resume.swap_last_tok = int(self.last_tok[i])
+                resume.swap_pos = int(self.real_lens[i])
         freed = len(row.pages)
         if row.pages:
             self._release_pages(row.pages)
@@ -2235,9 +2778,129 @@ class ContinuousBatcher:
         METRICS.inc("batcher.preemptions_total")
         log.info(
             "preempted rid %d from slot %d (%s): freed %d page(s), "
-            "%d token(s) kept for recompute", resume.rid, i, reason, freed,
+            "%d token(s) kept for %s", resume.rid, i, reason, freed,
             len(resume.resume_emitted or []),
+            "swap restore" if resume.swap_handle is not None else "recompute",
         )
+
+    def _swap_out_row(self, i: int, row: "_RowState") -> int | None:
+        """Try to park resident row ``i``'s raw pages in the host tier
+        (swap-preemption).  Returns the parcel handle, or None to fall
+        back to exact recompute (no tier, budget dry, or an injected
+        kv.swap_out drop).  The device gather is dispatched here; the
+        D2H copy runs on the tier's worker thread."""
+        tier = self.host_tier
+        if tier is None or not row.pages:
+            return None
+        rule = (self.faults.fire("kv.swap_out")
+                if self.faults is not None else None)
+        corrupt = False
+        if rule is not None:
+            if rule.action == "drop":
+                METRICS.inc("batcher.kv_swaps.fallback")
+                return None
+            corrupt = rule.action == "corrupt"
+        if not tier.can_fit(len(row.pages)):
+            METRICS.inc("batcher.kv_swaps.fallback")
+            return None
+        payload = _export_pages_raw(
+            self.cache, jnp.asarray(self._padded_page_list(row.pages))
+        )
+        handle = tier.park_swap(payload, len(row.pages), corrupt=corrupt)
+        if handle is None:  # lost the budget race to nothing — advisory check
+            METRICS.inc("batcher.kv_swaps.fallback")
+            return None
+        METRICS.inc("batcher.kv_swaps.out")
+        return handle
+
+    def _try_restore_swapped(self, i: int, req: "_Request") -> bool | None:
+        """Restore a swap-preempted request into free slot ``i`` by
+        scattering its parked raw pages back into freshly allocated pool
+        pages — no model call, no token sampled: the row's device
+        scheduling state is rebuilt verbatim and decode continues from
+        ``swap_last_tok``, so the reunited stream is byte-exact against
+        the never-preempted run at either KV width.
+
+        Returns True on restore, False after degrading the request to
+        exact recompute (parcel dropped/corrupted/missing — swap_handle
+        cleared, request stays queued), None on back-pressure (nothing
+        consumed; the caller stops admitting this round)."""
+        tier = self.host_tier
+        rule = (self.faults.fire("kv.swap_in")
+                if self.faults is not None else None)
+        if tier is None or (rule is not None and rule.action == "drop"):
+            if tier is not None:
+                tier.drop_swap(req.swap_handle)
+            req.swap_handle = None
+            METRICS.inc("batcher.kv_swaps.fallback")
+            log.warning("swap restore for rid %d dropped; recomputing",
+                        req.rid)
+            return False
+        corrupt = rule is not None and rule.action == "corrupt"
+        n = req.swap_pages
+        if not self._ensure_pages(n, "admit", below_priority=req.priority):
+            return None  # parcel stays parked; retry next round
+        payload = tier.take_swap(req.swap_handle, corrupt=corrupt)
+        req.swap_handle = None
+        if payload is None:
+            METRICS.inc("batcher.kv_swaps.fallback")
+            log.warning(
+                "swap restore for rid %d failed verification; recomputing",
+                req.rid,
+            )
+            return False
+        self._unqueue(req)
+        page_list = np.zeros((self.pages_per_row,), np.int32)
+        pages = self._alloc_pages(n)
+        page_list[:n] = pages
+        self.tables[i] = page_list
+        # The parcel was exported bucket-padded; scatter through the same
+        # padded list (pad slots rewrite the scratch page — never read).
+        self.cache = _import_pages_raw(
+            self.cache, jnp.asarray(self._padded_page_list(pages)),
+            *(jnp.asarray(a) for a in payload),
+        )
+        req_t = (self.sampling["temperature"] if req.temperature is None
+                 else float(req.temperature))
+        req_p = (self.sampling["top_p"] if req.top_p is None
+                 else float(req.top_p))
+        req_k = (self.sampling["top_k"] if req.top_k is None
+                 else int(req.top_k))
+        self.temp_row[i] = req_t
+        self.topp_row[i] = req_p
+        self.topk_row[i] = req_k
+        self.pres_row[i] = req.presence_penalty
+        self.freq_row[i] = req.frequency_penalty
+        emitted = list(req.resume_emitted or [])
+        if req.presence_penalty or req.frequency_penalty:
+            # The penalty histogram must see every token this request has
+            # emitted across residencies — identical rebuild to the
+            # recompute-resume path in _activate_row.
+            if self.tok_counts is None:
+                self.tok_counts = jnp.zeros(
+                    (self.b, self.cfg.vocab_size), jnp.int32
+                )
+            rowc = np.zeros((self.cfg.vocab_size,), np.int32)
+            np.add.at(rowc, np.asarray(emitted, np.int64), 1)
+            self.tok_counts = self.tok_counts.at[i].set(jnp.asarray(rowc))
+        self.last_tok[i] = req.swap_last_tok
+        self.real_lens[i] = req.swap_pos
+        self.valid[i] = np.arange(self.valid.shape[1]) < req.swap_pos
+        self.active[i] = True
+        # No admission token on a swap restore: max_new_tokens IS the
+        # remaining budget (set by _preempt_row from row.remaining).
+        self.budget[i] = req.max_new_tokens
+        self._admit_seq += 1
+        self.rows[i] = _RowState(
+            rid=req.rid, emitted=emitted, lps=list(req.resume_lps or []),
+            remaining=req.max_new_tokens, pages=pages, req=req,
+            priority=req.priority, admit_seq=self._admit_seq,
+            streamed=len(emitted),
+        )
+        METRICS.inc("batcher.kv_swaps.in")
+        log.info("restored swapped rid %d into slot %d (%d page(s))",
+                 req.rid, i, n)
+        return True
 
     def _ensure_pages(self, need: int, tag: str,
                       below_priority: int | None = None,
@@ -2272,14 +2935,122 @@ class ContinuousBatcher:
             avail = self._pages_available()
         return True
 
+    def _host_restorable(self, digests: list[bytes], start: int,
+                         cap: int) -> list[bytes]:
+        """The consecutive digest run PAST the device-cached run whose
+        pages are parked in the host spill tier — candidates for restore
+        instead of re-prefill."""
+        if self.host_tier is None:
+            return []
+        out: list[bytes] = []
+        for d in digests[start:cap]:
+            if not self.host_tier.has_spill(d):
+                break
+            out.append(d)
+        return out
+
+    def _match_tiered(self, digests: list[bytes], cap: int,
+                      n_init: int = 0) -> list[int]:
+        """The longest cached page run across BOTH tiers: alternate
+        device matches (retained) and host-tier restores until the chain
+        breaks.  LRU eviction reclaims a run's OLDEST (head) pages first,
+        so the common spill shape is a host-parked head in front of a
+        still-resident tail — a device-only match would miss the whole
+        run.  Restores use spare capacity only (``n_init`` is what the
+        admission itself still needs; restores never preempt live rows),
+        and restored pages come back row-held + published, so a
+        back-pressured caller releasing them simply parks them in the
+        device LRU — addressable again, nothing leaks."""
+        pc = self.prefix_cache
+        pages = pc.match(digests[:cap])
+        for p in pages:
+            self._retain_page(p)
+        k = len(pages)
+        while k < cap:
+            run = self._host_restorable(digests, k, cap)
+            if not run:
+                break
+            spare = self._pages_available() - max(
+                0, n_init - k - len(run)
+            )
+            restored = self._restore_spilled_run(run[: max(spare, 0)])
+            if not restored:
+                break
+            pages += restored
+            k += len(restored)
+            if len(restored) < len(run):
+                break
+            more = pc.match(digests[k:cap])
+            if not more:
+                break
+            for p in more:
+                self._retain_page(p)
+            pages += more
+            k += len(more)
+        return pages
+
+    def _restore_spilled_run(self, run: list[bytes]) -> list[int]:
+        """Scatter a host-spilled digest run back into freshly allocated
+        pool pages and publish the digests — the pages come back exactly
+        as they left (raw bytes), so a hit over them is byte-identical to
+        a hit over never-evicted pages.  The caller guarantees pool
+        availability (restores never preempt live rows: a cache restore
+        must not evict live work).  Returns the restored page list (a
+        prefix of ``run``; empty when a kv.spill restore drill drops it
+        or verification fails on the first page)."""
+        if not run:
+            return []
+        rule = (self.faults.fire("kv.spill", tag="restore")
+                if self.faults is not None else None)
+        if rule is not None and rule.action == "drop":
+            return []
+        payloads = []
+        for d in run:
+            got = self.host_tier.take_spill(d)
+            if got is None:
+                break
+            payloads.append(got)
+        if not payloads:
+            return []
+        pages = self._alloc_pages(len(payloads))
+        # Stack the per-page parcels and pad BOTH the payload and the
+        # destination list up the bucket ladder (pad slots target the
+        # scratch page) — restore counts must not compile per width.
+        padded = self._padded_page_list(pages)
+        nb = padded.shape[0]
+
+        def stack(j):
+            s = np.stack([p[j] for p in payloads], axis=1)
+            if nb > s.shape[1]:
+                s = np.concatenate(
+                    [s, np.zeros((s.shape[0], nb - s.shape[1]) + s.shape[2:],
+                                 s.dtype)], axis=1,
+                )
+            return jnp.asarray(s)
+
+        self.cache = _import_pages_raw(
+            self.cache, jnp.asarray(padded),
+            *(stack(j) for j in range(len(payloads[0]))),
+        )
+        for pg, d in zip(pages, run):
+            self.pool.publish_prefix(pg, d)
+        METRICS.inc("batcher.host_tier.restored_pages", len(pages))
+        METRICS.inc("batcher.host_tier.hits")
+        return pages
+
     def _reserve_row_pages(self, i, req, total_len, pfx):
         """Paged admission reservation, ON-DEMAND: pages for the prompt
         plus one decode page — NOT the full prompt+budget footprint (PR 1's
         policy), which left most reserved pages empty while the queue
         back-pressured.  The chunk-boundary growth loop (:meth:`_grow_rows`)
         allocates the rest only as the row actually reaches them.  A dry
-        pool first evicts LRU-cold cached pages (inside alloc), then
-        preempts a STRICTLY lower-priority victim, then back-pressures.
+        pool first evicts LRU-cold cached pages (inside alloc, spilling
+        their content to the host tier first when one is armed), then
+        preempts a STRICTLY lower-priority victim (swap-out when the host
+        budget allows, exact recompute otherwise), then back-pressures.
+        A prompt whose cached run was evicted to the HOST tier restores
+        those pages here (no preemption — restores only use spare
+        capacity) and counts them as cache hits.
         Returns (page_list, pages, cached_pages, cached_len, digests), or
         None on back-pressure (nothing allocated, hits released)."""
         blk = self.page_size
@@ -2296,20 +3067,21 @@ class ContinuousBatcher:
             # and must not rehash a long prompt each time); hits are
             # capped one page short of the whole prompt so at least one
             # real suffix token always prefills (the admission samples the
-            # first token from its logits).
+            # first token from its logits).  The match walks BOTH tiers
+            # (device hits retained, host-spilled pages restored), so an
+            # LRU-evicted head no longer hides a resident tail.
             if req.digests is None:
-                req.digests = PrefixCache.page_digests(
-                    req.ids, blk, len(req.ids) // blk
+                req.digests = self._page_digests(
+                    req.ids, len(req.ids) // blk
                 )
             digests = req.digests
-            cached_pages = pc.match(digests[: (len(req.ids) - 1) // blk])
+            cap = (len(req.ids) - 1) // blk
+            cached_pages = self._match_tiered(digests, cap, n_init=n_init)
             cached_len = len(cached_pages) * blk
-            # Retain hits BEFORE allocating: eviction must never reclaim
-            # the very run we just matched.
-            for p in cached_pages:
-                self._retain_page(p)
         need = n_init - len(cached_pages)
         if not self._ensure_pages(need, "admit", below_priority=req.priority):
+            # Restored pages release to the device LRU (still
+            # addressable); retained hits just drop our reference.
             self._release_pages(cached_pages)
             return None
         if auto:
@@ -2378,6 +3150,17 @@ class ContinuousBatcher:
             req = self._next_request()
             if req is None:
                 return
+            if req.swap_handle is not None:
+                # Swap-preempted resume: scatter the parked pages back
+                # instead of recomputing the prefix.  True = restored
+                # (next loop iteration admits more); False = the parcel
+                # was unusable and the request fell through to recompute
+                # (still queued, swap_handle cleared — re-selected next
+                # iteration); None = back-pressure, stop this round.
+                got = self._try_restore_swapped(i, req)
+                if got is None:
+                    return
+                continue
             pfx = self.prefixes[req.prefix] if req.prefix is not None else None
             pfx_len = len(pfx.ids) if pfx else 0
             total_len = pfx_len + len(req.ids)
@@ -2592,16 +3375,19 @@ class ContinuousBatcher:
             if pc is not None and req.prefix_cache:
                 blk = self.page_size
                 if req.digests is None:
-                    req.digests = PrefixCache.page_digests(
-                        req.ids, blk, len(req.ids) // blk
+                    req.digests = self._page_digests(
+                        req.ids, len(req.ids) // blk
                     )
                 digests = req.digests
-                cached_pages = pc.match(digests[: (len(req.ids) - 1) // blk])
+                cap = (len(req.ids) - 1) // blk
+                # Tiered match: device hits are retained for the WHOLE
+                # prefill (eviction must never reclaim a run the pending
+                # chunks continue from) and host-spilled pages restore
+                # with spare capacity (a chunked prefill holds no pool
+                # pages of its own yet) — the pending chunks then start
+                # past them, exactly as if they had never been evicted.
+                cached_pages = self._match_tiered(digests, cap)
                 cached_len = len(cached_pages) * blk
-                # Retain hits for the WHOLE prefill: eviction must never
-                # reclaim a run the pending chunks are continuing from.
-                for p in cached_pages:
-                    self._retain_page(p)
                 pc.record_lookup(cached_len, total_len - cached_len)
             if cached_pages:
                 read_list = np.zeros((self.pages_per_row,), np.int32)
@@ -2612,7 +3398,7 @@ class ContinuousBatcher:
                 done = cached_len
             else:
                 rc = model_lib.init_cache(self.cfg, 1, self.s,
-                                          dtype=self.cache.k.dtype)
+                                          dtype=_row_dtype_of(self.cache))
                 row_k, row_v, done = rc.k, rc.v, 0
         self._admit_seq += 1
         # The reserving row holds the cached pages so cancel_row /

@@ -479,6 +479,14 @@ class InferenceEngine:
         faults: Any = None,  # FaultPlane | None; None -> parse rt.faults —
         #   deterministic fault injection into the batcher's hot paths
         #   (runtime/faults.py), the lever behind `dlt-serve --fault`
+        kv_bits: int | None = None,  # None -> rt.kv_bits; 8 = int8 KV
+        #   pages in the paged pool (blockwise absmax scales, dequant
+        #   fused into the decode read) — needs paged mode, like the
+        #   prefix cache: explicit conflicts error, config-inherited ones
+        #   degrade with a warning
+        host_pages: int | None = None,  # None -> rt.host_pages; > 0 arms
+        #   the host-RAM tier behind the pool (swap-preemption + prefix-
+        #   cache spill) — same paged-mode degradation policy
     ):
         """A ContinuousBatcher over this engine's model: requests admit into
         an in-flight decode batch as rows free up (runtime/batcher.py) —
@@ -543,6 +551,40 @@ class InferenceEngine:
                 "contiguous KV (no paged pool to cache pages in)"
             )
             prefix_cache = False
+        # KV memory tiering: int8 pages and the host-RAM tier both live
+        # behind the paged pool — explicit requests on a non-paged engine
+        # error; config-inherited ones degrade with a warning (the shared
+        # cluster-config policy every paged knob above follows).
+        explicit_bits = kv_bits is not None
+        if kv_bits is None:
+            kv_bits = self.rt.kv_bits
+        if kv_bits not in (16, 8):
+            raise ValueError(f"kv_bits must be 16 or 8, got {kv_bits}")
+        if kv_bits == 8 and paged_pages is None:
+            if explicit_bits:
+                raise ValueError(
+                    "int8 KV pages live in the paged pool; pass "
+                    "paged_pages (or set runtime.paged_pages)"
+                )
+            log.warning(
+                "runtime.kv_bits=8 ignored: this engine serves contiguous "
+                "KV (full-width cache)"
+            )
+            kv_bits = 16
+        explicit_host = host_pages is not None
+        if host_pages is None:
+            host_pages = self.rt.host_pages
+        if host_pages and paged_pages is None:
+            if explicit_host:
+                raise ValueError(
+                    "the host-RAM KV tier backs the paged pool; pass "
+                    "paged_pages (or set runtime.paged_pages)"
+                )
+            log.warning(
+                "runtime.host_pages ignored: this engine serves "
+                "contiguous KV (no paged pool to tier)"
+            )
+            host_pages = 0
         if self.parallel is not None:
             # The shared cache shards its batch over 'data'; round the slot
             # count up so every mesh shape serves (extra slots are harmless
@@ -599,6 +641,7 @@ class InferenceEngine:
             prefill_chunk=prefill_chunk,
             prefill_concurrency=prefill_concurrency,
             faults=faults,
+            kv_bits=kv_bits, host_pages=int(host_pages),
         )
 
     # -- speculative decoding (runtime/speculative.py): greedy-exact at

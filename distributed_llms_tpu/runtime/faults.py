@@ -148,6 +148,24 @@ FAULT_SITES: dict[str, str] = {
         "request: 'close' (or 'raise') kills the replica abruptly "
         "mid-handoff — the router's degradation ladder must fall back to "
         "colocated prefill on the decode replica",
+    "kv.swap_out":
+        "a preemption victim about to SWAP its pages to the host tier "
+        "(runtime/batcher.py): 'drop' skips the swap (falls back to "
+        "exact recompute), 'corrupt' flips a parcel byte in host storage "
+        "(checksum verification at restore degrades to recompute); "
+        "'stall:<s>' models a slow D2H drill",
+    "kv.swap_in":
+        "one swap-restore attempt (a swapped request reaching the front "
+        "of admission): 'drop' abandons the parcel (the request "
+        "recomputes, exactly), 'corrupt' mangles the payload at take "
+        "time — verification must catch it and fall back",
+    "kv.spill":
+        "host-tier spill plane; tag 'out' (cold cached pages about to be "
+        "captured ahead of LRU eviction) or 'restore' (a prefix-cache "
+        "hit about to restore spilled pages): 'drop' skips the movement "
+        "(plain eviction / cold prefill — correct, just slower), "
+        "'corrupt' flips spilled bytes so restore verification rejects "
+        "them",
 }
 
 

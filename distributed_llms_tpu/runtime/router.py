@@ -120,12 +120,16 @@ class ReplicaRouter:
         # degrades to colocated prefill.
         handoff: bool = False,
         handoff_deadline_s: float = 15.0,
+        kv_bits: int = 16,  # the replicas' pool width — page digests are
+        #   salted by it (PrefixCache.page_digests), and router-side
+        #   affinity/handoff digests must match the fleet's
     ) -> None:
         self.fleet = fleet
         self.host = host
         self.port = port
         self.tokenizer = tokenizer
         self.page_size = page_size
+        self.kv_bits = kv_bits
         self.max_failover_retries = max_failover_retries
         self.affinity_max = affinity_max
         self.spill_factor = spill_factor
@@ -178,7 +182,8 @@ class ReplicaRouter:
         if not prompt_ids or self.page_size <= 0:
             return []
         n = max(0, (len(prompt_ids) - 1) // self.page_size)
-        return PrefixCache.page_digests(prompt_ids, self.page_size, n)
+        return PrefixCache.page_digests(prompt_ids, self.page_size, n,
+                                        kv_bits=self.kv_bits)
 
     def _affinity_lookup(self, d: bytes) -> str | None:
         """The replica a digest is sticky to — IF that replica's cache

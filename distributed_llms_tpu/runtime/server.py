@@ -75,6 +75,7 @@ Overload-safe serving (PR 3; README "Overload behavior"):
 from __future__ import annotations
 
 import asyncio
+import functools
 import json
 import math
 import threading
@@ -1289,7 +1290,14 @@ class InferenceServer:
         try:
             await kv_transfer.handle_kv_connection(
                 reader, writer,
-                page_digests_fn=PrefixCache.page_digests,
+                # Digest recompute must use the engine's salt: pool
+                # digests fold in the KV width (--kv-bits), so a frame
+                # from a differently-configured sender reads as a chain
+                # mismatch instead of poisoning the cache.
+                page_digests_fn=functools.partial(
+                    PrefixCache.page_digests,
+                    kv_bits=getattr(self.batcher, "kv_bits", 16),
+                ),
                 import_fn=self._kv_import,
                 faults=self.batcher.faults,
                 stats=self.kv_stats,

@@ -256,6 +256,29 @@ def test_pages_leak_on_exception_path_and_finally_is_safe(tmp_path):
     assert findings[0].line == 3  # grow's alloc, not safe's
 
 
+def test_host_tier_swap_handle_leak(tmp_path):
+    """KV tiering (GF301 host-tier leg): a swap handle minted by
+    park_swap that an exception path forgets is host RAM nothing will
+    ever restore or free — and a handle stored onto the resume request
+    before anything can raise is clean."""
+    findings = resources.check(_project(tmp_path, {
+        "pkg/runtime/batcher.py": (
+            "class B:\n"
+            "    def swap_out(self, row):\n"
+            "        handle = self.host_tier.park_swap(row.payload, 2)\n"
+            "        self.audit()\n"          # raises -> stranded parcel
+            "        row.req.swap_handle = handle\n"
+            "    def safe(self, row, resume):\n"
+            "        handle = self.host_tier.park_swap(row.payload, 2)\n"
+            "        resume.swap_handle = handle\n"
+            "        self.audit()\n"
+        ),
+    }))
+    assert _rules(findings) == ["GF301"]
+    assert findings[0].line == 3  # swap_out's park, not safe's
+    assert "exception exit" in findings[0].message
+
+
 def test_bare_acquire_needs_release_on_all_paths(tmp_path):
     findings = resources.check(_project(tmp_path, {
         "pkg/runtime/server.py": (

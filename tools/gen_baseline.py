@@ -69,6 +69,14 @@ def _result_cell(row: dict) -> str:
         ("offered_x", "offered load x"),
         ("shed_frac", "shed frac"),
         ("preemptions", "preemptions"),
+        ("rows_bf16", "rows @bf16"),
+        ("rows_int8", "rows @int8"),
+        ("capacity_factor_int8", "int8 capacity factor"),
+        ("swap_restore_ms", "swap restore ms"),
+        ("recompute_restore_ms", "recompute restore ms"),
+        ("swap_speedup", "swap speedup"),
+        ("spill_hit_ttft_ms", "spill-hit TTFT ms"),
+        ("cold_ttft_ms", "cold TTFT ms"),
         ("admit_row_keys", "admit compile keys"),
         ("admit_row_declared", "of declared"),
         ("decode_chunk_keys", "decode compile keys"),
@@ -111,8 +119,8 @@ def generate(ladder_path: str) -> str:
         # Aux rows run_ladder appends after the decode configs.
         "serving-latency", "continuous-batching", "local-proc-batching",
         "chunked-prefill", "prefix-cache-ttft", "fault-recovery",
-        "overload-goodput", "replica-failover", "disagg-handoff",
-        "compile-stability", "analysis-wall",
+        "overload-goodput", "kv-tiering", "replica-failover",
+        "disagg-handoff", "compile-stability", "analysis-wall",
         "ragged-decode-8k", "ragged-decode-win-8k", "quant-matmul-bw",
         "spec-decode", "spec-decode-7b-int8", "spec-batching",
         "paged-batching", "prefill-flash-2048", "prefill-flash-8192",

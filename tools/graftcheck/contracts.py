@@ -74,6 +74,15 @@ def abstract_pool(cfg, num_pages: int, page_size: int):
     )
 
 
+def abstract_quant_pool(cfg, num_pages: int, page_size: int):
+    """Int8 KV page pool (QuantKVCache: data int8 + f32 absmax scales)."""
+    from distributed_llms_tpu.runtime import batcher as batcher_lib
+
+    return jax.eval_shape(
+        lambda: batcher_lib._paged_pool(cfg, num_pages, page_size, kv_bits=8)
+    )
+
+
 def fake_mesh(**axes: int):
     """AbstractMesh over the standard axis names — sharding semantics with
     zero devices (jax.eval_shape/make_jaxpr accept it everywhere a real
@@ -290,6 +299,50 @@ def _paged_cases() -> list[OpCase]:
     return cases
 
 
+def _decode_int8_cases() -> list[OpCase]:
+    """Int8 legs of BOTH decode-attention kernels: quantized K/V (+ f32
+    absmax scales) in, q.dtype out, across the same (batch, seq, heads,
+    pages) sweep as the full-width contracts — tileable kernel shapes AND
+    the dense/gather fallbacks."""
+    from distributed_llms_tpu.ops import decode_attn
+
+    cases = []
+    dt = jnp.bfloat16
+    for b, s, h, kvh, d in [
+        (1, 128, 4, 2, 128),   # kernel-tileable
+        (2, 40, 4, 4, 64),     # untileable -> dense fallback
+        (3, 384, 8, 2, 128),   # block stepdown
+    ]:
+        cases.append(OpCase(
+            label=f"ragged b{b} s{s} h{h}/{kvh} d{d}",
+            fn=lambda q, k, v, ln, ks, vs:
+                decode_attn.ragged_decode_attention(
+                    q, k, v, ln, k_scale=ks, v_scale=vs),
+            args=(sds((b, 1, h, d), dt), sds((b, s, kvh, d), jnp.int8),
+                  sds((b, s, kvh, d), jnp.int8), sds((b,), jnp.int32),
+                  sds((b, s, kvh), jnp.float32),
+                  sds((b, s, kvh), jnp.float32)),
+            want=(((b, 1, h, d), "bfloat16"),),
+        ))
+    for b, nb, blk, p, h, kvh, d in [
+        (1, 16, 8, 4, 4, 2, 128),    # kernel-tileable, page boundary
+        (3, 8, 64, 2, 4, 4, 64),     # untileable d -> gather fallback
+        (2, 32, 16, 8, 8, 2, 128),
+    ]:
+        cases.append(OpCase(
+            label=f"paged b{b} nb{nb} blk{blk} p{p} h{h}/{kvh} d{d}",
+            fn=lambda q, k, v, ln, tb, ks, vs:
+                decode_attn.paged_decode_attention(
+                    q, k, v, ln, tb, k_scale=ks, v_scale=vs),
+            args=(sds((b, 1, h, d), dt), sds((nb, blk, kvh, d), jnp.int8),
+                  sds((nb, blk, kvh, d), jnp.int8), sds((b,), jnp.int32),
+                  sds((b, p), jnp.int32), sds((nb, blk, kvh), jnp.float32),
+                  sds((nb, blk, kvh), jnp.float32)),
+            want=(((b, 1, h, d), "bfloat16"),),
+        ))
+    return cases
+
+
 def _quant_cases() -> list[OpCase]:
     import numpy as np
 
@@ -370,6 +423,28 @@ def _forward_cases() -> list[OpCase]:
             want=(((b, 1, cfg.vocab_size), "float32"),
                   ((l, nb, blk, kvh, hd), "bfloat16"),
                   ((l, nb, blk, kvh, hd), "bfloat16")),
+        ))
+    # Int8 paged decode (--kv-bits 8): the pool round-trips at int8 with
+    # f32 scales — logits stay f32, nothing silently re-widens.
+    for b, nb, blk, p in [(2, 8, 8, 4), (1, 16, 8, 8)]:
+        qpool = abstract_quant_pool(cfg, nb, blk)
+        l, kvh, hd = cfg.num_layers, cfg.num_kv_heads, cfg.head_dim_
+        cases.append(OpCase(
+            label=f"llama-tiny int8-pageddecode b{b} nb{nb} blk{blk}",
+            fn=functools.partial(
+                lambda cfg, prm, tok, pos, c, ci, tb: (
+                    lambda out: (out[0], out[1].k, out[1].v,
+                                 out[1].k_scale, out[1].v_scale)
+                )(model_lib.forward(
+                    prm, cfg, tok, positions=pos, cache=c, cache_index=ci,
+                    kv_tables=tb)), cfg),
+            args=(params, sds((b, 1), jnp.int32), sds((b, 1), jnp.int32),
+                  qpool, sds((b,), jnp.int32), sds((b, p), jnp.int32)),
+            want=(((b, 1, cfg.vocab_size), "float32"),
+                  ((l, nb, blk, kvh, hd), "int8"),
+                  ((l, nb, blk, kvh, hd), "int8"),
+                  ((l, nb, blk, kvh), "float32"),
+                  ((l, nb, blk, kvh), "float32")),
         ))
     return cases
 
@@ -456,6 +531,10 @@ def op_contracts() -> list[OpContract]:
         OpContract("ops.decode_attn.paged_decode_attention", P_DECODE,
                    "[B,1,H,D] through page tables incl. page-boundary sizes",
                    _paged_cases),
+        OpContract("ops.decode_attn_int8", P_DECODE,
+                   "int8 pages + absmax scales in, q.dtype out "
+                   "(ragged + paged legs, kernel and fallback shapes)",
+                   _decode_int8_cases),
         OpContract("ops.quant_matmul.quant_contract", P_QMM,
                    "int8/int4 contraction keeps activation dtype and N axes",
                    _quant_cases),
@@ -717,6 +796,37 @@ def recompile_scenarios() -> list[RecompileScenario]:
         allowed_widths=(s_cap,),
         max_keys=1,
         trace=decode_trace,
+    ))
+
+    # -- int8 paged decode step: the quantized leg (per-step KV quantize
+    # + scale-fused attention read) must still be ONE compiled program —
+    # neither depths nor page contents are shapes.
+    def decode_int8_trace(width: int) -> str:
+        from distributed_llms_tpu.runtime import batcher as batcher_lib
+
+        b, nb, blk, p = 4, 16, 16, 8
+        params = abstract_params(cfg)
+        pool = abstract_quant_pool(cfg, nb, blk)
+        return jaxpr_hash(
+            lambda prm, c, lt, rl, va, ac, bu, rng, tb:
+                batcher_lib.decode_chunk(
+                    prm, cfg, c, lt, rl, va, ac, bu, rng, chunk_steps=8,
+                    tables=tb),
+            params, pool, sds((b,), jnp.int32), sds((b,), jnp.int32),
+            sds((b, p * blk), jnp.bool_), sds((b,), jnp.bool_),
+            sds((b,), jnp.int32), key_sds(), sds((b, p), jnp.int32),
+            statics={"cfg": cfg, "chunk_steps": 8},
+        )
+
+    out.append(RecompileScenario(
+        name="batcher.decode_chunk_int8", path=P_BATCHER,
+        doc="int8 paged decode (quantized write + scale-fused read) "
+            "stays ONE program across every resident depth",
+        ladder=_GC4_LADDER,
+        width_of=lambda n: s_cap,
+        allowed_widths=(s_cap,),
+        max_keys=1,
+        trace=decode_int8_trace,
     ))
 
     # -- whole-batch generate: the engine pads T up the ladder under the

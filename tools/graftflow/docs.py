@@ -37,8 +37,9 @@ RULE_DOCS: dict[str, tuple[str, str]] = {
               "every FaultPlane.fire reachable from a coroutine passes "
               "defer_stall=True (a stall rule must never block the loop)"),
     "GF301": ("GF3 resources",
-              "allocated KV pages reach a release/store/handoff on every "
-              "CFG path, exception edges included"),
+              "allocated KV pages AND host-tier swap handles (park_swap) "
+              "reach a release/store/handoff on every CFG path, "
+              "exception edges included"),
     "GF302": ("GF3 resources",
               "every bare .acquire() pairs with .release() on all paths "
               "(prefer 'with')"),

@@ -8,15 +8,17 @@ acquire and release).  GF3 walks every function's CFG — including the
 exception edges — and demands the pairing on all of them:
 
 - **GF301** page-pool pairing: pages obtained via ``x = <..>.alloc(...)``
-  (or the batcher's ``_alloc_pages`` wrapper) must be released, stored,
-  returned, or handed to another owner on EVERY path from the allocation
-  to function exit, exception exits included.  The first statement that
-  mentions ``x`` again counts as the sink (conservative: the checker
-  cannot see whether a callee keeps the reference), so what this rule
-  pins is the canonical leak — an alloc followed by a path (a guard
-  return, a raising call) that forgets the pages entirely.  An
-  intervening raising statement needs a ``try/finally`` release to be
-  safe.
+  (or the batcher's ``_alloc_pages`` wrapper), and host-tier swap handles
+  obtained via ``x = <..>.park_swap(...)`` (the KV tiering plane — a
+  handle nobody stores is host RAM nothing will ever restore or free),
+  must be released, stored, returned, or handed to another owner on
+  EVERY path from the allocation to function exit, exception exits
+  included.  The first statement that mentions ``x`` again counts as the
+  sink (conservative: the checker cannot see whether a callee keeps the
+  reference), so what this rule pins is the canonical leak — an alloc
+  followed by a path (a guard return, a raising call) that forgets the
+  pages entirely.  An intervening raising statement needs a
+  ``try/finally`` release to be safe.
 - **GF302** explicit ``<recv>.acquire()`` (lock/semaphore) must have a
   ``<recv>.release()`` on every path to exit — i.e. in a ``finally`` (or
   the code between them cannot raise or return).  Prefer ``with recv:``.
@@ -43,7 +45,7 @@ RULE_PAGES = "GF301"
 RULE_ACQUIRE = "GF302"
 RULE_REGISTRY = "GF303"
 
-_ALLOC_METHODS = frozenset({"alloc", "_alloc_pages"})
+_ALLOC_METHODS = frozenset({"alloc", "_alloc_pages", "park_swap"})
 _CLEANUP_METHODS = frozenset({"pop", "discard", "remove", "clear"})
 _CLEANUP_RE = re.compile(r"#\s*graftflow:\s*cleanup-required\b")
 

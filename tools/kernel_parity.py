@@ -150,6 +150,63 @@ def ragged_parity() -> None:
     check(f"ragged windowed S{s} win{win}", got, want, rtol=3e-2, atol=3e-2)
 
 
+def decode_int8_parity() -> None:
+    """Int8 KV-page legs (--kv-bits 8): the scale-fused kernels vs the
+    dequantize-then-dense reference (checkpoint.quantize.kv_dequantize
+    numerics — exactly what the CPU fallback computes)."""
+    from distributed_llms_tpu.checkpoint.quantize import (kv_dequantize,
+                                                          kv_quantize)
+
+    key = jax.random.PRNGKey(7)
+    # Ragged leg.
+    b, s, h, kvh, d = 4, 512, 8, 4, 128
+    ks = jax.random.split(key, 3)
+    q = jax.random.normal(ks[0], (b, 1, h, d), jnp.bfloat16)
+    kk = jax.random.normal(ks[1], (b, s, kvh, d), jnp.bfloat16)
+    v = jax.random.normal(ks[2], (b, s, kvh, d), jnp.bfloat16)
+    kq, ksc = kv_quantize(kk)
+    vq, vsc = kv_quantize(v)
+    ln = jnp.asarray([3, 200, 512, 64], jnp.int32)
+    got = jax.jit(decode_attn.ragged_decode_attention)(
+        q, kq, vq, ln, k_scale=ksc, v_scale=vsc
+    )
+    want = decode_attn._dense_reference(
+        q, kv_dequantize(kq, ksc, q.dtype), kv_dequantize(vq, vsc, q.dtype),
+        ln,
+    )
+    check(f"ragged int8 B{b} S{s}", got, want, rtol=3e-2, atol=3e-2)
+    # Paged leg.
+    pool, blk, pages = 48, 128, 4
+    rng = np.random.RandomState(1)
+    tables = jnp.asarray(
+        rng.permutation(pool)[: b * pages].reshape(b, pages), jnp.int32
+    )
+    k_pool = jnp.zeros((pool, blk, kvh, d), jnp.int8).at[
+        tables.reshape(-1)
+    ].set(kq[:, : pages * blk].reshape(b * pages, blk, kvh, d))
+    v_pool = jnp.zeros((pool, blk, kvh, d), jnp.int8).at[
+        tables.reshape(-1)
+    ].set(vq[:, : pages * blk].reshape(b * pages, blk, kvh, d))
+    ks_pool = jnp.ones((pool, blk, kvh), jnp.float32).at[
+        tables.reshape(-1)
+    ].set(ksc[:, : pages * blk].reshape(b * pages, blk, kvh))
+    vs_pool = jnp.ones((pool, blk, kvh), jnp.float32).at[
+        tables.reshape(-1)
+    ].set(vsc[:, : pages * blk].reshape(b * pages, blk, kvh))
+    ln = jnp.asarray([1, 300, pages * blk, 129], jnp.int32)
+    got = jax.jit(decode_attn.paged_decode_attention)(
+        q, k_pool, v_pool, ln, tables, k_scale=ks_pool, v_scale=vs_pool
+    )
+    want = decode_attn._dense_reference(
+        q,
+        kv_dequantize(kq[:, : pages * blk], ksc[:, : pages * blk], q.dtype),
+        kv_dequantize(vq[:, : pages * blk], vsc[:, : pages * blk], q.dtype),
+        ln,
+    )
+    check(f"paged int8 B{b} pool{pool} blk{blk}", got, want,
+          rtol=3e-2, atol=3e-2)
+
+
 def main() -> int:
     backend = jax.default_backend()
     print(f"kernel_parity: backend={backend} devices={jax.device_count()}")
@@ -160,11 +217,12 @@ def main() -> int:
     flash_parity()
     ragged_parity()
     paged_parity()
+    decode_int8_parity()
     mode = "compiled" if ON_TPU else "interpret"
-    # v2: round 5 added the windowed-flash leg — versioning the marker
-    # makes tools/tpu_runbook.sh re-run the sweep on the next TPU window
-    # instead of skipping on a pre-window PARITY_TPU.log.
-    print(f"kernel_parity: ALL PASS v2 ({mode}, backend={backend})")
+    # v3: round 12 added the int8 KV-page legs (scale-fused decode) —
+    # versioning the marker makes tools/tpu_runbook.sh re-run the sweep on
+    # the next TPU window instead of skipping on a pre-window PARITY_TPU.log.
+    print(f"kernel_parity: ALL PASS v3 ({mode}, backend={backend})")
     return 0
 
 

@@ -58,7 +58,7 @@ if [ "$PLATFORM" != "tpu" ]; then
 fi
 log "probe OK: tpu"
 
-if grep -q "ALL PASS v2 (compiled" PARITY_TPU.log 2>/dev/null; then
+if grep -q "ALL PASS v3 (compiled" PARITY_TPU.log 2>/dev/null; then
   log "kernel parity: already recorded in PARITY_TPU.log — skipping"
 else
   log "kernel parity (compiled on chip)..."
