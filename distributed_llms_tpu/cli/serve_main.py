@@ -37,6 +37,7 @@ _RUNTIME_FLAGS: dict[str, str] = {
     "prefix-cache": "prefix_cache",
     "kv-bits": "kv_bits",
     "host-pages": "host_pages",
+    "overlap": "overlap",
     "request-timeout": "request_timeout_s",
     "shed-cost-factor": "shed_cost_factor",
     "fault": "faults",
@@ -114,6 +115,8 @@ def _server_factory(args, engine, default_name, rt, faults, *,
             prefix_cache=args.prefix_cache,
             kv_bits=args.kv_bits,
             host_pages=args.host_pages,
+            overlap=(None if args.overlap is None
+                     else args.overlap == "on"),
             faults=faults,
         )
 
@@ -344,6 +347,15 @@ def main(argv=None) -> None:
                          "eviction under pool pressure); needs --paged-pages."
                          "  Per-request opt-out: \"prefix_cache\": false.  "
                          "(default: runtime.prefix_cache)")
+    ap.add_argument("--overlap", choices=["on", "off"], default=None,
+                    help="dispatch-ahead engine loop: while no scheduling "
+                         "work is pending, decode chunk N+1 dispatches "
+                         "from the device-resident carry and chunk N's "
+                         "host work (delivery, digest hashing, metrics) "
+                         "overlaps its device execution.  Temp-0 bytes "
+                         "identical on or off; gauges under "
+                         "batcher_overlap_* on /metrics (default: "
+                         "runtime.overlap, on)")
     ap.add_argument("--prefill-chunk", type=int, default=None,
                     help="chunked prefill: admit at most this many prompt "
                          "tokens per scheduling round per pending prefill, "

@@ -253,6 +253,15 @@ class RuntimeConfig:
     # prefix-cache pages spill there before LRU eviction (a later hit
     # restores instead of re-prefilling).  0 disables.
     host_pages: int = 0
+    # Dispatch-ahead engine loop (runtime/batcher.py): while no scheduling
+    # work is pending, decode chunk N+1 dispatches directly from chunk N's
+    # device-resident carry and chunk N's host work (token D2H, streaming
+    # delivery, digest hashing, metrics) overlaps N+1's device execution.
+    # Temp-0 outputs are byte-identical either way; admission/growth/
+    # preemption semantics are unchanged (every scheduling decision still
+    # runs against synced host mirrors).  Off = the fully-synchronous
+    # loop, one host round-trip per chunk.
+    overlap: bool = True
     # Speculative decoding (runtime/speculative.py).  With spec_decode=True
     # on a single-device full-precision engine, generate_text transparently
     # routes greedy requests through the speculative loop (results are

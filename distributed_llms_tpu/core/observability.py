@@ -213,6 +213,25 @@ METRIC_DOCS: dict[str, str] = {
                                  "decode-role engine",
     "batcher.kv_pages_imported": "handed-off KV pages adopted into the "
                                  "pool (decode-role engine)",
+    # -- dispatch-ahead engine loop (overlap) --
+    "batcher.overlap.dispatched_ahead": "decode chunks dispatched from the "
+                                        "device-resident carry while the "
+                                        "previous chunk's host work ran",
+    "batcher.overlap.carry_syncs": "decode spans ended by syncing the "
+                                   "device carry into the host mirrors "
+                                   "(scheduling work was pending)",
+    "batcher.overlap.host_lag_seconds": "host work per overlapped chunk "
+                                        "(D2H + delivery + digest "
+                                        "pre-hashing), concurrent with the "
+                                        "next chunk on device (histogram)",
+    "batcher.overlap.device_gap_seconds": "host time between a chunk "
+                                          "completing and the next chunk "
+                                          "dispatching — 0 by construction "
+                                          "for dispatched-ahead chunks "
+                                          "(histogram)",
+    "batcher.overlap.depth": "current dispatch depth: 1 while a chunk is "
+                             "dispatched ahead of its predecessor's host "
+                             "work, 0 at a carry sync (gauge)",
     # -- KV memory tiering (int8 pages + host-RAM tier) --
     "batcher.kv_swaps.out": "preemption victims swapped to the host tier "
                             "(raw pages parked instead of recomputed)",

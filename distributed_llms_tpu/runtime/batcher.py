@@ -315,7 +315,14 @@ def spec_chunk(
     Layout: contiguous (slot == position) exactly like decode_chunk; the
     rollback/backfill arguments mirror runtime/speculative.py with the
     frontier convention shifted to the batcher's (a token's KV is written
-    by the forward that consumes it, at slot == its position)."""
+    by the forward that consumes it, at slot == its position).
+
+    Chaining contract: like decode_chunk, every returned carry leaf
+    (cache', draft_cache', last_tok', real_lens', valid', active',
+    budget', counts') is a legal input for the next round — the
+    dispatch-ahead engine loop chains speculative rounds device-resident
+    exactly as it chains plain decode chunks (both caches are donated;
+    the carry vectors are not)."""
     s = cache.k.shape[-3]
     slots = jnp.arange(s, dtype=jnp.int32)
     penalized = counts is not None
@@ -986,7 +993,17 @@ def decode_chunk(
     histogram tracks every emitted token (rows with zero penalties read
     garbage counts harmlessly — the adjustment multiplies to zero).
     Logprobs stay RAW-distribution (pre-penalty), matching the logprobs
-    contract elsewhere."""
+    contract elsewhere.
+
+    Chaining contract (the dispatch-ahead engine loop): every returned
+    carry leaf (cache', last_tok', real_lens', valid', active', budget',
+    counts') is a legal INPUT for the next call — same shapes, same
+    dtypes, device-resident — so chunk N+1 can dispatch directly from
+    chunk N's outputs with no host round-trip, hitting the same compiled
+    program host-mirror inputs would (graftcheck GC4's
+    batcher.decode_chunk_overlap case pins this to one compile key).
+    Only ``cache`` is donated; the small carry vectors are read-only
+    inputs and safe to hold across the chained dispatch."""
     if tables is None:
         s = cache.k.shape[-3]
         slots = jnp.arange(s, dtype=jnp.int32)
@@ -1075,6 +1092,13 @@ def decode_chunk(
         counts = _replicated(pm, counts)
     return (toks, cache, last_tok, real_lens, valid, active, budget, lps,
             counts)
+
+
+def _writable(a: np.ndarray) -> np.ndarray:
+    """A writable host array from a ``jax.device_get`` result: the CPU
+    backend may hand back a read-only zero-copy view, and admission writes
+    into the scheduling mirrors (the copy is paid only when needed)."""
+    return a if a.flags.writeable else np.array(a)
 
 
 @partial(jax.jit, donate_argnames=("counts",))
@@ -1179,11 +1203,15 @@ class PrefixCache:
         digests: list[bytes] = []
         prev = (b"dlt-prefix-cache-v1" if kv_bits == 16
                 else b"dlt-prefix-cache-v1:kv%d" % kv_bits)
+        # ONE token-id conversion for the whole prompt, sliced per page —
+        # the old per-page np.asarray paid a fresh list->array
+        # materialization inside every blake2b update; the chain bytes
+        # are identical (tests/runtime/test_overlap.py pins equality
+        # against the per-page construction).
+        flat = np.asarray(ids[: n_pages * page_size], np.int64)
         for i in range(n_pages):
             h = hashlib.blake2b(prev, digest_size=16)
-            h.update(np.asarray(
-                ids[i * page_size: (i + 1) * page_size], np.int64
-            ).tobytes())
+            h.update(flat[i * page_size: (i + 1) * page_size].tobytes())
             prev = h.digest()
             digests.append(prev)
         return digests
@@ -1856,6 +1884,19 @@ class ContinuousBatcher:
         # restores instead of re-prefilling).
         kv_bits: int = 16,
         host_pages: int = 0,
+        # Dispatch-ahead engine loop: while no scheduling work is pending
+        # (nothing queued, no chunked prefill / KV import / growth /
+        # cancel), chunk N+1 dispatches DIRECTLY from chunk N's
+        # device-resident carry (JAX async dispatch) and chunk N's host
+        # work — token D2H, delivery/streaming callbacks, digest hashing,
+        # metrics — runs while N+1 executes on device.  The host
+        # scheduling mirrors refresh lazily at the next sync trigger, so
+        # admission/growth/preemption semantics are byte-for-byte
+        # unchanged and temp-0 outputs are byte-identical to overlap=False
+        # (tests/runtime/test_overlap.py).  Degrades (with a warning) on
+        # multi-process meshes, whose lockstep contract keeps every
+        # process on the fully-synchronous path.
+        overlap: bool = True,
     ) -> None:
         # Snapshot the constructor arguments FIRST (before any local
         # variables or normalization appear) so respawn() can rebuild an
@@ -1962,6 +2003,16 @@ class ContinuousBatcher:
                 "pass paged_pages (or use register_prefix for the "
                 "contiguous named-prefix path)"
             )
+        if overlap and jax.process_count() > 1:
+            # The dispatch-ahead loop's lazy host-mirror refresh is safe on
+            # a multi-process mesh only if every process takes identical
+            # sync decisions from identical state; keep the lockstep
+            # contract trivially true on the fully-synchronous path.
+            log.warning(
+                "overlap disabled on a multi-process mesh (%d processes): "
+                "the engine loop stays fully synchronous", jax.process_count()
+            )
+            overlap = False
         self.prefill_chunk = prefill_chunk
         self.prefill_concurrency = prefill_concurrency
         self._prefills: dict[int, _PendingPrefill] = {}  # slot -> pending
@@ -2093,6 +2144,21 @@ class ContinuousBatcher:
         # never see a penalty).
         self.tok_counts: jax.Array | None = None
         self.rows = [_RowState() for _ in range(batch_slots)]
+        # Dispatch-ahead engine loop (overlap): per-batcher counters the
+        # bench and tests read directly (mirrored into METRICS as they
+        # accrue).  ``_cancel_dirty`` flags a resident-row cancel taken
+        # while the decode carry was device-resident — the next chunk
+        # boundary must SYNC so the cancelled row actually stops;
+        # ``_t_complete`` stamps when the host last observed a chunk
+        # complete (the device-gap metric's reference point).
+        self.overlap = bool(overlap)
+        self.overlap_stats = {
+            "chunks": 0, "dispatched_ahead": 0, "carry_syncs": 0,
+            "host_lag_s": 0.0, "device_gap_s": 0.0, "gap_samples": 0,
+        }
+        self._cancel_dirty = False
+        self._tables_dirty = False
+        self._t_complete: float | None = None
         # Submission lock: the ONE cross-thread boundary of this class.
         # Serving front-ends submit() from their own thread while the
         # engine thread scans/admits; PR 3 relied on GIL-atomic deque ops
@@ -2609,6 +2675,12 @@ class ContinuousBatcher:
                 self.rows[i] = _RowState()
                 self.active[i] = False
                 self.budget[i] = 0
+                # If the decode carry is device-resident (dispatch-ahead
+                # in flight), the device still believes this row is
+                # active — force a carry sync at the next chunk boundary
+                # so the cancel takes effect there, exactly as it does on
+                # the synchronous path.
+                self._cancel_dirty = True
                 METRICS.inc("batcher.cancelled")
                 return True
         return False
@@ -3504,7 +3576,13 @@ class ContinuousBatcher:
     def _collect(
         self, toks: np.ndarray, was_active: np.ndarray,
         counts: np.ndarray | None = None, lps: np.ndarray | None = None,
+        active_host: np.ndarray | None = None,
     ) -> None:
+        # ``active_host``: the post-chunk activity vector.  The dispatch-
+        # ahead path passes the fetched chunk output directly (the host
+        # mirrors are stale while the carry is device-resident); the
+        # synchronous path leaves it None and reads the freshly-synced
+        # mirror, exactly as before.
         for i in range(self.b):
             row = self.rows[i]
             if row.rid is None or not was_active[i]:
@@ -3526,7 +3604,8 @@ class ContinuousBatcher:
                     break
         # Rows that finished this chunk publish their result and free up.
         # (Chunked prefills in flight are inactive but NOT finished.)
-        active_host = self.active
+        if active_host is None:
+            active_host = self.active
         for i in range(self.b):
             row = self.rows[i]
             if row.rid is not None and not active_host[i] and not row.prefilling:
@@ -3574,6 +3653,16 @@ class ContinuousBatcher:
         speculative mode gathered from the verify pass's logits, identical
         to the plain batcher's at temperature 0).
         Exceptions from the callback propagate (and abort the run).
+
+        With ``overlap`` on (the default) the loop dispatches ahead:
+        while no scheduling work is pending, chunk N+1 runs on device
+        concurrently with chunk N's host work (callbacks included), so a
+        callback observes each chunk one dispatch later than the
+        synchronous loop would — the token STREAM per rid is unchanged,
+        and temp-0 bytes are identical either way.  After ``run`` raises
+        (an injected crash, a callback exception) the host scheduling
+        mirrors may be stale; recover through :meth:`respawn`, the
+        supervisor contract.
         """
         self._on_tokens = on_tokens
         try:
@@ -3583,6 +3672,8 @@ class ContinuousBatcher:
 
     def _run_loop(self) -> dict[int, list[int]]:
         # Publish any 1-token requests finished by admission alone.
+        self._t_complete = None  # device-gap timing: a fresh run's first
+        #                          chunk follows no observed completion
         while self.has_queued() or bool(self.active.any()) or any(
             r.rid is not None for r in self.rows
         ) or self.has_kv_imports():
@@ -3597,6 +3688,7 @@ class ContinuousBatcher:
                 self._grow_rows()
             was_active = self.active.copy()
             if not was_active.any():
+                self._t_complete = None  # idle boundary: no chunk to gap
                 self._collect(
                     np.zeros((self.b, 0), np.int32), was_active
                 )
@@ -3604,93 +3696,350 @@ class ContinuousBatcher:
                         and all(r.rid is None for r in self.rows):
                     break
                 continue
-            if self.faults is not None:
-                # Injection site "batcher.decode": one hit per decode /
-                # speculative chunk about to be dispatched.  A "raise" rule
-                # here is the canonical engine crash (propagates out of
-                # run() into the serving supervisor); "stall" models a
-                # wedged device call for the watchdog.
-                self.faults.fire("batcher.decode")
-            counts = None
-            counts_out = None  # updated penalty histogram (either branch)
-            if self.speculative:
-                # Penalized path only while a penalized row is live — the
-                # all-default batch keeps the smaller static program (same
-                # policy as the plain branch below).
-                per_spec = {}
-                pen_live = self.active & (
-                    (self.pres_row != 0.0) | (self.freq_row != 0.0)
-                )
-                if bool(pen_live.any()):
-                    per_spec["counts"] = self.tok_counts
-                    per_spec["pres_row"] = jnp.asarray(self.pres_row)
-                    per_spec["freq_row"] = jnp.asarray(self.freq_row)
-                if self.sampling["temperature"] > 0.0:
-                    # Sampled rounds consume RNG; greedy rounds must not
-                    # (greedy spec stays bit-stable across configs).
-                    per_spec["rng"] = self._split_rng()
-                (toks, m, chunk_lps, self.cache, self.draft_cache, last_tok,
-                 real_lens, valid, active, budget, counts_out) = spec_chunk(
-                    self.params, self.cfg, self.draft_params, self.draft_cfg,
-                    self.cache, self.draft_cache, self.last_tok,
-                    self.real_lens, self.valid, self.active, self.budget,
-                    k=self.spec_k, eos_id=self.eos_id, pad_id=self.pad_id,
-                    **self.sampling, **per_spec,
-                )
-                counts = np.asarray(m)
-            else:
-                # Per-row sampling path only while a custom-sampled row is
-                # live: the all-default batch keeps the static program
-                # (greedy compiles to a bare argmax — no per-step vocab
-                # sort paid for traffic that never asked for sampling).
-                rows_live = self.active & (
-                    (self.temp_row != self.sampling["temperature"])
-                    | (self.topp_row != self.sampling["top_p"])
-                    | (self.topk_row != self.sampling["top_k"])
-                )
-                per_row = {}
-                if bool(rows_live.any()):
-                    per_row["temp_row"] = jnp.asarray(self.temp_row)
-                    if not bool((self.topp_row[self.active] == 1.0).all()):
-                        # All-1.0 top_p skips the per-step [B, V] sort+
-                        # softmax+cumsum mask entirely (sample_rows takes
-                        # the static keep-everything path).
-                        per_row["topp_row"] = jnp.asarray(self.topp_row)
-                    if not bool((
-                        self.topk_row[self.active] == self.sampling["top_k"]
-                    ).all()):
-                        # Engaged only while a row's top_k diverges from
-                        # the engine-wide static value — the traced mask
-                        # pays a per-step [B, V] sort the static path
-                        # doesn't.
-                        per_row["topk_row"] = jnp.asarray(self.topk_row)
-                pen_live = self.active & (
-                    (self.pres_row != 0.0) | (self.freq_row != 0.0)
-                )
-                if bool(pen_live.any()):
-                    per_row["counts"] = self.tok_counts
-                    per_row["pres_row"] = jnp.asarray(self.pres_row)
-                    per_row["freq_row"] = jnp.asarray(self.freq_row)
-                (toks, self.cache, last_tok, real_lens, valid, active,
-                 budget, chunk_lps, counts_out) = \
-                    decode_chunk(
-                        self.params, self.cfg_decode, self.cache, self.last_tok,
-                        self.real_lens, self.valid, self.active, self.budget,
-                        self._split_rng(), self.chunk_steps,
-                        eos_id=self.eos_id, pad_id=self.pad_id, pm=self.pm,
-                        tables=jnp.asarray(self.tables) if self.paged else None,
-                        **self.sampling, **per_row,
-                    )
-            # Back to host numpy mirrors (replicated outputs — every
-            # process reads identical values).  np.array, not asarray:
-            # device views are read-only and admission writes into these.
-            self.last_tok = np.array(last_tok)
-            self.real_lens = np.array(real_lens)
-            self.valid = np.array(valid)
-            self.active = np.array(active)
-            self.budget = np.array(budget)
-            if counts_out is not None:
-                self.tok_counts = counts_out
-            self._collect(np.asarray(toks), was_active, counts=counts,
-                          lps=np.asarray(chunk_lps))
+            self._decode_span(was_active)
         return dict(self.results)
+
+    # -- dispatch-ahead decode (the overlap plane) -------------------------
+
+    def _span_plan(self) -> dict:
+        """The traced-argument plan for ONE decode span, decided once from
+        the (fresh) host mirrors at span start and reused for every chunk
+        the span dispatches ahead: the per-row sampling / penalty kwargs
+        select which COMPILED PROGRAM runs, and a dispatched-ahead chunk
+        must reuse the first chunk's program (graftcheck GC4 pins the
+        chained decode to one compile key).  Row sampling state only
+        changes at admission — a span never admits — so the snapshot stays
+        valid for the whole span; a row finishing mid-span merely keeps
+        the (correct, slightly wider) program engaged until the sync."""
+        plan: dict = {
+            "tables": jnp.asarray(self.tables) if self.paged else None,
+        }
+        self._tables_dirty = False  # plan holds the current snapshot
+        pen_live = self.active & (
+            (self.pres_row != 0.0) | (self.freq_row != 0.0)
+        )
+        # Penalized path only while a penalized row is live — the
+        # all-default batch keeps the smaller static program.
+        plan["counts"] = bool(pen_live.any())
+        if self.speculative:
+            per_spec = {}
+            if plan["counts"]:
+                per_spec["pres_row"] = jnp.asarray(self.pres_row)
+                per_spec["freq_row"] = jnp.asarray(self.freq_row)
+            plan["per_spec"] = per_spec
+        else:
+            # Per-row sampling path only while a custom-sampled row is
+            # live: the all-default batch keeps the static program
+            # (greedy compiles to a bare argmax — no per-step vocab
+            # sort paid for traffic that never asked for sampling).
+            rows_live = self.active & (
+                (self.temp_row != self.sampling["temperature"])
+                | (self.topp_row != self.sampling["top_p"])
+                | (self.topk_row != self.sampling["top_k"])
+            )
+            per_row = {}
+            if bool(rows_live.any()):
+                per_row["temp_row"] = jnp.asarray(self.temp_row)
+                if not bool((self.topp_row[self.active] == 1.0).all()):
+                    # All-1.0 top_p skips the per-step [B, V] sort+
+                    # softmax+cumsum mask entirely (sample_rows takes
+                    # the static keep-everything path).
+                    per_row["topp_row"] = jnp.asarray(self.topp_row)
+                if not bool((
+                    self.topk_row[self.active] == self.sampling["top_k"]
+                ).all()):
+                    # Engaged only while a row's top_k diverges from
+                    # the engine-wide static value — the traced mask
+                    # pays a per-step [B, V] sort the static path
+                    # doesn't.
+                    per_row["topk_row"] = jnp.asarray(self.topk_row)
+            if plan["counts"]:
+                per_row["pres_row"] = jnp.asarray(self.pres_row)
+                per_row["freq_row"] = jnp.asarray(self.freq_row)
+            plan["per_row"] = per_row
+        return plan
+
+    def _dispatch_chunk(self, plan: dict, carry: tuple) -> tuple:
+        """Dispatch one decode/speculative chunk (JAX async dispatch —
+        returns immediately with device futures).  ``carry`` is the
+        scheduling carry (last_tok, real_lens, valid, active, budget):
+        host mirrors for the first chunk of a span, the PREVIOUS chunk's
+        device-resident outputs for a dispatched-ahead chunk — both feed
+        the same compiled program.  Returns (toks, lps, m, carry') with
+        ``m`` the speculative per-row commit counts (None on the plain
+        path); ``self.cache``/``self.draft_cache``/``self.tok_counts``
+        advance to the new chunk's (not-yet-materialized) outputs."""
+        last_tok, real_lens, valid, active, budget = carry
+        self.overlap_stats["chunks"] += 1
+        m = None
+        if self.speculative:
+            per_spec = dict(plan["per_spec"])
+            if plan["counts"]:
+                per_spec["counts"] = self.tok_counts
+            if self.sampling["temperature"] > 0.0:
+                # Sampled rounds consume RNG; greedy rounds must not
+                # (greedy spec stays bit-stable across configs).
+                per_spec["rng"] = self._split_rng()
+            (toks, m, lps, self.cache, self.draft_cache, last_tok,
+             real_lens, valid, active, budget, counts_out) = spec_chunk(
+                self.params, self.cfg, self.draft_params, self.draft_cfg,
+                self.cache, self.draft_cache, last_tok, real_lens, valid,
+                active, budget, k=self.spec_k, eos_id=self.eos_id,
+                pad_id=self.pad_id, **self.sampling, **per_spec,
+            )
+        else:
+            per_row = dict(plan["per_row"])
+            if plan["counts"]:
+                per_row["counts"] = self.tok_counts
+            (toks, self.cache, last_tok, real_lens, valid, active,
+             budget, lps, counts_out) = \
+                decode_chunk(
+                    self.params, self.cfg_decode, self.cache, last_tok,
+                    real_lens, valid, active, budget,
+                    self._split_rng(), self.chunk_steps,
+                    eos_id=self.eos_id, pad_id=self.pad_id, pm=self.pm,
+                    tables=plan["tables"],
+                    **self.sampling, **per_row,
+                )
+        if counts_out is not None:
+            self.tok_counts = counts_out
+        return toks, lps, m, (last_tok, real_lens, valid, active, budget)
+
+    def _overlap_ok(self, was_active: np.ndarray, chunks: int) -> bool:
+        """Whether the NEXT chunk may dispatch ahead from the device
+        carry, i.e. nothing needs the host scheduling mirrors at this
+        boundary.  THE sync-triggers list (README "Engine overlap"):
+
+        - a queued request (admission, shed-deadline scans),
+        - a pending chunked prefill or verified KV import,
+        - a resident-row cancel taken while the carry was device-resident,
+        - paged mode: a row near its page horizon that :meth:`_grow_ahead`
+          could not grow from SPARE pool capacity (growth under pressure
+          preempts, and preemption must run against fresh mirrors),
+        - every row (as of the last-known activity vector) already idle —
+          the span never chains a chunk behind a possibly-all-idle one,
+        - budget-certain completion (below): the next chunk could only be
+          a ghost.
+        """
+        if not bool(was_active.any()):
+            return False
+        if self._cancel_dirty:
+            return False
+        if self.has_queued() or self.has_kv_imports() or self._prefills:
+            return False
+        # Budget-certain completion: when every live row will have
+        # exhausted its budget within the chunks ALREADY dispatched, the
+        # next chunk could only be a ghost (all rows inactive) — let the
+        # sync observe the finishes instead of burning a device round.
+        # Plain chunks commit exactly chunk_steps tokens per active row;
+        # a speculative round commits at least one.  EOS finishes are not
+        # host-predictable, so a rare ghost behind an EOS remains (it
+        # pads nothing into the stream — _collect sees no active row).
+        per_chunk = 1 if self.speculative else self.chunk_steps
+        certain = True
+        for i in range(self.b):
+            if self.rows[i].rid is None or not self.active[i] \
+                    or self.rows[i].prefilling:
+                continue
+            if int(self.budget[i]) > chunks * per_chunk:
+                certain = False
+                break
+        if certain:
+            return False
+        if self.paged and not self._grow_ahead(chunks + 1):
+            return False
+        return True
+
+    def _note_gap(self, gap_s: float) -> None:
+        """Record one per-chunk device gap: the host time between the
+        previous chunk completing and this chunk dispatching.  A
+        dispatched-ahead chunk records 0 by construction — its dispatch
+        strictly precedes the predecessor's completion, so the device
+        stream runs back-to-back."""
+        self.overlap_stats["device_gap_s"] += gap_s
+        self.overlap_stats["gap_samples"] += 1
+        METRICS.observe("batcher.overlap.device_gap_seconds", gap_s)
+
+    def _grow_ahead(self, horizon_chunks: int) -> bool:
+        """Page growth ON the overlapped window: growth needs the page
+        POOL, not the carry mirrors, so a span can keep dispatching ahead
+        across page boundaries — rows grow against a CONSERVATIVE frontier
+        bound off the stale mirrors (``horizon_chunks`` chunks may have
+        advanced every row since the last sync; budget only shrinks, so
+        ``min(..., budget)`` stays an upper bound).  A still-live row
+        over-allocates at most one page (written as it arrives); a row
+        that already died (EOS) but whose fetch hasn't landed yet can
+        transiently hold up to ``horizon_chunks * chunk_steps /
+        page_size`` pages it will never write — they release at that
+        fetch's publish sweep, a chunk later.  Best-effort
+        only: growth that would need PRESSURE (preemption reads/writes
+        the mirrors and must never run against stale ones) returns False
+        and the span syncs — the normal growth path then applies today's
+        exact evict -> preempt -> back-pressure ladder.  Fault-armed
+        engines also return False: the ``batcher.page_alloc`` drill
+        windows must keep counting exactly one hit per growth round."""
+        if self.faults is not None:
+            return False
+        blk = self.page_size
+        for i in range(self.b):
+            row = self.rows[i]
+            if row.rid is None or not self.active[i] or row.prefilling:
+                continue
+            horizon = int(self.real_lens[i]) + min(
+                horizon_chunks * self.chunk_steps, int(self.budget[i])
+            )
+            need = -(-horizon // blk) - len(row.pages)
+            if need <= 0:
+                continue
+            if self._pages_available() < need:
+                return False  # pressure: sync and let _grow_rows preempt
+            have = len(row.pages)
+            fresh = self._alloc_pages(need)
+            row.pages.extend(fresh)
+            self.tables[i][have: have + need] = fresh
+            self._tables_dirty = True
+            METRICS.inc("batcher.pages_grown", need)
+        return True
+
+    def _fetch_chunk(self, out: tuple) -> tuple:
+        """Host work's D2H for a dispatched-ahead chunk: tokens, logprobs,
+        speculative commit counts, and the post-chunk activity vector in
+        ONE ``jax.device_get`` (blocks until the chunk completes — the
+        NEXT chunk is already executing behind it).  The rest of the
+        carry stays device-resident."""
+        toks, lps, m, carry = out
+        extras = () if m is None else (m,)
+        got = jax.device_get((toks, lps) + extras + (carry[3],))
+        self._t_complete = time.perf_counter()
+        toks_h, lps_h, *rest = got
+        return toks_h, lps_h, (rest[0] if m is not None else None), rest[-1]
+
+    def _sync_carry(self, out: tuple) -> tuple:
+        """Refresh the host scheduling mirrors from the chunk's outputs —
+        one batched ``jax.device_get`` of tokens + logprobs + the whole
+        carry (replicated outputs: every process reads identical values;
+        copies are taken only where the backend hands back read-only
+        views, since admission writes into the mirrors).  Slots whose
+        host bookkeeping dropped the row while the carry was device-
+        resident (cancel mid-span) are forced inactive — the device's
+        activity bit for them is stale by construction."""
+        toks, lps, m, carry = out
+        extras = () if m is None else (m,)
+        got = jax.device_get((toks, lps) + extras + carry)
+        self._t_complete = time.perf_counter()
+        toks_h, lps_h, *rest = got
+        m_h = rest[0] if m is not None else None
+        lt, rl, va, ac, bu = rest[-5:]
+        self.last_tok = _writable(lt)
+        self.real_lens = _writable(rl)
+        self.valid = _writable(va)
+        self.active = _writable(ac)
+        self.budget = _writable(bu)
+        for i in range(self.b):
+            if self.rows[i].rid is None and self.active[i]:
+                self.active[i] = False
+                self.budget[i] = 0
+        self._cancel_dirty = False
+        return toks_h, lps_h, m_h
+
+    def _prehash_queued(self) -> None:
+        """Overlapped host window: memoize page digests for requests that
+        arrived while this span ran, so the NEXT admission round (a sync
+        point — the device waits on it) finds the hashing already paid.
+        Engine thread only; the snapshot tolerates concurrent submits and
+        a request cancelled mid-hash just wastes the digests."""
+        pc = self.prefix_cache
+        if pc is None:
+            return
+        for req in self.queue_snapshot():
+            if (req.digests is None and req.prefix_cache
+                    and req.prefix is None and req.swap_handle is None):
+                req.digests = self._page_digests(
+                    req.ids, len(req.ids) // self.page_size
+                )
+
+    def _decode_span(self, was_active: np.ndarray) -> None:
+        """One decode SPAN: a first chunk dispatched from the fresh host
+        mirrors, then — while :meth:`_overlap_ok` holds — chunk N+1
+        dispatched directly from chunk N's device-resident carry (JAX
+        async dispatch) with chunk N's host work (token D2H, delivery
+        callbacks, digest pre-hashing, metrics) running concurrently
+        with N+1 on device.  Every span ends by syncing the carry into
+        the host mirrors, so code outside the span always sees fresh
+        scheduling state.  Temp-0 outputs are byte-identical to the
+        fully-synchronous loop: the chained carry feeds the same
+        compiled program the mirrors would, and every scheduling
+        decision (admission, growth, preemption, shed, cancel) still
+        happens against synced mirrors."""
+        if self.faults is not None:
+            # Injection site "batcher.decode": one hit per decode /
+            # speculative chunk about to be dispatched (dispatched-ahead
+            # chunks included).  A "raise" rule here is the canonical
+            # engine crash (propagates out of run() into the serving
+            # supervisor — a dispatched-ahead chunk in flight is simply
+            # dropped with the batcher); "stall" models a wedged device
+            # call for the watchdog.
+            self.faults.fire("batcher.decode")
+        # Mirrors are fresh here by construction (every span ends in
+        # _sync_carry, and nothing is in flight between spans), so any
+        # cancel recorded before this point already landed on them — only
+        # a cancel taken DURING the span must force the next sync.
+        self._cancel_dirty = False
+        plan = self._span_plan()
+        t_disp = time.perf_counter()
+        if self._t_complete is not None:
+            # First chunk of a span follows an OBSERVED completion (the
+            # previous span's sync): the host time in between is genuine
+            # device idle — collect/admit/grow ran with nothing in flight.
+            self._note_gap(max(0.0, t_disp - self._t_complete))
+        out = self._dispatch_chunk(plan, (
+            self.last_tok, self.real_lens, self.valid, self.active,
+            self.budget,
+        ))
+        chunks = 1
+        while self.overlap and self._overlap_ok(was_active, chunks):
+            if self.faults is not None:
+                self.faults.fire("batcher.decode")
+            if self._tables_dirty:
+                # In-span growth extended a row's table: the next chunk
+                # must read/write through the grown pages.
+                plan["tables"] = jnp.asarray(self.tables)
+                self._tables_dirty = False
+            rng_before = self._rng  # ghost refund point (below)
+            nxt = self._dispatch_chunk(plan, out[3])
+            self._note_gap(0.0)
+            chunks += 1
+            self.overlap_stats["dispatched_ahead"] += 1
+            METRICS.inc("batcher.overlap.dispatched_ahead")
+            METRICS.set_gauge("batcher.overlap.depth", 1)
+            # Chunk N's host work, concurrent with chunk N+1 on device.
+            host_t0 = time.perf_counter()
+            toks, lps, m, active_after = self._fetch_chunk(out)
+            if not active_after.any():
+                # Every row died (EOS) during the chunk we just fetched:
+                # the chunk dispatched ahead of it is a GHOST — all rows
+                # inactive, nothing sampled, its rng value irrelevant.
+                # REFUND its split so the engine RNG stream stays aligned
+                # with the synchronous loop (which never dispatches the
+                # ghost): sampled outputs of later requests match overlap
+                # off, not just temp-0 ones.  Only the last chunk of a
+                # span can be a ghost — the next _overlap_ok sees the
+                # all-idle activity vector and syncs.
+                self._rng = rng_before
+            self._collect(toks, was_active, counts=m, lps=lps,
+                          active_host=active_after)
+            self._prehash_queued()
+            lag = time.perf_counter() - host_t0
+            self.overlap_stats["host_lag_s"] += lag
+            METRICS.observe("batcher.overlap.host_lag_seconds", lag)
+            was_active = active_after
+            out = nxt
+        # Sync exit: mirrors refresh BEFORE _collect, so a cancel taken
+        # inside the delivery callbacks lands on fresh state (the
+        # synchronous loop's exact ordering).
+        toks, lps, m = self._sync_carry(out)
+        METRICS.set_gauge("batcher.overlap.depth", 0)
+        if self.overlap:
+            self.overlap_stats["carry_syncs"] += 1
+            METRICS.inc("batcher.overlap.carry_syncs")
+        self._collect(toks, was_active, counts=m, lps=lps)

@@ -487,6 +487,11 @@ class InferenceEngine:
         host_pages: int | None = None,  # None -> rt.host_pages; > 0 arms
         #   the host-RAM tier behind the pool (swap-preemption + prefix-
         #   cache spill) — same paged-mode degradation policy
+        overlap: bool | None = None,  # None -> rt.overlap; dispatch-ahead
+        #   engine loop: chunk N+1 dispatches from the device-resident
+        #   carry while chunk N's host work overlaps on the CPU (temp-0
+        #   bytes identical either way; the batcher degrades it with a
+        #   warning on multi-process meshes)
     ):
         """A ContinuousBatcher over this engine's model: requests admit into
         an in-flight decode batch as rows free up (runtime/batcher.py) —
@@ -585,6 +590,8 @@ class InferenceEngine:
                 "contiguous KV (no paged pool to tier)"
             )
             host_pages = 0
+        if overlap is None:
+            overlap = self.rt.overlap
         if self.parallel is not None:
             # The shared cache shards its batch over 'data'; round the slot
             # count up so every mesh shape serves (extra slots are harmless
@@ -642,6 +649,7 @@ class InferenceEngine:
             prefill_concurrency=prefill_concurrency,
             faults=faults,
             kv_bits=kv_bits, host_pages=int(host_pages),
+            overlap=bool(overlap),
         )
 
     # -- speculative decoding (runtime/speculative.py): greedy-exact at

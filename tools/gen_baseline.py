@@ -77,10 +77,18 @@ def _result_cell(row: dict) -> str:
         ("swap_speedup", "swap speedup"),
         ("spill_hit_ttft_ms", "spill-hit TTFT ms"),
         ("cold_ttft_ms", "cold TTFT ms"),
+        ("tok_per_s_overlap_off", "tok/s overlap-off"),
+        ("tok_per_s_overlap_on", "tok/s overlap-on"),
+        ("device_gap_ms_off", "device-gap ms off"),
+        ("device_gap_ms_on", "device-gap ms on"),
+        ("gap_reduction", "gap reduction x"),
+        ("dispatched_ahead_frac", "dispatched-ahead frac"),
         ("admit_row_keys", "admit compile keys"),
         ("admit_row_declared", "of declared"),
         ("decode_chunk_keys", "decode compile keys"),
         ("decode_chunk_declared", "of declared"),
+        ("decode_chunk_overlap_keys", "overlap decode compile keys"),
+        ("decode_chunk_overlap_declared", "of declared"),
         ("generate_tokens_keys", "generate compile keys"),
         ("generate_tokens_declared", "of declared"),
         ("trace_wall_ms", "trace wall ms"),
@@ -119,7 +127,8 @@ def generate(ladder_path: str) -> str:
         # Aux rows run_ladder appends after the decode configs.
         "serving-latency", "continuous-batching", "local-proc-batching",
         "chunked-prefill", "prefix-cache-ttft", "fault-recovery",
-        "overload-goodput", "kv-tiering", "replica-failover",
+        "overload-goodput", "kv-tiering", "decode-overlap",
+        "replica-failover",
         "disagg-handoff", "compile-stability", "analysis-wall",
         "ragged-decode-8k", "ragged-decode-win-8k", "quant-matmul-bw",
         "spec-decode", "spec-decode-7b-int8", "spec-batching",

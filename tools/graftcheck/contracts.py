@@ -829,6 +829,46 @@ def recompile_scenarios() -> list[RecompileScenario]:
         trace=decode_int8_trace,
     ))
 
+    # -- dispatch-ahead (overlapped) decode: the engine loop chains the
+    # carry from one chunk's outputs straight into the next call, with
+    # the per-row sampling + penalty-histogram kwargs engaged for the
+    # whole span.  That steady-state program must be ONE compile key
+    # across every resident depth — a second key would mean the chained
+    # dispatch pays a trace on the engine thread mid-span, serializing
+    # exactly the window the overlap exists to hide.
+    def decode_overlap_trace(width: int) -> str:
+        from distributed_llms_tpu.runtime import batcher as batcher_lib
+
+        b = 4
+        params = abstract_params(cfg)
+        cache = abstract_cache(cfg, b, s_cap)
+        return jaxpr_hash(
+            lambda p, c, lt, rl, va, ac, bu, rng, tr, pr, kr, cnt, prr, frr:
+                batcher_lib.decode_chunk(
+                    p, cfg, c, lt, rl, va, ac, bu, rng, chunk_steps=8,
+                    temp_row=tr, topp_row=pr, topk_row=kr, counts=cnt,
+                    pres_row=prr, freq_row=frr),
+            params, cache, sds((b,), jnp.int32), sds((b,), jnp.int32),
+            sds((b, s_cap), jnp.bool_), sds((b,), jnp.bool_),
+            sds((b,), jnp.int32), key_sds(),
+            sds((b,), jnp.float32), sds((b,), jnp.float32),
+            sds((b,), jnp.int32), sds((b, cfg.vocab_size), jnp.int32),
+            sds((b,), jnp.float32), sds((b,), jnp.float32),
+            statics={"cfg": cfg, "chunk_steps": 8},
+        )
+
+    out.append(RecompileScenario(
+        name="batcher.decode_chunk_overlap", path=P_BATCHER,
+        doc="dispatch-ahead decode (carry chained from the previous "
+            "chunk, per-row sampling + penalties engaged) stays ONE "
+            "program across every resident depth",
+        ladder=_GC4_LADDER,
+        width_of=lambda n: s_cap,
+        allowed_widths=(s_cap,),
+        max_keys=1,
+        trace=decode_overlap_trace,
+    ))
+
     # -- whole-batch generate: the engine pads T up the ladder under the
     # sequence budget; every padded width is one compile key.
     n_new, limit = 8, s_cap
