@@ -1932,6 +1932,131 @@ def _measure_decode_overlap(dtype: str = "bfloat16") -> dict:
     }
 
 
+def _measure_mesh_paged_impl(dtype: str = "float32") -> dict:
+    """Mesh-native paged serving (PR 11): the paged pool sharded over the
+    mesh 'model' axis on KV heads.  Two claims stamped, both on the
+    forced-device CPU mesh (honest degraded provenance — real chips
+    re-stamp): (a) CAPACITY — at a fixed PER-CHIP pool byte budget, a tp2
+    engine holds ~2x the concurrently-resident rows of tp1, because each
+    chip stores only its head slice of every page; (b) EXACTNESS+SPEED —
+    the same storm serves byte-identical tokens at tp1 and tp2, with
+    steady decode tok/s recorded for both (on the fake CPU mesh tp2 pays
+    jit-dispatch overhead per virtual device; the throughput win needs
+    real chips, which is exactly what the degraded stamp says)."""
+    import numpy as np
+
+    from distributed_llms_tpu.core.config import MeshConfig
+    from distributed_llms_tpu.models import model as model_lib, presets
+    from distributed_llms_tpu.parallel.api import make_parallel_model
+    from distributed_llms_tpu.runtime.batcher import (ContinuousBatcher,
+                                                      pool_page_bytes)
+
+    devices = jax.devices()
+    assert len(devices) >= 2, "mesh-paged needs >= 2 devices"
+    platform = devices[0].platform
+    cfg = presets.get_preset("gpt2-tiny", vocab_size=512, dtype=dtype)
+    params = model_lib.init_params(jax.random.key(0), cfg)
+    blk, max_len = 16, 96
+    # Per-chip budget = 13 pages' bytes at tp1.  tp1 pool: 13 pages.
+    # tp2: each chip holds half of every page, so the SAME per-chip bytes
+    # fund 26 global pages.
+    budget_pages = 13
+    per_chip_bytes = budget_pages * pool_page_bytes(cfg, blk, 16, dtype)
+
+    def mk(tp: int) -> ContinuousBatcher:
+        pages = budget_pages * tp
+        # Slots must never be the binding constraint — the pool is the
+        # subject: 16 slots >> what either pool can hold resident.
+        kw = dict(batch_slots=16, max_len=max_len, chunk_steps=4,
+                  page_size=blk, paged_pages=pages, prefix_cache=True)
+        if tp == 1:
+            return ContinuousBatcher(cfg, params, **kw)
+        pm = make_parallel_model(cfg, MeshConfig(model=tp),
+                                 devices=devices[:tp])
+        return ContinuousBatcher(cfg, pm.shard_params(params), parallel=pm,
+                                 **kw)
+
+    # (a) capacity: a storm of 2-page rows; peak concurrently-ACTIVE rows
+    # is what the pool actually held at once (growth + back-pressure keep
+    # it honest — nothing overcommits).
+    storm = [([7 + i, 1, 9, 2 + i] * 4, 24) for i in range(16)]
+
+    def drive(b) -> tuple[dict, int, float, int]:
+        peak = [0]
+
+        def cb(rid, new, done, lps):
+            peak[0] = max(peak[0], int(np.sum(b.active)))
+
+        rids = [b.submit(ids, max_new_tokens=n) for ids, n in storm]
+        t0 = time.perf_counter()
+        res = b.run(on_tokens=cb)
+        wall = time.perf_counter() - t0
+        b.assert_pool_consistent()
+        toks = sum(len(res[r]) for r in rids)
+        return {r: res[r] for r in rids}, peak[0], wall, toks
+
+    b1 = mk(1)
+    drive(b1)  # compile-warm lap
+    res1, rows1, wall1, toks1 = drive(b1)
+    b2 = mk(2)
+    assert not b2.cache.k.sharding.is_fully_replicated
+    drive(b2)  # compile-warm lap
+    res2, rows2, wall2, toks2 = drive(b2)
+    exact = sum(a == b for a, b in zip(res1.values(), res2.values()))
+    out = {
+        "preset": "gpt2-tiny",
+        # Honest provenance: a real multi-chip platform stamps itself; the
+        # virtual CPU mesh carries the degraded marker.
+        "platform": (f"{platform} (fake mesh)" if platform == "cpu"
+                     else platform),
+    }
+    if platform == "cpu":
+        out["degraded"] = ("cpu fake-mesh (virtual devices, jit dispatch "
+                           "included) — capacity factor is real "
+                           "accounting; tok/s needs a TPU re-stamp")
+    out.update({
+        "page_size": blk,
+        "per_chip_pool_kb": round(per_chip_bytes / 1024, 1),
+        "rows_per_chip_tp1": rows1,
+        "rows_per_chip_tp2": rows2,
+        "capacity_factor_tp2": round(rows2 / max(rows1, 1), 2),
+        "tok_per_s_tp1": round(toks1 / wall1, 1),
+        "tok_per_s_tp2": round(toks2 / wall2, 1),
+        "exact": exact,
+        "completed": len(storm),
+    })
+    return out
+
+
+def _measure_mesh_paged(dtype: str = "float32") -> dict:
+    """Run the mesh-paged measurement over a 2-device mesh: inline when
+    this process already sees >= 2 devices of ANY platform (a real
+    multi-chip TPU host re-stamps the row natively — that is the
+    promised TPU re-stamp path), else in a fresh subprocess with a
+    forced 2-device virtual CPU platform (the hop-latency fallback
+    pattern — xla_force_host_platform_device_count is frozen once the
+    parent's backend initialized).  Self-stamps the platform the number
+    actually ran on, never the parent's."""
+    import datetime
+
+    date = datetime.datetime.now(datetime.timezone.utc).strftime("%Y-%m-%d")
+    if len(jax.devices()) >= 2:
+        row = _measure_mesh_paged_impl(dtype=dtype)
+        return {**row, "measured_on": f"{date} {row['platform']}"}
+    out, r = _fake_mesh_subprocess(
+        f"_measure_mesh_paged_impl(dtype={dtype!r})", "MESHPAGED",
+        n_devices=2, timeout=1200,
+    )
+    if out is not None:
+        return out
+    detail = "<subprocess timed out>" if r is None else (
+        r.stderr.strip().splitlines() or ["<no output>"])[-1]
+    rc = "?" if r is None else r.returncode
+    raise RuntimeError(
+        f"mesh-paged subprocess produced no row (rc {rc}): {detail[:200]}"
+    )
+
+
 def _measure_compile_stability() -> dict:
     """Compile-key stability of the serving entry points
     (tools/graftcheck GC4, run as a MEASUREMENT): sweep the request-length
@@ -2095,12 +2220,18 @@ def _measure_hop_latency(d_model: int = 4096, batch: int = 8, iters: int = 50) -
     }
 
 
-def _measure_hop_latency_cpu_fallback(n_devices: int = 4) -> dict | None:
-    """Run _measure_hop_latency over an n-device VIRTUAL CPU mesh in a
-    fresh subprocess (XLA parses xla_force_host_platform_device_count once
-    per process, so the already-initialized parent can't grow devices).
-    An upper bound on a real interconnect hop — jit dispatch included —
-    but a recorded number beats prose quoting an artifact-less one."""
+def _fake_mesh_subprocess(
+    call: str, marker: str, n_devices: int, timeout: int = 600,
+) -> "tuple[dict | None, subprocess.CompletedProcess | None]":
+    """Run ``bench.<call>`` over an n-device VIRTUAL CPU mesh in a fresh
+    subprocess (XLA parses xla_force_host_platform_device_count once per
+    process, so the already-initialized parent can't grow devices) and
+    parse the ``MARKER=<json>`` line it prints.  The one forced-CPU-mesh
+    harness both self-stamping fallback rows (hop-latency, mesh-paged)
+    share — marker parsing, flag handling, and provenance policy live
+    here ONCE.  Returns (parsed row or None, CompletedProcess or None);
+    a parsed row carries the self-stamped 'cpu (fake mesh)'
+    provenance."""
     code = (
         "import os, json\n"
         "os.environ['XLA_FLAGS'] = (os.environ.get('XLA_FLAGS', '') +"
@@ -2108,21 +2239,22 @@ def _measure_hop_latency_cpu_fallback(n_devices: int = 4) -> dict | None:
         "import jax\n"
         "jax.config.update('jax_platforms', 'cpu')\n"
         "import bench\n"
-        "print('HOP=' + json.dumps(bench._measure_hop_latency()))\n"
+        f"print({marker + '='!r} + json.dumps(bench.{call}))\n"
     )
     try:
         r = subprocess.run(
             [sys.executable, "-c", code], capture_output=True, text=True,
-            timeout=600, cwd=os.path.dirname(os.path.abspath(__file__)),
+            timeout=timeout, cwd=os.path.dirname(os.path.abspath(__file__)),
         )
     except subprocess.TimeoutExpired:
-        return None
+        return None, None
+    prefix = marker + "="
     for line in reversed(r.stdout.strip().splitlines()):
-        if line.startswith("HOP="):
+        if line.startswith(prefix):
             try:
-                out = json.loads(line[4:])
+                out = json.loads(line[len(prefix):])
             except json.JSONDecodeError:
-                return None
+                return None, r
             if out is not None:
                 import datetime
 
@@ -2133,8 +2265,18 @@ def _measure_hop_latency_cpu_fallback(n_devices: int = 4) -> dict | None:
                 # Self-stamp: the parent's _stamp() reports the PARENT's
                 # platform, which may be a real chip this number never ran on.
                 out["measured_on"] = f"{date} cpu (fake mesh)"
-            return out
-    return None
+            return out, r
+    return None, r
+
+
+def _measure_hop_latency_cpu_fallback(n_devices: int = 4) -> dict | None:
+    """_measure_hop_latency over the forced virtual CPU mesh: an upper
+    bound on a real interconnect hop — jit dispatch included — but a
+    recorded number beats prose quoting an artifact-less one."""
+    out, _ = _fake_mesh_subprocess(
+        "_measure_hop_latency()", "HOP", n_devices
+    )
+    return out
 
 
 def _stamp() -> str:
@@ -2300,7 +2442,7 @@ def run_ladder(args, degraded: str | None) -> list[dict]:
             "local-proc-batching", "chunked-prefill", "prefix-cache-ttft",
             "fault-recovery", "overload-goodput", "compile-stability",
             "replica-failover", "disagg-handoff", "analysis-wall",
-            "kv-tiering", "decode-overlap",
+            "kv-tiering", "decode-overlap", "mesh-paged",
         }
         unknown = only - known
         if unknown:  # a typo must not masquerade as a clean zero-row run
@@ -2444,6 +2586,13 @@ def run_ladder(args, degraded: str | None) -> list[dict]:
         # overlap off vs on — a host-scheduling effect, meaningful on any
         # platform (JAX CPU dispatch is async too).
         ("decode-overlap", lambda: _measure_decode_overlap(dtype=dtype)),
+        # Mesh-native paged serving: per-chip row capacity at a fixed
+        # per-chip pool byte budget, tp1 vs tp2 (the pool shards KV heads
+        # over 'model'), plus byte-exactness and steady tok/s for both
+        # legs.  Runs over a forced 2-device virtual CPU mesh in a
+        # subprocess and self-stamps that provenance — the throughput
+        # number needs real chips, the capacity factor does not.
+        ("mesh-paged", lambda: _measure_mesh_paged(dtype="float32")),
         # Replica-fleet serving: N replicas behind the health-aware
         # router, one killed abruptly mid-storm; stamps failover recovery
         # latency, goodput, and the byte-exactness count of every
@@ -2515,13 +2664,16 @@ def run_ladder(args, degraded: str | None) -> list[dict]:
         row = {"config": name}
         try:
             row.update(fn())
-            row["measured_on"] = _stamp()
+            # Self-stamping rows (mesh-paged runs over a forced-device
+            # virtual CPU mesh in a subprocess) carry their own honest
+            # provenance — never overwrite it with the parent platform's.
+            row.setdefault("measured_on", _stamp())
             # local-proc-batching pins its workers to CPU BY DESIGN (its
             # subject is the cluster path's own overhead) — a run-wide
             # "accelerator-unavailable" marker would mislabel its native
             # measurement as a fallback.
             if degraded is not None and name != "local-proc-batching":
-                row["degraded"] = degraded
+                row.setdefault("degraded", degraded)
         except _RowSkip as skip:
             row.update({"preset": srv["preset"], "skipped": str(skip)})
         except Exception as exc:

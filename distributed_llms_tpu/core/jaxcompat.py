@@ -30,8 +30,8 @@ from typing import Any
 import jax
 
 __all__ = [
-    "HAS_VMA", "axis_size", "pcast", "shape_dtype_struct", "shard_map",
-    "vma_of",
+    "HAS_VMA", "axis_size", "def_partition", "pcast", "shape_dtype_struct",
+    "shard_map", "vma_of",
 ]
 
 # vma (varying manual axes) tracking arrived with the jax 0.6-era shard_map;
@@ -61,6 +61,27 @@ def vma_of(x) -> frozenset:
     if hasattr(jax, "typeof"):
         return frozenset(getattr(jax.typeof(x), "vma", frozenset()))
     return frozenset()
+
+
+def def_partition(f, *, partition, infer_sharding_from_operands,
+                  sharding_rule: str | None = None) -> None:
+    """``custom_partitioning.def_partition`` with the Shardy factor rule
+    attached only on runtimes whose signature takes it (jax >= 0.5).
+    The 0.4.x GSPMD partitioner ignores Shardy rules entirely, so
+    dropping the kwarg there is semantically the same registration —
+    passing it raises TypeError instead (the bug that silently disarmed
+    the quant-matmul SPMD wrapper on this runtime)."""
+    import inspect
+
+    kwargs = {}
+    if sharding_rule is not None and "sharding_rule" in inspect.signature(
+            f.def_partition).parameters:
+        kwargs["sharding_rule"] = sharding_rule
+    f.def_partition(
+        partition=partition,
+        infer_sharding_from_operands=infer_sharding_from_operands,
+        **kwargs,
+    )
 
 
 def shape_dtype_struct(shape, dtype, vma: frozenset = frozenset()):

@@ -43,6 +43,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from ..core import jaxcompat
+
 _NEG_INF = float(jnp.finfo(jnp.float32).min)
 
 
@@ -210,6 +212,26 @@ def _mode() -> str:
     return "kernel" if jax.default_backend() == "tpu" else "fallback"
 
 
+def _use_spmd(mode: str) -> bool:
+    """Whether a call tracing under a GSPMD-partitioned jit should route
+    through the custom_partitioning wrappers below (the decode-attention
+    analogue of quant_matmul's DLT_QUANT_MATMUL_SPMD dispatch).  A plain
+    pallas_call has no SPMD partitioning rule — without the wrapper XLA
+    would all-gather the KV pool to one shard, defeating the sharded
+    page pool entirely.  The dense fallback path needs no wrapper (XLA
+    partitions plain lax ops itself), so "fallback" mode skips it.
+    DLT_DECODE_ATTN_SPMD: "0" kill-switch, "1" force, default "auto"
+    (wrapper whenever the kernel itself would run)."""
+    from .quant_matmul import in_spmd_trace
+
+    if not in_spmd_trace():
+        return False
+    env = os.environ.get("DLT_DECODE_ATTN_SPMD", "auto")
+    if env == "0":
+        return False
+    return env == "1" or mode != "fallback"
+
+
 def ragged_decode_attention(
     q: jax.Array,  # [B, 1, H, D] — one query token per row
     k: jax.Array,  # [B, S, KVH, D] full cache width
@@ -225,11 +247,35 @@ def ragged_decode_attention(
     #   scales into the attention contraction (q.k_i8 * scale)
     v_scale: jax.Array | None = None,
 ) -> jax.Array:
-    """Returns [B, 1, H, D] in q.dtype.  Inference-only (no VJP)."""
+    """Returns [B, 1, H, D] in q.dtype.  Inference-only (no VJP).
+
+    Under a GSPMD-partitioned trace (tensor-parallel serving) the call
+    routes through :func:`_ragged_spmd` — each shard runs the kernel on
+    its local KV-head slice; lengths shard with the batch axis (or
+    replicate on a pure-TP mesh)."""
     mode = _mode()
+    quant = _check_quant(k, k_scale, v_scale)
+    if _use_spmd(mode):
+        f = _ragged_spmd(block_k, window, quant, mode)
+        args = (q, k, v, lengths.astype(jnp.int32))
+        if quant:
+            args += (k_scale.astype(jnp.float32),
+                     v_scale.astype(jnp.float32))
+        return f(*args)
+    return _ragged_impl(q, k, v, lengths, k_scale, v_scale,
+                        block_k=block_k, window=window, mode=mode)
+
+
+def _ragged_impl(
+    q, k, v, lengths, k_scale=None, v_scale=None, *,
+    block_k: int = 256, window: int | None = None, mode: str = "fallback",
+) -> jax.Array:
+    """The single-shard body: kernel when the (local) shapes tile, dense
+    fallback otherwise — total over any shard, exactly like
+    quant_matmul._qmm_flat."""
     b, t, h, d = q.shape
     assert t == 1, "ragged decode attention is single-token by construction"
-    quant = _check_quant(k, k_scale, v_scale)
+    quant = k_scale is not None
     s, kvh = k.shape[1], k.shape[2]
     g = h // kvh
     # Largest K block that tiles the cache width exactly — a width that is a
@@ -341,11 +387,35 @@ def paged_decode_attention(
     management, TPU-native static shapes).  The page table is scalar-
     prefetched and consumed by the K/V BlockSpec index maps, so each row's
     DMA walks its own pages and reads only its real depth.  Returns
-    [B, 1, H, D] in q.dtype.  Inference-only."""
+    [B, 1, H, D] in q.dtype.  Inference-only.
+
+    Under a GSPMD-partitioned trace (tensor-parallel paged serving) the
+    call routes through :func:`_paged_spmd`: the pool (and its int8
+    scales) shard over the KV-head axis, each shard runs the kernel on
+    its local head slice, and the page table + lengths replicate on a
+    pure-TP mesh (they shard only with an explicit batch axis)."""
     mode = _mode()
+    quant = _check_quant(k_pages, k_scale, v_scale)
+    if _use_spmd(mode):
+        f = _paged_spmd(quant, mode)
+        args = (q, k_pages, v_pages, lengths.astype(jnp.int32),
+                tables.astype(jnp.int32))
+        if quant:
+            args += (k_scale.astype(jnp.float32),
+                     v_scale.astype(jnp.float32))
+        return f(*args)
+    return _paged_impl(q, k_pages, v_pages, lengths, tables,
+                       k_scale, v_scale, mode=mode)
+
+
+def _paged_impl(
+    q, k_pages, v_pages, lengths, tables, k_scale=None, v_scale=None, *,
+    mode: str = "fallback",
+) -> jax.Array:
+    """Single-shard body of the paged kernel (see _ragged_impl)."""
     b, t, h, d = q.shape
     assert t == 1, "paged decode attention is single-token by construction"
-    quant = _check_quant(k_pages, k_scale, v_scale)
+    quant = k_scale is not None
     nb, blk, kvh = k_pages.shape[0], k_pages.shape[1], k_pages.shape[2]
     p = tables.shape[1]
     g = h // kvh
@@ -420,3 +490,234 @@ def paged_decode_attention(
     )(*operands)
     out = out.reshape(b, kvh, gp, d)[:, :, :g]
     return out.reshape(b, 1, h, d)
+
+# ---------------------------------------------------------------------------
+# SPMD partitioning rules (tensor-parallel serving meshes)
+# ---------------------------------------------------------------------------
+#
+# pallas_call has no built-in SPMD partitioning rule: traced bare under a
+# GSPMD jit, XLA would all-gather the whole KV pool onto every shard —
+# defeating the sharded page pool (and the contiguous mesh cache) entirely.
+# The wrappers below supply the rule via jax.experimental.custom_partitioning,
+# following the in-repo exemplar ops/quant_matmul._qmm_spmd: attention
+# output heads are independent per KV head, so each shard runs the kernel
+# unchanged on its LOCAL head slice (q heads and KV heads shard together
+# over the same mesh axis; the grouped ratio g = H/KVH is shard-invariant)
+# and no collective is needed.  Lengths and page tables shard only with an
+# explicit batch axis — on a pure-TP mesh they replicate; int8 absmax
+# scales shard with their pages on the KV-head axis.
+
+
+def _spec_tuple(info, rank: int) -> tuple:
+    spec = getattr(getattr(info, "sharding", None), "spec", None)
+    t = tuple(spec) if spec is not None else ()
+    return t + (None,) * (rank - len(t))
+
+
+def _names(ax) -> tuple:
+    return () if ax is None else (ax if isinstance(ax, tuple) else (ax,))
+
+
+def _axis_sz(mesh, ax) -> int:
+    sz = 1
+    for nm in _names(ax):
+        sz *= mesh.shape.get(nm, 1)
+    return sz
+
+
+def _resolve_decode_axes(mesh, q_info, kv_info, *, kv_batched: bool):
+    """(batch_axis, head_axis) with divisibility enforced — shared by
+    infer and partition (and the graftcheck GC2 audit surface) so they
+    cannot disagree.  ``kv_info`` is the K operand: [B, S, KVH, D]
+    contiguous (kv_batched) or [NB, BLK, KVH, D] pool pages."""
+    qs = _spec_tuple(q_info, 4)
+    ks = _spec_tuple(kv_info, 4)
+    b_ax = qs[0]
+    if b_ax is None and kv_batched:
+        b_ax = ks[0]
+    h_ax = ks[2] if ks[2] is not None else qs[2]
+    b, _, h, _ = q_info.shape
+    kvh = kv_info.shape[2]
+    # Every shard must hold WHOLE heads on both operands (the kernel's
+    # static head loop) — replicate the head axis when it doesn't divide.
+    hs = _axis_sz(mesh, h_ax)
+    if hs > 1 and (h % hs or kvh % hs):
+        h_ax = None
+    bs = _axis_sz(mesh, b_ax)
+    if bs > 1 and b % bs:
+        b_ax = None
+    # A mesh axis may appear once per spec: on a collision keep the head
+    # sharding (the sharded pool is the point) and replicate batch.
+    if set(_names(b_ax)) & set(_names(h_ax)):
+        b_ax = None
+    return b_ax, h_ax
+
+
+def _ragged_operand_specs(b_ax, h_ax, quant: bool) -> dict:
+    from jax.sharding import PartitionSpec as P
+
+    specs = {
+        "q": P(b_ax, None, h_ax, None),
+        "k": P(b_ax, None, h_ax, None),
+        "v": P(b_ax, None, h_ax, None),
+        "lengths": P(b_ax),
+    }
+    if quant:
+        specs["k_scale"] = P(b_ax, None, h_ax)
+        specs["v_scale"] = P(b_ax, None, h_ax)
+    return specs
+
+
+def _paged_operand_specs(b_ax, h_ax, quant: bool) -> dict:
+    from jax.sharding import PartitionSpec as P
+
+    specs = {
+        "q": P(b_ax, None, h_ax, None),
+        "k_pages": P(None, None, h_ax, None),
+        "v_pages": P(None, None, h_ax, None),
+        "lengths": P(b_ax),
+        "tables": P(b_ax, None),
+    }
+    if quant:
+        specs["k_scale"] = P(None, None, h_ax)
+        specs["v_scale"] = P(None, None, h_ax)
+    return specs
+
+
+def spmd_operand_specs(
+    mesh, q_shape: tuple, kv_shape: tuple, *, paged: bool,
+    quant: bool = False, batch_axis="data", head_axis="model",
+):
+    """The operand PartitionSpecs the SPMD rule resolves for canonical
+    inputs (batch over ``batch_axis``, KV heads over ``head_axis``) on
+    ``mesh`` — the audit surface tools/graftcheck GC2 structure-matches
+    against abstract operand trees (axis names, rank, divisibility).
+    Returns (operand-spec dict, output spec).  Built on the SAME
+    ``_resolve_decode_axes`` the partition rule runs, so the audit can
+    never drift from the lowering."""
+    from jax.sharding import PartitionSpec as P
+
+    class _Info:
+        def __init__(self, shape, spec):
+            self.shape = shape
+            self.sharding = type("S", (), {"spec": spec})()
+
+    q_info = _Info(q_shape, P(batch_axis, None, head_axis, None))
+    kv_spec = (P(batch_axis, None, head_axis, None) if not paged
+               else P(None, None, head_axis, None))
+    kv_info = _Info(kv_shape, kv_spec)
+    b_ax, h_ax = _resolve_decode_axes(
+        mesh, q_info, kv_info, kv_batched=not paged
+    )
+    build = _paged_operand_specs if paged else _ragged_operand_specs
+    return build(b_ax, h_ax, quant), P(b_ax, None, h_ax, None)
+
+
+@functools.lru_cache(maxsize=None)
+def _ragged_spmd(block_k: int, window: int | None, quant: bool,
+                 mode: str):
+    """custom_partitioning wrapper for the ragged kernel: each shard runs
+    :func:`_ragged_impl` on its local (batch, head) slice — untileable
+    LOCAL shapes take the dense fallback inside the shard, so the wrapper
+    is total over any placement.  lru_cache keyed on the static config —
+    the RESOLVED mode included: a DLT_DECODE_ATTN_SPMD=1 force on a
+    backend whose mode is "fallback" must run the dense body per shard,
+    never the TPU kernel — so jit retracing reuses one wrapper instance
+    per configuration."""
+    from jax.experimental.custom_partitioning import custom_partitioning
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    def impl(q, k, v, lengths, k_scale=None, v_scale=None):
+        return _ragged_impl(q, k, v, lengths, k_scale, v_scale,
+                            block_k=block_k, window=window, mode=mode)
+
+    if quant:
+        @custom_partitioning
+        def f(q, k, v, lengths, k_scale, v_scale):
+            return impl(q, k, v, lengths, k_scale, v_scale)
+    else:
+        @custom_partitioning
+        def f(q, k, v, lengths):
+            return impl(q, k, v, lengths)
+
+    def _shardings(mesh, arg_infos):
+        b_ax, h_ax = _resolve_decode_axes(
+            mesh, arg_infos[0], arg_infos[1], kv_batched=True
+        )
+        specs = _ragged_operand_specs(b_ax, h_ax, quant)
+        return (
+            tuple(NamedSharding(mesh, s) for s in specs.values()),
+            NamedSharding(mesh, P(b_ax, None, h_ax, None)),
+        )
+
+    def infer(mesh, arg_infos, result_infos):
+        return _shardings(mesh, arg_infos)[1]
+
+    def partition(mesh, arg_infos, result_infos):
+        args, out = _shardings(mesh, arg_infos)
+        return mesh, impl, out, args
+
+    # Shardy factor rule: batch and heads propagate to the output; the
+    # cache width and KV-head axes are independent factors (H != KVH
+    # under GQA, so q's head axis cannot share the KV operands' factor).
+    rule = "b u h d, b s k d, b s k d, b -> b u h d"
+    if quant:
+        rule = "b u h d, b s k d, b s k d, b, b s k, b s k -> b u h d"
+    jaxcompat.def_partition(
+        f, infer_sharding_from_operands=infer, partition=partition,
+        sharding_rule=rule,
+    )
+    return f
+
+
+@functools.lru_cache(maxsize=None)
+def _paged_spmd(quant: bool, mode: str):
+    """custom_partitioning wrapper for the paged kernel: the page pool
+    (and its int8 scales) shard over the KV-head axis, each shard runs
+    :func:`_paged_impl` on its local head slice, and the page table +
+    lengths replicate on a pure-TP mesh (they shard only with an explicit
+    batch axis).  No collective: attention output heads are independent
+    per KV head.  Keyed on the RESOLVED mode (see _ragged_spmd)."""
+    from jax.experimental.custom_partitioning import custom_partitioning
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    def impl(q, k_pages, v_pages, lengths, tables, k_scale=None,
+             v_scale=None):
+        return _paged_impl(q, k_pages, v_pages, lengths, tables,
+                           k_scale, v_scale, mode=mode)
+
+    if quant:
+        @custom_partitioning
+        def f(q, k_pages, v_pages, lengths, tables, k_scale, v_scale):
+            return impl(q, k_pages, v_pages, lengths, tables, k_scale,
+                        v_scale)
+    else:
+        @custom_partitioning
+        def f(q, k_pages, v_pages, lengths, tables):
+            return impl(q, k_pages, v_pages, lengths, tables)
+
+    def _shardings(mesh, arg_infos):
+        b_ax, h_ax = _resolve_decode_axes(
+            mesh, arg_infos[0], arg_infos[1], kv_batched=False
+        )
+        specs = _paged_operand_specs(b_ax, h_ax, quant)
+        return (
+            tuple(NamedSharding(mesh, s) for s in specs.values()),
+            NamedSharding(mesh, P(b_ax, None, h_ax, None)),
+        )
+
+    def infer(mesh, arg_infos, result_infos):
+        return _shardings(mesh, arg_infos)[1]
+
+    def partition(mesh, arg_infos, result_infos):
+        args, out = _shardings(mesh, arg_infos)
+        return mesh, impl, out, args
+
+    rule = "b u h d, n p k d, n p k d, b, b t -> b u h d"
+    if quant:
+        rule = "b u h d, n p k d, n p k d, b, b t, n p k, n p k -> b u h d"
+    jaxcompat.def_partition(
+        f, infer_sharding_from_operands=infer, partition=partition,
+        sharding_rule=rule,
+    )
+    return f

@@ -52,6 +52,15 @@ def spmd_fallback():
     finally:
         _SPMD_FALLBACK.reset(token)
 
+
+def in_spmd_trace() -> bool:
+    """Whether the current trace runs under a GSPMD-partitioned jit
+    (ParallelModel.forward wraps its GSPMD path in :func:`spmd_fallback`).
+    Shared marker: ops/decode_attn.py consults it to route its kernels
+    through their own custom_partitioning wrappers on tensor-parallel
+    serving meshes."""
+    return _SPMD_FALLBACK.get()
+
 # Candidate tile sizes, largest first; a dimension uses the first candidate
 # that divides it (grids must tile exactly — no masking on the K/N axes).
 _BK_CANDIDATES = (512, 256, 128)
@@ -324,12 +333,16 @@ def _qmm_spmd(bits: int, interpret: bool):
         )
         return mesh, lower, NamedSharding(mesh, P(m_ax, n_ax)), args
 
-    f.def_partition(
+    jaxcompat.def_partition(
+        f,
         infer_sharding_from_operands=infer,
         partition=partition,
         # Shardy factor rule: m/n propagate to the output; the contracted and
         # block axes are independent factors (int4 packs K, so x's K and q's
-        # rows differ in size and cannot share a factor).
+        # rows differ in size and cannot share a factor).  (Attached only on
+        # runtimes whose def_partition takes it — jaxcompat.def_partition —
+        # the 0.4.x signature raised TypeError, which silently disarmed this
+        # wrapper on the current image.)
         sharding_rule="m k, p n, q b -> m n",
     )
     return f

@@ -297,12 +297,13 @@ class ParallelModel:
     def _forward_adapter(
         self, params, cfg, tokens, positions=None, cache=None,
         cache_index=None, attn_mask=None, key_positions=None,
+        kv_tables=None,
     ):
         del cfg  # self.cfg is authoritative
         return self.forward(
             params, tokens, positions=positions, cache=cache,
             cache_index=cache_index, attn_mask=attn_mask,
-            key_positions=key_positions,
+            key_positions=key_positions, kv_tables=kv_tables,
         )
 
     def _make_cache_adapter(self, cfg, batch, max_len, prompt_len=None):
@@ -434,12 +435,22 @@ class ParallelModel:
         return_aux: bool = False,
         key_positions: jax.Array | None = None,  # [B, S] slot->position map
         #   (sliding-window decode under the right-padded generate layout)
+        kv_tables: jax.Array | None = None,  # [B, P] page table — the cache
+        #   holds page POOLS sharded over 'model' on KV heads (mesh-native
+        #   paged serving; GSPMD path only — the paged decode kernel's
+        #   custom_partitioning rule partitions it)
     ) -> tuple[jax.Array, KVCache | None] | tuple[jax.Array, KVCache | None, jax.Array]:
         """Same contract as models.model.forward, but mesh-parallel.
         ``return_aux`` (MoE load-balance loss) flows through on the
         GSPMD paths; the pipeline/seq shard_map schedules return aux=0 —
         train MoE with data/model/expert axes."""
         cfg = self.cfg
+        if kv_tables is not None and (self.pipelined or self.seq_parallel):
+            raise NotImplementedError(
+                "paged decode (kv_tables) runs on pure data/tensor-parallel "
+                "meshes only — pipelined/seq-parallel schedules keep "
+                "contiguous caches"
+            )
         if self.seq_parallel and key_positions is not None:
             raise NotImplementedError(
                 "sequence-parallel paths do not thread key_positions "
@@ -492,6 +503,7 @@ class ParallelModel:
                     params, cfg, tokens, positions=positions, cache=cache,
                     cache_index=cache_index, remat=remat, attn_mask=attn_mask,
                     return_aux=return_aux, key_positions=key_positions,
+                    kv_tables=kv_tables,
                 )
 
         b, t = tokens.shape

@@ -22,6 +22,22 @@ tests/cluster/test_multihost.py).  Pipelined / sequence-parallel meshes
 keep their own decode schedules (wavefront, ring) — the batcher rejects
 them loudly.
 
+PAGED mode is mesh-native too (ROADMAP item 3): the page pool (and the
+int8 QuantKVCache pool) shards its KV-head axis over 'model'
+(parallel.specs.page_pool_specs — per-chip pool bytes divide by tp, so
+per-chip row capacity multiplies by the mesh), the ragged/paged decode
+kernels partition through their own custom_partitioning rules
+(ops/decode_attn — each shard runs its local head slice; page tables and
+lengths replicate on a pure-TP mesh), and every pool-carrying jit in this
+module (admission splices, growth/swap scatter-gathers, KV-import
+adoption, the decode carry) re-constrains its pool output so one
+placement — and one compile key per bucket — serves the whole engine.
+Host-facing semantics (digests, tiering, preemption, temp-0 bytes) are
+identical to the single-device paged engine, pinned by
+tests/runtime/test_mesh_paged.py.  KV heads must divide over 'model';
+batch_slots must divide over 'data'.  Speculative batching stays
+single-device contiguous.
+
 TPU-native formulation (everything static-shaped, two compiled functions):
 
 - ``admit_row``: prefill ONE request into batch slot ``i`` of the shared
@@ -552,7 +568,7 @@ def admit_row_with_prefix(
     return (cache, *_replicated(pm, tok, row_valid, lp))
 
 
-@partial(jax.jit, static_argnames=("cfg",),
+@partial(jax.jit, static_argnames=("cfg", "pm"),
          donate_argnames=("row_k", "row_v"))
 def prefill_chunk_step(
     params: Any,
@@ -562,6 +578,7 @@ def prefill_chunk_step(
     done: jax.Array,    # scalar int32 — prompt tokens already in the row
     chunk: jax.Array,   # [Tc] int32 — next chunk, right-padded (bucketed)
     clen: jax.Array,    # scalar int32 true chunk length
+    pm: Any = None,     # ParallelModel — GSPMD dp/tp mesh batching
 ) -> tuple[jax.Array, jax.Array, jax.Array]:
     """One chunk of a CHUNKED prefill: consume ``chunk`` into the transient
     single-row cache at offset ``done`` — the same continuation math as
@@ -572,19 +589,20 @@ def prefill_chunk_step(
     step exclusively-owned buffers, copying a registered prefix's KV once
     up front rather than aliasing it.
     Returns (row_k', row_v', last_logits [1, V] at the chunk's last real
-    position — the sampling source once the prompt completes)."""
+    position — the sampling source once the prompt completes; replicated
+    on a mesh batcher so the finishing sample runs lockstep)."""
     logits, row_cache = _prefill_row_with_prefix(
-        model_lib.forward, params, cfg, row_k, row_v, done, chunk
+        _fwd(pm), params, cfg, row_k, row_v, done, chunk
     )
     last = jnp.take_along_axis(
         logits, jnp.maximum(clen - 1, 0)[None, None, None], axis=1
     )[:, 0]  # [1, V]
-    return row_cache.k, row_cache.v, last
+    return row_cache.k, row_cache.v, _replicated(pm, last)
 
 
 @partial(
     jax.jit,
-    static_argnames=("cfg", "temperature", "top_k", "top_p"),
+    static_argnames=("cfg", "temperature", "top_k", "top_p", "pm"),
     donate_argnames=("cache",),
 )
 def finish_chunked_admission(
@@ -599,6 +617,7 @@ def finish_chunked_admission(
     temperature: float = 0.0,
     top_k: int = 0,
     top_p: float = 1.0,
+    pm: Any = None,          # ParallelModel — GSPMD dp/tp mesh batching
     temp_req: jax.Array | None = None,
     topp_req: jax.Array | None = None,
     topk_req: jax.Array | None = None,
@@ -607,16 +626,17 @@ def finish_chunked_admission(
     chunk's last-position logits and splice the fully-prefilled transient
     row into the shared cache — the same _finish_admission used by the
     monolithic paths, so results are bit-identical."""
-    return _finish_admission(
+    cache, tok, row_valid, lp = _finish_admission(
         cache, slot, KVCache(k=row_k, v=row_v), last_logits[:, None, :],
         jnp.int32(1), rng, temperature, top_k, top_p, total_len,
         temp_req=temp_req, topp_req=topp_req, topk_req=topk_req,
     )
+    return (cache, *_replicated(pm, tok, row_valid, lp))
 
 
 @partial(
     jax.jit,
-    static_argnames=("temperature", "top_k", "top_p"),
+    static_argnames=("temperature", "top_k", "top_p", "pm"),
     donate_argnames=("cache",),  # row_k/row_v feed a gather-reshape XLA
     #   cannot alias — donating them only triggers the unused-donation
     #   warning every admission.
@@ -631,6 +651,7 @@ def finish_chunked_admission_paged(
     temperature: float = 0.0,
     top_k: int = 0,
     top_p: float = 1.0,
+    pm: Any = None,          # ParallelModel — GSPMD dp/tp mesh batching
     temp_req: jax.Array | None = None,
     topp_req: jax.Array | None = None,
     topk_req: jax.Array | None = None,
@@ -644,13 +665,13 @@ def finish_chunked_admission_paged(
     return _paged_splice(
         cache, page_list, KVCache(k=row_k, v=row_v),
         last_logits[:, None, :], jnp.int32(1), rng, temperature, top_k,
-        top_p, temp_req, topp_req, topk_req,
+        top_p, temp_req, topp_req, topk_req, pm=pm,
     )
 
 
-@jax.jit
+@partial(jax.jit, static_argnames=("pm",))
 def _import_pages(cache: Any, page_list: jax.Array, k_pages: jax.Array,
-                  v_pages: jax.Array) -> Any:
+                  v_pages: jax.Array, pm: Any = None) -> Any:
     """Scatter HANDED-OFF KV pages into the pool (disaggregated serving:
     a prefill-role engine shipped a finished row's pages over
     cluster/kv_transfer.py and this decode-role engine adopts them).
@@ -667,17 +688,17 @@ def _import_pages(cache: Any, page_list: jax.Array, k_pages: jax.Array,
 
         kq, ks = kv_quantize(k_pages)
         vq, vs = kv_quantize(v_pages)
-        return QuantKVCache(
+        return _pool_constrain(pm, QuantKVCache(
             k=cache.k.at[:, page_list].set(kq),
             v=cache.v.at[:, page_list].set(vq),
             k_scale=cache.k_scale.at[:, page_list].set(ks),
             v_scale=cache.v_scale.at[:, page_list].set(vs),
             row_dtype=cache.row_dtype,
-        )
-    return KVCache(
+        ))
+    return _pool_constrain(pm, KVCache(
         k=cache.k.at[:, page_list].set(k_pages.astype(cache.k.dtype)),
         v=cache.v.at[:, page_list].set(v_pages.astype(cache.v.dtype)),
-    )
+    ))
 
 
 @jax.jit
@@ -694,23 +715,25 @@ def _export_pages_raw(cache: Any, page_list: jax.Array) -> tuple:
     return (cache.k[:, page_list], cache.v[:, page_list])
 
 
-@jax.jit
+@partial(jax.jit, static_argnames=("pm",))
 def _import_pages_raw(cache: Any, page_list: jax.Array, k_pages: jax.Array,
                       v_pages: jax.Array, k_scale: jax.Array | None = None,
-                      v_scale: jax.Array | None = None) -> Any:
+                      v_scale: jax.Array | None = None,
+                      pm: Any = None) -> Any:
     """Scatter a raw host-tier parcel (``_export_pages_raw`` layout) back
     into freshly allocated pool pages, verbatim — no quantize/dequantize
     hop, so restore is exact by construction."""
     if isinstance(cache, QuantKVCache):
-        return QuantKVCache(
+        return _pool_constrain(pm, QuantKVCache(
             k=cache.k.at[:, page_list].set(k_pages),
             v=cache.v.at[:, page_list].set(v_pages),
             k_scale=cache.k_scale.at[:, page_list].set(k_scale),
             v_scale=cache.v_scale.at[:, page_list].set(v_scale),
             row_dtype=cache.row_dtype,
-        )
-    return KVCache(k=cache.k.at[:, page_list].set(k_pages),
-                   v=cache.v.at[:, page_list].set(v_pages))
+        ))
+    return _pool_constrain(pm, KVCache(
+        k=cache.k.at[:, page_list].set(k_pages),
+        v=cache.v.at[:, page_list].set(v_pages)))
 
 
 @jax.jit
@@ -745,6 +768,32 @@ def _gather_row_pages(cache: Any, read_list: jax.Array) -> tuple[jax.Array, jax.
         return pool[:, read_list].reshape(l, 1, p * blk, kvh, hd)
 
     return gather(cache.k), gather(cache.v)
+
+
+def _pool_constrain(pm, cache):
+    """Pin a page pool's leaves to their mesh sharding — KV heads over
+    'model' (parallel.specs.page_pool_specs), the layout every paged jit
+    in this module produces and consumes on a mesh batcher.  Applied to
+    every program output that carries the pool (splice, decode chunk,
+    import scatters) so XLA can never hand back a differently-placed pool
+    and force a resharding copy (or a fresh compile key) on the next
+    call.  No-op single-device."""
+    if pm is None:
+        return cache
+    from jax.sharding import NamedSharding
+
+    from ..parallel.specs import page_pool_specs
+    quant = isinstance(cache, QuantKVCache)
+    specs = page_pool_specs(
+        pm.cfg, pm.mesh, kv_bits=8 if quant else 16,
+        row_dtype=cache.row_dtype if quant else None,
+    )
+    return jax.tree.map(
+        lambda x, s: jax.lax.with_sharding_constraint(
+            x, NamedSharding(pm.mesh, s)
+        ),
+        cache, specs,
+    )
 
 
 def _paged_pool(cfg: ModelConfig, num_pages: int, page_size: int, dtype=None,
@@ -792,7 +841,7 @@ def pool_page_bytes(cfg: ModelConfig, page_size: int, kv_bits: int = 16,
 
 def _paged_splice(cache, page_list, row_cache, logits, last_idx, rng,
                   temperature, top_k, top_p, temp_req=None, topp_req=None,
-                  topk_req=None):
+                  topk_req=None, pm=None):
     """Admission tail for the paged pool: sample the first token, then
     scatter the contiguous transient row cache into the row's pages.
     ``page_list`` [P] is padded with the reserved scratch page 0 past the
@@ -801,7 +850,9 @@ def _paged_splice(cache, page_list, row_cache, logits, last_idx, rng,
     (freed rows' clamped decode reads do touch it, but their outputs are
     masked to pad).  Prefix-cache-hit admissions also route their CACHED
     positions to the scratch page: the shared pages already hold exactly
-    that KV and must never be rewritten while other rows read them."""
+    that KV and must never be rewritten while other rows read them.
+    On a mesh batcher (``pm``) the pool result is re-constrained to its
+    sharding and the sampled token/logprob replicate (lockstep mirrors)."""
     tok, lp = _sample_first(logits, last_idx, rng, temperature, top_k, top_p,
                             temp_req, topp_req, topk_req)
     p = page_list.shape[0]
@@ -822,8 +873,9 @@ def _paged_splice(cache, page_list, row_cache, logits, last_idx, rng,
 
         k, sk = qsplice(cache.k, cache.k_scale, row_cache.k)
         v, sv = qsplice(cache.v, cache.v_scale, row_cache.v)
-        return QuantKVCache(k=k, v=v, k_scale=sk, v_scale=sv,
-                            row_dtype=cache.row_dtype), tok, lp
+        cache = QuantKVCache(k=k, v=v, k_scale=sk, v_scale=sv,
+                             row_dtype=cache.row_dtype)
+        return (_pool_constrain(pm, cache), *_replicated(pm, tok, lp))
 
     def splice(pool, row):  # row: [L, 1, P*BLK, KVH, HD]
         l, _, _, kvh, hd = row.shape
@@ -833,12 +885,12 @@ def _paged_splice(cache, page_list, row_cache, logits, last_idx, rng,
     cache = KVCache(
         k=splice(cache.k, row_cache.k), v=splice(cache.v, row_cache.v)
     )
-    return cache, tok, lp
+    return (_pool_constrain(pm, cache), *_replicated(pm, tok, lp))
 
 
 @partial(
     jax.jit,
-    static_argnames=("cfg", "temperature", "top_k", "top_p"),
+    static_argnames=("cfg", "temperature", "top_k", "top_p", "pm"),
     donate_argnames=("cache",),
 )
 def admit_row_paged(
@@ -852,6 +904,7 @@ def admit_row_paged(
     temperature: float = 0.0,
     top_k: int = 0,
     top_p: float = 1.0,
+    pm: Any = None,  # ParallelModel — GSPMD dp/tp mesh batching
     temp_req: jax.Array | None = None,  # traced per-request overrides
     topp_req: jax.Array | None = None,
     topk_req: jax.Array | None = None,
@@ -860,18 +913,18 @@ def admit_row_paged(
     cache, then scatter its pages into the pool.
     Returns (cache', tok, logprob)."""
     logits, row_cache = _prefill_row(
-        _fwd(None), params, cfg, _row_dtype_of(cache),
+        _fwd(pm), params, cfg, _row_dtype_of(cache),
         page_list.shape[0] * cache.k.shape[2], prompt,
     )
     return _paged_splice(
         cache, page_list, row_cache, logits, plen, rng, temperature, top_k,
-        top_p, temp_req, topp_req, topk_req,
+        top_p, temp_req, topp_req, topk_req, pm=pm,
     )
 
 
 @partial(
     jax.jit,
-    static_argnames=("cfg", "temperature", "top_k", "top_p"),
+    static_argnames=("cfg", "temperature", "top_k", "top_p", "pm"),
     donate_argnames=("cache",),
 )
 def admit_row_with_prefix_paged(
@@ -888,6 +941,7 @@ def admit_row_with_prefix_paged(
     temperature: float = 0.0,
     top_k: int = 0,
     top_p: float = 1.0,
+    pm: Any = None,  # ParallelModel — GSPMD dp/tp mesh batching
     temp_req: jax.Array | None = None,  # traced per-request overrides
     topp_req: jax.Array | None = None,
     topk_req: jax.Array | None = None,
@@ -896,17 +950,17 @@ def admit_row_with_prefix_paged(
     cache, only the suffix prefills, then the pages scatter into the pool.
     Returns (cache', tok, logprob)."""
     logits, row_cache = _prefill_row_with_prefix(
-        _fwd(None), params, cfg, prefix_k, prefix_v, prefix_len, chunk
+        _fwd(pm), params, cfg, prefix_k, prefix_v, prefix_len, chunk
     )
     return _paged_splice(
         cache, page_list, row_cache, logits, clen, rng, temperature, top_k,
-        top_p, temp_req, topp_req, topk_req,
+        top_p, temp_req, topp_req, topk_req, pm=pm,
     )
 
 
 @partial(
     jax.jit,
-    static_argnames=("cfg", "temperature", "top_k", "top_p"),
+    static_argnames=("cfg", "temperature", "top_k", "top_p", "pm"),
     donate_argnames=("cache",),
 )
 def admit_row_auto_paged(
@@ -924,6 +978,7 @@ def admit_row_auto_paged(
     temperature: float = 0.0,
     top_k: int = 0,
     top_p: float = 1.0,
+    pm: Any = None,  # ParallelModel — GSPMD dp/tp mesh batching
     temp_req: jax.Array | None = None,  # traced per-request overrides
     topp_req: jax.Array | None = None,
     topk_req: jax.Array | None = None,
@@ -940,12 +995,12 @@ def admit_row_auto_paged(
     Returns (cache', tok, logprob)."""
     row_k, row_v = _gather_row_pages(cache, read_list)
     logits, row_cache = _prefill_row_with_prefix(
-        _fwd(None), params, cfg, row_k, row_v,
+        _fwd(pm), params, cfg, row_k, row_v,
         prefix_len, chunk,
     )
     return _paged_splice(
         cache, write_list, row_cache, logits, clen, rng, temperature, top_k,
-        top_p, temp_req, topp_req, topk_req,
+        top_p, temp_req, topp_req, topk_req, pm=pm,
     )
 
 
@@ -1090,6 +1145,11 @@ def decode_chunk(
         # The histogram is scheduling state too: replicated, so every host
         # of a multi-process mesh applies identical penalty adjustments.
         counts = _replicated(pm, counts)
+    if tables is not None:
+        # Mesh paged decode: pin the pool carry back to its sharding (KV
+        # heads over 'model') so chained dispatch-ahead chunks and the
+        # scatter/gather jits all consume one placement (no-op off-mesh).
+        cache = _pool_constrain(pm, cache)
     return (toks, cache, last_tok, real_lens, valid, active, budget, lps,
             counts)
 
@@ -1893,9 +1953,11 @@ class ContinuousBatcher:
         # scheduling mirrors refresh lazily at the next sync trigger, so
         # admission/growth/preemption semantics are byte-for-byte
         # unchanged and temp-0 outputs are byte-identical to overlap=False
-        # (tests/runtime/test_overlap.py).  Degrades (with a warning) on
-        # multi-process meshes, whose lockstep contract keeps every
-        # process on the fully-synchronous path.
+        # (tests/runtime/test_overlap.py).  Mesh-legal, multi-process
+        # included: the device-resident carry is replicated scheduling
+        # state (every chunk fn constrains it P()), so a deferred sync
+        # reads identical mirrors on every process and the lockstep
+        # contract holds with the overlap on.
         overlap: bool = True,
     ) -> None:
         # Snapshot the constructor arguments FIRST (before any local
@@ -1923,16 +1985,32 @@ class ContinuousBatcher:
                 "the host-RAM KV tier backs the paged pool; pass paged_pages"
             )
         if paged_pages is not None:
-            if parallel is not None:
-                raise ValueError(
-                    "paged KV is single-device for now (no SPMD rule for "
-                    "the paged kernel)"
-                )
+            if parallel is not None and not (
+                parallel.pipelined or parallel.seq_parallel
+            ):
+                # Mesh-native paged serving: the pool shards its KV-head
+                # axis over 'model' (parallel.specs.page_pool_specs) and
+                # the paged decode kernel partitions through its SPMD rule
+                # (ops/decode_attn._paged_spmd) — each shard holds whole
+                # heads, so the head count must divide.  Pipelined /
+                # seq-parallel meshes fall through to the generic
+                # rejection below (paged x pipelined stays unsupported
+                # with the same message every batching mode gets).
+                tp = parallel.mesh.shape.get("model", 1)
+                if tp > 1 and cfg.num_kv_heads % tp:
+                    raise ValueError(
+                        f"paged KV on a tensor-parallel mesh shards the "
+                        f"pool on the KV-head axis: num_kv_heads "
+                        f"{cfg.num_kv_heads} must divide over 'model' "
+                        f"({tp})"
+                    )
             if cfg.sliding_window is not None:
                 raise ValueError(
                     "paged KV cannot serve sliding-window models (the paged "
                     "decode kernel attends the full cache prefix); use "
-                    "contiguous mode"
+                    "contiguous mode, which serves windowed models single-"
+                    "device or on dp/tp meshes via the ragged kernel's "
+                    "window band"
                 )
             if max_len % page_size:
                 raise ValueError(
@@ -1962,9 +2040,14 @@ class ContinuousBatcher:
             if draft_cfg is None:
                 raise ValueError("draft_params needs draft_cfg")
             if parallel is not None or paged_pages is not None:
+                # Paged KV and dp/tp meshes both serve through the PLAIN
+                # batcher (paged is mesh-legal since the pool/kernel grew
+                # SPMD rules); only the speculative draft/verify chain
+                # itself remains single-device contiguous.
                 raise ValueError(
-                    "speculative batching is single-device contiguous mode "
-                    "(no mesh, no paged KV)"
+                    "speculative batching runs single-device contiguous "
+                    "mode; serve paged or mesh engines through the plain "
+                    "batcher (both compose — speculation does not, yet)"
                 )
             # Engine-wide temperature/top_k/top_p compose with speculation
             # (distribution-preserving rejection sampling in spec_chunk);
@@ -1982,13 +2065,17 @@ class ContinuousBatcher:
                 raise ValueError(
                     f"prefill_chunk must be >= 1, got {prefill_chunk}"
                 )
-            if self.speculative or parallel is not None:
-                # Paged mode composes since PR 3: the prefill runs against
-                # the pageless transient row and pool pages are allocated
-                # only at the finishing splice (on-demand, preemption-aware).
+            if self.speculative:
+                # Paged mode composes since PR 3 (the prefill runs against
+                # the pageless transient row; pages are allocated only at
+                # the finishing splice) and dp/tp meshes compose since the
+                # chunk step threads the mesh forward (pm) with its
+                # last-logits replicated.  Only the speculative draft's
+                # monolithic full-prompt admission remains incompatible.
                 raise ValueError(
-                    "chunked prefill is single-device mode for now (no "
-                    "mesh, no speculative draft)"
+                    "chunked prefill does not compose with speculative "
+                    "batching (the draft admission prefills the full "
+                    "prompt monolithically)"
                 )
         if prefill_concurrency < 1:
             # Validated regardless of prefill_chunk: a bad value must not
@@ -2003,16 +2090,15 @@ class ContinuousBatcher:
                 "pass paged_pages (or use register_prefix for the "
                 "contiguous named-prefix path)"
             )
-        if overlap and jax.process_count() > 1:
-            # The dispatch-ahead loop's lazy host-mirror refresh is safe on
-            # a multi-process mesh only if every process takes identical
-            # sync decisions from identical state; keep the lockstep
-            # contract trivially true on the fully-synchronous path.
-            log.warning(
-                "overlap disabled on a multi-process mesh (%d processes): "
-                "the engine loop stays fully synchronous", jax.process_count()
-            )
-            overlap = False
+        # The dispatch-ahead loop is mesh-legal, multi-process included
+        # (PR 10 degraded it there with a warning): the device carry is
+        # small scheduling state every chunk fn returns CONSTRAINED
+        # REPLICATED (_replicated, like _fwd's mirrors), so a deferred
+        # _sync_carry reads identical values on every process, and the
+        # sync triggers themselves (_overlap_ok) consult only
+        # deterministic host state the lockstep contract already keeps
+        # identical (queue contents, prefills, imports, pool accounting —
+        # never wall clocks).  No degrade needed.
         self.prefill_chunk = prefill_chunk
         self.prefill_concurrency = prefill_concurrency
         self._prefills: dict[int, _PendingPrefill] = {}  # slot -> pending
@@ -2024,9 +2110,12 @@ class ContinuousBatcher:
         # Decode-chunk variant of the config: ragged decode attention (row b
         # reads only its cache prefix — ops/decode_attn.py) when the kernel
         # would actually run (TPU, or DLT_RAGGED_DECODE=kernel/interpret).
-        # Not on meshes (pallas has no SPMD rule there), and not on the CPU
-        # "fallback" mode whose dense math is a different op from the masked
-        # dot path (the exact-token invariant is against the latter).
+        # Meshes included: the ragged/paged kernels carry their own SPMD
+        # partitioning rules now (ops/decode_attn._ragged_spmd/_paged_spmd
+        # — each shard runs its local head slice; DLT_DECODE_ATTN_SPMD=0
+        # is the kill-switch).  Not on the CPU "fallback" mode, whose
+        # dense math is a different op from the masked dot path (the
+        # exact-token invariant is against the latter).
         import dataclasses
 
         from ..ops import decode_attn
@@ -2037,7 +2126,7 @@ class ContinuousBatcher:
         # band equals the position-space window exactly.)
         self.cfg_decode = (
             dataclasses.replace(cfg, ragged_decode=True)
-            if parallel is None and decode_attn._mode() != "fallback"
+            if decode_attn._mode() != "fallback"
             else cfg
         )
         self.params = params
@@ -2075,12 +2164,33 @@ class ContinuousBatcher:
                         f"kv_dtype {want!r} conflicts with the mesh's "
                         f"kv_dtype {parallel.kv_dtype!r}"
                     )
-            # Under jit so the zeros+constraint build the GLOBAL sharded
-            # cache directly — on a mesh spanning processes an eager
-            # host-local zeros could not be constrained onto it.
-            self.cache = jax.jit(
-                lambda: parallel.init_cache(batch_slots, max_len)
-            )()
+            if paged_pages is not None:
+                # Mesh-sharded PAGE POOL: every leaf [L, NB, BLK, KVH, HD]
+                # (and the int8 scale stacks) shards its KV-head axis over
+                # 'model' — per-chip pool bytes divide by tp, so per-chip
+                # row capacity multiplies by the mesh.  Built under jit so
+                # zeros+constraint materialize the GLOBAL sharded pool
+                # directly (same reasoning as the contiguous mesh cache
+                # below).  Pages are shared across rows (prefix cache,
+                # handoff imports), so no axis shards over 'data'.
+                pm_built = parallel
+
+                def build_pool():
+                    return _pool_constrain(pm_built, _paged_pool(
+                        cfg, paged_pages, page_size,
+                        dtype=(jnp.dtype(parallel.kv_dtype)
+                               if parallel.kv_dtype else None),
+                        kv_bits=kv_bits,
+                    ))
+
+                self.cache = jax.jit(build_pool)()
+            else:
+                # Under jit so the zeros+constraint build the GLOBAL
+                # sharded cache directly — on a mesh spanning processes an
+                # eager host-local zeros could not be constrained onto it.
+                self.cache = jax.jit(
+                    lambda: parallel.init_cache(batch_slots, max_len)
+                )()
         elif paged_pages is not None:
             self.cache = _paged_pool(
                 cfg, paged_pages, page_size,
@@ -2435,6 +2545,7 @@ class ContinuousBatcher:
             self.cache, jnp.asarray(np.asarray(pages, np.int32)),
             jnp.asarray(np.ascontiguousarray(k_pages[:, missing])),
             jnp.asarray(np.ascontiguousarray(v_pages[:, missing])),
+            pm=self.pm,
         )
         for p, i in zip(pages, missing):
             # First writer wins: a digest published since the scan above
@@ -2930,7 +3041,7 @@ class ContinuousBatcher:
         # padded list (pad slots rewrite the scratch page — never read).
         self.cache = _import_pages_raw(
             self.cache, jnp.asarray(self._padded_page_list(pages)),
-            *(jnp.asarray(a) for a in payload),
+            *(jnp.asarray(a) for a in payload), pm=self.pm,
         )
         req_t = (self.sampling["temperature"] if req.temperature is None
                  else float(req.temperature))
@@ -3102,7 +3213,7 @@ class ContinuousBatcher:
 
         self.cache = _import_pages_raw(
             self.cache, jnp.asarray(padded),
-            *(stack(j) for j in range(len(payloads[0]))),
+            *(stack(j) for j in range(len(payloads[0]))), pm=self.pm,
         )
         for pg, d in zip(pages, run):
             self.pool.publish_prefix(pg, d)
@@ -3288,7 +3399,7 @@ class ContinuousBatcher:
                     self.params, self.cfg, self.cache, jnp.asarray(page_list),
                     pfx.k, pfx.v, jnp.int32(pfx_len),
                     jnp.asarray(prompt), jnp.int32(len(req.ids)),
-                    self._split_rng(), **self.sampling, **extra,
+                    self._split_rng(), pm=self.pm, **self.sampling, **extra,
                 )
                 row_valid = np.arange(self.s) < total_len
             elif self.paged and cached_len:
@@ -3307,14 +3418,14 @@ class ContinuousBatcher:
                     jnp.asarray(page_list), jnp.asarray(write_list),
                     jnp.int32(cached_len), jnp.asarray(chunk),
                     jnp.int32(len(suffix)), self._split_rng(),
-                    **self.sampling, **extra,
+                    pm=self.pm, **self.sampling, **extra,
                 )
                 row_valid = np.arange(self.s) < total_len
             elif self.paged:
                 self.cache, tok, lp = admit_row_paged(
                     self.params, self.cfg, self.cache, jnp.asarray(page_list),
                     jnp.asarray(prompt), jnp.int32(len(req.ids)),
-                    self._split_rng(), **self.sampling, **extra,
+                    self._split_rng(), pm=self.pm, **self.sampling, **extra,
                 )
                 row_valid = np.arange(self.s) < total_len
             elif pfx is not None:
@@ -3508,7 +3619,7 @@ class ContinuousBatcher:
             chunk[:clen] = pp.ids[off: off + clen]
             pp.row_k, pp.row_v, pp.last_logits = prefill_chunk_step(
                 self.params, self.cfg, pp.row_k, pp.row_v, jnp.int32(pp.done),
-                jnp.asarray(chunk), jnp.int32(clen),
+                jnp.asarray(chunk), jnp.int32(clen), pm=self.pm,
             )
             pp.done += clen
             METRICS.inc("batcher.prefill_chunks")
@@ -3553,7 +3664,8 @@ class ContinuousBatcher:
             write_list[:n_cached] = 0
             self.cache, tok, lp = finish_chunked_admission_paged(
                 self.cache, jnp.asarray(write_list), pp.row_k, pp.row_v,
-                pp.last_logits, self._split_rng(), **self.sampling, **extra,
+                pp.last_logits, self._split_rng(), pm=self.pm,
+                **self.sampling, **extra,
             )
             # Publish the freshly-written full prompt pages (first writer
             # wins) — the cached run is already published.
@@ -3566,7 +3678,7 @@ class ContinuousBatcher:
             self.cache, tok, row_valid, lp = finish_chunked_admission(
                 self.cfg, self.cache, jnp.int32(i), pp.row_k, pp.row_v,
                 pp.last_logits, jnp.int32(pp.total_len), self._split_rng(),
-                **self.sampling, **extra,
+                pm=self.pm, **self.sampling, **extra,
             )
         del self._prefills[i]
         self._activate_row(i, req, tok, lp, row_valid, pp.total_len,

@@ -471,9 +471,9 @@ class InferenceEngine:
         #   attached draft + greedy + single-device contiguous mode
         prefill_chunk: int | None = None,  # chunked prefill: admit at most
         #   this many prompt tokens per scheduling round PER PENDING
-        #   prefill (single-device plain mode, contiguous or paged — paged
-        #   finishes allocate pool pages on demand at the splice; see
-        #   ContinuousBatcher)
+        #   prefill (contiguous or paged, single-device or dp/tp mesh —
+        #   paged finishes allocate pool pages on demand at the splice;
+        #   see ContinuousBatcher.  Not with a speculative draft)
         prefill_concurrency: int = 2,  # chunked prefills in flight at once
         #   (1 restores the old one-at-a-time head-of-line behavior)
         faults: Any = None,  # FaultPlane | None; None -> parse rt.faults —
@@ -490,15 +490,17 @@ class InferenceEngine:
         overlap: bool | None = None,  # None -> rt.overlap; dispatch-ahead
         #   engine loop: chunk N+1 dispatches from the device-resident
         #   carry while chunk N's host work overlaps on the CPU (temp-0
-        #   bytes identical either way; the batcher degrades it with a
-        #   warning on multi-process meshes)
+        #   bytes identical either way; mesh-legal — the carry is
+        #   replicated scheduling state)
     ):
         """A ContinuousBatcher over this engine's model: requests admit into
         an in-flight decode batch as rows free up (runtime/batcher.py) —
         no head-of-line blocking on mixed-length traffic.  Single-device
-        engines and GSPMD data/tensor-parallel meshes; pipelined and
-        sequence-parallel meshes keep their own decode schedules (the
-        batcher constructor rejects them).  Paged mode is overload-safe:
+        engines and GSPMD data/tensor-parallel meshes — paged mode
+        included (the pool shards KV heads over 'model'; per-chip
+        capacity multiplies by the mesh); pipelined and sequence-parallel
+        meshes keep their own decode schedules (the batcher constructor
+        rejects them).  Paged mode is overload-safe:
         rows admit with prompt + one decode page, grow on demand at chunk
         boundaries, and a dry pool preempts the lowest-priority /
         most-recently-admitted row for recompute (temp-0 exact) instead of
@@ -528,20 +530,29 @@ class InferenceEngine:
         if prefix_cache is None:
             prefix_cache = self.rt.prefix_cache
         if paged_pages is not None and self.parallel is not None:
-            if explicit:
-                raise ValueError(
-                    "paged KV serving is single-device for now; pass "
-                    "paged_pages=0 (or unset runtime.paged_pages) on mesh "
-                    "engines"
+            # Mesh-native paged serving: the pool shards its KV-head axis
+            # over 'model' (batcher + parallel.specs.page_pool_specs), so
+            # the head count must divide.  Explicit requests that cannot
+            # shard error loudly; a config-inherited paged_pages on a
+            # mesh whose head count doesn't divide degrades to contiguous
+            # with a warning (the shared cluster-config policy every
+            # paged knob follows).
+            tp = self.parallel.mesh.shape.get("model", 1)
+            if tp > 1 and self.cfg.num_kv_heads % tp:
+                if explicit:
+                    raise ValueError(
+                        f"paged KV on this mesh shards the pool on the "
+                        f"KV-head axis: num_kv_heads "
+                        f"{self.cfg.num_kv_heads} does not divide over "
+                        f"'model' ({tp}); pass paged_pages=0 or reshape "
+                        f"the mesh"
+                    )
+                log.warning(
+                    "runtime.paged_pages=%d ignored: num_kv_heads %d does "
+                    "not divide over the mesh 'model' axis (%d); serving "
+                    "contiguous", paged_pages, self.cfg.num_kv_heads, tp,
                 )
-            # A shared cluster config with runtime.paged_pages set must not
-            # turn mesh workers' requests into errors — serve contiguous.
-            log.warning(
-                "runtime.paged_pages=%d ignored on a mesh engine (paged KV "
-                "is single-device for now); serving contiguous",
-                paged_pages,
-            )
-            paged_pages = None
+                paged_pages = None
         if prefix_cache and paged_pages is None:
             if explicit_cache:
                 raise ValueError(

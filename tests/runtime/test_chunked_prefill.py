@@ -230,7 +230,9 @@ def test_chunked_guards(tiny):
     cfg, params = tiny
     with pytest.raises(ValueError, match="prefill_chunk"):
         ContinuousBatcher(cfg, params, max_len=64, prefill_chunk=0)
-    with pytest.raises(ValueError, match="single-device"):
+    # Chunked prefill composes with paged AND dp/tp meshes now; only the
+    # speculative draft's monolithic admission remains incompatible.
+    with pytest.raises(ValueError, match="speculative"):
         ContinuousBatcher(cfg, params, max_len=64, prefill_chunk=4,
                           draft_params=params, draft_cfg=cfg)
     from distributed_llms_tpu.core.config import RuntimeConfig

@@ -133,8 +133,9 @@ def test_paged_kernel_program_runs(tiny, monkeypatch):
 
 def test_runtime_config_knobs_reach_engine_batcher(tiny):
     """RuntimeConfig.paged_pages/page_size flow through
-    engine.continuous_batcher (the path the cluster worker uses), and a
-    mesh engine rejects paged loudly."""
+    engine.continuous_batcher (the path the cluster worker uses); a mesh
+    whose KV heads cannot shard degrades (config-inherited) or rejects
+    (explicit), while a divisible mesh serves paged natively."""
     from distributed_llms_tpu.core.config import MeshConfig, RuntimeConfig
     from distributed_llms_tpu.parallel.api import make_parallel_model
     from distributed_llms_tpu.runtime.engine import InferenceEngine
@@ -149,14 +150,23 @@ def test_runtime_config_knobs_reach_engine_batcher(tiny):
     # paged_pages=0 explicitly opts back into contiguous.
     assert not eng.continuous_batcher(batch_slots=2, paged_pages=0).paged
 
+    # llama-tiny has 2 KV heads: model=4 cannot shard the pool.
     pm = make_parallel_model(cfg, MeshConfig(data=2, model=4))
     mesh_eng = InferenceEngine(cfg, rt, params, parallel=pm)
-    # Config-INHERITED paged on a mesh degrades to contiguous (a shared
-    # cluster config must not error mesh workers' requests)...
+    # Config-INHERITED paged on a NON-DIVISIBLE mesh degrades to
+    # contiguous (a shared cluster config must not error mesh workers'
+    # requests)...
     assert not mesh_eng.continuous_batcher(batch_slots=2).paged
-    # ...but an EXPLICIT request raises.
-    with pytest.raises(ValueError, match="single-device"):
+    # ...but an EXPLICIT request on that mesh raises.
+    with pytest.raises(ValueError, match="does not divide"):
         mesh_eng.continuous_batcher(paged_pages=9)
+    # A DIVISIBLE mesh serves paged natively (mesh-native paged serving —
+    # pool sharded on KV heads; byte-exactness pinned in
+    # tests/runtime/test_mesh_paged.py).
+    pm2 = make_parallel_model(cfg, MeshConfig(data=4, model=2))
+    mesh_eng2 = InferenceEngine(cfg, rt, params, parallel=pm2)
+    b2 = mesh_eng2.continuous_batcher(batch_slots=4)
+    assert b2.paged and b2.pm is not None
 
 
 def test_paged_batcher_over_quantized_weights(monkeypatch):

@@ -77,6 +77,12 @@ def _result_cell(row: dict) -> str:
         ("swap_speedup", "swap speedup"),
         ("spill_hit_ttft_ms", "spill-hit TTFT ms"),
         ("cold_ttft_ms", "cold TTFT ms"),
+        ("rows_per_chip_tp1", "rows/chip @tp1"),
+        ("rows_per_chip_tp2", "rows/chip @tp2"),
+        ("capacity_factor_tp2", "tp2 capacity factor"),
+        ("tok_per_s_tp1", "tok/s @tp1"),
+        ("tok_per_s_tp2", "tok/s @tp2"),
+        ("per_chip_pool_kb", "per-chip pool KB"),
         ("tok_per_s_overlap_off", "tok/s overlap-off"),
         ("tok_per_s_overlap_on", "tok/s overlap-on"),
         ("device_gap_ms_off", "device-gap ms off"),
@@ -127,7 +133,7 @@ def generate(ladder_path: str) -> str:
         # Aux rows run_ladder appends after the decode configs.
         "serving-latency", "continuous-batching", "local-proc-batching",
         "chunked-prefill", "prefix-cache-ttft", "fault-recovery",
-        "overload-goodput", "kv-tiering", "decode-overlap",
+        "overload-goodput", "kv-tiering", "decode-overlap", "mesh-paged",
         "replica-failover",
         "disagg-handoff", "compile-stability", "analysis-wall",
         "ragged-decode-8k", "ragged-decode-win-8k", "quant-matmul-bw",

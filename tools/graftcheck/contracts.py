@@ -343,6 +343,68 @@ def _decode_int8_cases() -> list[OpCase]:
     return cases
 
 
+def _decode_spmd_cases() -> list[OpCase]:
+    """Per-SHARD shapes of the decode-attention SPMD rule (mesh-native
+    paged serving): under `ops.decode_attn._ragged_spmd`/`_paged_spmd`
+    each device runs the kernel on its local head slice — H and KVH both
+    divided by tp, page table and cache width intact.  These cases trace
+    exactly those local calls at tp2/tp4 slices of the full-head
+    contracts, both legs, bf16 AND int8 — a head-slice shape the kernel
+    cannot serve would mean the partition rule hands shards an illegal
+    program."""
+    from distributed_llms_tpu.ops import decode_attn
+
+    cases = []
+    dt = jnp.bfloat16
+    # Ragged local shards: (tp, b, s, h, kvh, d).
+    for tp, b, s, h, kvh, d in [(2, 2, 128, 8, 4, 128),
+                                (4, 1, 256, 8, 4, 128)]:
+        hl, kl = h // tp, kvh // tp
+        cases.append(OpCase(
+            label=f"ragged tp{tp} shard b{b} s{s} h{hl}/{kl} d{d}",
+            fn=lambda q, k, v, ln: decode_attn.ragged_decode_attention(
+                q, k, v, ln),
+            args=(sds((b, 1, hl, d), dt), sds((b, s, kl, d), dt),
+                  sds((b, s, kl, d), dt), sds((b,), jnp.int32)),
+            want=(((b, 1, hl, d), "bfloat16"),),
+        ))
+        cases.append(OpCase(
+            label=f"ragged-int8 tp{tp} shard b{b} s{s} h{hl}/{kl} d{d}",
+            fn=lambda q, k, v, ln, ks, vs:
+                decode_attn.ragged_decode_attention(
+                    q, k, v, ln, k_scale=ks, v_scale=vs),
+            args=(sds((b, 1, hl, d), dt), sds((b, s, kl, d), jnp.int8),
+                  sds((b, s, kl, d), jnp.int8), sds((b,), jnp.int32),
+                  sds((b, s, kl), jnp.float32), sds((b, s, kl), jnp.float32)),
+            want=(((b, 1, hl, d), "bfloat16"),),
+        ))
+    # Paged local shards: (tp, b, nb, blk, p, h, kvh, d) — the pool's
+    # page axes stay whole, only KVH slices.
+    for tp, b, nb, blk, p, h, kvh, d in [(2, 2, 16, 8, 4, 8, 4, 128),
+                                         (4, 1, 32, 16, 8, 8, 4, 128)]:
+        hl, kl = h // tp, kvh // tp
+        cases.append(OpCase(
+            label=f"paged tp{tp} shard b{b} nb{nb} blk{blk} h{hl}/{kl}",
+            fn=decode_attn.paged_decode_attention,
+            args=(sds((b, 1, hl, d), dt), sds((nb, blk, kl, d), dt),
+                  sds((nb, blk, kl, d), dt), sds((b,), jnp.int32),
+                  sds((b, p), jnp.int32)),
+            want=(((b, 1, hl, d), "bfloat16"),),
+        ))
+        cases.append(OpCase(
+            label=f"paged-int8 tp{tp} shard b{b} nb{nb} blk{blk} h{hl}/{kl}",
+            fn=lambda q, k, v, ln, tb, ks, vs:
+                decode_attn.paged_decode_attention(
+                    q, k, v, ln, tb, k_scale=ks, v_scale=vs),
+            args=(sds((b, 1, hl, d), dt), sds((nb, blk, kl, d), jnp.int8),
+                  sds((nb, blk, kl, d), jnp.int8), sds((b,), jnp.int32),
+                  sds((b, p), jnp.int32), sds((nb, blk, kl), jnp.float32),
+                  sds((nb, blk, kl), jnp.float32)),
+            want=(((b, 1, hl, d), "bfloat16"),),
+        ))
+    return cases
+
+
 def _quant_cases() -> list[OpCase]:
     import numpy as np
 
@@ -535,6 +597,10 @@ def op_contracts() -> list[OpContract]:
                    "int8 pages + absmax scales in, q.dtype out "
                    "(ragged + paged legs, kernel and fallback shapes)",
                    _decode_int8_cases),
+        OpContract("ops.decode_attn_spmd", P_DECODE,
+                   "per-shard head-slice shapes of the SPMD rule stay "
+                   "legal (ragged + paged, bf16 + int8, tp2/tp4 slices)",
+                   _decode_spmd_cases),
         OpContract("ops.quant_matmul.quant_contract", P_QMM,
                    "int8/int4 contraction keeps activation dtype and N axes",
                    _quant_cases),
@@ -612,6 +678,90 @@ def spec_audits() -> list[SpecAudit]:
     out.append(SpecAudit("llama-tiny@staged-pp2",
                          "distributed_llms_tpu/parallel/api.py",
                          build_staged))
+    out += _page_pool_audits()
+    out += _decode_spmd_audits()
+    return out
+
+
+_MESH_PAGED_LADDER: tuple[tuple[str, dict], ...] = (
+    ("tp2", dict(model=2)),
+    ("tp4", dict(model=4)),
+    ("dp2tp2", dict(data=2, model=2)),
+)
+
+
+def _page_pool_audits() -> list[SpecAudit]:
+    """Sharded page-pool layout (mesh-native paged serving): the pool
+    trees `_paged_pool` builds must structure-match
+    `parallel.specs.page_pool_specs` — KV heads over 'model', int8 absmax
+    scales sharded with their pages — with axis names and divisibility
+    checked over the tp ladder.  llama-tiny (2 KV heads) exercises the
+    non-divisible degrade at tp4; gpt2-tiny (4 heads) shards at both."""
+    out = []
+    for pname in ("llama-tiny", "gpt2-tiny"):
+        for mlabel, axes in _MESH_PAGED_LADDER:
+            for bits in (16, 8):
+                def build(pname=pname, axes=axes, bits=bits):
+                    from distributed_llms_tpu.parallel import (
+                        specs as specs_lib,
+                    )
+
+                    cfg = preset(pname)
+                    mesh = fake_mesh(**axes)
+                    pool = (abstract_quant_pool if bits == 8
+                            else abstract_pool)(cfg, 16, 16)
+                    return pool, specs_lib.page_pool_specs(
+                        cfg, mesh, kv_bits=bits), mesh
+
+                out.append(SpecAudit(
+                    f"page-pool[kv{bits}|{pname}]@{mlabel}", P_SPECS, build
+                ))
+    return out
+
+
+def _decode_spmd_audits() -> list[SpecAudit]:
+    """The decode-attention SPMD rule's operand placement
+    (`ops.decode_attn.spmd_operand_specs` — built on the SAME axis
+    resolver the custom_partitioning lowering runs): every operand spec
+    must name real mesh axes and divide its dims over the ladder, for
+    the ragged and paged legs at both KV widths."""
+    out = []
+    b, s, h, kvh, d = 4, 128, 8, 4, 128
+    nb, blk, p = 16, 16, 8
+    for mlabel, axes in _MESH_PAGED_LADDER:
+        for paged in (False, True):
+            for quant in (False, True):
+                def build(axes=axes, paged=paged, quant=quant):
+                    from distributed_llms_tpu.ops import decode_attn
+
+                    mesh = fake_mesh(**axes)
+                    kv_shape = (nb, blk, kvh, d) if paged else (b, s, kvh, d)
+                    kv_dt = jnp.int8 if quant else jnp.bfloat16
+                    tree = {"q": sds((b, 1, h, d), jnp.bfloat16),
+                            "lengths": sds((b,), jnp.int32)}
+                    if paged:
+                        tree["k_pages"] = sds(kv_shape, kv_dt)
+                        tree["v_pages"] = sds(kv_shape, kv_dt)
+                        tree["tables"] = sds((b, p), jnp.int32)
+                    else:
+                        tree["k"] = sds(kv_shape, kv_dt)
+                        tree["v"] = sds(kv_shape, kv_dt)
+                    if quant:
+                        scale_shape = kv_shape[:-1]
+                        tree["k_scale"] = sds(scale_shape, jnp.float32)
+                        tree["v_scale"] = sds(scale_shape, jnp.float32)
+                    specs, _ = decode_attn.spmd_operand_specs(
+                        mesh, (b, 1, h, d), kv_shape, paged=paged,
+                        quant=quant,
+                    )
+                    return tree, specs, mesh
+
+                leg = "paged" if paged else "ragged"
+                bits = "int8" if quant else "bf16"
+                out.append(SpecAudit(
+                    f"decode-attn-spmd[{leg}|{bits}]@{mlabel}", P_DECODE,
+                    build,
+                ))
     return out
 
 
@@ -1040,12 +1190,24 @@ def contracts_table() -> str:
     rows = ["| family | contract | pins |", "|---|---|---|"]
     for c in op_contracts():
         rows.append(f"| GC1 | `{c.name}` | {c.doc} |")
-    presets = sorted({a.name.split("@")[0] for a in spec_audits()})
+    presets = sorted({a.name.split("@")[0] for a in spec_audits()
+                      if "[" not in a.name})
     meshes = ", ".join(label for label, _ in MESH_LADDER)
     rows.append(
         f"| GC2 | `parallel.specs.param_specs` | tree structure, axis "
         f"names, rank, divisibility over {len(presets)} presets x "
         f"({meshes}) + staged blocks |"
+    )
+    paged_meshes = ", ".join(label for label, _ in _MESH_PAGED_LADDER)
+    rows.append(
+        f"| GC2 | `parallel.specs.page_pool_specs` | sharded page-pool "
+        f"layout (KV heads over 'model'; int8 scales shard with their "
+        f"pages) over {{kv16, kv8}} x ({paged_meshes}) |"
+    )
+    rows.append(
+        f"| GC2 | `ops.decode_attn.spmd_operand_specs` | decode-attn "
+        f"SPMD rule operand placement (ragged + paged, bf16 + int8) "
+        f"over ({paged_meshes}) |"
     )
     for a in collective_audits():
         rows.append(f"| GC2 | `{a.name}` | {a.doc} |")
