@@ -40,6 +40,8 @@ _RUNTIME_FLAGS: dict[str, str] = {
     "overlap": "overlap",
     "request-timeout": "request_timeout_s",
     "shed-cost-factor": "shed_cost_factor",
+    "constrained": "constrained_decoding",
+    "constrain-cache": "constrain_cache_size",
     "fault": "faults",
 }
 # Server plumbing with no RuntimeConfig twin (transport, process, and
@@ -120,6 +122,16 @@ def _server_factory(args, engine, default_name, rt, faults, *,
             faults=faults,
         )
 
+    # Size the compiled-constraint LRU once per serving process (the
+    # cache is module-level: replicas and respawns share remembered
+    # automata by design).
+    from ..runtime import constrain as constrain_lib
+
+    constrain_lib.configure_cache(
+        args.constrain_cache if args.constrain_cache is not None
+        else rt.constrain_cache_size
+    )
+
     def make_server():
         return InferenceServer(
             make_batcher(),
@@ -136,6 +148,8 @@ def _server_factory(args, engine, default_name, rt, faults, *,
                               if args.shed_cost_factor is not None
                               else rt.shed_cost_factor),
             role=role,
+            constrained=(args.constrained if args.constrained is not None
+                         else rt.constrained_decoding),
         )
 
     return make_server
@@ -414,6 +428,20 @@ def main(argv=None) -> None:
                          "sheds at the front door instead of queueing "
                          "doomed work (0 disables; default: "
                          "runtime.shed_cost_factor)")
+    ap.add_argument("--constrained", action=argparse.BooleanOptionalAction,
+                    default=None,
+                    help="grammar-constrained structured output: the "
+                         "response_format={\"type\": \"json_schema\"|"
+                         "\"regex\"} request fields plus logit_bias / "
+                         "banned_tokens, served as token-mask automata "
+                         "fused into the shared decode step.  "
+                         "--no-constrained answers every constrained "
+                         "request 400 (default: "
+                         "runtime.constrained_decoding, on)")
+    ap.add_argument("--constrain-cache", type=int, default=None,
+                    help="LRU capacity of the compiled (constraint, "
+                         "tokenizer) automaton cache (default: "
+                         "runtime.constrain_cache_size)")
     ap.add_argument("--watchdog-timeout", type=float, default=30.0,
                     help="engine watchdog: /healthz flips unhealthy when "
                          "in-flight work exists but no chunk was delivered "

@@ -291,6 +291,17 @@ class RuntimeConfig:
     # the front door instead of queueing work doomed to time out.
     # None/0 disables the gate.
     shed_cost_factor: float | None = 2.0
+    # Grammar-constrained structured output (runtime/constrain.py): the
+    # serving gateway's response_format={"type": "json_schema"|"regex"}
+    # fields plus the logit_bias / banned_tokens ride-alongs.  False
+    # answers every constrained request 400 (operator kill-switch —
+    # automaton compiles are host CPU work an adversarial schema could
+    # lean on).
+    constrained_decoding: bool = True
+    # LRU capacity of the compiled (constraint, tokenizer) -> token-mask
+    # automaton cache: each entry holds two [n_states, vocab] tables, so
+    # the capacity bounds host RAM spent on remembered schemas.
+    constrain_cache_size: int = 64
 
 
 @dataclass(frozen=True)

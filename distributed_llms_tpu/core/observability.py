@@ -232,6 +232,15 @@ METRIC_DOCS: dict[str, str] = {
     "batcher.overlap.depth": "current dispatch depth: 1 while a chunk is "
                              "dispatched ahead of its predecessor's host "
                              "work, 0 at a carry sync (gauge)",
+    # -- grammar-constrained structured output (runtime/constrain.py) --
+    "batcher.constrain.rows": "constrained/biased rows admitted (token-mask "
+                              "automaton engaged in the decode step)",
+    "batcher.constrain.cache_hits": "constraint compiles served from the "
+                                    "(constraint, tokenizer) LRU cache",
+    "batcher.constrain.cache_misses": "schema/regex -> token-DFA compiles "
+                                      "actually built",
+    "batcher.constrain.compile_seconds": "wall time of one token-mask "
+                                         "automaton compile (histogram)",
     # -- KV memory tiering (int8 pages + host-RAM tier) --
     "batcher.kv_swaps.out": "preemption victims swapped to the host tier "
                             "(raw pages parked instead of recomputed)",

@@ -79,6 +79,7 @@ from ..core.config import ModelConfig
 from ..core.observability import METRICS, get_logger
 from ..models import model as model_lib
 from ..models.model import KVCache, QuantKVCache
+from . import constrain as constrain_lib
 from . import sampling
 from .shapes import bucket_length as _bucket
 
@@ -113,19 +114,25 @@ def _replicated(pm, *xs):
 
 
 def _sample_first(logits, last_idx, rng, temperature, top_k, top_p,
-                  temp_req=None, topp_req=None, topk_req=None):
+                  temp_req=None, topp_req=None, topk_req=None,
+                  mask_req=None):
     """Sample the admitted row's first token from the last real position's
     logits — the one sampling tail shared by every admission path.
     ``temp_req``/``topp_req``/``topk_req`` (traced scalars) override the
-    static knobs for per-request sampling without a recompile per value."""
+    static knobs for per-request sampling without a recompile per value.
+    ``mask_req`` [V] is a constrained/biased request's start-state token
+    mask (runtime/constrain.py): applied before the draw AND the greedy
+    argmax, never to the logprob (the logprobs contract stays
+    raw-distribution)."""
     next_logits = jnp.take_along_axis(
         logits, jnp.maximum(last_idx - 1, 0)[None, None, None], axis=1
     )[:, 0]
+    src = next_logits if mask_req is None else next_logits + mask_req[None, :]
     if temp_req is None:
-        tok = sampling.sample(rng, next_logits, temperature, top_k, top_p)[0]
+        tok = sampling.sample(rng, src, temperature, top_k, top_p)[0]
     else:
         tok = sampling.sample_rows(
-            rng, next_logits, jnp.reshape(temp_req, (1,)), top_k,
+            rng, src, jnp.reshape(temp_req, (1,)), top_k,
             jnp.reshape(topp_req, (1,)),
             top_k_rows=(None if topk_req is None
                         else jnp.reshape(topk_req, (1,))),
@@ -173,13 +180,13 @@ def _prefill_row_with_prefix(fwd, params, cfg, prefix_k, prefix_v, prefix_len,
 
 def _finish_admission(
     cache, slot, row_cache, logits, last_idx, rng, temperature, top_k, top_p,
-    total_len, temp_req=None, topp_req=None, topk_req=None,
+    total_len, temp_req=None, topp_req=None, topk_req=None, mask_req=None,
 ):
     """Shared admission tail (plain and prefix-cached paths): sample the
     first token from the last real position's logits, splice the prefilled
     row into the shared cache, report the row's valid slots."""
     tok, lp = _sample_first(logits, last_idx, rng, temperature, top_k, top_p,
-                            temp_req, topp_req, topk_req)
+                            temp_req, topp_req, topk_req, mask_req)
     ax = _batch_axis(cache.k.ndim)
 
     def splice(full, row):
@@ -215,6 +222,7 @@ def admit_row(
     temp_req: jax.Array | None = None,  # traced per-request overrides
     topp_req: jax.Array | None = None,
     topk_req: jax.Array | None = None,
+    mask_req: jax.Array | None = None,  # [V] constrained first-token mask
 ) -> tuple[Any, jax.Array, jax.Array, jax.Array]:
     """Prefill one request into batch row ``slot``.  Returns
     (cache', first_token, row_valid [S], first_token_logprob) —
@@ -227,7 +235,7 @@ def admit_row(
     cache, tok, row_valid, lp = _finish_admission(
         cache, slot, row_cache, logits, plen, rng, temperature, top_k, top_p,
         total_len=plen, temp_req=temp_req, topp_req=topp_req,
-        topk_req=topk_req,
+        topk_req=topk_req, mask_req=mask_req,
     )
     return (cache, *_replicated(pm, tok, row_valid, lp))
 
@@ -552,6 +560,7 @@ def admit_row_with_prefix(
     temp_req: jax.Array | None = None,  # traced per-request overrides
     topp_req: jax.Array | None = None,
     topk_req: jax.Array | None = None,
+    mask_req: jax.Array | None = None,  # [V] constrained first-token mask
 ) -> tuple[Any, jax.Array, jax.Array, jax.Array]:
     """Prefix-cached admission: the shared prefix's KV (computed ONCE by
     ``register_prefix``) seeds the row; only the request's suffix prefills —
@@ -563,7 +572,7 @@ def admit_row_with_prefix(
     cache, tok, row_valid, lp = _finish_admission(
         cache, slot, row_cache, logits, clen, rng, temperature, top_k, top_p,
         total_len=prefix_len + clen, temp_req=temp_req, topp_req=topp_req,
-        topk_req=topk_req,
+        topk_req=topk_req, mask_req=mask_req,
     )
     return (cache, *_replicated(pm, tok, row_valid, lp))
 
@@ -621,6 +630,7 @@ def finish_chunked_admission(
     temp_req: jax.Array | None = None,
     topp_req: jax.Array | None = None,
     topk_req: jax.Array | None = None,
+    mask_req: jax.Array | None = None,  # [V] constrained first-token mask
 ) -> tuple[Any, jax.Array, jax.Array, jax.Array]:
     """Tail of a chunked admission: sample the first token from the final
     chunk's last-position logits and splice the fully-prefilled transient
@@ -630,6 +640,7 @@ def finish_chunked_admission(
         cache, slot, KVCache(k=row_k, v=row_v), last_logits[:, None, :],
         jnp.int32(1), rng, temperature, top_k, top_p, total_len,
         temp_req=temp_req, topp_req=topp_req, topk_req=topk_req,
+        mask_req=mask_req,
     )
     return (cache, *_replicated(pm, tok, row_valid, lp))
 
@@ -655,6 +666,7 @@ def finish_chunked_admission_paged(
     temp_req: jax.Array | None = None,
     topp_req: jax.Array | None = None,
     topk_req: jax.Array | None = None,
+    mask_req: jax.Array | None = None,  # [V] constrained first-token mask
 ) -> tuple[Any, jax.Array, jax.Array]:
     """Tail of a chunked admission in PAGED mode: sample the first token
     from the final chunk's logits and scatter the transient row's pages
@@ -665,7 +677,7 @@ def finish_chunked_admission_paged(
     return _paged_splice(
         cache, page_list, KVCache(k=row_k, v=row_v),
         last_logits[:, None, :], jnp.int32(1), rng, temperature, top_k,
-        top_p, temp_req, topp_req, topk_req, pm=pm,
+        top_p, temp_req, topp_req, topk_req, mask_req, pm=pm,
     )
 
 
@@ -841,7 +853,7 @@ def pool_page_bytes(cfg: ModelConfig, page_size: int, kv_bits: int = 16,
 
 def _paged_splice(cache, page_list, row_cache, logits, last_idx, rng,
                   temperature, top_k, top_p, temp_req=None, topp_req=None,
-                  topk_req=None, pm=None):
+                  topk_req=None, mask_req=None, pm=None):
     """Admission tail for the paged pool: sample the first token, then
     scatter the contiguous transient row cache into the row's pages.
     ``page_list`` [P] is padded with the reserved scratch page 0 past the
@@ -854,7 +866,7 @@ def _paged_splice(cache, page_list, row_cache, logits, last_idx, rng,
     On a mesh batcher (``pm``) the pool result is re-constrained to its
     sharding and the sampled token/logprob replicate (lockstep mirrors)."""
     tok, lp = _sample_first(logits, last_idx, rng, temperature, top_k, top_p,
-                            temp_req, topp_req, topk_req)
+                            temp_req, topp_req, topk_req, mask_req)
     p = page_list.shape[0]
     blk = cache.k.shape[2]
 
@@ -908,6 +920,7 @@ def admit_row_paged(
     temp_req: jax.Array | None = None,  # traced per-request overrides
     topp_req: jax.Array | None = None,
     topk_req: jax.Array | None = None,
+    mask_req: jax.Array | None = None,  # [V] constrained first-token mask
 ) -> tuple[Any, jax.Array, jax.Array]:
     """Paged admission: dense causal prefill on a transient contiguous row
     cache, then scatter its pages into the pool.
@@ -918,7 +931,7 @@ def admit_row_paged(
     )
     return _paged_splice(
         cache, page_list, row_cache, logits, plen, rng, temperature, top_k,
-        top_p, temp_req, topp_req, topk_req, pm=pm,
+        top_p, temp_req, topp_req, topk_req, mask_req, pm=pm,
     )
 
 
@@ -945,6 +958,7 @@ def admit_row_with_prefix_paged(
     temp_req: jax.Array | None = None,  # traced per-request overrides
     topp_req: jax.Array | None = None,
     topk_req: jax.Array | None = None,
+    mask_req: jax.Array | None = None,  # [V] constrained first-token mask
 ) -> tuple[Any, jax.Array, jax.Array]:
     """Prefix-cached paged admission: the prefix KV seeds the transient row
     cache, only the suffix prefills, then the pages scatter into the pool.
@@ -954,7 +968,7 @@ def admit_row_with_prefix_paged(
     )
     return _paged_splice(
         cache, page_list, row_cache, logits, clen, rng, temperature, top_k,
-        top_p, temp_req, topp_req, topk_req, pm=pm,
+        top_p, temp_req, topp_req, topk_req, mask_req, pm=pm,
     )
 
 
@@ -982,6 +996,7 @@ def admit_row_auto_paged(
     temp_req: jax.Array | None = None,  # traced per-request overrides
     topp_req: jax.Array | None = None,
     topk_req: jax.Array | None = None,
+    mask_req: jax.Array | None = None,  # [V] constrained first-token mask
 ) -> tuple[Any, jax.Array, jax.Array]:
     """AUTOMATIC prefix-cache admission: the row's cached prefix KV is
     gathered out of its own (shared, refcounted) pool pages into the
@@ -1000,7 +1015,7 @@ def admit_row_auto_paged(
     )
     return _paged_splice(
         cache, write_list, row_cache, logits, clen, rng, temperature, top_k,
-        top_p, temp_req, topp_req, topk_req, pm=pm,
+        top_p, temp_req, topp_req, topk_req, mask_req, pm=pm,
     )
 
 
@@ -1036,19 +1051,34 @@ def decode_chunk(
     counts: jax.Array | None = None,  # [B, V] int32 output-token histogram
     pres_row: jax.Array | None = None,  # [B] traced presence penalties
     freq_row: jax.Array | None = None,  # [B] traced frequency penalties
+    mask_stack: jax.Array | None = None,  # [S, V] f32 per-state token mask
+    #   (runtime/constrain.py build_stack: state 0 free, grammar automata
+    #   stacked behind it, state axis padded up a closed bucket ladder)
+    next_stack: jax.Array | None = None,  # [S, V] int32 DFA transitions
+    dfa_state: jax.Array | None = None,   # [B] int32 per-row automaton
+    #   state (0 = free) — part of the device-resident decode carry
 ) -> tuple[jax.Array, Any, jax.Array, jax.Array, jax.Array, jax.Array,
-           jax.Array, jax.Array, jax.Array | None]:
+           jax.Array, jax.Array, jax.Array | None, jax.Array | None]:
     """K decode steps with per-row positions.  Returns
     (toks [B, K], cache', last_tok', real_lens', valid', active', budget',
-    logprobs [B, K], counts').  ``temp_row``/``topp_row``/``topk_row``
+    logprobs [B, K], counts', dfa_state').  ``temp_row``/``topp_row``/``topk_row``
     switch sampling to the per-row path (sampling.sample_rows) —
     per-request sampling in one shared batch.  ``counts``+``pres_row``+``freq_row`` engage OpenAI
     presence/frequency penalties: logits adjust by
     ``- freq*count - pres*(count > 0)`` per row BEFORE sampling, and the
     histogram tracks every emitted token (rows with zero penalties read
     garbage counts harmlessly — the adjustment multiplies to zero).
-    Logprobs stay RAW-distribution (pre-penalty), matching the logprobs
-    contract elsewhere.
+    ``mask_stack``+``next_stack``+``dfa_state`` engage grammar-constrained
+    structured output (runtime/constrain.py): each row gathers its
+    state's token mask, adds it to the sampling logits (after penalties —
+    the mask dominates any finite adjustment), and advances its automaton
+    state on the sampled token INSIDE this jitted program, so the state
+    carry stays device-resident across dispatch-ahead chunks and
+    constrained and free rows share one compiled decode step (graftcheck
+    GC4 batcher.decode_chunk_constrained).  Free rows ride state 0, whose
+    mask row is all zeros — their sampled bytes are untouched.
+    Logprobs stay RAW-distribution (pre-penalty, pre-mask), matching the
+    logprobs contract elsewhere.
 
     Chaining contract (the dispatch-ahead engine loop): every returned
     carry leaf (cache', last_tok', real_lens', valid', active', budget',
@@ -1064,7 +1094,8 @@ def decode_chunk(
         slots = jnp.arange(s, dtype=jnp.int32)
 
     def step(carry, rng_step):
-        cache, last_tok, real_lens, valid, active, budget, cnts = carry
+        (cache, last_tok, real_lens, valid, active, budget, cnts,
+         dstate) = carry
         # One batched forward with PER-ROW write slots (models.model accepts
         # a [B] cache_index: only the KV write scatters; all matmuls stay
         # batched).  Paged mode: the page table routes each row's read and
@@ -1101,14 +1132,29 @@ def decode_chunk(
             )
         else:
             sample_from = logits
+        # Grammar/bias mask: gather each row's state mask AFTER penalties
+        # (the -1e30 forbidden entries dominate any finite adjustment;
+        # free rows gather state 0's all-zero row — exact identity).
+        bias = (constrain_lib.gather_bias(mask_stack, dstate)
+                if dstate is not None else None)
         if temp_row is None:
-            tok = sampling.sample(rng_step, sample_from, temperature, top_k,
+            src = sample_from if bias is None else sample_from + bias
+            tok = sampling.sample(rng_step, src, temperature, top_k,
                                   top_p)
         else:
             tok = sampling.sample_rows(
                 rng_step, sample_from, temp_row, top_k,
                 1.0 if topp_row is None else topp_row,
-                top_k_rows=topk_row,
+                top_k_rows=topk_row, mask_rows=bias,
+            )
+        if dstate is not None:
+            # Advance each (pre-step-)active row's automaton on its
+            # sampled token — one gather, device-resident, so a chained
+            # dispatch-ahead chunk consumes the advanced state directly.
+            dstate = jnp.where(
+                carry[4],
+                constrain_lib.advance_states(next_stack, dstate, tok),
+                dstate,
             )
         if cnts is not None:
             cnts = cnts.at[
@@ -1130,14 +1176,16 @@ def decode_chunk(
         lp = jnp.where(carry[4], lp, 0.0)
         last_tok = jnp.where(carry[4], tok, last_tok)
         return (
-            (cache, last_tok, real_lens, valid, active, budget, cnts),
+            (cache, last_tok, real_lens, valid, active, budget, cnts,
+             dstate),
             (out, lp),
         )
 
     rngs = jax.random.split(rng, chunk_steps)
-    carry0 = (cache, last_tok, real_lens, valid, active, budget, counts)
-    ((cache, last_tok, real_lens, valid, active, budget, counts),
-     (toks, lps)) = jax.lax.scan(step, carry0, rngs)
+    carry0 = (cache, last_tok, real_lens, valid, active, budget, counts,
+              dfa_state)
+    ((cache, last_tok, real_lens, valid, active, budget, counts,
+      dfa_state), (toks, lps)) = jax.lax.scan(step, carry0, rngs)
     toks, lps, last_tok, real_lens, valid, active, budget = _replicated(
         pm, toks.T, lps.T, last_tok, real_lens, valid, active, budget
     )
@@ -1145,13 +1193,17 @@ def decode_chunk(
         # The histogram is scheduling state too: replicated, so every host
         # of a multi-process mesh applies identical penalty adjustments.
         counts = _replicated(pm, counts)
+    if dfa_state is not None:
+        # The automaton state is replicated scheduling state like the rest
+        # of the carry: every host syncs identical states at span end.
+        dfa_state = _replicated(pm, dfa_state)
     if tables is not None:
         # Mesh paged decode: pin the pool carry back to its sharding (KV
         # heads over 'model') so chained dispatch-ahead chunks and the
         # scatter/gather jits all consume one placement (no-op off-mesh).
         cache = _pool_constrain(pm, cache)
     return (toks, cache, last_tok, real_lens, valid, active, budget, lps,
-            counts)
+            counts, dfa_state)
 
 
 def _writable(a: np.ndarray) -> np.ndarray:
@@ -1189,6 +1241,12 @@ class _Request:
     top_k: int | None = None
     presence_penalty: float = 0.0   # OpenAI-style, applied to output tokens
     frequency_penalty: float = 0.0
+    # Grammar-constrained structured output / logit bias / banned tokens
+    # (runtime/constrain.py): ONE compiled token-mask automaton covers all
+    # three.  The row's automaton state is a pure function of its emitted
+    # tokens, so preemption/resume carries nothing extra — re-admission
+    # replays the emitted prefix through the automaton on the host.
+    constraint: Any = None  # constrain.TokenDFA | None
     prefix_cache: bool = True  # per-request opt-out of AUTOMATIC caching
     digests: list | None = None  # memoized page digests — a back-pressured
     #   request retries admission every round; its prompt hash never changes
@@ -2249,6 +2307,14 @@ class ContinuousBatcher:
         self.topk_row = np.full((batch_slots,), top_k, np.int32)
         self.pres_row = np.zeros((batch_slots,), np.float32)
         self.freq_row = np.zeros((batch_slots,), np.float32)
+        # Constrained-decoding mirrors: each constrained row's automaton
+        # state LOCAL to its own TokenDFA (the span plan rebases to
+        # absolute stack indices), synced back from the device carry at
+        # span end.  ``_con_stack`` memoizes the span's (bias, next,
+        # offsets) stack across spans with an unchanged constraint mix.
+        self.dfa_row = np.zeros((batch_slots,), np.int32)
+        self._con_stack: tuple | None = None  # (key, bias_j, next_j, offs)
+        self._dfa_carry: jax.Array | None = None  # device [B] abs states
         # Output-token histogram [B, V], allocated on the first penalized
         # admission (1 MB at 32k vocab — but zero cost for servers that
         # never see a penalty).
@@ -2603,6 +2669,14 @@ class ContinuousBatcher:
         presence_penalty: float = 0.0,
         frequency_penalty: float = 0.0, prefix_cache: bool = True,
         priority: int = 0, deadline: float | None = None,
+        response_format: dict | None = None,
+        logit_bias: dict | None = None,
+        banned_tokens: list[int] | None = None,
+        constraint: Any = None,  # pre-compiled constrain.TokenDFA — a
+        #   serving front-end that already compiled OFF its event loop
+        #   passes the automaton itself, closing the window where an LRU
+        #   eviction between its compile and this submit would force a
+        #   synchronous rebuild on the caller's thread
     ) -> int:
         """Queue a request.  ``temperature``/``top_p``/``top_k`` override
         the batcher's sampling config FOR THIS REQUEST (serving
@@ -2613,6 +2687,19 @@ class ContinuousBatcher:
         output tokens before sampling.  ``prefix_cache=False`` opts this
         request out of AUTOMATIC prefix caching (its prompt is neither
         matched against nor published into the shared page cache).
+
+        ``response_format`` constrains the OUTPUT to a grammar
+        (``{"type": "json_schema", "json_schema": {...}}`` or
+        ``{"type": "regex", "regex": ...}``): the constraint compiles to
+        a token-mask automaton (runtime/constrain.py; LRU-cached per
+        (constraint, tokenizer) pair) applied as a traced per-row mask
+        inside the shared decode step — constrained and free rows share
+        one compiled program, and free neighbors' outputs are
+        byte-identical to a constraint-free batch.  ``logit_bias``
+        (token id -> [-100, 100]) and ``banned_tokens`` ride the SAME
+        mask mechanism.  Malformed constraints raise
+        :class:`~.constrain.ConstraintError` (a ValueError) here, before
+        anything is queued.
 
         ``priority`` orders admission (higher first; FIFO within a
         priority) and shields the row from preemption by lower-priority
@@ -2699,6 +2786,27 @@ class ContinuousBatcher:
                           ("frequency_penalty", frequency_penalty)):
             if not -2.0 <= pen <= 2.0:  # also rejects NaN/inf
                 raise ValueError(f"{name} must be in [-2, 2], got {pen}")
+        if (response_format is not None or logit_bias is not None
+                or banned_tokens is not None or constraint is not None):
+            if self.speculative:
+                raise ValueError(
+                    "speculative batching does not support constrained or "
+                    "biased sampling (response_format/logit_bias/"
+                    "banned_tokens) yet — the draft/verify chain would "
+                    "need the mask on both models; serve constrained "
+                    "traffic through a plain engine"
+                )
+            if constraint is None:
+                # Compiles (or LRU-hits — serving front-ends pre-compile
+                # off this thread and pass ``constraint=``) the request's
+                # token-mask automaton; malformed input raises
+                # ConstraintError (a ValueError) here, before anything is
+                # queued.
+                constraint = constrain_lib.compile_request(
+                    response_format, logit_bias, banned_tokens,
+                    tokenizer=self.tokenizer,
+                    vocab_size=self.cfg.vocab_size, eos_id=self.eos_id,
+                )
         # Presence/frequency penalties serve everywhere the batcher does:
         # single-device, speculative, and GSPMD dp/tp meshes (the [B, V]
         # histogram rides decode_chunk replicated, like the rest of the
@@ -2721,6 +2829,7 @@ class ContinuousBatcher:
                 temperature=temperature, top_p=top_p, top_k=top_k,
                 presence_penalty=float(presence_penalty),
                 frequency_penalty=float(frequency_penalty),
+                constraint=constraint,
                 prefix_cache=prefix_cache, priority=priority,
                 deadline=deadline,
             ))
@@ -2933,6 +3042,11 @@ class ContinuousBatcher:
                 temperature=req.temperature, top_p=req.top_p,
                 top_k=req.top_k, presence_penalty=req.presence_penalty,
                 frequency_penalty=req.frequency_penalty,
+                # The compiled automaton rides the resume request; its
+                # state rebuilds from the emitted prefix at re-admission
+                # (TokenDFA.advance), so the reunited stream stays
+                # byte-exact under the same masks.
+                constraint=req.constraint,
                 prefix_cache=req.prefix_cache, priority=req.priority,
                 deadline=req.deadline, resume_emitted=list(row.emitted),
                 resume_lps=list(row.lps),
@@ -3066,6 +3180,12 @@ class ContinuousBatcher:
             rowc = np.zeros((self.cfg.vocab_size,), np.int32)
             np.add.at(rowc, np.asarray(emitted, np.int64), 1)
             self.tok_counts = self.tok_counts.at[i].set(jnp.asarray(rowc))
+        if req.constraint is not None:
+            # Rebuild the row's automaton state by replaying the tokens it
+            # already emitted — the state is a pure function of them, so a
+            # swap-restored constrained row continues under the exact
+            # masks the unpreempted run would have seen.
+            self.dfa_row[i] = req.constraint.advance(0, emitted)
         self.last_tok[i] = req.swap_last_tok
         self.real_lens[i] = req.swap_pos
         self.valid[i] = np.arange(self.valid.shape[1]) < req.swap_pos
@@ -3394,6 +3514,12 @@ class ContinuousBatcher:
             )
             if custom and req_k != self.sampling["top_k"]:
                 extra["topk_req"] = jnp.int32(req_k)
+            if req.constraint is not None:
+                # The first output token draws under the automaton's
+                # start-state mask (a resumed request replays its emitted
+                # prefix to recover the state first).
+                st0 = req.constraint.advance(0, req.resume_emitted or [])
+                extra["mask_req"] = jnp.asarray(req.constraint.bias[st0])
             if self.paged and pfx is not None:
                 self.cache, tok, lp = admit_row_with_prefix_paged(
                     self.params, self.cfg, self.cache, jnp.asarray(page_list),
@@ -3473,6 +3599,16 @@ class ContinuousBatcher:
         state, stream the token."""
         tok = int(tok)  # replicated scalar — identical on every process
         self.last_tok[i] = tok
+        if req.constraint is not None:
+            # Automaton state after the admission token: replay (resumed
+            # prefix +) the token on the host — the state is a pure
+            # function of the emitted stream.
+            self.dfa_row[i] = req.constraint.advance(
+                0, list(req.resume_emitted or []) + [tok]
+            )
+            METRICS.inc("batcher.constrain.rows")
+        else:
+            self.dfa_row[i] = 0
         self.temp_row[i] = req_t
         self.topp_row[i] = req_p
         self.topk_row[i] = (self.sampling["top_k"] if req_k is None
@@ -3641,6 +3777,10 @@ class ContinuousBatcher:
         )
         if custom and req_k != self.sampling["top_k"]:
             extra["topk_req"] = jnp.int32(req_k)
+        if req.constraint is not None:
+            # Same first-token masking as the monolithic admissions.
+            st0 = req.constraint.advance(0, req.resume_emitted or [])
+            extra["mask_req"] = jnp.asarray(req.constraint.bias[st0])
         if self.paged:
             blk = self.page_size
             n_cached = len(pp.cached_pages)
@@ -3825,6 +3965,7 @@ class ContinuousBatcher:
         the (correct, slightly wider) program engaged until the sync."""
         plan: dict = {
             "tables": jnp.asarray(self.tables) if self.paged else None,
+            "constrain": None,
         }
         self._tables_dirty = False  # plan holds the current snapshot
         pen_live = self.active & (
@@ -3868,6 +4009,51 @@ class ContinuousBatcher:
             if plan["counts"]:
                 per_row["pres_row"] = jnp.asarray(self.pres_row)
                 per_row["freq_row"] = jnp.asarray(self.freq_row)
+            # Constrained structured output: stack the live rows' token
+            # automata into ONE (bias, next) pair the decode step gathers
+            # from; the per-row state vector rides the DEVICE carry
+            # (self._dfa_carry — _dispatch_chunk chains it chunk to
+            # chunk) and syncs back to the dfa_row mirrors at span end.
+            # The state axis pads up the shared bucket ladder so the
+            # compile key is independent of the live schema mix.
+            con = [
+                i for i in range(self.b)
+                if self.active[i] and self.rows[i].req is not None
+                and self.rows[i].req.constraint is not None
+            ]
+            if con:
+                key = tuple(
+                    (i, id(self.rows[i].req.constraint)) for i in con
+                )
+                if self._con_stack is None or self._con_stack[0] != key:
+                    dfas = [self.rows[i].req.constraint for i in con]
+                    total = 1 + sum(d.n_states for d in dfas)
+                    bias, nxt, offs = constrain_lib.build_stack(
+                        dfas, self.cfg.vocab_size,
+                        pad_states_to=_bucket(total),
+                    )
+                    # The memo HOLDS the automata: the key compares ids,
+                    # and a reference pins them so a freed automaton's id
+                    # can never be recycled into a stale-key match.
+                    self._con_stack = (
+                        key, jnp.asarray(bias), jnp.asarray(nxt), offs,
+                        dfas,
+                    )
+                _, bias_j, nxt_j, offs, _dfas = self._con_stack
+                abs_state = np.zeros((self.b,), np.int32)
+                for off, i in zip(offs, con):
+                    abs_state[i] = off + int(self.dfa_row[i])
+                per_row["mask_stack"] = bias_j
+                per_row["next_stack"] = nxt_j
+                self._dfa_carry = jnp.asarray(abs_state)
+                plan["constrain"] = [
+                    (i, off, self.rows[i].rid) for off, i in zip(offs, con)
+                ]
+            else:
+                # Constrained traffic drained: release the memoized stack
+                # (device tables + pinned automata) — it rebuilds on the
+                # next constrained span at the same cost it was built.
+                self._con_stack = None
             plan["per_row"] = per_row
         return plan
 
@@ -3884,6 +4070,7 @@ class ContinuousBatcher:
         last_tok, real_lens, valid, active, budget = carry
         self.overlap_stats["chunks"] += 1
         m = None
+        dfa_out = None
         if self.speculative:
             per_spec = dict(plan["per_spec"])
             if plan["counts"]:
@@ -3903,8 +4090,13 @@ class ContinuousBatcher:
             per_row = dict(plan["per_row"])
             if plan["counts"]:
                 per_row["counts"] = self.tok_counts
+            if plan["constrain"]:
+                # The automaton-state carry chains like the KV cache: a
+                # dispatched-ahead chunk consumes the PREVIOUS chunk's
+                # (not-yet-materialized) state output directly.
+                per_row["dfa_state"] = self._dfa_carry
             (toks, self.cache, last_tok, real_lens, valid, active,
-             budget, lps, counts_out) = \
+             budget, lps, counts_out, dfa_out) = \
                 decode_chunk(
                     self.params, self.cfg_decode, self.cache, last_tok,
                     real_lens, valid, active, budget,
@@ -3915,6 +4107,8 @@ class ContinuousBatcher:
                 )
         if counts_out is not None:
             self.tok_counts = counts_out
+        if dfa_out is not None:
+            self._dfa_carry = dfa_out
         return toks, lps, m, (last_tok, real_lens, valid, active, budget)
 
     def _overlap_ok(self, was_active: np.ndarray, chunks: int) -> bool:
@@ -4154,4 +4348,18 @@ class ContinuousBatcher:
         if self.overlap:
             self.overlap_stats["carry_syncs"] += 1
             METRICS.inc("batcher.overlap.carry_syncs")
+        if plan["constrain"]:
+            # Span boundary: pull the advanced automaton states back into
+            # the LOCAL per-row mirrors (abs index minus the row's stack
+            # offset) — preemption/cancel/admission decisions run against
+            # fresh dfa_row, like every other scheduling mirror.  Rows
+            # whose host bookkeeping dropped them mid-span are skipped
+            # (rid mismatch — their state is garbage by construction).
+            abs_states = np.asarray(jax.device_get(self._dfa_carry))
+            for i, off, rid in plan["constrain"]:
+                row = self.rows[i]
+                if row.rid == rid and row.req is not None \
+                        and row.req.constraint is not None:
+                    self.dfa_row[i] = int(abs_states[i]) - off
+        self._dfa_carry = None
         self._collect(toks, was_active, counts=m, lps=lps)

@@ -6,6 +6,12 @@ SERVICE replica-safe.  N full server/batcher stacks (each with its own
 PR-2 supervisor, watchdog, and overload plane) sit behind one HTTP front
 door that:
 
+- **Forwards bodies VERBATIM.**  The proxy ships the request's exact
+  bytes to the chosen replica, so every per-request serving field —
+  sampling knobs, penalties, priorities, and the constrained-decoding
+  surface (``response_format`` / ``logit_bias`` / ``banned_tokens``,
+  runtime/constrain.py) — passes through untouched and is validated
+  where it is served (the replica's own 400-before-admission gate).
 - **Places health-aware.**  Candidates are the replicas the fleet's
   ``/healthz`` probes currently call routable.  Among them, placement
   follows PREFIX AFFINITY first: the router hashes the request's prompt

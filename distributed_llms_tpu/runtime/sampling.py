@@ -108,16 +108,25 @@ def sample_rows(
     top_p: jax.Array | float = 1.0,  # [B] or scalar, traced
     top_k_rows: jax.Array | None = None,  # [B] int32 traced — overrides the
     #   static ``top_k`` when given (per-request top_k in a shared batch)
+    mask_rows: jax.Array | None = None,  # [B, V] traced additive mask —
+    #   grammar-constrained / logit-biased rows (runtime/constrain.py):
+    #   0 keeps a token, a large negative value forbids it; free rows in
+    #   the same batch carry an all-zero row (exact identity)
 ) -> jax.Array:
     """Per-row sampling: each batch row draws with its OWN temperature,
-    top-p, and (via ``top_k_rows``) top-k — continuous-batching serving
-    mixes per-request sampling configs in one decode step without
-    recompiling (the knobs are traced inputs, not static).  Without
-    ``top_k_rows`` the static ``top_k`` applies batch-wide (``lax.top_k``
-    needs a compile-time k; the traced variant pays a full [B, V] sort).
-    Rows with temperature == 0 take the greedy token (identical to
-    :func:`greedy`); the warp order matches :func:`sample`, so a uniform
-    batch draws the same tokens as the static path under the same rng."""
+    top-p, (via ``top_k_rows``) top-k, and (via ``mask_rows``) token mask
+    — continuous-batching serving mixes per-request sampling configs in
+    one decode step without recompiling (the knobs are traced inputs, not
+    static).  Without ``top_k_rows`` the static ``top_k`` applies
+    batch-wide (``lax.top_k`` needs a compile-time k; the traced variant
+    pays a full [B, V] sort).  ``mask_rows`` applies BEFORE the
+    temperature warp and before the greedy fallback, so constrained
+    greedy rows take the masked argmax.  Rows with temperature == 0 take
+    the greedy token (identical to :func:`greedy` when unmasked); the
+    warp order matches :func:`sample`, so a uniform batch draws the same
+    tokens as the static path under the same rng."""
+    if mask_rows is not None:
+        logits = logits + mask_rows
     temperature = jnp.asarray(temperature, logits.dtype)
     safe_t = jnp.where(temperature > 0, temperature, 1.0)[:, None]
     warped = logits / safe_t

@@ -222,6 +222,12 @@ class InferenceServer:
         xfer_attempt_s: float = 5.0,
         xfer_max_retries: int = 3,
         max_inflight_transfers: int = 4,
+        # Grammar-constrained structured output (runtime/constrain.py):
+        # response_format / logit_bias / banned_tokens request fields.
+        # False answers 400 to any constrained request (operator
+        # kill-switch: RuntimeConfig.constrained_decoding /
+        # dlt-serve --no-constrained).
+        constrained: bool = True,
     ) -> None:
         if batcher.tokenizer is None:
             raise ValueError(
@@ -259,6 +265,7 @@ class InferenceServer:
         self.xfer_attempt_s = xfer_attempt_s
         self.xfer_max_retries = xfer_max_retries
         self.max_inflight_transfers = max_inflight_transfers
+        self.constrained = bool(constrained)
         self._xfer_sem: asyncio.Semaphore | None = None  # made on start()
         self._kv_server: asyncio.base_events.Server | None = None
         from ..cluster.kv_transfer import ReceiverStats
@@ -946,6 +953,38 @@ class InferenceServer:
             raise BadRequest("'prefix_cache' must be a boolean")
         temperature, top_p, top_k, pres_pen, freq_pen = \
             self._parse_sampling(req)
+        response_format = req.get("response_format")
+        logit_bias = req.get("logit_bias")
+        banned_tokens = req.get("banned_tokens")
+        dfa = None
+        if (response_format is not None or logit_bias is not None
+                or banned_tokens is not None):
+            if not self.constrained:
+                raise BadRequest(
+                    "constrained decoding is disabled on this server "
+                    "(runtime.constrained_decoding / --no-constrained)"
+                )
+            from . import constrain as constrain_lib
+
+            b = self.batcher
+            try:
+                # Compile (or LRU-hit) the token-mask automaton OFF the
+                # event loop — a large schema's DFA build is host numpy
+                # work measured in wall-clock, and this loop answers the
+                # fleet's health probes.  The compiled automaton itself is
+                # handed to submit() below: re-looking it up could MISS
+                # (LRU eviction in the window) and rebuild synchronously
+                # on this loop.
+                dfa = await asyncio.to_thread(
+                    constrain_lib.compile_request,
+                    response_format, logit_bias, banned_tokens,
+                    tokenizer=b.tokenizer, vocab_size=b.cfg.vocab_size,
+                    eos_id=b.eos_id,
+                )
+            except constrain_lib.ConstraintError as e:
+                # Malformed schema/regex/bias: structured 400 BEFORE any
+                # admission state exists (no mailbox, no queue entry).
+                raise BadRequest(str(e)) from None
         lp_req = req.get("logprobs")
         if lp_req is None or lp_req is False:
             want_lp = False
@@ -1040,6 +1079,8 @@ class InferenceServer:
             temperature=temperature, top_p=top_p, top_k=top_k,
             presence_penalty=pres_pen, frequency_penalty=freq_pen,
             prefix_cache=use_cache, priority=priority, deadline=deadline,
+            response_format=response_format, logit_bias=logit_bias,
+            banned_tokens=banned_tokens,
         )
         subs: list[tuple[int, int, _Mailbox]] = []  # (choice index, rid, mbox)
         sub_err: Exception | None = None
@@ -1064,7 +1105,9 @@ class InferenceServer:
                         temperature=temperature, top_p=top_p, top_k=top_k,
                         presence_penalty=pres_pen, frequency_penalty=freq_pen,
                         prefix_cache=use_cache, priority=priority,
-                        deadline=deadline,
+                        deadline=deadline, response_format=response_format,
+                        logit_bias=logit_bias, banned_tokens=banned_tokens,
+                        constraint=dfa,
                     )
                     assert got == rid
                 except (ValueError, KeyError) as e:

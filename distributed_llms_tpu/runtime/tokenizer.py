@@ -43,6 +43,13 @@ class ByteTokenizer:
         data = bytes(i for i in ids if 0 <= int(i) < 256)
         return data.decode("utf-8", errors="replace")
 
+    def token_bytes(self, i: int) -> bytes | None:
+        """The exact byte content of one token id (constrained decoding
+        builds token-mask automata from this — runtime/constrain.py).
+        None for specials/out-of-range ids: they carry no text and are
+        masked out of every grammar."""
+        return bytes([i]) if 0 <= i < 256 else None
+
     def apply_chat_template(self, messages: list[dict]) -> str:
         """Plain-text fallback template (no model-specific control tokens
         exist at the byte level)."""
@@ -73,6 +80,30 @@ class HFTokenizer:
 
     def decode(self, ids) -> str:
         return self._tok.decode([int(i) for i in ids], skip_special_tokens=True)
+
+    def token_bytes(self, i: int) -> bytes | None:
+        """Best-effort byte content of one token id for constrained
+        decoding (runtime/constrain.py).  Specials, empty decodes, and
+        tokens whose single-id decode is not faithful map to None
+        (masked out of every grammar): a byte-level BPE vocabulary's
+        UTF-8-FRAGMENT tokens decode to U+FFFD replacement characters,
+        and building the automaton from those phantom bytes would
+        enforce the grammar on content the model never emits —
+        conservative masking keeps every allowed token's bytes exact
+        (ASCII-coded grammars, i.e. all generated JSON structure, are
+        unaffected; multi-byte text inside strings is reachable only
+        through whole-character tokens)."""
+        if not 0 <= i < self.vocab_size:
+            return None
+        if i in (self._tok.all_special_ids or ()):
+            return None
+        try:
+            s = self._tok.decode([i])
+        except Exception:
+            return None
+        if not s or "�" in s:
+            return None
+        return s.encode("utf-8")
 
     def apply_chat_template(self, messages: list[dict]) -> str:
         """The model's own chat template when it ships one (Llama/Mistral/

@@ -1,0 +1,490 @@
+"""Grammar-constrained structured output (runtime/constrain.py + the
+batcher/server mask leg).
+
+Core invariants:
+- every constrained completion PARSES: regex-constrained outputs
+  full-match their pattern and schema-constrained outputs json.loads +
+  validate, at temperature 0 AND temperature > 0;
+- free rows in a mixed batch are byte-identical (tokens AND logprobs) to
+  a batch with no constrained neighbors — the mask path adds exactly 0.0
+  to their logits;
+- composition: constrained x {prefix cache, chunked prefill,
+  preempt+swap-restore, overlap on/off, int8 KV pages} stays byte-stable;
+- logit_bias / banned_tokens ride the SAME mask mechanism (no second
+  path) with the same isolation guarantees;
+- serving: malformed schemas answer a structured 400 BEFORE admission,
+  response_format round-trips end to end over HTTP, and "n": K choices
+  share the prompt's KV pages through the refcounted pool.
+"""
+
+import asyncio
+import json
+import re
+
+import jax
+import numpy as np
+import pytest
+
+from distributed_llms_tpu.core.observability import METRICS
+from distributed_llms_tpu.models import model as model_lib, presets
+from distributed_llms_tpu.runtime import constrain as C
+from distributed_llms_tpu.runtime.batcher import ContinuousBatcher
+from distributed_llms_tpu.runtime.server import InferenceServer
+from distributed_llms_tpu.runtime.tokenizer import ByteTokenizer
+
+TOK = ByteTokenizer()
+
+TOOL_SCHEMA = {
+    "type": "object",
+    "properties": {
+        "name": {"enum": ["get_weather", "get_time"]},
+        "args": {
+            "type": "object",
+            "properties": {
+                "city": {"type": "string", "maxLength": 8},
+                "celsius": {"type": "boolean"},
+            },
+            "required": ["city", "celsius"],
+        },
+    },
+    "required": ["name", "args"],
+}
+RF_SCHEMA = {"type": "json_schema", "json_schema": {"schema": TOOL_SCHEMA}}
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    cfg = presets.get_preset("llama-tiny", vocab_size=512)
+    params = model_lib.init_params(jax.random.key(0), cfg)
+    return cfg, params
+
+
+def make(tiny, **kw):
+    cfg, params = tiny
+    kw.setdefault("batch_slots", 4)
+    kw.setdefault("max_len", 96)
+    kw.setdefault("chunk_steps", 4)
+    kw.setdefault("tokenizer", TOK)
+    kw.setdefault("eos_id", TOK.eos_id)
+    kw.setdefault("pad_id", TOK.pad_id)
+    return ContinuousBatcher(cfg, params, **kw)
+
+
+def _paged(tiny, **kw):
+    kw.setdefault("batch_slots", 3)
+    kw.setdefault("max_len", 64)
+    kw.setdefault("page_size", 16)
+    kw.setdefault("paged_pages", 9)
+    return make(tiny, **kw)
+
+
+def text_of(b, out):
+    """Decode a result, dropping the terminating EOS if present."""
+    if out and out[-1] == TOK.eos_id:
+        out = out[:-1]
+    return TOK.decode(out)
+
+
+# -- compiler unit tests ----------------------------------------------------
+
+
+def test_regex_char_dfa_semantics():
+    dfa = C.regex_to_char_dfa(r"(?:ab|a[0-9]{2,3})c?")
+    for s, want in [("ab", True), ("a12", True), ("a123c", True),
+                    ("abc", True), ("a1", False), ("a1234", False),
+                    ("", False), ("b", False)]:
+        assert C.char_dfa_matches(dfa, s.encode()) == want, s
+    # an empty language fails at compile, not at serve time
+    with pytest.raises(C.ConstraintError, match="matches nothing"):
+        C.regex_to_char_dfa(r"a[^\x00-\xff]b")
+    with pytest.raises(C.ConstraintError, match="repetition"):
+        C.regex_to_char_dfa("a{3,2}")
+
+
+def test_schema_to_regex_agrees_with_python_re():
+    rx = C.schema_to_regex(TOOL_SCHEMA)
+    good = {"name": "get_time", "args": {"city": "oslo", "celsius": True}}
+    bad = {"name": "nope", "args": {"city": "oslo", "celsius": True}}
+    s_good = json.dumps(good, separators=(",", ":"))
+    s_bad = json.dumps(bad, separators=(",", ":"))
+    assert re.fullmatch(rx, s_good)
+    assert not re.fullmatch(rx, s_bad)
+    assert C.validates(TOOL_SCHEMA, good)
+    assert not C.validates(TOOL_SCHEMA, bad)
+    # arrays + numbers + null
+    rx2 = C.schema_to_regex({"type": "array", "items": {"type": "number"},
+                             "maxItems": 3})
+    assert re.fullmatch(rx2, "[1.5,0,2]")
+    assert not re.fullmatch(rx2, "[1,2,3,4]")
+    assert re.fullmatch(C.schema_to_regex({"type": "null"}), "null")
+
+
+def test_schema_subset_rejections():
+    with pytest.raises(C.ConstraintError, match="required"):
+        C.schema_to_regex({"type": "object",
+                           "properties": {"a": {"type": "null"}},
+                           "required": []})
+    with pytest.raises(C.ConstraintError, match="unsupported schema type"):
+        C.schema_to_regex({"type": "frobnicate"})
+    with pytest.raises(C.ConstraintError, match="unsupported schema keyword"):
+        C.schema_to_regex({"anyOf": [{"type": "null"}]})
+    with pytest.raises(C.ConstraintError, match="enum"):
+        C.schema_to_regex({"enum": []})
+    with pytest.raises(C.ConstraintError, match="unsupported escape"):
+        C.regex_to_char_dfa(r"\ba\b")
+    # The keyword set is an ALLOWLIST: an unenforced constraint must 400,
+    # never be silently ignored (the output would violate the schema).
+    with pytest.raises(C.ConstraintError, match="unsupported schema keyword"):
+        C.schema_to_regex({"type": "integer", "maximum": 10})
+    with pytest.raises(C.ConstraintError, match="minimum"):
+        C.schema_to_regex({"type": "integer", "minimum": 5})
+    with pytest.raises(C.ConstraintError, match="additionalProperties"):
+        C.schema_to_regex({"type": "object", "properties": {},
+                           "required": [], "additionalProperties": True})
+    # ... while enforceable/annotation keys pass.
+    assert C.schema_to_regex({"type": "integer", "minimum": 0}) \
+        == "(?:0|[1-9][0-9]{0,14})"
+    assert "\\{\\}" == C.schema_to_regex(
+        {"type": "object", "properties": {}, "required": [],
+         "additionalProperties": False, "title": "t"})
+
+
+def test_string_length_bounds_are_utf8_bytes():
+    schema = {"type": "string", "minLength": 4, "maxLength": 4}
+    rx = C.schema_to_regex(schema)
+    # The grammar counts BYTES; validates() must use the same measure, or
+    # a grammar-legal output would fail its own schema.
+    assert re.fullmatch(rx, '"abcd"')
+    assert C.validates(schema, "abcd")
+    assert C.validates(schema, "éé")       # 2 chars, 4 UTF-8 bytes
+    assert not C.validates(schema, "abc")  # 3 bytes
+
+
+def test_compile_cache_hit_path():
+    C.clear_cache()
+    rf = {"type": "regex", "regex": "[0-9]{1,4}"}
+    a = C.compile_request(rf, tokenizer=TOK, vocab_size=512,
+                          eos_id=TOK.eos_id)
+    st = C.cache_stats()
+    b = C.compile_request(rf, tokenizer=TOK, vocab_size=512,
+                          eos_id=TOK.eos_id)
+    st2 = C.cache_stats()
+    assert b is a  # the LRU returned the SAME automaton object
+    assert st2["hits"] == st["hits"] + 1
+    assert st2["misses"] == st["misses"]
+
+
+# -- constrained generation: parse guarantees -------------------------------
+
+
+def test_constrained_outputs_match_regex_greedy_and_sampled(tiny):
+    pat = "[0-9]{2,6}"
+    rf = {"type": "regex", "regex": pat}
+    b = make(tiny, seed=3)
+    rids = [
+        b.submit([7, 1, 9], max_new_tokens=12, response_format=rf),
+        b.submit([4, 4], max_new_tokens=12, temperature=1.5,
+                 response_format=rf),
+        b.submit([9, 8], max_new_tokens=12, temperature=0.8, top_p=0.95,
+                 response_format=rf),
+    ]
+    res = b.run()
+    rows0 = METRICS.get_counter("batcher.constrain.rows")
+    assert rows0 >= 3
+    for r in rids:
+        assert res[r][-1] == TOK.eos_id, res[r]
+        assert re.fullmatch(pat, text_of(b, res[r])), res[r]
+
+
+def test_constrained_json_schema_parses_and_validates(tiny):
+    b = make(tiny, seed=11)
+    rids = [
+        b.submit([60 + i, 2, 3], max_new_tokens=70,
+                 temperature=(0.0 if i % 2 == 0 else 1.1),
+                 response_format=RF_SCHEMA)
+        for i in range(4)
+    ]
+    res = b.run()
+    for r in rids:
+        obj = json.loads(text_of(b, res[r]))
+        assert C.validates(TOOL_SCHEMA, obj), obj
+
+
+def test_free_rows_byte_identical_next_to_constrained(tiny):
+    """The SAME batch (same submission order, prompts, budgets, seed)
+    with the third request constrained vs free: the two free rows —
+    one greedy, one sampled — must be byte-identical in tokens AND
+    logprobs (their mask row is exactly zero, and the rng stream is
+    consumption-aligned: one split per admission, one per chunk)."""
+
+    def drive(constrained):
+        b = make(tiny, seed=5)
+        rids = [
+            b.submit([7, 1, 9], max_new_tokens=10),
+            b.submit([4, 4, 4], max_new_tokens=8, temperature=1.3),
+        ]
+        kw = ({"response_format": {"type": "regex",
+                                   "regex": "[a-z]{4,12}"}}
+              if constrained else {})
+        b.submit([2, 2], max_new_tokens=16, **kw)
+        res = b.run()
+        return ([res[r] for r in rids],
+                [b.result_logprobs[r] for r in rids])
+
+    toks_free, lps_free = drive(False)
+    toks_mixed, lps_mixed = drive(True)
+    assert toks_mixed == toks_free
+    # Bit-identity, not approximate: the free rows' logits never saw the
+    # mask (their bias row adds exactly 0.0).
+    assert lps_mixed == lps_free
+
+
+# -- ride-alongs: logit_bias / banned_tokens --------------------------------
+
+
+def test_logit_bias_and_banned_tokens_share_the_mask_path(tiny):
+    b0 = make(tiny)
+    r0 = b0.submit([7, 1, 9], max_new_tokens=8)
+    free = b0.run()[r0]
+
+    # +100 bias dominates every tiny-model logit: greedy emits the token.
+    b = make(tiny)
+    r = b.submit([7, 1, 9], max_new_tokens=4, logit_bias={"65": 100.0})
+    assert b.run()[r][:1] == [65]
+
+    # Banning greedy's first choice changes the path; the banned id never
+    # appears; an unbiased neighbor in the same batch stays exact.
+    b2 = make(tiny)
+    rb = b2.submit([7, 1, 9], max_new_tokens=8, banned_tokens=[free[0]])
+    rn = b2.submit([7, 1, 9], max_new_tokens=8)
+    res = b2.run()
+    assert free[0] not in res[rb]
+    assert res[rb] != free
+    assert res[rn] == free
+
+    # Validation: range and shape errors raise BEFORE anything queues.
+    with pytest.raises(ValueError, match="logit_bias"):
+        b2.submit([1], max_new_tokens=2, logit_bias={"65": 101.0})
+    with pytest.raises(ValueError, match="logit_bias"):
+        b2.submit([1], max_new_tokens=2, logit_bias={"x": 1.0})
+    with pytest.raises(ValueError, match="banned"):
+        b2.submit([1], max_new_tokens=2, banned_tokens=[512])
+    with pytest.raises(ValueError, match="banned"):
+        b2.submit([1], max_new_tokens=2, banned_tokens=[])
+
+
+def test_speculative_rejects_constraints(tiny):
+    cfg, params = tiny
+    b = ContinuousBatcher(
+        cfg, params, batch_slots=2, max_len=64, chunk_steps=4,
+        tokenizer=TOK, eos_id=TOK.eos_id, pad_id=TOK.pad_id,
+        draft_params=params, draft_cfg=cfg, spec_k=2,
+    )
+    for kw in (dict(response_format={"type": "regex", "regex": "[0-9]+"}),
+               dict(logit_bias={"5": 1.0}),
+               dict(banned_tokens=[5])):
+        with pytest.raises(ValueError, match="speculative"):
+            b.submit([1, 2, 3], max_new_tokens=4, **kw)
+
+
+# -- composition ------------------------------------------------------------
+
+
+def test_constrained_overlap_on_off_byte_stable(tiny):
+    pat = {"type": "regex", "regex": "[0-9]{2,20}"}
+
+    def drive(overlap):
+        b = make(tiny, seed=9, overlap=overlap)
+        rc = b.submit([7, 1], max_new_tokens=24, response_format=pat)
+        rs = b.submit([4, 4], max_new_tokens=24, temperature=1.2,
+                      response_format=pat)
+        rf = b.submit([9, 9], max_new_tokens=10)
+        res = b.run()
+        return res[rc], res[rs], res[rf]
+
+    assert drive(True) == drive(False)
+
+
+def test_constrained_chunked_prefill_matches_monolithic(tiny):
+    prompt = list(range(40, 58))  # long enough to chunk
+    rf = {"type": "regex", "regex": "[0-9]{2,10}"}
+    mono = make(tiny)
+    rm = mono.submit(prompt, max_new_tokens=14, response_format=rf)
+    want = mono.run()[rm]
+    chunked = make(tiny, prefill_chunk=5)
+    rc = chunked.submit(prompt, max_new_tokens=14, response_format=rf)
+    assert chunked.run()[rc] == want
+    assert re.fullmatch("[0-9]{2,10}", text_of(mono, want))
+
+
+def test_constrained_prefix_cache_composes(tiny):
+    # 32-token shared prompt = 2 full pages; the second constrained
+    # request admits off the cached run and must produce the same bytes.
+    prompt = [5] * 33
+    rf = {"type": "regex", "regex": "[0-9]{2,10}"}
+    b = _paged(tiny, prefix_cache=True)
+    r1 = b.submit(prompt, max_new_tokens=10, response_format=rf)
+    res1 = b.run()
+    r2 = b.submit(prompt, max_new_tokens=10, response_format=rf)
+    res2 = b.run()
+    assert b.prefix_cached_tokens[r2] >= 32
+    assert res2[r2] == res1[r1]
+    assert re.fullmatch("[0-9]{2,10}", text_of(b, res2[r2]))
+    b.assert_pool_consistent()
+
+
+def test_constrained_preempt_swap_restore_byte_exact(tiny):
+    # Pool pressure (3 rows x 44-token budgets against 9 pages) forces
+    # swap-preemption; the roomy pool serves the byte-exact reference.
+    # The 40-digit floor keeps every row decoding long enough to be a
+    # victim (no early EOS), and the automaton state must survive the
+    # round trip (restore replays the emitted prefix through the DFA).
+    rf = {"type": "regex", "regex": "[0-9]{40,60}"}
+    reqs = [([7, 1, 9, 2], 44), ([4, 4, 4, 4], 44), ([9, 8, 7, 3], 44)]
+
+    def drive(pages, host_pages):
+        b = _paged(tiny, paged_pages=pages, host_pages=host_pages)
+        rids = [b.submit(ids, max_new_tokens=n, response_format=rf)
+                for ids, n in reqs]
+        res = b.run()
+        b.assert_pool_consistent()
+        return b, [res[r] for r in rids]
+
+    ref_b, want = drive(16, 0)
+    assert ref_b.preemptions == 0
+    swaps0 = METRICS.get_counter("batcher.kv_swaps.out")
+    got_b, got = drive(9, 16)
+    assert got_b.preemptions >= 1  # pressure actually fired
+    assert METRICS.get_counter("batcher.kv_swaps.out") > swaps0
+    assert got == want  # byte-exact across preempt + swap restore
+    for out in got:
+        assert re.fullmatch("[0-9]{40,60}", text_of(got_b, out))
+
+
+def test_constrained_int8_kv_valid_and_deterministic(tiny):
+    rf = {"type": "regex", "regex": "[0-9]{2,12}"}
+
+    def drive():
+        b = _paged(tiny, kv_bits=8)
+        r = b.submit([7, 1, 9], max_new_tokens=14, response_format=rf)
+        out = b.run()[r]
+        b.assert_pool_consistent()
+        return b, out
+
+    b1, o1 = drive()
+    _, o2 = drive()
+    assert o1 == o2  # int8 pages: deterministic
+    assert re.fullmatch("[0-9]{2,12}", text_of(b1, o1))
+
+
+# -- serving: HTTP surface --------------------------------------------------
+
+
+async def _request(host, port, method, path, body=None):
+    reader, writer = await asyncio.open_connection(host, port)
+    payload = b"" if body is None else json.dumps(body).encode()
+    writer.write(
+        f"{method} {path} HTTP/1.1\r\nHost: t\r\n"
+        f"Content-Length: {len(payload)}\r\n\r\n".encode() + payload
+    )
+    await writer.drain()
+    status = int((await reader.readline()).split()[1])
+    while (await reader.readline()) not in (b"\r\n", b"\n", b""):
+        pass
+    data = await reader.read()
+    writer.close()
+    return status, data
+
+
+def test_server_constrained_end_to_end(tiny):
+    async def drive():
+        srv = InferenceServer(_paged(tiny, prefix_cache=True),
+                              host="127.0.0.1", port=0)
+        host, port = await srv.start()
+        try:
+            # Malformed schema: structured 400 BEFORE admission — no
+            # mailbox, no queue entry, and the engine still serves.
+            code, raw = await _request(
+                host, port, "POST", "/v1/completions",
+                {"prompt": "x", "max_tokens": 4, "response_format":
+                 {"type": "json_schema", "json_schema": {"schema": {
+                     "type": "object",
+                     "properties": {"a": {"type": "null"}},
+                     "required": []}}}},
+            )
+            assert code == 400
+            assert json.loads(raw)["error"]["type"] == \
+                "invalid_request_error"
+            assert srv._inflight() == 0 and not srv.batcher.has_queued()
+            code, raw = await _request(
+                host, port, "POST", "/v1/completions",
+                {"prompt": "x", "max_tokens": 4,
+                 "response_format": {"type": "regex", "regex": "["}},
+            )
+            assert code == 400
+
+            # A valid schema-constrained completion round-trips: the text
+            # parses and validates.  (A compact schema — the paged test
+            # engine's 64-token rows bound prompt + completion.)
+            small = {"type": "object",
+                     "properties": {"name": {"enum": ["get_weather",
+                                                      "get_time"]}},
+                     "required": ["name"]}
+            code, raw = await _request(
+                host, port, "POST", "/v1/completions",
+                {"prompt": "tool:", "max_tokens": 30,
+                 "response_format":
+                     {"type": "json_schema",
+                      "json_schema": {"schema": small}}},
+            )
+            assert code == 200, raw
+            body = json.loads(raw)
+            obj = json.loads(body["choices"][0]["text"])
+            assert C.validates(small, obj), obj
+
+            # n-best: K choices admit as K rows sharing the prompt's KV
+            # pages via the refcounted pool (prefix-cache retain path);
+            # greedy makes every choice identical, cached_tokens reports
+            # the reuse, and the pool audits clean afterwards.
+            prompt = "n" * 40  # 41 ids with BOS -> 2 full 16-token pages
+            code, raw = await _request(
+                host, port, "POST", "/v1/completions",
+                {"prompt": prompt, "max_tokens": 6, "n": 3},
+            )
+            assert code == 200, raw
+            body = json.loads(raw)
+            texts = [c["text"] for c in body["choices"]]
+            assert len(texts) == 3 and len(set(texts)) == 1
+            assert body["usage"]["completion_tokens"] > 0
+            assert body["usage"]["prompt_tokens_details"][
+                "cached_tokens"] >= 32
+            srv.batcher.assert_pool_consistent()
+        finally:
+            await srv.stop()
+
+    asyncio.run(drive())
+
+
+def test_server_constrained_kill_switch(tiny):
+    async def drive():
+        srv = InferenceServer(make(tiny), host="127.0.0.1", port=0,
+                              constrained=False)
+        host, port = await srv.start()
+        try:
+            code, raw = await _request(
+                host, port, "POST", "/v1/completions",
+                {"prompt": "x", "max_tokens": 4,
+                 "response_format": {"type": "regex", "regex": "[0-9]+"}},
+            )
+            assert code == 400
+            assert b"disabled" in raw
+            # logit_bias rides the same gate
+            code, _ = await _request(
+                host, port, "POST", "/v1/completions",
+                {"prompt": "x", "max_tokens": 4, "logit_bias": {"5": 1}},
+            )
+            assert code == 400
+        finally:
+            await srv.stop()
+
+    asyncio.run(drive())

@@ -85,6 +85,11 @@ def _result_cell(row: dict) -> str:
         ("per_chip_pool_kb", "per-chip pool KB"),
         ("tok_per_s_overlap_off", "tok/s overlap-off"),
         ("tok_per_s_overlap_on", "tok/s overlap-on"),
+        ("dfa_compile_ms", "DFA compile ms"),
+        ("tok_per_s_free", "free tok/s"),
+        ("tok_per_s_constrained", "constrained tok/s"),
+        ("mask_overhead_pct", "mask overhead %"),
+        ("parse_valid_frac", "parse-valid frac"),
         ("device_gap_ms_off", "device-gap ms off"),
         ("device_gap_ms_on", "device-gap ms on"),
         ("gap_reduction", "gap reduction x"),
@@ -95,6 +100,8 @@ def _result_cell(row: dict) -> str:
         ("decode_chunk_declared", "of declared"),
         ("decode_chunk_overlap_keys", "overlap decode compile keys"),
         ("decode_chunk_overlap_declared", "of declared"),
+        ("decode_chunk_constrained_keys", "constrained decode compile keys"),
+        ("decode_chunk_constrained_declared", "of declared"),
         ("generate_tokens_keys", "generate compile keys"),
         ("generate_tokens_declared", "of declared"),
         ("trace_wall_ms", "trace wall ms"),
@@ -133,8 +140,8 @@ def generate(ladder_path: str) -> str:
         # Aux rows run_ladder appends after the decode configs.
         "serving-latency", "continuous-batching", "local-proc-batching",
         "chunked-prefill", "prefix-cache-ttft", "fault-recovery",
-        "overload-goodput", "kv-tiering", "decode-overlap", "mesh-paged",
-        "replica-failover",
+        "overload-goodput", "kv-tiering", "decode-overlap",
+        "constrained-decode", "mesh-paged", "replica-failover",
         "disagg-handoff", "compile-stability", "analysis-wall",
         "ragged-decode-8k", "ragged-decode-win-8k", "quant-matmul-bw",
         "spec-decode", "spec-decode-7b-int8", "spec-batching",

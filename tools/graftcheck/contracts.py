@@ -31,6 +31,7 @@ P_QMM = "distributed_llms_tpu/ops/quant_matmul.py"
 P_MODEL = "distributed_llms_tpu/models/model.py"
 P_SPECS = "distributed_llms_tpu/parallel/specs.py"
 P_SAMPLING = "distributed_llms_tpu/runtime/sampling.py"
+P_CONSTRAIN = "distributed_llms_tpu/runtime/constrain.py"
 P_BATCHER = "distributed_llms_tpu/runtime/batcher.py"
 P_ENGINE = "distributed_llms_tpu/runtime/engine.py"
 
@@ -573,6 +574,31 @@ def _sampling_cases() -> list[OpCase]:
     return cases
 
 
+def _constrain_cases() -> list[OpCase]:
+    """Constraint mask ops (runtime/constrain.py): the per-row mask
+    gather returns [B, V] float32 and the DFA advance returns [B] int32,
+    over a (batch, states, vocab) sweep covering the byte-tokenizer and
+    real-checkpoint vocab scales plus 1-state bias-only automata."""
+    from distributed_llms_tpu.runtime import constrain
+
+    cases = []
+    for b, s, v in [(1, 1, 259), (4, 33, 512), (8, 300, 32000)]:
+        cases.append(OpCase(
+            label=f"gather_bias b{b} s{s} v{v}",
+            fn=constrain.gather_bias,
+            args=(sds((s, v), jnp.float32), sds((b,), jnp.int32)),
+            want=(((b, v), "float32"),),
+        ))
+        cases.append(OpCase(
+            label=f"advance_states b{b} s{s} v{v}",
+            fn=constrain.advance_states,
+            args=(sds((s, v), jnp.int32), sds((b,), jnp.int32),
+                  sds((b,), jnp.int32)),
+            want=(((b,), "int32"),),
+        ))
+    return cases
+
+
 def op_contracts() -> list[OpContract]:
     return [
         OpContract("ops.flash.flash_attention", P_FLASH,
@@ -610,6 +636,10 @@ def op_contracts() -> list[OpContract]:
         OpContract("runtime.sampling", P_SAMPLING,
                    "samplers return [B] int32 for static and per-row paths",
                    _sampling_cases),
+        OpContract("runtime.constrain.mask_ops", P_CONSTRAIN,
+                   "mask gather [B,V] f32 + DFA advance [B] i32 over a "
+                   "batch/state/vocab sweep",
+                   _constrain_cases),
         OpContract("batcher.kv_page_transfer", P_BATCHER,
                    "handoff export/import: pool shape+dtype round-trip, "
                    "payload cast to pool dtype",
@@ -1017,6 +1047,46 @@ def recompile_scenarios() -> list[RecompileScenario]:
         allowed_widths=(s_cap,),
         max_keys=1,
         trace=decode_overlap_trace,
+    ))
+
+    # -- constrained decode: mixed constrained+free rows (the token-mask
+    # stack + per-row automaton states + per-row sampling engaged, as
+    # runtime/batcher._span_plan builds it) must still be ONE compiled
+    # program across every resident depth — the mask is a traced gather,
+    # the DFA advance a traced scatter-free lookup, and the state carry
+    # chains device-resident through dispatch-ahead chunks.
+    def decode_constrained_trace(width: int) -> str:
+        from distributed_llms_tpu.runtime import batcher as batcher_lib
+
+        b, n_states = 4, 32
+        params = abstract_params(cfg)
+        cache = abstract_cache(cfg, b, s_cap)
+        return jaxpr_hash(
+            lambda p, c, lt, rl, va, ac, bu, rng, tr, ms, ns, ds:
+                batcher_lib.decode_chunk(
+                    p, cfg, c, lt, rl, va, ac, bu, rng, chunk_steps=8,
+                    temp_row=tr, mask_stack=ms, next_stack=ns,
+                    dfa_state=ds),
+            params, cache, sds((b,), jnp.int32), sds((b,), jnp.int32),
+            sds((b, s_cap), jnp.bool_), sds((b,), jnp.bool_),
+            sds((b,), jnp.int32), key_sds(),
+            sds((b,), jnp.float32),
+            sds((n_states, cfg.vocab_size), jnp.float32),
+            sds((n_states, cfg.vocab_size), jnp.int32),
+            sds((b,), jnp.int32),
+            statics={"cfg": cfg, "chunk_steps": 8},
+        )
+
+    out.append(RecompileScenario(
+        name="batcher.decode_chunk_constrained", path=P_BATCHER,
+        doc="mixed constrained+free decode (token-mask stack, per-row "
+            "DFA states, per-row sampling engaged) stays ONE program "
+            "across every resident depth",
+        ladder=_GC4_LADDER,
+        width_of=lambda n: s_cap,
+        allowed_widths=(s_cap,),
+        max_keys=1,
+        trace=decode_constrained_trace,
     ))
 
     # -- whole-batch generate: the engine pads T up the ladder under the
