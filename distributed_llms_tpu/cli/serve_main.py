@@ -38,6 +38,8 @@ _RUNTIME_FLAGS: dict[str, str] = {
     "kv-bits": "kv_bits",
     "host-pages": "host_pages",
     "overlap": "overlap",
+    "schedule": "schedule",
+    "token-budget": "token_budget",
     "request-timeout": "request_timeout_s",
     "shed-cost-factor": "shed_cost_factor",
     "constrained": "constrained_decoding",
@@ -119,6 +121,8 @@ def _server_factory(args, engine, default_name, rt, faults, *,
             host_pages=args.host_pages,
             overlap=(None if args.overlap is None
                      else args.overlap == "on"),
+            schedule=args.schedule,
+            token_budget=args.token_budget,
             faults=faults,
         )
 
@@ -370,6 +374,22 @@ def main(argv=None) -> None:
                          "identical on or off; gauges under "
                          "batcher_overlap_* on /metrics (default: "
                          "runtime.overlap, on)")
+    ap.add_argument("--schedule", choices=["mixed", "alternate"],
+                    default=None,
+                    help="scheduling policy (runtime/scheduler.py): "
+                         "'mixed' fuses pending prefill-chunk bites into "
+                         "the decode step as one token-budget program so "
+                         "decode rows never stall for a serialized "
+                         "prefill forward; 'alternate' keeps the classic "
+                         "serialized rounds.  Temp-0 bytes identical "
+                         "either way (default: runtime.schedule, mixed)")
+    ap.add_argument("--token-budget", type=int, default=None,
+                    help="per-step token budget of the mixed schedule: "
+                         "each fused step runs one decode leg per active "
+                         "slot plus up to budget - n_active prefill "
+                         "tokens; prompts longer than the budget "
+                         "auto-chunk.  0 = prefill-chunk-sized bites "
+                         "(default: runtime.token_budget)")
     ap.add_argument("--prefill-chunk", type=int, default=None,
                     help="chunked prefill: admit at most this many prompt "
                          "tokens per scheduling round per pending prefill, "

@@ -262,6 +262,22 @@ class RuntimeConfig:
     # runs against synced host mirrors).  Off = the fully-synchronous
     # loop, one host round-trip per chunk.
     overlap: bool = True
+    # Scheduling policy (runtime/scheduler.py): "mixed" (default) fuses
+    # pending prefill-chunk bites into the decode step as one compiled
+    # token-budget program, so resident decode rows never stall for a
+    # serialized prefill forward and the dispatch-ahead span keeps
+    # running while a long prompt admits; "alternate" keeps the classic
+    # serialized prefill_chunk_step rounds.  Temp-0 token streams are
+    # byte-identical either way — this is a latency knob, not a
+    # semantics knob.
+    schedule: str = "mixed"
+    # Per-step token budget the mixed policy sizes prefill bites
+    # against: each fused step runs one decode leg per active slot plus
+    # up to token_budget - n_active prompt tokens of the head pending
+    # prefill.  Set, it also auto-chunks any prompt longer than the
+    # budget even when prefill_chunk was never configured.  None/0 =
+    # prefill_chunk-sized bites (fusion without re-budgeting).
+    token_budget: int | None = None
     # Speculative decoding (runtime/speculative.py).  With spec_decode=True
     # on a single-device full-precision engine, generate_text transparently
     # routes greedy requests through the speculative loop (results are

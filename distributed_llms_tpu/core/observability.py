@@ -201,6 +201,23 @@ METRIC_DOCS: dict[str, str] = {
     "batcher.preemptions_total": "rows preempted for KV pool pressure",
     "batcher.pages_grown": "KV pages allocated by on-demand row growth",
     "batcher.prefill_chunks": "chunked-prefill bites consumed",
+    "batcher.sched.budget_tokens": "per-step token budget available to "
+        "fused mixed-schedule dispatches (cumulative; runtime/"
+        "scheduler.py)",
+    "batcher.sched.prefill_tokens": "prompt tokens consumed by prefill "
+        "bites, fused (mixed) and serialized (alternate) alike",
+    "batcher.sched.decode_tokens": "decode-token legs dispatched "
+        "(span-start live rows x chunk_steps per plain decode/mixed "
+        "step — an upper bound on committed tokens: rows finishing "
+        "mid-span still occupy their legs until the carry sync)",
+    "batcher.sched.stall_rounds": "serialized prefill bites that ran "
+        "while decode rows were live — the alternating schedule's "
+        "latency spike; the mixed schedule keeps this at zero",
+    "batcher.sched.budget_utilization": "per-step token budget fill of "
+        "the latest fused dispatch (gauge: (n_active + bite) / "
+        "token_budget; exceeds 1.0 when the active decode legs alone "
+        "over-subscribe the budget — the floor-1 bite keeps the prefill "
+        "progressing)",
     "batcher.prefix_cache.lookups": "automatic prefix-cache lookups",
     "batcher.prefix_cache.hits": "lookups that matched >= 1 cached page",
     "batcher.prefix_cache.hit_tokens": "prompt tokens served from cache",

@@ -59,6 +59,15 @@ Invariant pinned by tests/runtime/test_batcher.py: at temperature 0 every
 request's tokens are IDENTICAL to running runtime.generate.generate_tokens
 on that request alone — continuous batching changes scheduling, never
 results.
+
+Scheduling POLICY lives in runtime/scheduler.py (admission order, prefill
+chunk sizing against the token budget, victim selection, the pressure
+ladder, the overlap sync-trigger list — declared hooks the run loop
+delegates through ``self.sched``); this module keeps the MECHANISM.  The
+default ``schedule="mixed"`` policy runs chunked-prefill bites INSIDE the
+decode dispatch (:func:`mixed_step` — one fused token-budget program), so
+resident decode rows never stall for a serialized prefill forward; the
+host-RAM KV tier lives in runtime/kv_tier.py (re-exported here).
 """
 
 from __future__ import annotations
@@ -81,6 +90,10 @@ from ..models import model as model_lib
 from ..models.model import KVCache, QuantKVCache
 from . import constrain as constrain_lib
 from . import sampling
+from . import scheduler as scheduler_lib
+# Re-export: the host-RAM KV tier lives in kv_tier.py since round 16.
+from .kv_tier import HostTier
+from .scheduler import make_scheduler
 from .shapes import bucket_length as _bucket
 
 log = get_logger("batcher")
@@ -600,6 +613,15 @@ def prefill_chunk_step(
     Returns (row_k', row_v', last_logits [1, V] at the chunk's last real
     position — the sampling source once the prompt completes; replicated
     on a mesh batcher so the finishing sample runs lockstep)."""
+    return _prefill_leg(params, cfg, row_k, row_v, done, chunk, clen, pm)
+
+
+def _prefill_leg(params, cfg, row_k, row_v, done, chunk, clen, pm):
+    """The one prefill-bite definition, shared VERBATIM by the
+    serialized :func:`prefill_chunk_step` and the fused
+    :func:`mixed_step` — like `_decode_steps` for the decode leg, a
+    single definition is what keeps the two schedules trivially
+    byte-identical."""
     logits, row_cache = _prefill_row_with_prefix(
         _fwd(pm), params, cfg, row_k, row_v, done, chunk
     )
@@ -1019,76 +1041,16 @@ def admit_row_auto_paged(
     )
 
 
-@partial(
-    jax.jit,
-    static_argnames=(
-        "cfg", "chunk_steps", "temperature", "top_k", "top_p", "eos_id",
-        "pad_id", "pm",
-    ),
-    donate_argnames=("cache",),
-)
-def decode_chunk(
-    params: Any,
-    cfg: ModelConfig,
-    cache: Any,  # shared KVCache
-    last_tok: jax.Array,  # [B] int32 — each row's most recent token
-    real_lens: jax.Array,  # [B] int32 — tokens resident per row (write pos)
-    valid: jax.Array,  # [B, S] bool — per-row valid cache slots
-    active: jax.Array,  # [B] bool
-    budget: jax.Array,  # [B] int32 — tokens this row may still emit
-    rng: jax.Array,
-    chunk_steps: int,
-    temperature: float = 0.0,
-    top_k: int = 0,
-    top_p: float = 1.0,
-    eos_id: int = -1,
-    pad_id: int = 0,
-    pm: Any = None,  # ParallelModel — GSPMD dp/tp mesh batching
-    tables: jax.Array | None = None,  # [B, P] page table — cache is a pool
-    temp_row: jax.Array | None = None,  # [B] traced per-row temperature
-    topp_row: jax.Array | None = None,  # [B] traced per-row top-p
-    topk_row: jax.Array | None = None,  # [B] traced per-row top-k
-    counts: jax.Array | None = None,  # [B, V] int32 output-token histogram
-    pres_row: jax.Array | None = None,  # [B] traced presence penalties
-    freq_row: jax.Array | None = None,  # [B] traced frequency penalties
-    mask_stack: jax.Array | None = None,  # [S, V] f32 per-state token mask
-    #   (runtime/constrain.py build_stack: state 0 free, grammar automata
-    #   stacked behind it, state axis padded up a closed bucket ladder)
-    next_stack: jax.Array | None = None,  # [S, V] int32 DFA transitions
-    dfa_state: jax.Array | None = None,   # [B] int32 per-row automaton
-    #   state (0 = free) — part of the device-resident decode carry
-) -> tuple[jax.Array, Any, jax.Array, jax.Array, jax.Array, jax.Array,
-           jax.Array, jax.Array, jax.Array | None, jax.Array | None]:
-    """K decode steps with per-row positions.  Returns
-    (toks [B, K], cache', last_tok', real_lens', valid', active', budget',
-    logprobs [B, K], counts', dfa_state').  ``temp_row``/``topp_row``/``topk_row``
-    switch sampling to the per-row path (sampling.sample_rows) —
-    per-request sampling in one shared batch.  ``counts``+``pres_row``+``freq_row`` engage OpenAI
-    presence/frequency penalties: logits adjust by
-    ``- freq*count - pres*(count > 0)`` per row BEFORE sampling, and the
-    histogram tracks every emitted token (rows with zero penalties read
-    garbage counts harmlessly — the adjustment multiplies to zero).
-    ``mask_stack``+``next_stack``+``dfa_state`` engage grammar-constrained
-    structured output (runtime/constrain.py): each row gathers its
-    state's token mask, adds it to the sampling logits (after penalties —
-    the mask dominates any finite adjustment), and advances its automaton
-    state on the sampled token INSIDE this jitted program, so the state
-    carry stays device-resident across dispatch-ahead chunks and
-    constrained and free rows share one compiled decode step (graftcheck
-    GC4 batcher.decode_chunk_constrained).  Free rows ride state 0, whose
-    mask row is all zeros — their sampled bytes are untouched.
-    Logprobs stay RAW-distribution (pre-penalty, pre-mask), matching the
-    logprobs contract elsewhere.
-
-    Chaining contract (the dispatch-ahead engine loop): every returned
-    carry leaf (cache', last_tok', real_lens', valid', active', budget',
-    counts') is a legal INPUT for the next call — same shapes, same
-    dtypes, device-resident — so chunk N+1 can dispatch directly from
-    chunk N's outputs with no host round-trip, hitting the same compiled
-    program host-mirror inputs would (graftcheck GC4's
-    batcher.decode_chunk_overlap case pins this to one compile key).
-    Only ``cache`` is donated; the small carry vectors are read-only
-    inputs and safe to hold across the chained dispatch."""
+def _decode_steps(
+    params, cfg, cache, last_tok, real_lens, valid, active, budget, rng,
+    chunk_steps, temperature, top_k, top_p, eos_id, pad_id, pm, tables,
+    temp_row, topp_row, topk_row, counts, pres_row, freq_row, mask_stack,
+    next_stack, dfa_state,
+):
+    """The K-step decode scan shared VERBATIM by :func:`decode_chunk` and
+    the fused :func:`mixed_step` — one definition of the decode leg is
+    what keeps ``schedule=mixed`` trivially byte-identical to the
+    alternating loop's decode math."""
     if tables is None:
         s = cache.k.shape[-3]
         slots = jnp.arange(s, dtype=jnp.int32)
@@ -1204,6 +1166,159 @@ def decode_chunk(
         cache = _pool_constrain(pm, cache)
     return (toks, cache, last_tok, real_lens, valid, active, budget, lps,
             counts, dfa_state)
+
+
+@partial(
+    jax.jit,
+    static_argnames=(
+        "cfg", "chunk_steps", "temperature", "top_k", "top_p", "eos_id",
+        "pad_id", "pm",
+    ),
+    donate_argnames=("cache",),
+)
+def decode_chunk(
+    params: Any,
+    cfg: ModelConfig,
+    cache: Any,  # shared KVCache
+    last_tok: jax.Array,  # [B] int32 — each row's most recent token
+    real_lens: jax.Array,  # [B] int32 — tokens resident per row (write pos)
+    valid: jax.Array,  # [B, S] bool — per-row valid cache slots
+    active: jax.Array,  # [B] bool
+    budget: jax.Array,  # [B] int32 — tokens this row may still emit
+    rng: jax.Array,
+    chunk_steps: int,
+    temperature: float = 0.0,
+    top_k: int = 0,
+    top_p: float = 1.0,
+    eos_id: int = -1,
+    pad_id: int = 0,
+    pm: Any = None,  # ParallelModel — GSPMD dp/tp mesh batching
+    tables: jax.Array | None = None,  # [B, P] page table — cache is a pool
+    temp_row: jax.Array | None = None,  # [B] traced per-row temperature
+    topp_row: jax.Array | None = None,  # [B] traced per-row top-p
+    topk_row: jax.Array | None = None,  # [B] traced per-row top-k
+    counts: jax.Array | None = None,  # [B, V] int32 output-token histogram
+    pres_row: jax.Array | None = None,  # [B] traced presence penalties
+    freq_row: jax.Array | None = None,  # [B] traced frequency penalties
+    mask_stack: jax.Array | None = None,  # [S, V] f32 per-state token mask
+    #   (runtime/constrain.py build_stack: state 0 free, grammar automata
+    #   stacked behind it, state axis padded up a closed bucket ladder)
+    next_stack: jax.Array | None = None,  # [S, V] int32 DFA transitions
+    dfa_state: jax.Array | None = None,   # [B] int32 per-row automaton
+    #   state (0 = free) — part of the device-resident decode carry
+) -> tuple[jax.Array, Any, jax.Array, jax.Array, jax.Array, jax.Array,
+           jax.Array, jax.Array, jax.Array | None, jax.Array | None]:
+    """K decode steps with per-row positions.  Returns
+    (toks [B, K], cache', last_tok', real_lens', valid', active', budget',
+    logprobs [B, K], counts', dfa_state').  ``temp_row``/``topp_row``/``topk_row``
+    switch sampling to the per-row path (sampling.sample_rows) —
+    per-request sampling in one shared batch.  ``counts``+``pres_row``+``freq_row`` engage OpenAI
+    presence/frequency penalties: logits adjust by
+    ``- freq*count - pres*(count > 0)`` per row BEFORE sampling, and the
+    histogram tracks every emitted token (rows with zero penalties read
+    garbage counts harmlessly — the adjustment multiplies to zero).
+    ``mask_stack``+``next_stack``+``dfa_state`` engage grammar-constrained
+    structured output (runtime/constrain.py): each row gathers its
+    state's token mask, adds it to the sampling logits (after penalties —
+    the mask dominates any finite adjustment), and advances its automaton
+    state on the sampled token INSIDE this jitted program, so the state
+    carry stays device-resident across dispatch-ahead chunks and
+    constrained and free rows share one compiled decode step (graftcheck
+    GC4 batcher.decode_chunk_constrained).  Free rows ride state 0, whose
+    mask row is all zeros — their sampled bytes are untouched.
+    Logprobs stay RAW-distribution (pre-penalty, pre-mask), matching the
+    logprobs contract elsewhere.
+
+    Chaining contract (the dispatch-ahead engine loop): every returned
+    carry leaf (cache', last_tok', real_lens', valid', active', budget',
+    counts') is a legal INPUT for the next call — same shapes, same
+    dtypes, device-resident — so chunk N+1 can dispatch directly from
+    chunk N's outputs with no host round-trip, hitting the same compiled
+    program host-mirror inputs would (graftcheck GC4's
+    batcher.decode_chunk_overlap case pins this to one compile key).
+    Only ``cache`` is donated; the small carry vectors are read-only
+    inputs and safe to hold across the chained dispatch."""
+    return _decode_steps(
+        params, cfg, cache, last_tok, real_lens, valid, active, budget,
+        rng, chunk_steps, temperature, top_k, top_p, eos_id, pad_id, pm,
+        tables, temp_row, topp_row, topk_row, counts, pres_row, freq_row,
+        mask_stack, next_stack, dfa_state,
+    )
+
+
+@partial(
+    jax.jit,
+    static_argnames=(
+        "cfg", "pcfg", "chunk_steps", "temperature", "top_k", "top_p",
+        "eos_id", "pad_id", "pm",
+    ),
+    donate_argnames=("cache", "row_k", "row_v"),
+)
+def mixed_step(
+    params: Any,
+    cfg: ModelConfig,   # decode-leg config (ragged decode where enabled)
+    pcfg: ModelConfig,  # prefill-leg config (the plain forward)
+    cache: Any,
+    last_tok: jax.Array,
+    real_lens: jax.Array,
+    valid: jax.Array,
+    active: jax.Array,
+    budget: jax.Array,
+    rng: jax.Array,
+    chunk_steps: int,
+    row_k: jax.Array,   # [..., 1, S, KVH, HD] the head pending prefill's
+    row_v: jax.Array,   # transient row (DONATED — updated in place)
+    done: jax.Array,    # scalar int32 — prompt tokens already in the row
+    pchunk: jax.Array,  # [Tw] int32 — the bite, right-padded to the
+    #   policy's FIXED bucket width (the compile key stays independent of
+    #   the live prefill mix — graftcheck GC4 batcher.mixed_step)
+    pclen: jax.Array,   # scalar int32 true bite length
+    temperature: float = 0.0,
+    top_k: int = 0,
+    top_p: float = 1.0,
+    eos_id: int = -1,
+    pad_id: int = 0,
+    pm: Any = None,
+    tables: jax.Array | None = None,
+    temp_row: jax.Array | None = None,
+    topp_row: jax.Array | None = None,
+    topk_row: jax.Array | None = None,
+    counts: jax.Array | None = None,
+    pres_row: jax.Array | None = None,
+    freq_row: jax.Array | None = None,
+    mask_stack: jax.Array | None = None,
+    next_stack: jax.Array | None = None,
+    dfa_state: jax.Array | None = None,
+) -> tuple:
+    """ONE fused token-budget step (``schedule=mixed``): the K-step decode
+    scan for every active slot AND one prefill bite of the head pending
+    chunked prefill, in the same compiled program — so resident decode
+    rows never wait on a separately-dispatched serialized prefill forward
+    (the Sarathi-Serve coalescing at Orca's iteration granularity).  The
+    prefill leg is :func:`prefill_chunk_step`'s exact math (the segment
+    enters variable-length, right-padded up the shared bucket ladder;
+    continuation masking keeps pad columns unattended) against the
+    prefill's own transient row cache; the decode leg is
+    :func:`_decode_steps` verbatim — the legs touch disjoint buffers
+    (transient row vs shared pool/cache), so fusion changes dispatch
+    count, never bytes, and temp-0 streams are identical to the
+    alternating loop.
+
+    Returns :func:`decode_chunk`'s 10-tuple extended with
+    ``(row_k', row_v', last_logits [1, V])`` — every leaf is a legal
+    input for the next fused call (the dispatch-ahead chaining contract:
+    the decode carry AND the prefill row both stay device-resident across
+    a span)."""
+    prow_k, prow_v, plast = _prefill_leg(
+        params, pcfg, row_k, row_v, done, pchunk, pclen, pm
+    )
+    out = _decode_steps(
+        params, cfg, cache, last_tok, real_lens, valid, active, budget,
+        rng, chunk_steps, temperature, top_k, top_p, eos_id, pad_id, pm,
+        tables, temp_row, topp_row, topk_row, counts, pres_row, freq_row,
+        mask_stack, next_stack, dfa_state,
+    )
+    return (*out, prow_k, prow_v, plast)
 
 
 def _writable(a: np.ndarray) -> np.ndarray:
@@ -1376,265 +1491,6 @@ class PrefixCache:
                 "batcher.prefix_cache.hit_rate", self.hit_tokens / total
             )
 
-
-@dataclass
-class _HostEntry:
-    """One host-tier parcel: ``future`` resolves (on the tier's worker
-    thread) to ``(arrays, checksum)`` — an INDEPENDENT host-numpy copy of
-    a raw page export plus its blake2b checksum.  Swap parcels hold a
-    whole row (``index`` None); a spill entry holds exactly one page
-    (``index`` records which slice of the gathered stack it copied out —
-    every entry owns its own bytes, so eviction frees them)."""
-
-    n_pages: int
-    future: Any
-    index: int | None = None
-
-
-class HostTier:
-    """Host-RAM KV page tier behind the :class:`PagePool` (``--host-pages``).
-
-    Two kinds of parcels, one page budget:
-
-    - **swap parcels**: a preempted row's pages, raw pool bytes, keyed by
-      an opaque handle carried on the requeued request — restore scatters
-      them back instead of recomputing the prefix;
-    - **spilled pages**: cold prefix-cache pages captured just before LRU
-      eviction, keyed by content digest — a later cache hit restores them
-      instead of re-prefilling.
-
-    Swaps outrank spills: parking a swap may evict spilled pages (they are
-    only a cache), never the other way.  Device-to-host copies and
-    checksumming run on a single worker thread (``park_*`` merely submits
-    the already-dispatched device gather), so the engine loop never blocks
-    on a D2H transfer at preemption time; ``take_*`` joins the future and
-    VERIFIES the checksum — a corrupted parcel degrades to exact recompute
-    / cold prefill rather than poisoning the cache.
-
-    Thread contract: park/take/drop run under ``_lock`` (engine thread,
-    plus the serving thread's cancel path); the worker thread touches only
-    its own future's payload."""
-
-    def __init__(self, pages: int) -> None:
-        if pages < 1:
-            raise ValueError(f"host tier needs >= 1 page, got {pages}")
-        self.pages = pages
-        self._lock = threading.Lock()
-        # graftflow: cleanup-required
-        self._swaps: dict[int, _HostEntry] = {}  # guarded-by: self._lock
-        self._spills: OrderedDict[bytes, _HostEntry] = OrderedDict()  # guarded-by: self._lock
-        self.used = 0  # guarded-by: self._lock
-        self._next_handle = 0  # guarded-by: self._lock
-        self._workers = None  # lazy single-thread executor
-
-    # graftlint: holds(self._lock)
-    def _executor(self):
-        if self._workers is None:
-            import concurrent.futures
-
-            self._workers = concurrent.futures.ThreadPoolExecutor(
-                max_workers=1, thread_name_prefix="kv-host-tier"
-            )
-        return self._workers
-
-    @staticmethod
-    def _checksum(arrays) -> bytes:
-        h = hashlib.blake2b(digest_size=16)
-        for a in arrays:
-            h.update(np.ascontiguousarray(a).tobytes())
-        return h.digest()
-
-    @staticmethod
-    def _flip_byte(arrays) -> tuple:
-        """Corrupt a parcel in host storage (the ``corrupt`` fault drill):
-        flip the first byte of the first array — checksum verification at
-        take time must catch it."""
-        raw = bytearray(np.ascontiguousarray(arrays[0]).tobytes())
-        raw[0] ^= 0xFF
-        bad = np.frombuffer(bytes(raw), dtype=arrays[0].dtype).reshape(
-            arrays[0].shape
-        )
-        return (bad,) + tuple(arrays[1:])
-
-    @classmethod
-    def _to_host(cls, payload, corrupt: bool):
-        """WORKER THREAD: device arrays -> host numpy + checksum.  The
-        np.asarray calls are the actual D2H transfers."""
-        arrays = tuple(np.asarray(a) for a in payload)
-        checksum = cls._checksum(arrays)
-        if corrupt:
-            arrays = cls._flip_byte(arrays)
-        return arrays, checksum
-
-    @classmethod
-    def _to_host_page(cls, payload, i: int, corrupt: bool):
-        """WORKER THREAD: spill variant — ONE page's slices copied out
-        independently (np.ascontiguousarray detaches from the stacked
-        gather), so each spill entry owns exactly its own bytes: evicting
-        it frees them, and the `pages` budget really bounds host RAM."""
-        arrays = tuple(
-            np.ascontiguousarray(np.asarray(a[:, i])) for a in payload
-        )
-        checksum = cls._checksum(arrays)
-        if corrupt:
-            arrays = cls._flip_byte(arrays)
-        return arrays, checksum
-
-    # graftlint: holds(self._lock)
-    def _fit_locked(self, n: int) -> bool:
-        """Make room for ``n`` pages, evicting spilled pages (oldest
-        first) if needed — spills are only a cache.  Swap parcels are
-        never evicted: their content is the ONLY copy of a live request's
-        KV."""
-        while self.pages - self.used < n and self._spills:
-            self._spills.popitem(last=False)
-            self.used -= 1
-            METRICS.inc("batcher.host_tier.spill_evictions")
-        return self.pages - self.used >= n
-
-    def can_fit(self, n: int) -> bool:
-        """Whether ``n`` pages could be parked right now (spills count as
-        evictable).  Engine-thread advisory — the authoritative check is
-        park's own."""
-        with self._lock:
-            return self.pages - self.used + len(self._spills) >= n
-
-    def park_swap(self, payload, n_pages: int,
-                  corrupt: bool = False) -> int | None:
-        """Park a preempted row's raw page export; returns the handle the
-        resume request carries, or None when the budget cannot fit it
-        (the caller falls back to exact recompute)."""
-        with self._lock:
-            if not self._fit_locked(n_pages):
-                return None
-            fut = self._executor().submit(self._to_host, payload, corrupt)
-            handle = self._next_handle
-            self._next_handle += 1
-            self.used += n_pages
-            self._swaps[handle] = _HostEntry(n_pages, fut)
-        return handle
-
-    def take_swap(self, handle: int, corrupt: bool = False):
-        """Resolve and REMOVE a swap parcel: returns the raw page arrays,
-        or None when the handle is unknown or the checksum fails (the
-        caller falls back to exact recompute either way).  Budget is
-        released even on verification failure — the parcel is gone."""
-        with self._lock:
-            entry = self._swaps.pop(handle, None)
-            if entry is None:
-                return None
-            self.used -= entry.n_pages
-        try:
-            arrays, checksum = entry.future.result()
-        except Exception:
-            # A failed D2H (host OOM, device error surfacing on the copy)
-            # must degrade to exact recompute, not crash the engine —
-            # the same contract as a checksum mismatch.
-            log.exception("host-tier swap parcel %d copy failed", handle)
-            return None
-        if corrupt:
-            arrays = self._flip_byte(arrays)
-        if self._checksum(arrays) != checksum:
-            log.warning("host-tier swap parcel %d failed verification", handle)
-            return None
-        return arrays
-
-    def drop_swap(self, handle: int) -> None:
-        """Free a swap parcel whose request will never resume (cancelled
-        or shed while queued)."""
-        with self._lock:
-            entry = self._swaps.pop(handle, None)
-            if entry is not None:
-                self.used -= entry.n_pages
-
-    def park_spill(self, digests: list[bytes], payload,
-                   corrupt: bool = False) -> int:
-        """Park soon-to-be-evicted cached pages (stacked raw export, one
-        digest per page).  Best-effort: parks the prefix that fits after
-        evicting older spills; returns how many pages were parked.  Each
-        page gets its OWN worker task and host copy (never a shared
-        stack), so the budget bounds actual host bytes: evicting an
-        entry frees its pages."""
-        with self._lock:
-            room = 0
-            for _ in digests:
-                if not self._fit_locked(1):
-                    break
-                self.used += 1
-                room += 1
-            for i, d in enumerate(digests[:room]):
-                fut = self._executor().submit(
-                    self._to_host_page, payload, i, corrupt and i == 0
-                )
-                # Re-spilling content already parked would double-count
-                # its budget page: drop the stale entry (its budget page
-                # transfers to the fresh one reserved above).
-                if d in self._spills:
-                    self._spills.pop(d)
-                    self.used -= 1
-                self._spills[d] = _HostEntry(1, fut, index=i)
-        return room
-
-    def has_spill(self, digest: bytes) -> bool:
-        with self._lock:
-            return digest in self._spills
-
-    def take_spill(self, digest: bytes):
-        """Resolve and REMOVE one spilled page: returns its raw arrays
-        ([L, BLK, ...] slices), or None when absent or corrupted (the
-        caller prefillls cold — correct, just slower)."""
-        with self._lock:
-            entry = self._spills.pop(digest, None)
-            if entry is None:
-                return None
-            self.used -= 1
-        try:
-            page, checksum = entry.future.result()
-        except Exception:
-            log.exception("host-tier spilled page copy failed")
-            return None
-        if self._checksum(page) != checksum:
-            log.warning("host-tier spilled page failed verification")
-            return None
-        return page
-
-    def stats(self) -> dict[str, int]:
-        # Key names become batcher.host_tier.* GAUGES on /metrics
-        # (publish_gauges): none may collide with a same-named counter —
-        # "spill_entries" here vs the "spilled_pages" cumulative counter,
-        # or the exposition renders one series under two TYPEs and the
-        # whole scrape fails to parse.
-        with self._lock:
-            return {
-                "pages": self.pages,
-                "used": self.used,
-                "swap_parcels": len(self._swaps),
-                "spill_entries": len(self._spills),
-            }
-
-    def assert_consistent(self, swap_handles=()) -> None:
-        """Audit the tier: budget accounting must equal the parcels held,
-        and every parked swap handle must be owned by exactly one queued
-        resume request (``swap_handles``) — a handle nobody will ever
-        restore or free is a host-RAM leak, the tier's analogue of the
-        pool's dangling refcount."""
-        with self._lock:
-            swaps = {h: e.n_pages for h, e in self._swaps.items()}
-            spills = len(self._spills)
-            used = self.used
-        expect = set(swap_handles)
-        held = set(swaps)
-        assert used == sum(swaps.values()) + spills, (
-            f"host tier budget diverged: used={used}, swaps={swaps}, "
-            f"spilled={spills}"
-        )
-        assert used <= self.pages, (
-            f"host tier over budget: {used} > {self.pages}"
-        )
-        assert held == expect, (
-            f"host-tier swap handles diverge from queued resume requests: "
-            f"parked={sorted(held)} expected={sorted(expect)}"
-        )
 
 
 class PagePool:
@@ -2017,6 +1873,21 @@ class ContinuousBatcher:
         # reads identical mirrors on every process and the lockstep
         # contract holds with the overlap on.
         overlap: bool = True,
+        # Scheduling policy (runtime/scheduler.py): "mixed" (default)
+        # fuses pending prefill-chunk bites into the decode step as one
+        # compiled token-budget program (decode rows never stall for a
+        # serialized prefill forward, and a pending prefill no longer
+        # parks the dispatch-ahead span); "alternate" keeps the classic
+        # serialized prefill_chunk_step rounds.  Temp-0 bytes identical
+        # either way (tests/runtime/test_mixed_step.py).
+        schedule: str = "mixed",
+        # Per-step token budget the mixed policy sizes prefill bites
+        # against: each fused step runs one decode leg per active slot
+        # plus up to token_budget - n_active prompt tokens.  None = bites
+        # stay prefill_chunk-sized (fusion without re-budgeting); set, it
+        # also auto-chunks any prompt longer than the budget even when
+        # prefill_chunk was never configured.
+        token_budget: int | None = None,
     ) -> None:
         # Snapshot the constructor arguments FIRST (before any local
         # variables or normalization appear) so respawn() can rebuild an
@@ -2159,6 +2030,15 @@ class ContinuousBatcher:
         # never wall clocks).  No degrade needed.
         self.prefill_chunk = prefill_chunk
         self.prefill_concurrency = prefill_concurrency
+        # THE scheduling policy (runtime/scheduler.py): every decision the
+        # run loop takes — admission order, chunk sizing against the token
+        # budget, victim selection, the pressure ladder, the overlap
+        # sync-trigger list — delegates to this object's declared hooks.
+        self.sched = make_scheduler(
+            schedule, chunk_steps=chunk_steps, prefill_chunk=prefill_chunk,
+            prefill_concurrency=prefill_concurrency,
+            token_budget=token_budget, speculative=self.speculative,
+        )
         self._prefills: dict[int, _PendingPrefill] = {}  # slot -> pending
         self.draft_params = draft_params
         self.draft_cfg = draft_cfg
@@ -2918,16 +2798,11 @@ class ContinuousBatcher:
         return None
 
     def _next_request(self) -> "_Request | None":
-        """Admission order: highest priority first, FIFO (rid) within a
-        priority.  A preempted request keeps its original rid, so it
-        resumes ahead of later same-priority arrivals.  Deterministic in
-        the queue contents alone, so multi-process meshes stay lockstep.
-        The serving loop thread appends concurrently — the scan holds the
-        submission lock.  Returns None on an empty queue."""
+        """Admission order — the scheduler's ``admission_order`` hook,
+        consulted under the submission lock (the serving loop thread
+        appends concurrently).  Returns None on an empty queue."""
         with self._lock:
-            if not self.queue:
-                return None
-            return max(self.queue, key=lambda r: (r.priority, -r.rid))
+            return self.sched.admission_order(self.queue)
 
     def _unqueue(self, req: "_Request") -> None:
         """Remove an admitted request from the queue (identity compare —
@@ -2984,31 +2859,20 @@ class ContinuousBatcher:
     # -- overload plane: preemption + on-demand growth (paged mode) --------
 
     def _pick_victim(self, below_priority: int | None = None) -> int | None:
-        """The row to preempt under pool pressure: lowest priority first,
-        most-recently-admitted among equals (its lost work is smallest —
-        vLLM's recompute-preemption policy).  ``below_priority`` restricts
-        to STRICTLY lower-priority victims (the admission path: a newcomer
-        never preempts its own class, which would livelock two requests
-        trading the same pages).  Rows holding no pool pages (chunked
-        prefills in flight) are skipped — preempting them frees nothing.
-        INACTIVE rows are skipped too: a row that finished at admission
+        """Victim selection — the scheduler's ``select_victim`` hook over
+        the preemptable rows.  Rows holding no pool pages (chunked
+        prefills in flight) are excluded — preempting them frees nothing.
+        INACTIVE rows are excluded too: a row that finished at admission
         (max_new_tokens == 1, or EOS as its first token) still holds rid
         and pages until _collect's publish sweep — preempting it would
         requeue a COMPLETED request with a fresh 1-token budget and emit
         a token past its max_tokens/EOS; its pages free at the chunk
         boundary anyway."""
-        best: int | None = None
-        best_key: tuple[int, int] | None = None
-        for i in range(self.b):
-            r = self.rows[i]
-            if r.rid is None or not r.pages or not self.active[i]:
-                continue
-            if below_priority is not None and r.priority >= below_priority:
-                continue
-            key = (r.priority, -r.admit_seq)
-            if best is None or key < best_key:
-                best, best_key = i, key
-        return best
+        cands = [
+            (i, r.priority, r.admit_seq) for i, r in enumerate(self.rows)
+            if r.rid is not None and r.pages and self.active[i]
+        ]
+        return self.sched.select_victim(cands, below_priority=below_priority)
 
     def _preempt_row(self, i: int, reason: str) -> None:
         """Preempt resident row ``i``: free its pages NOW, keep the tokens
@@ -3055,8 +2919,12 @@ class ContinuousBatcher:
             # host instead of throwing the prefix away — restore scatters
             # them back (byte-exact, no recompute).  A dry host budget or
             # a kv.swap_out drill leaves swap_handle None and the request
-            # takes the recompute path above unchanged.
-            handle = self._swap_out_row(i, row)
+            # takes the recompute path above unchanged.  The swap rung is
+            # the scheduler's to declare: a policy without it sends every
+            # victim straight to exact recompute.
+            handle = (self._swap_out_row(i, row)
+                      if "swap_preempt" in self.sched.pressure_rungs()
+                      else None)
             if handle is not None:
                 resume.swap_handle = handle
                 resume.swap_pages = len(row.pages)
@@ -3439,13 +3307,18 @@ class ContinuousBatcher:
         # round's admissions should be matchable by them.
         self._drain_kv_imports()
         self._shed_expired_queued()
-        # Advance every pending chunked prefill one chunk per round — up to
-        # prefill_concurrency in flight, so the round's prefill work is at
-        # most prefill_concurrency * prefill_chunk tokens (interleaved long
-        # prompts trade per-round decode latency for admission
-        # parallelism); decode rounds interleave between chunks.
+        # Advance pending chunked prefills.  ALTERNATE: one serialized
+        # prefill_chunk_step bite per prefill per round (up to
+        # prefill_concurrency * prefill_chunk stall tokens).  MIXED:
+        # while decode rows are live, bites ride the fused span instead
+        # (_decode_span), so only completed prompts run their finishing
+        # splice here; with no decode rows live the classic advance
+        # runs.  Re-evaluated per slot: a finishing splice earlier in
+        # this loop activates a decode row, and later bites must then
+        # ride the span, not stall it.
         for slot in list(self._prefills):
-            self._advance_chunk(slot)
+            fused = self.sched.fuse_prefill() and bool(self.active.any())
+            self._advance_chunk(slot, advance=not fused)
         while True:
             i = self._free_slot()
             if i is None:
@@ -3467,8 +3340,8 @@ class ContinuousBatcher:
             pfx = self.prefixes[req.prefix] if req.prefix is not None else None
             pfx_len = len(pfx.ids) if pfx else 0
             total_len = pfx_len + len(req.ids)
-            if (self.prefill_chunk is not None
-                    and len(req.ids) > self.prefill_chunk):
+            thr = self.sched.chunk_threshold()
+            if thr is not None and len(req.ids) > thr:
                 if len(self._prefills) >= self.prefill_concurrency:
                     # Prefill slots full, and strict admission order: stop
                     # admitting (the selected request never gets jumped).
@@ -3734,19 +3607,31 @@ class ContinuousBatcher:
             cached_pages=cached_pages, cached_len=cached_len,
             digests=digests,
         )
-        self._advance_chunk(i)
+        # Alternate runs the first bite NOW (serialized); mixed defers it
+        # to the fused span whenever decode rows are live to stall.
+        self._advance_chunk(
+            i, advance=not (self.sched.fuse_prefill()
+                            and bool(self.active.any())),
+        )
 
-    def _advance_chunk(self, i: int) -> None:
-        """Consume one ``prefill_chunk``-sized bite of slot ``i``'s pending
-        prompt; finish the admission when the prompt completes.  In paged
-        mode the finish ALLOCATES the row's pages on demand (prompt + one
+    def _advance_chunk(self, i: int, advance: bool = True) -> None:
+        """Consume one scheduler-sized bite of slot ``i``'s pending
+        prompt (``advance=False`` — the mixed policy's fused span already
+        runs the bites on device — only checks for the finishing splice);
+        finish the admission when the prompt completes.  In paged mode
+        the finish ALLOCATES the row's pages on demand (prompt + one
         decode page) — a dry pool preempts a strictly-lower-priority
         victim, else the finish retries next round (the prefilled transient
         row is kept; no work is lost)."""
         pp = self._prefills[i]
-        if pp.done < pp.total_len:
+        if advance and pp.done < pp.total_len:
             pfx_len = pp.total_len - len(pp.ids)
-            clen = min(self.prefill_chunk, pp.total_len - pp.done)
+            clen = self._clamp_bite(
+                pp.done,
+                self.sched.prefill_bite(pp.total_len - pp.done,
+                                        int(self.active.sum())),
+                pp.total_len,
+            )
             off = pp.done - pfx_len
             # Bucket for compile reuse, capped so cache_index + T <= width
             # (forward's contract; dynamic_update_slice clamps overflows).
@@ -3759,6 +3644,13 @@ class ContinuousBatcher:
             )
             pp.done += clen
             METRICS.inc("batcher.prefill_chunks")
+            METRICS.inc("batcher.sched.prefill_tokens", clen)
+            if bool(self.active.any()):
+                # Live decode rows just waited out this serialized prefill
+                # forward — the alternating loop's inter-token-latency
+                # spike the mixed schedule exists to remove (it keeps
+                # this counter at zero by fusing the bite instead).
+                METRICS.inc("batcher.sched.stall_rounds")
         if pp.done < pp.total_len:
             return
         req = pp.req
@@ -4055,6 +3947,21 @@ class ContinuousBatcher:
                 # next constrained span at the same cost it was built.
                 self._con_stack = None
             plan["per_row"] = per_row
+        # Fused token-budget step (schedule=mixed): the HEAD pending
+        # prefill rides every chunk this span dispatches; bites are
+        # sized per dispatch against the span-start live row count.
+        # "Head" = the FIRST (start-order) prefill with prompt work left
+        # — a completed head whose finishing splice is back-pressured
+        # must not starve a later prefill of its bites (the finish
+        # itself retries at the round boundaries the prefill_finish
+        # sync trigger forces).
+        plan["n_active"] = int(self.active.sum())
+        plan["mixed"] = None
+        if self.sched.fuse_prefill() and not self.speculative:
+            for slot, pp in self._prefills.items():
+                if pp.done < pp.total_len:
+                    plan["mixed"] = slot
+                    break
         return plan
 
     def _dispatch_chunk(self, plan: dict, carry: tuple) -> tuple:
@@ -4095,66 +4002,164 @@ class ContinuousBatcher:
                 # dispatched-ahead chunk consumes the PREVIOUS chunk's
                 # (not-yet-materialized) state output directly.
                 per_row["dfa_state"] = self._dfa_carry
-            (toks, self.cache, last_tok, real_lens, valid, active,
-             budget, lps, counts_out, dfa_out) = \
-                decode_chunk(
-                    self.params, self.cfg_decode, self.cache, last_tok,
-                    real_lens, valid, active, budget,
-                    self._split_rng(), self.chunk_steps,
-                    eos_id=self.eos_id, pad_id=self.pad_id, pm=self.pm,
-                    tables=plan["tables"],
-                    **self.sampling, **per_row,
+            METRICS.inc("batcher.sched.decode_tokens",
+                        plan["n_active"] * self.chunk_steps)
+            pp = (self._prefills.get(plan["mixed"])
+                  if plan["mixed"] is not None else None)
+            if pp is not None and pp.done < pp.total_len:
+                (toks, self.cache, last_tok, real_lens, valid, active,
+                 budget, lps, counts_out, dfa_out) = self._dispatch_mixed(
+                    plan, (last_tok, real_lens, valid, active, budget),
+                    per_row, pp,
                 )
+            else:
+                if self.faults is not None and self.sched.fuse_prefill():
+                    # Injection site "batcher.mixed_step" tag "decode":
+                    # a mixed-schedule dispatch with no prefill riding.
+                    self.faults.fire("batcher.mixed_step", tag="decode")
+                (toks, self.cache, last_tok, real_lens, valid, active,
+                 budget, lps, counts_out, dfa_out) = \
+                    decode_chunk(
+                        self.params, self.cfg_decode, self.cache, last_tok,
+                        real_lens, valid, active, budget,
+                        self._split_rng(), self.chunk_steps,
+                        eos_id=self.eos_id, pad_id=self.pad_id, pm=self.pm,
+                        tables=plan["tables"],
+                        **self.sampling, **per_row,
+                    )
         if counts_out is not None:
             self.tok_counts = counts_out
         if dfa_out is not None:
             self._dfa_carry = dfa_out
         return toks, lps, m, (last_tok, real_lens, valid, active, budget)
 
+    def _mixed_width(self, done: int) -> int:
+        """Prefill-leg width of a fused step: ONE bucket sized to the
+        policy's largest possible bite, so the steady-state compile key
+        is independent of the live prefill mix (graftcheck GC4
+        batcher.mixed_step).  At the row TAIL — where cache_index + T <=
+        width must hold (dynamic_update_slice CLAMPS an overflowing
+        start, which would misalign the suffix) — the width shrinks DOWN
+        the shared bucket ladder, never to a raw remainder
+        (:meth:`_clamp_bite` guarantees a bite boundary never lands
+        inside the last sub-floor slots): tail keys stay on the closed
+        ladder (one per bucket, the tentpole's GC4 budget) instead of
+        compiling per prompt length on the engine thread mid-span."""
+        cap = self.sched.token_budget or self.sched.prefill_chunk or self.s
+        w = _bucket(min(cap, self.s))
+        room = self.s - done
+        while w > room and w > 8:  # 8 = shapes.BUCKET_FLOOR
+            w //= 2
+        return min(w, room)
+
+    def _clamp_bite(self, done: int, bite: int, total_len: int) -> int:
+        """Keep every bite boundary OFF the row's last sub-floor slots
+        (s-8 < done' < total_len would force the NEXT chunk's width to a
+        raw off-ladder remainder and a fresh XLA trace mid-span): a bite
+        that would end there shortens to land exactly on s-8, and a bite
+        STARTING at the boundary finishes the prompt outright (<= 7
+        tokens, the budget floor notwithstanding — once per prompt at
+        most).  Applied to fused and serialized bites alike, so chunk
+        splits — and therefore nothing byte-visible — stay
+        schedule-invariant."""
+        if self.s - 8 <= done:
+            return total_len - done
+        end = done + bite
+        if end < total_len and self.s - end < 8:
+            bite = (self.s - 8) - done
+        return bite
+
+    def _dispatch_mixed(self, plan: dict, carry: tuple, per_row: dict,
+                        pp: "_PendingPrefill") -> tuple:
+        """Dispatch ONE fused token-budget step (schedule=mixed): the
+        decode chunk AND the head pending prefill's next bite as one
+        compiled program — resident decode rows never wait on a separate
+        serialized prefill forward.  Host bookkeeping (``pp.done``, bite
+        metrics) advances at dispatch time; the transient prefill row and
+        its last-logits chain device-resident across dispatch-ahead
+        chunks exactly like the decode carry.  Returns decode_chunk's
+        10-tuple."""
+        last_tok, real_lens, valid, active, budget = carry
+        tw = self._mixed_width(pp.done)
+        # Clamp AFTER the width truncation: min(bite, tw) moves the bite
+        # boundary, and only the post-truncation boundary must be kept
+        # out of the sub-floor tail zone (clamping first and truncating
+        # after could land the boundary right back inside it).
+        bite = self._clamp_bite(
+            pp.done,
+            min(self.sched.prefill_bite(pp.total_len - pp.done,
+                                        plan["n_active"]), tw),
+            pp.total_len,
+        )
+        bite = min(bite, tw)  # the finish branch is invariant-bounded;
+        #                       never trust it past the chunk width
+        off = pp.done - (pp.total_len - len(pp.ids))
+        chunk = np.full((tw,), self.pad_id, np.int32)
+        chunk[:bite] = pp.ids[off: off + bite]
+        if self.faults is not None:
+            # Injection site "batcher.mixed_step" tag "prefill": one hit
+            # per fused dispatch carrying a prefill bite.
+            self.faults.fire("batcher.mixed_step", tag="prefill")
+        (toks, cache, last_tok, real_lens, valid, active, budget, lps,
+         counts_out, dfa_out, pp.row_k, pp.row_v, pp.last_logits) = \
+            mixed_step(
+                self.params, self.cfg_decode, self.cfg, self.cache,
+                last_tok, real_lens, valid, active, budget,
+                self._split_rng(), self.chunk_steps,
+                pp.row_k, pp.row_v, jnp.int32(pp.done),
+                jnp.asarray(chunk), jnp.int32(bite),
+                eos_id=self.eos_id, pad_id=self.pad_id, pm=self.pm,
+                tables=plan["tables"], **self.sampling, **per_row,
+            )
+        pp.done += bite
+        METRICS.inc("batcher.prefill_chunks")
+        METRICS.inc("batcher.sched.prefill_tokens", bite)
+        budget_t = self.sched.token_budget or (plan["n_active"] + bite)
+        METRICS.inc("batcher.sched.budget_tokens", budget_t)
+        METRICS.set_gauge("batcher.sched.budget_utilization",
+                          (plan["n_active"] + bite) / max(budget_t, 1))
+        return (toks, cache, last_tok, real_lens, valid, active, budget,
+                lps, counts_out, dfa_out)
+
     def _overlap_ok(self, was_active: np.ndarray, chunks: int) -> bool:
         """Whether the NEXT chunk may dispatch ahead from the device
         carry, i.e. nothing needs the host scheduling mirrors at this
-        boundary.  THE sync-triggers list (README "Engine overlap"):
-
-        - a queued request (admission, shed-deadline scans),
-        - a pending chunked prefill or verified KV import,
-        - a resident-row cancel taken while the carry was device-resident,
-        - paged mode: a row near its page horizon that :meth:`_grow_ahead`
-          could not grow from SPARE pool capacity (growth under pressure
-          preempts, and preemption must run against fresh mirrors),
-        - every row (as of the last-known activity vector) already idle —
-          the span never chains a chunk behind a possibly-all-idle one,
-        - budget-certain completion (below): the next chunk could only be
-          a ghost.
-        """
-        if not bool(was_active.any()):
-            return False
-        if self._cancel_dirty:
-            return False
-        if self.has_queued() or self.has_kv_imports() or self._prefills:
-            return False
-        # Budget-certain completion: when every live row will have
-        # exhausted its budget within the chunks ALREADY dispatched, the
-        # next chunk could only be a ghost (all rows inactive) — let the
-        # sync observe the finishes instead of burning a device round.
-        # Plain chunks commit exactly chunk_steps tokens per active row;
-        # a speculative round commits at least one.  EOS finishes are not
-        # host-predictable, so a rare ghost behind an EOS remains (it
-        # pads nothing into the stream — _collect sees no active row).
-        per_chunk = 1 if self.speculative else self.chunk_steps
-        certain = True
-        for i in range(self.b):
-            if self.rows[i].rid is None or not self.active[i] \
-                    or self.rows[i].prefilling:
-                continue
-            if int(self.budget[i]) > chunks * per_chunk:
-                certain = False
+        boundary — the scheduler's ``sync_triggers`` hook over a host-
+        state snapshot (the trigger list and its policy live in
+        runtime/scheduler.py; README "Engine overlap" documents it).
+        The mixed policy keeps dispatching ahead while the head pending
+        prefill still has bites to ride the fused step; the alternate
+        policy parks the span for any pending prefill.  ``head_left``
+        reports the first prefill WITH WORK (the one _span_plan fuses)
+        — but any COMPLETED prefill awaiting its finishing splice forces
+        0, so the finish retries at every chunk boundary instead of
+        waiting out a sibling's whole prefill."""
+        head_left = 0
+        for pp in self._prefills.values():
+            left = pp.total_len - pp.done
+            if left <= 0:
+                head_left = 0
                 break
-        if certain:
-            return False
-        if self.paged and not self._grow_ahead(chunks + 1):
-            return False
-        return True
+            if head_left == 0:
+                head_left = left
+        view = scheduler_lib.SyncView(
+            any_active=bool(was_active.any()),
+            cancel_dirty=self._cancel_dirty,
+            queued=self.has_queued(),
+            kv_imports=self.has_kv_imports(),
+            prefills=len(self._prefills),
+            head_prefill_left=head_left,
+            live_budgets=tuple(
+                int(self.budget[i]) for i in range(self.b)
+                if self.rows[i].rid is not None and self.active[i]
+                and not self.rows[i].prefilling
+            ),
+            chunks_ahead=chunks,
+            grow_blocked=lambda: (
+                self.paged and not self._grow_ahead(chunks + 1)
+            ),
+        )
+        return not self.sched.sync_triggers(view)
 
     def _note_gap(self, gap_s: float) -> None:
         """Record one per-chunk device gap: the host time between the

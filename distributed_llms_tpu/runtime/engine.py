@@ -492,6 +492,16 @@ class InferenceEngine:
         #   carry while chunk N's host work overlaps on the CPU (temp-0
         #   bytes identical either way; mesh-legal — the carry is
         #   replicated scheduling state)
+        schedule: str | None = None,  # None -> rt.schedule; "mixed"
+        #   (default) fuses pending prefill-chunk bites into the decode
+        #   step as one token-budget program (runtime/scheduler.py —
+        #   decode rows never stall for a serialized prefill forward);
+        #   "alternate" keeps the classic serialized rounds.  Temp-0
+        #   bytes identical either way.
+        token_budget: int | None = None,  # None -> rt.token_budget; the
+        #   per-step token budget the mixed policy sizes prefill bites
+        #   against (decode legs claim n_active of it first).  0/None =
+        #   prefill_chunk-sized bites.
     ):
         """A ContinuousBatcher over this engine's model: requests admit into
         an in-flight decode batch as rows free up (runtime/batcher.py) —
@@ -603,6 +613,12 @@ class InferenceEngine:
             host_pages = 0
         if overlap is None:
             overlap = self.rt.overlap
+        if schedule is None:
+            schedule = self.rt.schedule
+        if token_budget is None:
+            token_budget = self.rt.token_budget
+        if token_budget == 0:  # the CLI/config "disable" spelling
+            token_budget = None
         if self.parallel is not None:
             # The shared cache shards its batch over 'data'; round the slot
             # count up so every mesh shape serves (extra slots are harmless
@@ -661,6 +677,7 @@ class InferenceEngine:
             faults=faults,
             kv_bits=kv_bits, host_pages=int(host_pages),
             overlap=bool(overlap),
+            schedule=schedule, token_budget=token_budget,
         )
 
     # -- speculative decoding (runtime/speculative.py): greedy-exact at

@@ -98,6 +98,12 @@ FAULT_SITES: dict[str, str] = {
         "(chunk-boundary growth) — 'exhaust' forces the pressure path",
     "batcher.preempt":
         "one hit per row preemption, BEFORE the victim's pages are freed",
+    "batcher.mixed_step":
+        "each mixed-schedule dispatch (runtime/scheduler.py): tag "
+        "'prefill' when the step carries a fused prefill bite, 'decode' "
+        "for a budget-only decode dispatch — 'raise' crashes the fused "
+        "step (the supervisor-restart drill for the stall-free path), "
+        "'stall:<s>' wedges it for the watchdog",
     "proto.send":
         "cluster protocol frame about to be written (tag = message type)",
     "proto.recv":

@@ -105,8 +105,11 @@ def test_prefix_cache_exact_and_hit_accounting(tiny):
 
 def test_chunked_prefill_exact(tiny):
     """Chunked prefill (paged + prefix cache): a long prompt admitted in
-    chunks composes with the overlap plane — a pending prefill is a sync
-    trigger, so every prefill round runs against fresh mirrors."""
+    chunks composes with the overlap plane — under the default mixed
+    schedule the bites ride the fused span and only the finishing splice
+    syncs; under alternate every prefill round syncs (the scheduler's
+    sync_triggers hook, runtime/scheduler.py).  Bytes identical on/off
+    either way."""
     long_prompt = "a long prompt that must chunk " * 2
     reqs = [(long_prompt, 12), ("short", 10)]
     kw = dict(prefill_chunk=16, **PAGED)

@@ -94,6 +94,15 @@ def _result_cell(row: dict) -> str:
         ("device_gap_ms_on", "device-gap ms on"),
         ("gap_reduction", "gap reduction x"),
         ("dispatched_ahead_frac", "dispatched-ahead frac"),
+        ("itl_p95_ms_alternate", "ITL p95 ms (alternate)"),
+        ("itl_p95_ms_mixed", "ITL p95 ms (mixed)"),
+        ("itl_p95_gain", "ITL p95 gain x"),
+        ("ttft_first_s_alternate", "long-prompt TTFT s (alternate)"),
+        ("ttft_first_s_mixed", "long-prompt TTFT s (mixed)"),
+        ("ttft_ratio", "TTFT ratio (mixed/alternate)"),
+        ("ttft_last_s_mixed", "last-prefill TTFT s (mixed)"),
+        ("stall_rounds_alternate", "stall bites (alternate)"),
+        ("stall_rounds_mixed", "stall bites (mixed)"),
         ("admit_row_keys", "admit compile keys"),
         ("admit_row_declared", "of declared"),
         ("decode_chunk_keys", "decode compile keys"),
@@ -140,7 +149,7 @@ def generate(ladder_path: str) -> str:
         # Aux rows run_ladder appends after the decode configs.
         "serving-latency", "continuous-batching", "local-proc-batching",
         "chunked-prefill", "prefix-cache-ttft", "fault-recovery",
-        "overload-goodput", "kv-tiering", "decode-overlap",
+        "overload-goodput", "kv-tiering", "decode-overlap", "mixed-step",
         "constrained-decode", "mesh-paged", "replica-failover",
         "disagg-handoff", "compile-stability", "analysis-wall",
         "ragged-decode-8k", "ragged-decode-win-8k", "quant-matmul-bw",

@@ -550,6 +550,70 @@ def _kv_transfer_cases() -> list[OpCase]:
     return cases
 
 
+def _mixed_step_cases() -> list[OpCase]:
+    """The fused mixed step's segment legs (the mixed-segment attention
+    leg of ``schedule=mixed``): across prefill-bite buckets and
+    contiguous/paged pools, the decode leg keeps [B, K] int32 tokens +
+    [B, K] f32 logprobs, the prefill segment's transient row keeps its
+    shape AND dtype (the continuation-mask attention must not widen it —
+    the row splices into the shared cache at the finish), and the
+    finishing-splice logits stay [1, V] f32."""
+    import jax.numpy as jnp
+
+    from distributed_llms_tpu.runtime import batcher as batcher_lib
+
+    cfg = preset("llama-tiny", dtype="bfloat16")
+    l, kvh, hd = cfg.num_layers, cfg.num_kv_heads, cfg.head_dim_
+    v = cfg.vocab_size
+    b, s, k = 4, 128, 8
+    params = abstract_params(cfg)
+    row = abstract_cache(cfg, 1, s)
+
+    def pick(out):
+        # (toks, lps, row_k', row_v', last_logits) — the fused step's
+        # segment-leg outputs; the cache carry is pinned by the GC4
+        # chaining contract and the decode_chunk GC1 cases already.
+        return out[0], out[7], out[10], out[11], out[12]
+
+    want = (((b, k), "int32"), ((b, k), "float32"),
+            ((l, 1, s, kvh, hd), "bfloat16"),
+            ((l, 1, s, kvh, hd), "bfloat16"),
+            ((1, v), "float32"))
+    cases = []
+    for pw in (8, 32, 64):  # bite buckets up the shared ladder
+        cases.append(OpCase(
+            label=f"contiguous pw{pw}",
+            fn=lambda p, c, lt, rl, va, ac, bu, rng, rk, rv, dn, pc, pl:
+                pick(batcher_lib.mixed_step(
+                    p, cfg, cfg, c, lt, rl, va, ac, bu, rng, k,
+                    rk, rv, dn, pc, pl)),
+            args=(params, abstract_cache(cfg, b, s), sds((b,), jnp.int32),
+                  sds((b,), jnp.int32), sds((b, s), jnp.bool_),
+                  sds((b,), jnp.bool_), sds((b,), jnp.int32), key_sds(),
+                  row.k, row.v, sds((), jnp.int32),
+                  sds((pw,), jnp.int32), sds((), jnp.int32)),
+            want=want,
+        ))
+    nb, blk, p = 16, 16, 8  # pool pages, page size, pages per row (= s)
+    for pw in (8, 64):
+        cases.append(OpCase(
+            label=f"paged pw{pw}",
+            fn=lambda prm, c, lt, rl, va, ac, bu, rng, rk, rv, dn, pc, pl,
+                tb:
+                pick(batcher_lib.mixed_step(
+                    prm, cfg, cfg, c, lt, rl, va, ac, bu, rng, k,
+                    rk, rv, dn, pc, pl, tables=tb)),
+            args=(params, abstract_pool(cfg, nb, blk), sds((b,), jnp.int32),
+                  sds((b,), jnp.int32), sds((b, s), jnp.bool_),
+                  sds((b,), jnp.bool_), sds((b,), jnp.int32), key_sds(),
+                  row.k, row.v, sds((), jnp.int32),
+                  sds((pw,), jnp.int32), sds((), jnp.int32),
+                  sds((b, p), jnp.int32)),
+            want=want,
+        ))
+    return cases
+
+
 def _sampling_cases() -> list[OpCase]:
     from distributed_llms_tpu.runtime import sampling
 
@@ -644,6 +708,11 @@ def op_contracts() -> list[OpContract]:
                    "handoff export/import: pool shape+dtype round-trip, "
                    "payload cast to pool dtype",
                    _kv_transfer_cases),
+        OpContract("batcher.mixed_step", P_BATCHER,
+                   "fused mixed-segment legs: decode toks/lps shapes, "
+                   "prefill row shape+dtype preserved, splice logits "
+                   "[1,V] f32 (contiguous + paged, bite-bucket sweep)",
+                   _mixed_step_cases),
     ]
 
 
@@ -1087,6 +1156,46 @@ def recompile_scenarios() -> list[RecompileScenario]:
         allowed_widths=(s_cap,),
         max_keys=1,
         trace=decode_constrained_trace,
+    ))
+
+    # -- fused mixed step (schedule=mixed): the K-step decode scan AND
+    # the head pending prefill's bite in ONE compiled program.  The
+    # prefill leg's width is pinned to a single policy-sized bucket
+    # (batcher._mixed_width), so the whole prefill-mix ladder — any bite
+    # length, any live-row count, any resident depth (all traced values,
+    # never shapes) — must land on EXACTLY one compile key: a second key
+    # would mean a fused dispatch pays an XLA trace on the engine thread
+    # mid-span, serializing exactly the stall the mixed schedule removes.
+    def mixed_step_trace(width: int) -> str:
+        from distributed_llms_tpu.runtime import batcher as batcher_lib
+
+        b, pw = 4, 32  # pw: the policy's fixed prefill-leg bucket
+        params = abstract_params(cfg)
+        cache = abstract_cache(cfg, b, s_cap)
+        row = abstract_cache(cfg, 1, s_cap)
+        return jaxpr_hash(
+            lambda p, c, lt, rl, va, ac, bu, rng, rk, rv, dn, pc, pl:
+                batcher_lib.mixed_step(
+                    p, cfg, cfg, c, lt, rl, va, ac, bu, rng, 8,
+                    rk, rv, dn, pc, pl),
+            params, cache, sds((b,), jnp.int32), sds((b,), jnp.int32),
+            sds((b, s_cap), jnp.bool_), sds((b,), jnp.bool_),
+            sds((b,), jnp.int32), key_sds(),
+            row.k, row.v, sds((), jnp.int32),
+            sds((pw,), jnp.int32), sds((), jnp.int32),
+            statics={"cfg": cfg, "pcfg": cfg, "chunk_steps": 8},
+        )
+
+    out.append(RecompileScenario(
+        name="batcher.mixed_step", path=P_BATCHER,
+        doc="fused token-budget step (decode scan + prefill bite, "
+            "schedule=mixed) stays ONE program across the whole "
+            "prefill-mix ladder",
+        ladder=_GC4_LADDER,
+        width_of=lambda n: s_cap,
+        allowed_widths=(s_cap,),
+        max_keys=1,
+        trace=mixed_step_trace,
     ))
 
     # -- whole-batch generate: the engine pads T up the ladder under the
