@@ -1201,11 +1201,9 @@ def decode_chunk(
     pres_row: jax.Array | None = None,  # [B] traced presence penalties
     freq_row: jax.Array | None = None,  # [B] traced frequency penalties
     mask_stack: jax.Array | None = None,  # [S, V] f32 per-state token mask
-    #   (runtime/constrain.py build_stack: state 0 free, grammar automata
-    #   stacked behind it, state axis padded up a closed bucket ladder)
+    #   (constrain.build_stack: state 0 free, padded up a closed ladder)
     next_stack: jax.Array | None = None,  # [S, V] int32 DFA transitions
-    dfa_state: jax.Array | None = None,   # [B] int32 per-row automaton
-    #   state (0 = free) — part of the device-resident decode carry
+    dfa_state: jax.Array | None = None,  # [B] int32 DFA state (0 = free)
 ) -> tuple[jax.Array, Any, jax.Array, jax.Array, jax.Array, jax.Array,
            jax.Array, jax.Array, jax.Array | None, jax.Array | None]:
     """K decode steps with per-row positions.  Returns
@@ -1269,9 +1267,8 @@ def mixed_step(
     row_k: jax.Array,   # [..., 1, S, KVH, HD] the head pending prefill's
     row_v: jax.Array,   # transient row (DONATED — updated in place)
     done: jax.Array,    # scalar int32 — prompt tokens already in the row
-    pchunk: jax.Array,  # [Tw] int32 — the bite, right-padded to the
-    #   policy's FIXED bucket width (the compile key stays independent of
-    #   the live prefill mix — graftcheck GC4 batcher.mixed_step)
+    pchunk: jax.Array,  # [Tw] int32 — the bite, right-padded to the policy's
+    #   FIXED bucket width (compile key mix-independent — GC4 mixed_step)
     pclen: jax.Array,   # scalar int32 true bite length
     temperature: float = 0.0,
     top_k: int = 0,
@@ -1875,18 +1872,16 @@ class ContinuousBatcher:
         overlap: bool = True,
         # Scheduling policy (runtime/scheduler.py): "mixed" (default)
         # fuses pending prefill-chunk bites into the decode step as one
-        # compiled token-budget program (decode rows never stall for a
-        # serialized prefill forward, and a pending prefill no longer
-        # parks the dispatch-ahead span); "alternate" keeps the classic
-        # serialized prefill_chunk_step rounds.  Temp-0 bytes identical
-        # either way (tests/runtime/test_mixed_step.py).
+        # compiled token-budget program so decode rows never stall for a
+        # serialized prefill forward and a pending prefill no longer
+        # parks the dispatch-ahead span; "alternate" keeps the serialized
+        # prefill_chunk_step rounds.  Temp-0 bytes identical either way.
         schedule: str = "mixed",
         # Per-step token budget the mixed policy sizes prefill bites
         # against: each fused step runs one decode leg per active slot
         # plus up to token_budget - n_active prompt tokens.  None = bites
-        # stay prefill_chunk-sized (fusion without re-budgeting); set, it
-        # also auto-chunks any prompt longer than the budget even when
-        # prefill_chunk was never configured.
+        # stay prefill_chunk-sized; set, it also auto-chunks any prompt
+        # longer than the budget even when prefill_chunk is unset.
         token_budget: int | None = None,
     ) -> None:
         # Snapshot the constructor arguments FIRST (before any local
