@@ -691,6 +691,110 @@ def _measure_spec_batching(
     return out
 
 
+def _measure_spec_paged(dtype: str = "bfloat16") -> dict:
+    """Paged speculative serving (round 17): spec-on vs spec-off at EQUAL
+    pool budget — same requests, same paged pool, same scheduler; the
+    spec leg drafts with the int4 self-draft and verifies through the
+    page tables (scratch-tail pages instead of the contiguous engine's
+    max_len+spec_k+1 slot reservation).  Stamps steady tok/s + delivery
+    ITL p50 for both legs, the acceptance fraction and downshift count,
+    byte-exactness spec-on vs spec-off, and the CAPACITY arithmetic: rows
+    per pool byte for contiguous-spec (which must reserve
+    max_len+spec_k+1 slots per row up front) vs paged-spec (prompt +
+    budget + scratch-tail pages, allocated on demand).  The capacity and
+    exactness results are platform-independent; CPU tok/s is honest but
+    degraded (the draft's weight-bandwidth advantage needs real chips —
+    XLA:CPU dequantizes the int4 draft into the same dense flops as the
+    target)."""
+    from distributed_llms_tpu.runtime.batcher import (ContinuousBatcher,
+                                                      pool_page_bytes)
+
+    preset = ("gpt2-125m" if jax.devices()[0].platform == "cpu"
+              else "tinyllama-1.1b")
+    cfg, tparams = _build_params(preset, dtype, "int8")
+    _, dparams = _build_params(preset, dtype, "int4")
+    max_len, blk, pages, k, slots = 256, 16, 33, 4, 6
+    rng = np.random.RandomState(0)
+    lens = rng.randint(12, 41, size=8)
+    budget = 40
+    prompts = [rng.randint(1, cfg.vocab_size, size=n).tolist() for n in lens]
+    total_new = budget * len(prompts)
+
+    def leg(spec: bool):
+        b = ContinuousBatcher(
+            cfg, tparams, batch_slots=slots, max_len=max_len, chunk_steps=4,
+            paged_pages=pages, page_size=blk,
+            **(dict(draft_params=dparams, draft_cfg=cfg, spec_k=k)
+               if spec else {}),
+        )
+        last: dict[int, float] = {}
+        gaps: list[float] = []
+
+        def cb(rid, new, done, lps):
+            t = time.perf_counter()
+            prev = last.get(rid)
+            if prev is not None and new:
+                gaps.append((t - prev) / len(new))
+            last[rid] = t
+
+        rids = [b.submit(p, max_new_tokens=budget) for p in prompts]
+        t0 = time.perf_counter()
+        res = b.run(on_tokens=cb)
+        wall = time.perf_counter() - t0
+        b.assert_pool_consistent()
+        return wall, [res[r] for r in rids], gaps, b
+
+    leg(False)  # warm compiles outside the timed runs
+    leg(True)
+    t_plain, out_plain, gaps_plain, _ = leg(False)
+    t_spec, out_spec, gaps_spec, bs = leg(True)
+    exact = out_plain == out_spec
+    stats = bs.spec_stats
+    drafted = stats["accepted"] + stats["rejected"]
+    # Capacity at the SAME pool byte budget: contiguous spec reserves
+    # max_len+k+1 slots per row up front; paged spec holds the workload's
+    # actual footprint (prompt + budget + the k+1-slot scratch tail).
+    usable = pages - 1  # page 0 is scratch
+    pool_kib = usable * pool_page_bytes(cfg, blk, 16, dtype) / 1024
+    rows_contig = int(usable * blk // (max_len + k + 1))
+    mean_pages = -(-int(np.mean(lens) + budget + k + 1) // blk)
+    rows_paged = usable // mean_pages
+    out = {
+        "preset": preset,
+        "quant": "int8 target, int4 self-draft",
+        "k": k,
+        "slots": slots,
+        "pool_pages": pages,
+        "page_size": blk,
+        "pool_kib": round(pool_kib, 1),
+        "platform": jax.devices()[0].platform,
+        "exact_spec_vs_plain": bool(exact),
+        "tok_per_s_plain": round(total_new / t_plain, 2),
+        "tok_per_s_spec": round(total_new / t_spec, 2),
+        "speedup": round(t_plain / t_spec, 3),
+        "itl_p50_ms_plain": round(
+            float(np.percentile(gaps_plain, 50)) * 1e3, 2),
+        "itl_p50_ms_spec": round(
+            float(np.percentile(gaps_spec, 50)) * 1e3, 2),
+        "acceptance_frac": round(stats["accepted"] / max(drafted, 1), 3),
+        "spec_rounds": stats["rounds"],
+        "k_downshifts": stats["downshifts"],
+        "rows_contig_spec": rows_contig,
+        "rows_paged_spec": rows_paged,
+        "capacity_factor": round(rows_paged / max(rows_contig, 1), 2),
+    }
+    if not exact:
+        out["note"] = "EXACTNESS FAILED: paged speculative != paged plain"
+    elif out["platform"] == "cpu":
+        out["note"] = (
+            "CPU: the int4 draft dequantizes to FULL dense flops per step "
+            "(no weight-bandwidth advantage), so spec-on tok/s needs a TPU "
+            "re-stamp; exactness, capacity factor, acceptance, and the "
+            "downshift count are platform-independent"
+        )
+    return out
+
+
 def _measure_ragged_decode(
     preset: str = "tinyllama-1.1b", dtype: str = "bfloat16",
     max_len: int = 8192, slots: int = 8, iters: int = 5,
@@ -2714,7 +2818,7 @@ def run_ladder(args, degraded: str | None) -> list[dict]:
             "fault-recovery", "overload-goodput", "compile-stability",
             "replica-failover", "disagg-handoff", "analysis-wall",
             "kv-tiering", "decode-overlap", "constrained-decode",
-            "mesh-paged", "mixed-step",
+            "mesh-paged", "mixed-step", "spec-paged",
         }
         unknown = only - known
         if unknown:  # a typo must not masquerade as a clean zero-row run
@@ -2864,6 +2968,12 @@ def run_ladder(args, degraded: str | None) -> list[dict]:
         # step) at equal budget, plus both long prompts' TTFT — a
         # host-scheduling effect, meaningful on any platform.
         ("mixed-step", lambda: _measure_mixed_step(dtype=dtype)),
+        # Paged speculative serving: spec-on vs spec-off at equal pool
+        # budget, acceptance fraction, and the capacity arithmetic that
+        # shows paged spec dropping the contiguous max_len+spec_k+1
+        # reservation.  Exactness + capacity are platform-independent;
+        # tok/s carries the CPU degraded marker for TPU re-stamp.
+        ("spec-paged", lambda: _measure_spec_paged(dtype=dtype)),
         # Grammar-constrained structured output: token-DFA compile wall
         # for a realistic tool-call schema, constrained-vs-free steady
         # tok/s (the traced mask overhead), and the parse-valid fraction

@@ -44,6 +44,9 @@ _RUNTIME_FLAGS: dict[str, str] = {
     "shed-cost-factor": "shed_cost_factor",
     "constrained": "constrained_decoding",
     "constrain-cache": "constrain_cache_size",
+    "spec-decode": "spec_decode",
+    "spec-k": "spec_k",
+    "spec-adaptive-k": "spec_adaptive_k",
     "fault": "faults",
 }
 # Server plumbing with no RuntimeConfig twin (transport, process, and
@@ -63,6 +66,21 @@ def _build_engine(args):
     spec) — fleet mode re-parses the spec into a plane PER REPLICA."""
     cfg = load_config(args.config, args.override)
     rt = cfg.runtime
+    # Speculative knobs must land on the RuntimeConfig BEFORE the engine
+    # builds: the engine attaches its self-draft at construction from
+    # rt.spec_decode (flag wins when given; the field is the config-file
+    # spelling, like every _RUNTIME_FLAGS entry).
+    spec_overrides = {
+        field: val for field, val in (
+            ("spec_decode", args.spec_decode),
+            ("spec_k", args.spec_k),
+            ("spec_adaptive_k", args.spec_adaptive_k),
+        ) if val is not None
+    }
+    if spec_overrides:
+        import dataclasses
+
+        rt = dataclasses.replace(rt, **spec_overrides)
     # Parse the fault spec BEFORE the (slow) engine build: an operator's
     # typo'd site must fail the boot in milliseconds, not after a full
     # model load.  strict=True checks sites against FAULT_SITES — a rule
@@ -458,6 +476,25 @@ def main(argv=None) -> None:
                          "--no-constrained answers every constrained "
                          "request 400 (default: "
                          "runtime.constrained_decoding, on)")
+    ap.add_argument("--spec-decode", action=argparse.BooleanOptionalAction,
+                    default=None,
+                    help="speculative decoding: the engine drafts spec-k "
+                         "tokens per row with its own int-quantized "
+                         "self-draft and verifies them in one target "
+                         "forward — temp-0 bytes identical with it on or "
+                         "off.  Composes with --paged-pages (the "
+                         "draft/verify window writes through the page "
+                         "tables), --prefix-cache, --kv-bits 8, and the "
+                         "host tier; rejected with --prefill-chunk and "
+                         "on meshes (default: runtime.spec_decode)")
+    ap.add_argument("--spec-k", type=int, default=None,
+                    help="draft tokens per speculative round "
+                         "(default: runtime.spec_k)")
+    ap.add_argument("--spec-adaptive-k",
+                    action=argparse.BooleanOptionalAction, default=None,
+                    help="adaptive per-row spec_k downshift from the "
+                         "acceptance-rate EMA + token budget "
+                         "(default: runtime.spec_adaptive_k)")
     ap.add_argument("--constrain-cache", type=int, default=None,
                     help="LRU capacity of the compiled (constraint, "
                          "tokenizer) automaton cache (default: "

@@ -288,6 +288,17 @@ class RuntimeConfig:
     spec_decode: bool = False
     spec_k: int = 4
     spec_draft_quantize: int = 4
+    # Adaptive spec_k downshift (greedy engines, schedule=mixed): per-row
+    # acceptance-rate EMAs feed the scheduler's spec_round_k hook, which
+    # clamps each row's COMMITTED tokens per round against the per-step
+    # token budget.  The clamp is a ledger bound (a round never commits
+    # more than the budget; cancel/deadline checks run at bounded
+    # intervals) — the compiled round's device work is CONSTANT by design
+    # (full k-draft + (k+1)-token verify, one compile key), so the clamp
+    # trades commit granularity, never flops.  Streams stay byte-exact at
+    # any clamp (the forced stop emits the target's own token); only
+    # arrival granularity changes.
+    spec_adaptive_k: bool = True
     # Deterministic fault injection (runtime/faults.py): a comma-separated
     # spec like "batcher.decode:raise@3,proto.send/HEARTBEAT:drop@1+".
     # Engine/batcher hot paths and the cluster protocol framing consult the

@@ -249,6 +249,22 @@ METRIC_DOCS: dict[str, str] = {
     "batcher.overlap.depth": "current dispatch depth: 1 while a chunk is "
                              "dispatched ahead of its predecessor's host "
                              "work, 0 at a carry sync (gauge)",
+    # -- paged speculative decoding (batcher spec_chunk) --
+    "batcher.spec.rounds": "speculative draft/verify rounds dispatched",
+    "batcher.spec.accepted_tokens": "drafted tokens the verify pass "
+                                    "committed (bonus/correction tokens "
+                                    "excluded)",
+    "batcher.spec.rejected_tokens": "drafted tokens the verify pass "
+                                    "rejected (rolled back by the "
+                                    "pos/length clamp)",
+    "batcher.spec.k_downshifts": "rounds dispatched with at least one "
+                                 "row's draft length adaptively clamped "
+                                 "below spec_k (budget or acceptance-EMA "
+                                 "downshift)",
+    "batcher.spec.acceptance": "cumulative accepted/(accepted+rejected) "
+                               "draft fraction (gauge; per-round "
+                               "fractions feed the engine.spec_acceptance "
+                               "histogram)",
     # -- grammar-constrained structured output (runtime/constrain.py) --
     "batcher.constrain.rows": "constrained/biased rows admitted (token-mask "
                               "automaton engaged in the decode step)",

@@ -97,6 +97,58 @@ def init_cache(
 # Blocks
 # ---------------------------------------------------------------------------
 
+def _paged_window_attention(q, k, v, p, layer_cache, cache_index, kv_tables):
+    """T-token paged attention window (T >= 2, static): the speculative
+    draft/verify pass against a page-pool cache.  K/V for all T tokens
+    scatter through the page table first (slots cache_index..+T-1 — the
+    caller's growth loop guaranteed pages cover the window), then each
+    query offset j reads its row's prefix through slot cache_index+j via
+    the paged decode kernel.  Freed rows' tables are zeroed to the shared
+    scratch page, so duplicate (page, off) scatter targets are possible
+    and tolerated exactly as in the single-token leg (XLA picks a winner;
+    no live row reads the scratch page).  An int8 pool (4-tuple
+    layer_cache) quantizes the whole window once at the write and hands
+    the kernel the scales — pool reads stay 1 byte/elem."""
+    from ..ops import decode_attn
+
+    t_w = q.shape[1]
+    rows = jnp.arange(q.shape[0], dtype=jnp.int32)
+    quant = len(layer_cache) == 4
+    blk = layer_cache[0].shape[1]
+    idx = cache_index[:, None] + jnp.arange(t_w, dtype=jnp.int32)[None, :]
+    page = kv_tables[rows[:, None], idx // blk]  # [B, T]
+    off = idx % blk
+    if quant:
+        from ..checkpoint.quantize import kv_quantize
+
+        ck, cv, sk, sv = layer_cache
+        kq, ks = kv_quantize(k)  # [B, T, KVH, HD] i8, [B, T, KVH] f32
+        vq, vs = kv_quantize(v)
+        ck = ck.at[page, off].set(kq)
+        cv = cv.at[page, off].set(vq)
+        sk = sk.at[page, off].set(ks)
+        sv = sv.at[page, off].set(vs)
+        new_cache = (ck, cv, sk, sv)
+        scales = {"k_scale": sk, "v_scale": sv}
+    else:
+        ck, cv = layer_cache
+        ck = ck.at[page, off].set(k.astype(ck.dtype))
+        cv = cv.at[page, off].set(v.astype(cv.dtype))
+        new_cache = (ck, cv)
+        scales = {}
+    out = jnp.concatenate(
+        [
+            decode_attn.paged_decode_attention(
+                q[:, j: j + 1], ck, cv, cache_index + 1 + j, kv_tables,
+                **scales,
+            )
+            for j in range(t_w)
+        ],
+        axis=1,
+    )
+    return layers.out_project(out, p), new_cache
+
+
 def _attention(
     x: jax.Array,
     p: Params,
@@ -153,10 +205,10 @@ def _attention(
             k = layers.apply_rope(k, positions, cfg.rope_theta, rope_scale)
 
     if kv_tables is not None:
-        if layer_cache is None or getattr(cache_index, "ndim", 0) != 1 or x.shape[1] != 1:
+        if layer_cache is None or getattr(cache_index, "ndim", 0) != 1:
             raise ValueError(
-                "paged attention is single-token decode with a per-row "
-                "cache_index over a page-pool cache"
+                "paged attention is per-row decode (a per-row cache_index "
+                "over a page-pool cache)"
             )
         if cfg.sliding_window is not None:
             raise ValueError(
@@ -164,6 +216,24 @@ def _attention(
                 "cannot honor sliding_window"
             )
         from ..ops import decode_attn
+
+        if x.shape[1] > 1:
+            # Multi-token paged WINDOW (the speculative verify pass): row
+            # b's T tokens scatter their K/V through the page table at
+            # slots cache_index[b]..cache_index[b]+T-1, and query j reads
+            # its row's prefix through slot cache_index[b]+j — per-offset
+            # lengths give exact causality inside the window while the
+            # paged kernel's prefix contract covers everything before it.
+            # T is static (spec_k + 1), so the per-offset reads unroll
+            # into T kernel calls inside ONE compiled program; the MXU
+            # still sees the (k+1)-token matmuls everywhere else in the
+            # block, which is the point of verification.  Rollback is
+            # free, exactly like the contiguous spec cache: slots past
+            # the committed frontier hold junk no read ever admits
+            # (lengths cap every read), awaiting overwrite.
+            return _paged_window_attention(
+                q, k, v, p, layer_cache, cache_index, kv_tables
+            )
 
         if len(layer_cache) == 4:
             # Int8-quantized pool (QuantKVCache per layer): quantize this

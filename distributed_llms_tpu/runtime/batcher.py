@@ -98,6 +98,11 @@ from .shapes import bucket_length as _bucket
 
 log = get_logger("batcher")
 
+# Acceptance-EMA smoothing for the adaptive spec_k downshift: ~5 rounds of
+# history — fast enough that a cold draft downshifts within one long row,
+# slow enough that a single unlucky round doesn't collapse k.
+_SPEC_EMA_ALPHA = 0.2
+
 
 def _batch_axis(leaf_ndim: int) -> int:
     # KVCache leaves end in [..., B, S, KVH, HD]; batch is 4th from the right.
@@ -315,6 +320,13 @@ def spec_chunk(
     top_k: int = 0,
     top_p: float = 1.0,
     rng: jax.Array | None = None,  # required when temperature > 0
+    tables: jax.Array | None = None,  # [B, P] page table — the TARGET
+    #   cache is a page-pool (KVCache or int8 QuantKVCache) and the
+    #   verify window writes through it (the paged spec leg; the draft
+    #   cache stays contiguous)
+    k_row: jax.Array | None = None,  # [B] int32 adaptive per-row draft
+    #   length (acceptance clamped at j < k_row; traced, so the whole
+    #   spec_k ladder shares one compiled program)
 ) -> tuple:
     """ONE speculative round over the batch: draft k tokens per row
     against the draft cache, verify all of them in one (k+1)-token target
@@ -354,13 +366,43 @@ def spec_chunk(
     frontier convention shifted to the batcher's (a token's KV is written
     by the forward that consumes it, at slot == its position).
 
+    PAGED leg (``tables`` set — the spec x paged tentpole): the TARGET
+    cache is the shared page pool and the k-token draft/verify window
+    writes THROUGH the page tables (models.model._paged_window_attention
+    scatters the k+1 tokens' KV at slots real_lens..real_lens+k and each
+    verify query reads its row's prefix through per-offset lengths).
+    What the contiguous leg does with the ``+spec_k+1`` headroom slots,
+    the paged leg does with per-row SCRATCH-TAIL pages: the growth loop
+    provisions pages through slot real_lens+spec_k before every round,
+    and rejection rollback is the same pos/length clamp ``commit_clamp``
+    applies today — ``real_lens`` only advances by the committed count,
+    so the junk KV past the frontier is never read (the kernel's prefix
+    contract) and the next round overwrites it.  The small quantized
+    self-draft cache stays contiguous; ``valid`` gates only ITS masks
+    here.  Temp-0 bytes are identical to the contiguous spec engine and
+    to the non-speculative paged engine (tests/runtime/test_spec_paged).
+
+    ``k_row`` (both legs) is the budget-aware adaptive downshift: a
+    per-row TRACED draft-length clamp — acceptance stops at j < k_row,
+    and the forced stop at j == k_row emits the target's own token for
+    that position (greedy: greedy[j]; sampled: a draw from p_j with the
+    draft distribution zero-extended past the clamp, which is exactly a
+    fresh target sample), so the emitted stream is unchanged at ANY
+    clamp — only arrival granularity shrinks, freeing verify-token
+    budget for mixed prefill bites.  One compiled program serves the
+    whole spec_k ladder (graftcheck GC4 batcher.spec_chunk_paged).
+
     Chaining contract: like decode_chunk, every returned carry leaf
     (cache', draft_cache', last_tok', real_lens', valid', active',
     budget', counts') is a legal input for the next round — the
     dispatch-ahead engine loop chains speculative rounds device-resident
     exactly as it chains plain decode chunks (both caches are donated;
     the carry vectors are not)."""
-    s = cache.k.shape[-3]
+    paged = tables is not None
+    # Contiguous: draft and target share one slot layout (equal widths),
+    # so using the draft's width everywhere leaves the program unchanged;
+    # paged: the masks below gate only the contiguous DRAFT cache.
+    s = draft_cache.k.shape[-3]
     slots = jnp.arange(s, dtype=jnp.int32)
     penalized = counts is not None
     sampled = temperature > 0.0
@@ -426,17 +468,27 @@ def spec_chunk(
         drafts, qs = draft_ys, None
     drafts = drafts.T  # [B, k]
 
-    # --- verify: one (k+1)-token target forward.
+    # --- verify: one (k+1)-token target forward.  Paged: the window
+    # writes through the page tables and reads per-offset prefixes (no
+    # mask — the kernel's length contract is the causality); contiguous:
+    # the explicit row masks, exactly as before.
     vtoks = jnp.concatenate([last_tok[:, None], drafts], axis=1)
     voff = jnp.arange(k + 1, dtype=jnp.int32)
-    vmask = jnp.concatenate(
-        [row_mask(real_lens + q) for q in range(k + 1)], axis=2
-    )  # [B, 1, k+1, S]
-    vlogits, cache = model_lib.forward(
-        params, cfg, vtoks,
-        positions=real_lens[:, None] + voff[None, :],
-        cache=cache, cache_index=real_lens, attn_mask=vmask,
-    )
+    if paged:
+        vlogits, cache = model_lib.forward(
+            params, cfg, vtoks,
+            positions=real_lens[:, None] + voff[None, :],
+            cache=cache, cache_index=real_lens, kv_tables=tables,
+        )
+    else:
+        vmask = jnp.concatenate(
+            [row_mask(real_lens + q) for q in range(k + 1)], axis=2
+        )  # [B, 1, k+1, S]
+        vlogits, cache = model_lib.forward(
+            params, cfg, vtoks,
+            positions=real_lens[:, None] + voff[None, :],
+            cache=cache, cache_index=real_lens, attn_mask=vmask,
+        )
     if penalized:
         # counts_j = base + one-hots of d_1..d_j (position j consumed
         # [last_tok, d_1..d_j]; last_tok is already in the base histogram).
@@ -469,11 +521,26 @@ def spec_chunk(
         q_at = jnp.take_along_axis(qs, drafts[..., None], axis=-1)[..., 0]
         u = jax.random.uniform(ku, (b, k))
         accept = u * jnp.maximum(q_at, 1e-20) < p_at
+        if k_row is not None:
+            # Adaptive downshift, sampled leg: force a stop at j == k_row
+            # and zero the draft distribution past it — the "residual" at
+            # a forced stop is then max(p - 0, 0) = p itself, i.e. a
+            # fresh sample from the target (the draft was never consulted
+            # there), so the theorem's output distribution is preserved
+            # at any per-row clamp.
+            accept = jnp.logical_and(
+                accept, jnp.arange(k, dtype=jnp.int32)[None, :]
+                < k_row[:, None],
+            )
         lead = jnp.cumprod(accept.astype(jnp.int32), axis=1)
         a = jnp.sum(lead, axis=1)                        # [B] in 0..k
         # Unified residual: zero-extend q so position k's "residual" is
         # p_{k+1} itself (the bonus draw).
         q_ext = jnp.concatenate([qs, jnp.zeros_like(ps[:, :1])], axis=1)
+        if k_row is not None:
+            q_ext = q_ext * (
+                j_ar[None, :] < k_row[:, None]
+            ).astype(q_ext.dtype)[..., None]
         p_a = jnp.take_along_axis(ps, a[:, None, None], axis=1)[:, 0]
         q_a = jnp.take_along_axis(q_ext, a[:, None, None], axis=1)[:, 0]
         resid = jnp.maximum(p_a - q_a, 0.0)
@@ -496,7 +563,7 @@ def spec_chunk(
     else:
         greedy = jnp.argmax(pen_vlogits, axis=-1).astype(jnp.int32)
         cand, m, has_eos, _ = greedy_accept_commit(
-            drafts, greedy, active, budget, eos_id, k
+            drafts, greedy, active, budget, eos_id, k, k_row=k_row
         )
     # Chosen-token logprobs for the committed tokens (OpenAI logprobs
     # contract): vlogits[:, j] predicts the token committed at offset j.
@@ -1821,6 +1888,15 @@ class ContinuousBatcher:
         draft_params: Any = None,
         draft_cfg: ModelConfig | None = None,
         spec_k: int = 4,
+        # Adaptive spec_k downshift (greedy engines, schedule=mixed): a
+        # per-row acceptance-rate EMA feeds the scheduler's spec_round_k
+        # hook, which clamps each row's draft length — a cold draft stops
+        # burning n_active*(spec_k+1) verify tokens of the step budget on
+        # rounds that commit one token.  The clamp is a TRACED input
+        # (one compiled program across the whole ladder) and the forced
+        # stop emits the target's own token, so streams stay byte-exact
+        # at any clamp; only arrival granularity changes.
+        spec_adaptive_k: bool = True,
         # Chunked prefill: admission consumes at most this many prompt
         # tokens per scheduling round PER PENDING PREFILL (up to
         # ``prefill_concurrency`` advance concurrently), so a long prompt
@@ -1941,7 +2017,13 @@ class ContinuousBatcher:
                     f"max_len {max_len} must be a multiple of page_size "
                     f"{page_size}"
                 )
-            if paged_pages < max_len // page_size + 1:
+            # Speculative rows need scratch-TAIL pages past max_len (the
+            # verify window writes up to spec_k+1 slots beyond the
+            # frontier — the paged analogue of the contiguous engine's
+            # headroom slots), so a full-depth spec row holds a bit more
+            # than max_len/page_size pages.
+            _tail = spec_k + 1 if draft_params is not None else 0
+            if paged_pages < -(-(max_len + _tail) // page_size) + 1:
                 raise ValueError(
                     f"paged_pages {paged_pages} cannot hold even one "
                     f"full-depth row (+1 scratch page)"
@@ -1963,15 +2045,16 @@ class ContinuousBatcher:
         if self.speculative:
             if draft_cfg is None:
                 raise ValueError("draft_params needs draft_cfg")
-            if parallel is not None or paged_pages is not None:
-                # Paged KV and dp/tp meshes both serve through the PLAIN
-                # batcher (paged is mesh-legal since the pool/kernel grew
-                # SPMD rules); only the speculative draft/verify chain
-                # itself remains single-device contiguous.
+            if parallel is not None:
+                # The TARGET's KV rides the shared (shardable) pool in
+                # paged mode, but the draft/verify chain itself has no
+                # SPMD rule — spec x mesh stays fenced with a clear error
+                # while spec x paged (prefix cache, int8 pages, the swap
+                # tier, mixed budgets) composes since round 17.
                 raise ValueError(
-                    "speculative batching runs single-device contiguous "
-                    "mode; serve paged or mesh engines through the plain "
-                    "batcher (both compose — speculation does not, yet)"
+                    "speculative batching runs single-device (contiguous "
+                    "or paged); serve mesh engines through the plain "
+                    "batcher — the draft/verify chain has no SPMD rule"
                 )
             # Engine-wide temperature/top_k/top_p compose with speculation
             # (distribution-preserving rejection sampling in spec_chunk);
@@ -2033,11 +2116,21 @@ class ContinuousBatcher:
             schedule, chunk_steps=chunk_steps, prefill_chunk=prefill_chunk,
             prefill_concurrency=prefill_concurrency,
             token_budget=token_budget, speculative=self.speculative,
+            spec_adaptive=bool(spec_adaptive_k),
         )
         self._prefills: dict[int, _PendingPrefill] = {}  # slot -> pending
         self.draft_params = draft_params
         self.draft_cfg = draft_cfg
         self.spec_k = spec_k
+        # Per-row acceptance-rate EMA (fraction of drafted tokens accepted
+        # recently; optimistic 1.0 at admission so a fresh row drafts the
+        # full k) + cumulative spec accounting for bench/tests — a pure
+        # function of the committed stream, so downshifts are
+        # deterministic run to run.
+        self.spec_ema = np.ones((batch_slots,), np.float64)
+        self.spec_stats = {
+            "rounds": 0, "accepted": 0, "rejected": 0, "downshifts": 0,
+        }
         self.pm = parallel
         self.cfg = cfg
         # Decode-chunk variant of the config: ragged decode attention (row b
@@ -2148,7 +2241,15 @@ class ContinuousBatcher:
         self.host_tier: HostTier | None = None
         self.faults = faults  # FaultPlane | None (runtime/faults.py)
         if self.paged:
-            self.pages_per_row = max_len // page_size
+            # Speculative page tables carry the scratch-tail pages too:
+            # the verify window writes through slot real_lens + spec_k,
+            # so a full-depth row's table must reach past max_len by the
+            # k+1-token window (the contiguous engine's headroom slots,
+            # as pages).
+            self.pages_per_row = (
+                -(-(max_len + spec_k + 1) // page_size)
+                if self.speculative else max_len // page_size
+            )
             if prefix_cache:
                 self.prefix_cache = PrefixCache()
             if host_pages:
@@ -2169,8 +2270,13 @@ class ContinuousBatcher:
         # Sized to the CACHE width (speculative mode pads k+1 headroom slots
         # past max_len; admission row_valid vectors come back cache-sized).
         # Paged mode keeps per-row logical width (the cache is a page pool).
+        # Paged mode keeps per-row logical width (the target cache is a
+        # page pool) — EXCEPT under speculation, where ``valid`` gates the
+        # contiguous DRAFT cache's masks and must span its headroom slots.
         self.valid = np.zeros(
-            (batch_slots, max_len if self.paged else cache_len), bool
+            (batch_slots,
+             cache_len if (self.speculative or not self.paged) else max_len),
+            bool,
         )
         self.active = np.zeros((batch_slots,), bool)
         self.budget = np.zeros((batch_slots,), np.int32)
@@ -2262,9 +2368,12 @@ class ContinuousBatcher:
             )
         # Contiguous mode: CACHE width, not self.s — speculative mode pads
         # headroom slots and the admission splice needs shape-matched rows.
-        # Paged mode keeps logical width (the pool's shape[-3] is the page
-        # size, and its admission scatters by pages, not a splice).
-        width = self.s if self.paged else self.cache.k.shape[-3]
+        # Paged mode: the TABLE width (pages_per_row * page_size — equal to
+        # self.s except under speculation, whose tables carry scratch-tail
+        # pages), since _paged_splice reshapes the row into exactly the
+        # page-list's pages.
+        width = (self.pages_per_row * self.page_size if self.paged
+                 else self.cache.k.shape[-3])
         row_cache = model_lib.init_cache(
             self.cfg, 1, width, dtype=_row_dtype_of(self.cache)
         )
@@ -3049,6 +3158,24 @@ class ContinuousBatcher:
             # swap-restored constrained row continues under the exact
             # masks the unpreempted run would have seen.
             self.dfa_row[i] = req.constraint.advance(0, emitted)
+        if self.speculative:
+            # Rebuild the DRAFT cache from prompt + emitted: the draft is
+            # never swapped (small, quantized, contiguous) — one KV-only
+            # prefill of the first swap_pos tokens restores exactly the
+            # resident-KV invariant (the newest emitted token's KV is
+            # written by the round that consumes it, for both caches), so
+            # the reunited spec stream is byte-exact vs the never-
+            # preempted run.  req.ids already holds prompt + emitted.
+            seed_ids = req.ids[: req.swap_pos]
+            td = min(_bucket(len(seed_ids)), self.s)
+            dprompt = np.full((td,), self.pad_id, np.int32)
+            dprompt[: len(seed_ids)] = seed_ids
+            self.draft_cache = admit_row_kv(
+                self.draft_params, self.draft_cfg, self.draft_cache,
+                jnp.int32(i), jnp.asarray(dprompt),
+                jnp.int32(len(seed_ids)),
+            )
+            self.spec_ema[i] = 1.0
         self.last_tok[i] = req.swap_last_tok
         self.real_lens[i] = req.swap_pos
         self.valid[i] = np.arange(self.valid.shape[1]) < req.swap_pos
@@ -3276,9 +3403,17 @@ class ContinuousBatcher:
             row = self.rows[i]
             if row.rid is None or not self.active[i] or row.prefilling:
                 continue
-            horizon = int(self.real_lens[i]) + min(
-                self.chunk_steps, int(self.budget[i])
-            )
+            if self.speculative:
+                # The verify window writes slots real_lens..real_lens+k
+                # REGARDLESS of budget (rollback clamps commits, not
+                # writes) — pages must cover the whole window before the
+                # round dispatches, exactly the contiguous engine's
+                # headroom contract.
+                horizon = int(self.real_lens[i]) + self.spec_k + 1
+            else:
+                horizon = int(self.real_lens[i]) + min(
+                    self.chunk_steps, int(self.budget[i])
+                )
             need_pages = -(-horizon // blk)
             have = len(row.pages)
             if need_pages <= have:
@@ -3395,7 +3530,7 @@ class ContinuousBatcher:
                     jnp.asarray(prompt), jnp.int32(len(req.ids)),
                     self._split_rng(), pm=self.pm, **self.sampling, **extra,
                 )
-                row_valid = np.arange(self.s) < total_len
+                row_valid = np.arange(self.valid.shape[1]) < total_len
             elif self.paged and cached_len:
                 # Prefix-cache HIT: the cached run seeds the row through a
                 # pool gather; only the suffix prefills.  Writes for the
@@ -3414,14 +3549,14 @@ class ContinuousBatcher:
                     jnp.int32(len(suffix)), self._split_rng(),
                     pm=self.pm, **self.sampling, **extra,
                 )
-                row_valid = np.arange(self.s) < total_len
+                row_valid = np.arange(self.valid.shape[1]) < total_len
             elif self.paged:
                 self.cache, tok, lp = admit_row_paged(
                     self.params, self.cfg, self.cache, jnp.asarray(page_list),
                     jnp.asarray(prompt), jnp.int32(len(req.ids)),
                     self._split_rng(), pm=self.pm, **self.sampling, **extra,
                 )
-                row_valid = np.arange(self.s) < total_len
+                row_valid = np.arange(self.valid.shape[1]) < total_len
             elif pfx is not None:
                 self.cache, tok, row_valid, lp = admit_row_with_prefix(
                     self.params, self.cfg, self.cache, jnp.int32(i),
@@ -3467,6 +3602,7 @@ class ContinuousBatcher:
         state, stream the token."""
         tok = int(tok)  # replicated scalar — identical on every process
         self.last_tok[i] = tok
+        self.spec_ema[i] = 1.0  # fresh rows draft the full k (optimistic)
         if req.constraint is not None:
             # Automaton state after the admission token: replay (resumed
             # prefix +) the token on the host — the state is a pure
@@ -3866,6 +4002,14 @@ class ContinuousBatcher:
             if plan["counts"]:
                 per_spec["pres_row"] = jnp.asarray(self.pres_row)
                 per_spec["freq_row"] = jnp.asarray(self.freq_row)
+            # The adaptive k_row clamp is NOT part of the span-frozen
+            # plan: it is a traced [B] input (values never touch the
+            # compile key — graftcheck GC4 batcher.spec_chunk_paged), so
+            # _dispatch_chunk re-plans it per dispatch from the freshest
+            # EMA mirrors and ``k_hist`` pairs each dispatched clamp with
+            # its fetch (FIFO — chunks fetch in dispatch order) for the
+            # acceptance accounting.
+            plan["k_hist"] = deque()
             plan["per_spec"] = per_spec
         else:
             # Per-row sampling path only while a custom-sampled row is
@@ -3981,12 +4125,60 @@ class ContinuousBatcher:
                 # Sampled rounds consume RNG; greedy rounds must not
                 # (greedy spec stays bit-stable across configs).
                 per_spec["rng"] = self._split_rng()
+            if self.faults is not None:
+                # Injection site "batcher.spec_verify": the round is ONE
+                # compiled draft+verify program, so both tags fire at its
+                # dispatch — the tag selects which drill phase a rule
+                # targets ('draft' = the k draft steps, 'verify' = the
+                # (k+1)-token target pass).  A 'raise' here is the
+                # supervisor-restart drill for the speculative leg.
+                self.faults.fire("batcher.spec_verify", tag="draft")
+                self.faults.fire("batcher.spec_verify", tag="verify")
+            # Per-dispatch adaptive clamp (the scheduler's spec_round_k
+            # hook: token-budget clamp + acceptance-EMA downshift).
+            # Greedy engines only: the sampled forced-stop draw is
+            # distribution-preserving but changes the per-seed stream,
+            # and flipping the downshift on must never change sampled
+            # outputs.  The clamp is ALWAYS passed as a traced [B]
+            # vector (full k when inert) so one compiled program serves
+            # every value.  Mid-span the activity mirrors are stale by
+            # construction — stale the same way every run, so the
+            # downshift schedule stays deterministic.
+            live = self.active & np.asarray(
+                [r.rid is not None for r in self.rows]
+            )
+            emas = tuple(
+                float(self.spec_ema[i]) if live[i] else 1.0
+                for i in range(self.b)
+            )
+            if self.sampling["temperature"] == 0.0:
+                ks = self.sched.spec_round_k(
+                    self.spec_k, emas, int(live.sum())
+                )
+            else:
+                ks = [self.spec_k] * self.b
+            kh = np.clip(np.asarray(ks, np.int32), 1, self.spec_k)
+            plan["k_hist"].append(kh)
+            per_spec["k_row"] = jnp.asarray(kh)
+            METRICS.inc("batcher.spec.rounds")
+            self.spec_stats["rounds"] += 1
+            # Budget accounting: a round charges (k_row+1) COMMITTABLE
+            # tokens per live row against the ledger (spec_round_k
+            # already clamped the sum against token_budget).  The
+            # dispatched program is always k+1 wide — the ledger bounds
+            # commits, not flops (one compile key).
+            METRICS.inc("batcher.sched.decode_tokens",
+                        int(np.sum((kh + 1)[live])))
+            if bool((kh[live] < self.spec_k).any()):
+                METRICS.inc("batcher.spec.k_downshifts")
+                self.spec_stats["downshifts"] += 1
             (toks, m, lps, self.cache, self.draft_cache, last_tok,
              real_lens, valid, active, budget, counts_out) = spec_chunk(
                 self.params, self.cfg, self.draft_params, self.draft_cfg,
                 self.cache, self.draft_cache, last_tok, real_lens, valid,
                 active, budget, k=self.spec_k, eos_id=self.eos_id,
-                pad_id=self.pad_id, **self.sampling, **per_spec,
+                pad_id=self.pad_id, tables=plan["tables"],
+                **self.sampling, **per_spec,
             )
         else:
             per_row = dict(plan["per_row"])
@@ -4156,6 +4348,52 @@ class ContinuousBatcher:
         )
         return not self.sched.sync_triggers(view)
 
+    def _spec_note(self, m, was_active: np.ndarray, plan: dict) -> None:
+        """Per-round speculative accounting from the fetched commit
+        counts: update each row's acceptance-rate EMA (feeding the
+        scheduler's adaptive spec_k downshift at the next span plan) and
+        the spec metrics.  ``accepted`` counts committed DRAFTS (the
+        bonus/correction token excluded); EOS/budget clamps deflate it —
+        that loss is data, matching the standalone loop's accounting.
+        Everything here is a pure function of the committed stream and
+        the span structure, so two identical runs downshift
+        identically."""
+        if m is None:
+            return
+        # FIFO pairing: chunks fetch in dispatch order, so the head of
+        # k_hist is exactly the clamp this fetched chunk drafted with.
+        kh = (plan["k_hist"].popleft() if plan["k_hist"]
+              else np.full((self.b,), self.spec_k, np.int32))
+        acc = rej = 0
+        for i in range(self.b):
+            if not was_active[i] or m[i] <= 0:
+                continue
+            drafted = int(kh[i])
+            accepted = min(int(m[i]) - 1, drafted)
+            acc += accepted
+            rej += drafted - accepted
+            self.spec_ema[i] = (
+                (1.0 - _SPEC_EMA_ALPHA) * float(self.spec_ema[i])
+                + _SPEC_EMA_ALPHA * (accepted / max(drafted, 1))
+            )
+        if acc:
+            METRICS.inc("batcher.spec.accepted_tokens", acc)
+        if rej:
+            METRICS.inc("batcher.spec.rejected_tokens", rej)
+        self.spec_stats["accepted"] += acc
+        self.spec_stats["rejected"] += rej
+        total = self.spec_stats["accepted"] + self.spec_stats["rejected"]
+        if total:
+            # The cumulative acceptance gauge, fed by the same per-round
+            # fraction engine.spec_acceptance observes for the standalone
+            # speculative loop — one histogram serves both paths.
+            METRICS.set_gauge(
+                "batcher.spec.acceptance",
+                self.spec_stats["accepted"] / total,
+            )
+        if acc + rej:
+            METRICS.observe("engine.spec_acceptance", acc / (acc + rej))
+
     def _note_gap(self, gap_s: float) -> None:
         """Record one per-chunk device gap: the host time between the
         previous chunk completing and this chunk dispatching.  A
@@ -4191,9 +4429,16 @@ class ContinuousBatcher:
             row = self.rows[i]
             if row.rid is None or not self.active[i] or row.prefilling:
                 continue
-            horizon = int(self.real_lens[i]) + min(
-                horizon_chunks * self.chunk_steps, int(self.budget[i])
-            )
+            if self.speculative:
+                # A speculative chunk commits at most spec_k+1 tokens and
+                # always writes a spec_k+1 window past its frontier.
+                horizon = int(self.real_lens[i]) + min(
+                    horizon_chunks * (self.spec_k + 1), int(self.budget[i])
+                ) + self.spec_k + 1
+            else:
+                horizon = int(self.real_lens[i]) + min(
+                    horizon_chunks * self.chunk_steps, int(self.budget[i])
+                )
             need = -(-horizon // blk) - len(row.pages)
             if need <= 0:
                 continue
@@ -4321,6 +4566,8 @@ class ContinuousBatcher:
             # Chunk N's host work, concurrent with chunk N+1 on device.
             host_t0 = time.perf_counter()
             toks, lps, m, active_after = self._fetch_chunk(out)
+            if self.speculative:
+                self._spec_note(m, was_active, plan)
             if not active_after.any():
                 # Every row died (EOS) during the chunk we just fetched:
                 # the chunk dispatched ahead of it is a GHOST — all rows
@@ -4344,6 +4591,8 @@ class ContinuousBatcher:
         # inside the delivery callbacks lands on fresh state (the
         # synchronous loop's exact ordering).
         toks, lps, m = self._sync_carry(out)
+        if self.speculative:
+            self._spec_note(m, was_active, plan)
         METRICS.set_gauge("batcher.overlap.depth", 0)
         if self.overlap:
             self.overlap_stats["carry_syncs"] += 1

@@ -468,7 +468,11 @@ class InferenceEngine:
         #   mode — a config-inherited flag degrades with a warning where
         #   paged itself does)
         speculative: bool | None = None,  # None -> rt.spec_decode; needs an
-        #   attached draft + greedy + single-device contiguous mode
+        #   attached draft + greedy + a single-device engine (contiguous
+        #   OR paged — the target's KV rides the shared page pool and the
+        #   draft/verify window writes through the page tables; prefix
+        #   cache, int8 pages, the swap tier and mixed budgets all
+        #   compose).  Mesh engines serve plain
         prefill_chunk: int | None = None,  # chunked prefill: admit at most
         #   this many prompt tokens per scheduling round PER PENDING
         #   prefill (contiguous or paged, single-device or dp/tp mesh —
@@ -626,20 +630,36 @@ class InferenceEngine:
             # default 8 on a data=16 mesh).
             dp = self.parallel.mesh.shape.get("data", 1)
             batch_slots = -(-batch_slots // dp) * dp
+        explicit_spec = speculative is not None
         if speculative is None:
             # Config-driven default mirrors generate_text's routing: only
             # when every precondition holds (never erroring where the plain
             # batcher works).  temperature == 0 keeps the flip-on-spec
             # bit-exactness contract; sampled speculation (distribution-
             # preserving, different RNG stream) is available by passing
-            # speculative=True explicitly.
+            # speculative=True explicitly.  Paged pools compose since
+            # round 17 (the draft/verify window writes through the page
+            # tables), so paged engines speculate by default too.
             speculative = (
                 self.rt.spec_decode
                 and self.rt.temperature == 0.0
                 and self.parallel is None
-                and paged_pages is None
                 and getattr(self, "draft_params", None) is not None
             )
+        if speculative and prefill_chunk is not None and not explicit_spec:
+            # Config-inherited degrade (the shared cluster-config policy
+            # every paged knob follows): a config with spec_decode on must
+            # not brick a server that also chunks prefills — the draft
+            # admission prefills monolithically, so speculation turns off
+            # with a warning.  An explicit speculative=True still errors
+            # loudly in the batcher constructor.
+            log.warning(
+                "runtime.spec_decode ignored: chunked prefill is "
+                "configured (prefill_chunk=%d) and the speculative draft "
+                "admission prefills monolithically; serving plain",
+                prefill_chunk,
+            )
+            speculative = False
         spec_kwargs = {}
         if speculative:
             if getattr(self, "draft_params", None) is None:
@@ -650,6 +670,7 @@ class InferenceEngine:
             spec_kwargs = dict(
                 draft_params=self.draft_params, draft_cfg=self.draft_cfg,
                 spec_k=self.rt.spec_k,
+                spec_adaptive_k=self.rt.spec_adaptive_k,
             )
         if faults is None and self.rt.faults:
             # Config-driven fault plane (operator drills / CI): each batcher

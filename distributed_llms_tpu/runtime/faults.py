@@ -98,6 +98,13 @@ FAULT_SITES: dict[str, str] = {
         "(chunk-boundary growth) — 'exhaust' forces the pressure path",
     "batcher.preempt":
         "one hit per row preemption, BEFORE the victim's pages are freed",
+    "batcher.spec_verify":
+        "each speculative draft/verify round about to dispatch (the "
+        "round is one compiled program): tag 'draft' = the k draft "
+        "steps, 'verify' = the (k+1)-token target pass — 'raise' is the "
+        "supervisor-restart drill for the speculative leg (respawn "
+        "re-admits and serves byte-exact), 'stall:<s>' wedges it for "
+        "the watchdog",
     "batcher.mixed_step":
         "each mixed-schedule dispatch (runtime/scheduler.py): tag "
         "'prefill' when the step carries a fused prefill bite, 'decode' "

@@ -65,6 +65,12 @@ HOOKS: dict[str, str] = {
     "sync_triggers":
         "which conditions end a dispatch-ahead span (the overlap "
         "plane's host-sync decision list)",
+    "spec_round_k":
+        "per-row COMMIT bound for the next speculative round: the sum of "
+        "committable tokens (k_row+1 per live row) is clamped against "
+        "the per-step token budget and each row's acceptance-rate EMA "
+        "feeds an adaptive downshift — a ledger/granularity bound; the "
+        "compiled round's device work is constant (one compile key)",
 }
 
 # Rung names of the declared pressure ladder (PR-9's order).  "evict_spill"
@@ -109,7 +115,8 @@ class Scheduler:
                  prefill_chunk: int | None = None,
                  prefill_concurrency: int = 2,
                  token_budget: int | None = None,
-                 speculative: bool = False) -> None:
+                 speculative: bool = False,
+                 spec_adaptive: bool = True) -> None:
         if token_budget is not None and token_budget < 1:
             raise ValueError(
                 f"token_budget must be >= 1, got {token_budget}"
@@ -119,6 +126,7 @@ class Scheduler:
         self.prefill_concurrency = prefill_concurrency
         self.token_budget = token_budget
         self.speculative = speculative
+        self.spec_adaptive = spec_adaptive
 
     # -- admission order ---------------------------------------------------
 
@@ -178,6 +186,17 @@ class Scheduler:
         dropping ``swap_preempt`` from a policy would send every victim
         straight to exact recompute."""
         return PRESSURE_LADDER
+
+    # -- speculative round sizing ------------------------------------------
+
+    def spec_round_k(self, k_max: int, emas: Sequence[float],
+                     n_active: int) -> list[int]:
+        """Per-row draft length for the next speculative round.  The
+        alternate policy never downshifts: every row drafts the full k
+        (the PR-6..16 behavior), and the batcher's traced clamp is inert.
+        ``emas`` is one acceptance-rate EMA per batch slot (1.0 for
+        non-live slots)."""
+        return [k_max] * len(emas)
 
     # -- overlap sync triggers ---------------------------------------------
 
@@ -277,6 +296,49 @@ class MixedScheduler(Scheduler):
         return None if view.head_prefill_left > 0 else "prefill_finish"
 
 
+class SpecMixedScheduler(MixedScheduler):
+    """Budget-aware speculative rounds — the ``mixed`` policy a
+    speculative engine schedules under (selected by :func:`make_scheduler`
+    when ``speculative=True``).  A round charges ``k_row+1`` committable
+    tokens per live row against ``token_budget``, so two clamps size each
+    row's commit bound:
+
+    - BUDGET (engine-wide): k_row shrinks until the round's committable
+      sum fits the per-step budget — the scheduler's ledger stays
+      consistent and a round never commits (or delivers) more tokens
+      than the budget, keeping cancel/deadline cadence bounded;
+    - ACCEPTANCE (per row): each row's acceptance-rate EMA scales its
+      bound (``max(1, round(ema * k_max))``) — a cold draft's commits
+      shrink toward plain-decode granularity.
+
+    These are LEDGER bounds, not compute savers: the compiled round
+    always runs the full ``k_max``-step draft scan and ``k_max+1``-token
+    verify (static shapes are what keep the whole ladder on ONE compile
+    key — graftcheck GC4 ``batcher.spec_chunk_paged``), so clamping
+    discards already-verified tokens rather than skipping work.
+    Skipping a genuinely cold row's round entirely (dispatching the
+    plain decode program instead) is the compute-saving follow-up; see
+    ROADMAP.  Both clamps reach the compiled round as ONE traced [B]
+    vector (``spec_chunk``'s ``k_row``), and the forced stop emits the
+    target's own token — streams stay byte-exact at any clamp (only
+    arrival granularity changes).
+    """
+
+    name = "mixed"
+
+    def spec_round_k(self, k_max: int, emas: Sequence[float],
+                     n_active: int) -> list[int]:
+        if not self.spec_adaptive:
+            return [k_max] * len(emas)
+        kb = k_max
+        if self.token_budget is not None and n_active:
+            while kb > 1 and n_active * (kb + 1) > self.token_budget:
+                kb -= 1
+        return [
+            min(kb, max(1, int(e * k_max + 0.5))) for e in emas
+        ]
+
+
 POLICIES: dict[str, type[Scheduler]] = {
     "alternate": Scheduler,
     "mixed": MixedScheduler,
@@ -286,11 +348,15 @@ POLICIES: dict[str, type[Scheduler]] = {
 def make_scheduler(name: str, **knobs: Any) -> Scheduler:
     """Build the named policy (``--schedule`` / ``RuntimeConfig.schedule``).
     Unknown names fail loudly — a typo'd schedule must not silently serve
-    the default."""
+    the default.  A speculative engine's ``mixed`` policy resolves to the
+    :class:`SpecMixedScheduler` subclass (budget-aware spec rounds) — new
+    scheduling behaviors land as subclasses here, not batcher branches."""
     try:
         cls = POLICIES[name]
     except KeyError:
         raise ValueError(
             f"unknown schedule {name!r}; known: {sorted(POLICIES)}"
         ) from None
+    if knobs.get("speculative") and cls is MixedScheduler:
+        cls = SpecMixedScheduler
     return cls(**knobs)

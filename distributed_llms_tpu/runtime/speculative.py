@@ -62,6 +62,14 @@ def greedy_accept_commit(
     budget: jax.Array,   # [B] int32 — tokens each row may still emit
     eos_id: int,
     k: int,
+    k_row: jax.Array | None = None,  # [B] int32 — per-row effective draft
+    #   length (the adaptive spec_k downshift): acceptance is clamped at
+    #   j < k_row[b], so a row commits at most k_row[b]+1 tokens.  A
+    #   forced stop at j == k_row emits greedy[j] — the token the
+    #   sequential greedy decode would emit there — so the stream stays
+    #   bit-identical at ANY per-row clamp; only arrival granularity
+    #   changes.  Traced, so every clamp value shares one compiled
+    #   program (graftcheck GC4 batcher.spec_chunk_paged).
 ) -> tuple[jax.Array, jax.Array, jax.Array]:
     """Greedy acceptance + commit bookkeeping — the SINGLE definition shared
     by the standalone loop and the batcher's spec_chunk (their only
@@ -70,6 +78,9 @@ def greedy_accept_commit(
     cand[:m] per row; m accounts for EOS truncation, the budget clamp, and
     dead rows; a is the raw accepted-draft count (for acceptance stats)."""
     agree = drafts == greedy[:, :k]
+    if k_row is not None:
+        jk = jnp.arange(k, dtype=jnp.int32)
+        agree = jnp.logical_and(agree, jk[None, :] < k_row[:, None])
     lead = jnp.cumprod(agree.astype(jnp.int32), axis=1)
     a = jnp.sum(lead, axis=1)                            # [B] in 0..k
     j_ar = jnp.arange(k + 1, dtype=jnp.int32)

@@ -62,6 +62,10 @@ ISOLATED = [
     # Dispatch-ahead overlap (round 13): the speculative leg compiles
     # spec_chunk programs — same crash class as test_spec_batcher.
     "tests/runtime/test_overlap.py::test_speculative_exact_on_vs_off",
+    # Paged speculative decoding (round 17): every composition leg
+    # compiles paged spec_chunk programs — same crash class as
+    # test_spec_batcher.
+    "tests/runtime/test_spec_paged.py",
     # Stall-free mixed batching (round 16): every fused-step composition
     # compiles mixed_step programs per pool/bucket config — the policy
     # hook tests at the top of the file are model-free and also run in

@@ -202,9 +202,14 @@ def test_spec_batcher_guards(models):
     cfg, params, dcfg, dparams = models
     with pytest.raises(ValueError, match="draft_cfg"):
         ContinuousBatcher(cfg, params, max_len=64, draft_params=dparams)
-    with pytest.raises(ValueError, match="single-device"):
+    # spec x paged composes since round 17 (the draft/verify window rides
+    # the page pool); only chunked prefill still rejects with a clear
+    # error (the draft admission prefills the full prompt monolithically).
+    ContinuousBatcher(cfg, params, draft_params=dparams, draft_cfg=dcfg,
+                      paged_pages=8, page_size=16, max_len=64)
+    with pytest.raises(ValueError, match="chunked prefill"):
         ContinuousBatcher(cfg, params, draft_params=dparams, draft_cfg=dcfg,
-                          paged_pages=8, page_size=16, max_len=64)
+                          prefill_chunk=8, max_len=64)
     with pytest.raises(ValueError, match="vocab"):
         bad = presets.get_preset("llama-tiny", vocab_size=97)
         ContinuousBatcher(cfg, params, max_len=64,

@@ -1,0 +1,367 @@
+"""Paged speculative decoding (round 17): the draft/verify chain joins the
+paged pool, prefix cache, pressure ladder, and mixed scheduler.
+
+The acceptance contract pinned here:
+
+- **Byte-exactness.**  At temperature 0 the paged speculative engine's
+  streams are IDENTICAL to (a) the contiguous speculative engine and
+  (b) the non-speculative paged engine — across prefix-cache hits, int8
+  pages, overlap on/off, mixed-step budgets, and the adaptive spec_k
+  downshift (acceptance only changes arrival granularity, never bytes).
+- **swap x spec (the ROADMAP's declared composition debt).**  A
+  speculative row preempted mid-stream through the SWAP rung restores
+  byte-exact (target pages verbatim from the host tier, draft cache
+  rebuilt from prompt+emitted), and the host-budget-dry recompute
+  fallback leg is equally exact.
+- **Clear rejections.**  spec x {chunked prefill, mesh>1, constraints}
+  still fail fast with actionable errors.
+- **The audit holds.**  ``assert_pool_consistent()`` after every
+  workload — scratch-tail pages release with their rows.
+"""
+
+import types
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_llms_tpu.core.observability import METRICS
+from distributed_llms_tpu.models import model as model_lib, presets
+from distributed_llms_tpu.runtime import generate as gen_lib
+from distributed_llms_tpu.runtime.batcher import ContinuousBatcher
+from distributed_llms_tpu.runtime.faults import FaultPlane, InjectedFault
+from distributed_llms_tpu.runtime.scheduler import (MixedScheduler,
+                                                    Scheduler,
+                                                    SpecMixedScheduler,
+                                                    make_scheduler)
+
+# Spec programs crash long-lived XLA:CPU processes — whole-family
+# fresh-process isolation (tests/conftest.py + test_isolated.ISOLATED).
+pytestmark = pytest.mark.fragile_xla_cpu
+
+
+@pytest.fixture(scope="module")
+def models():
+    cfg = presets.get_preset("llama-tiny", vocab_size=512)
+    params = model_lib.init_params(jax.random.key(0), cfg)
+    dcfg = presets.get_preset("llama-tiny", vocab_size=512, num_layers=2)
+    dparams = model_lib.init_params(jax.random.key(99), dcfg)  # unrelated
+    return cfg, params, dcfg, dparams
+
+
+def _mk(models, spec=True, self_draft=False, **kw):
+    cfg, params, dcfg, dparams = models
+    kw.setdefault("batch_slots", 2)
+    kw.setdefault("max_len", 64)
+    kw.setdefault("chunk_steps", 4)
+    if spec:
+        kw.setdefault("spec_k", 3)
+        kw.setdefault("draft_params", params if self_draft else dparams)
+        kw.setdefault("draft_cfg", cfg if self_draft else dcfg)
+    return ContinuousBatcher(cfg, params, **kw)
+
+
+def _run(b, reqs):
+    rids = [b.submit(ids, max_new_tokens=n) for ids, n in reqs]
+    res = b.run()
+    b.assert_pool_consistent()
+    return [res[r] for r in rids]
+
+
+REQS = [([7, 1, 9, 4, 2], 9), ([4, 4, 4], 5), ([11, 12], 12), ([42], 7),
+        ([3, 1], 1)]
+PAGED = dict(paged_pages=24, page_size=16)
+
+
+# -- the composition matrix: byte-exact vs contiguous spec AND plain --------
+
+
+def test_paged_spec_matches_plain_and_contiguous(models):
+    """The tentpole invariant: paged speculative streams are bit-identical
+    to the contiguous speculative engine's AND to the plain (greedy,
+    non-spec) engine's — paged or not."""
+    plain = _run(_mk(models, spec=False), REQS)
+    plain_paged = _run(_mk(models, spec=False, **PAGED), REQS)
+    spec_cont = _run(_mk(models), REQS)
+    spec_paged = _run(_mk(models, **PAGED), REQS)
+    assert plain == plain_paged == spec_cont == spec_paged
+
+
+def test_paged_spec_self_draft_backfill(models):
+    """Self-draft: every round fully accepts — hammers the draft backfill
+    and the scratch-tail page walk round after round."""
+    reqs = [([7, 1, 9], 13), ([5, 5], 11)]
+    plain = _run(_mk(models, spec=False), reqs)
+    sp = _run(_mk(models, self_draft=True, spec_k=4, **PAGED), reqs)
+    assert plain == sp
+
+
+def test_paged_spec_prefix_cache_hit_exact(models):
+    """A cache-hit speculative admission (admit_row_auto_paged) skips the
+    drafted TARGET prefill for the cached run — bytes and cached_tokens
+    both match the non-spec paged engine."""
+    shared = list(range(100, 118))  # 18 tokens > one 8-token page
+
+    def leg(spec):
+        b = _mk(models, spec=spec, paged_pages=30, page_size=8,
+                prefix_cache=True)
+        r1 = b.submit(shared + [11], max_new_tokens=6)
+        o1 = b.run()
+        r2 = b.submit(shared + [12], max_new_tokens=6)
+        o2 = b.run()
+        b.assert_pool_consistent()
+        return o1[r1], o2[r2], b.prefix_cached_tokens[r2]
+
+    p1, p2, pc = leg(False)
+    s1, s2, sc = leg(True)
+    assert (p1, p2) == (s1, s2)
+    assert sc == pc and sc == 16  # two full 8-token pages served from cache
+
+
+def test_paged_spec_int8_exact_vs_int8_plain(models):
+    """spec x int8 pages: the verify window quantizes its writes exactly
+    like the plain decode step, so streams equal the int8 plain engine's
+    (quantization is parity-bounded vs bf16, but spec-vs-plain at one
+    width is byte-exact)."""
+    plain8 = _run(_mk(models, spec=False, kv_bits=8, **PAGED), REQS)
+    spec8 = _run(_mk(models, kv_bits=8, **PAGED), REQS)
+    assert plain8 == spec8
+
+
+def test_paged_spec_overlap_on_vs_off(models):
+    """The dispatch-ahead carry chains paged spec rounds device-resident;
+    bytes identical with the overlap on or off."""
+    on = _run(_mk(models, overlap=True, **PAGED), REQS)
+    off = _run(_mk(models, overlap=False, **PAGED), REQS)
+    assert on == off
+
+
+def test_paged_spec_named_prefix_exact(models):
+    """register_prefix x paged spec: the prefix KV seeds the target row at
+    the spec table width; the draft prefills prefix+suffix itself."""
+    def leg(spec):
+        b = _mk(models, spec=spec, **PAGED)
+        b.register_prefix("sys", [9, 8, 7, 6, 5])
+        rids = [b.submit([1, 2], max_new_tokens=7, prefix="sys"),
+                b.submit([4, 4, 4], max_new_tokens=6)]
+        res = b.run()
+        b.assert_pool_consistent()
+        return [res[r] for r in rids]
+
+    assert leg(False) == leg(True)
+
+
+# -- swap x spec: the ROADMAP's declared composition debt -------------------
+
+
+STORM = [([7, 1, 9, 2], 44), ([4, 4, 4, 4], 44), ([9, 8, 7, 3], 44)]
+
+
+def test_swap_preempt_spec_byte_exact(models):
+    """Pin swap x spec byte-exact: an overcommitted speculative storm with
+    the host tier armed SWAPS victims out mid-stream; every restored
+    stream equals the never-preempted paged-spec run AND the contiguous
+    spec run — and the spec accounting survives the preemption."""
+    ref = _run(_mk(models, batch_slots=3, paged_pages=22, page_size=16,
+                   spec_k=3), STORM)           # roomy pool: no preemption
+    cont = _run(_mk(models, batch_slots=3, spec_k=3), STORM)  # contiguous
+    out0 = METRICS.get_counter("batcher.kv_swaps.out")
+    in0 = METRICS.get_counter("batcher.kv_swaps.in")
+    b = _mk(models, batch_slots=3, paged_pages=9, page_size=16,
+            spec_k=3, host_pages=16)
+    got = _run(b, STORM)
+    assert got == ref == cont
+    assert b.preemptions >= 1
+    assert METRICS.get_counter("batcher.kv_swaps.out") - out0 >= 1
+    assert METRICS.get_counter("batcher.kv_swaps.in") - in0 >= 1
+    assert b.spec_stats["rounds"] > 0
+
+
+def test_swap_spec_host_budget_dry_recompute_fallback(models):
+    """The same storm with a 1-page host tier: every victim falls back to
+    exact recompute (draft re-prefilled from prompt+emitted at
+    re-admission) — still byte-exact, and the fallback counter says so."""
+    ref = _run(_mk(models, batch_slots=3, paged_pages=22, page_size=16,
+                   spec_k=3), STORM)
+    fb0 = METRICS.get_counter("batcher.kv_swaps.fallback")
+    in0 = METRICS.get_counter("batcher.kv_swaps.in")
+    b = _mk(models, batch_slots=3, paged_pages=9, page_size=16,
+            spec_k=3, host_pages=1)
+    got = _run(b, STORM)
+    assert got == ref
+    assert b.preemptions >= 1
+    assert METRICS.get_counter("batcher.kv_swaps.fallback") - fb0 >= 1
+    assert METRICS.get_counter("batcher.kv_swaps.in") == in0
+
+
+def test_swap_spec_streams_once_across_restore(models):
+    """Streamed deliveries across a spec swap restore never re-deliver
+    and fire done exactly once per rid."""
+    b = _mk(models, batch_slots=3, paged_pages=9, page_size=16,
+            spec_k=3, host_pages=16)
+    deliveries, dones = {}, {}
+
+    def on_tokens(rid, toks, done, lps):
+        deliveries.setdefault(rid, []).extend(toks)
+        if done:
+            dones[rid] = dones.get(rid, 0) + 1
+
+    rids = [b.submit(ids, max_new_tokens=n) for ids, n in STORM]
+    res = b.run(on_tokens=on_tokens)
+    b.assert_pool_consistent()
+    assert b.preemptions >= 1
+    for rid in rids:
+        assert deliveries[rid] == res[rid], "stream diverged from result"
+        assert dones[rid] == 1
+
+
+# -- adaptive spec_k downshift ----------------------------------------------
+
+
+def test_adaptive_k_downshift_deterministic_and_exact(models):
+    """An unrelated draft's acceptance collapses, the EMA downshifts k —
+    bytes still equal the plain engine's, two identical runs downshift
+    identically, and the downshift counter moved."""
+    reqs = [([7, 1, 9, 4, 2], 24), ([4, 4, 4], 20)]
+    plain = _run(_mk(models, spec=False, **PAGED), reqs)
+
+    def leg():
+        b = _mk(models, spec_k=4, **PAGED)
+        return _run(b, reqs), dict(b.spec_stats)
+
+    got1, stats1 = leg()
+    got2, stats2 = leg()
+    assert got1 == plain and got2 == plain
+    assert stats1 == stats2, "downshift schedule is nondeterministic"
+    assert stats1["downshifts"] >= 1, "cold draft never downshifted"
+    assert stats1["rejected"] > 0
+
+
+def test_adaptive_k_off_never_downshifts(models):
+    reqs = [([7, 1, 9, 4, 2], 16)]
+    plain = _run(_mk(models, spec=False, **PAGED), reqs)
+    b = _mk(models, spec_k=4, spec_adaptive_k=False, **PAGED)
+    assert _run(b, reqs) == plain
+    assert b.spec_stats["downshifts"] == 0
+
+
+def test_token_budget_clamps_spec_rounds(models):
+    """Mixed-step budget accounting: with token_budget tighter than
+    n_active*(spec_k+1), the scheduler clamps every round's draft length
+    (downshifts fire even with a perfect self-draft) and bytes stay
+    identical to the unbudgeted run."""
+    reqs = [([7, 1, 9], 12), ([5, 5], 12)]
+    free = _run(_mk(models, self_draft=True, spec_k=4, **PAGED), reqs)
+    d0 = METRICS.get_counter("batcher.spec.k_downshifts")
+    b = _mk(models, self_draft=True, spec_k=4, token_budget=6, **PAGED)
+    got = _run(b, reqs)
+    assert got == free
+    assert b.spec_stats["downshifts"] >= 1
+    assert METRICS.get_counter("batcher.spec.k_downshifts") > d0
+
+
+# -- scheduler policy hooks (model-free) ------------------------------------
+
+
+def test_spec_round_k_policy_hooks():
+    """The budget-aware spec policy subclass: mixed+speculative resolves
+    to SpecMixedScheduler; the budget clamp bounds n_active*(k+1); the
+    EMA scales per-row k; alternate and adaptive-off never downshift."""
+    s = make_scheduler("mixed", speculative=True, token_budget=8)
+    assert isinstance(s, SpecMixedScheduler)
+    # Budget clamp: 2 rows at k=4 would cost 10 > 8 -> kb=3 (cost 8).
+    assert s.spec_round_k(4, (1.0, 1.0), 2) == [3, 3]
+    # EMA downshift: a cold row drops toward 1, a hot row keeps kb.
+    assert s.spec_round_k(4, (1.0, 0.1), 2) == [3, 1]
+    assert s.spec_round_k(4, (0.0, 0.5), 1) == [1, 2]
+    # No budget: only the EMA clamps.
+    s2 = make_scheduler("mixed", speculative=True)
+    assert s2.spec_round_k(4, (1.0, 0.4), 4) == [4, 2]
+    # Adaptive off / alternate policy: always the full k.
+    s3 = make_scheduler("mixed", speculative=True, spec_adaptive=False)
+    assert s3.spec_round_k(4, (0.0, 0.0), 2) == [4, 4]
+    s4 = make_scheduler("alternate", speculative=True, token_budget=4)
+    assert type(s4) is Scheduler
+    assert s4.spec_round_k(4, (0.0,), 3) == [4]
+    # Non-speculative mixed stays the plain MixedScheduler.
+    assert type(make_scheduler("mixed")) is MixedScheduler
+
+
+# -- pool geometry ----------------------------------------------------------
+
+
+def test_spec_scratch_tail_geometry(models):
+    """Spec page tables carry the scratch-tail pages (the contiguous
+    engine's +spec_k+1 headroom, as pages) and the pool floor check
+    accounts for them."""
+    cfg, params, dcfg, dparams = models
+    b = _mk(models, spec_k=3, **PAGED)
+    assert b.pages_per_row == -(-(64 + 3 + 1) // 16) == 5
+    assert _mk(models, spec=False, **PAGED).pages_per_row == 4
+    # 5 pages + 1 scratch is the spec floor at max_len 64 / page 16.
+    with pytest.raises(ValueError, match="full-depth row"):
+        _mk(models, spec_k=3, paged_pages=5, page_size=16)
+    _mk(models, spec=False, paged_pages=5, page_size=16)  # plain fits
+
+
+# -- fault drill + supervisor respawn ---------------------------------------
+
+
+def test_spec_verify_raise_drill_respawn_exact(models):
+    """batcher.spec_verify raise drill: the crash propagates out of run()
+    (the supervisor contract), the rule counts exactly one firing, and a
+    respawn serves the same request byte-exact."""
+    want = _run(_mk(models, **PAGED), [([7, 1, 9], 8)])
+    plane = FaultPlane.parse("batcher.spec_verify/verify:raise@2")
+    b = _mk(models, faults=plane, **PAGED)
+    b.submit([7, 1, 9], max_new_tokens=8)
+    with pytest.raises(InjectedFault):
+        b.run()
+    assert plane.rules[0].fired == 1
+    b2 = b.respawn()
+    rid = b2.submit([7, 1, 9], max_new_tokens=8)
+    assert [b2.run()[rid]] == want
+    b2.assert_pool_consistent()
+    # The draft-tagged leg drills the same site's other phase.
+    plane_d = FaultPlane.parse("batcher.spec_verify/draft:raise@1")
+    bd = _mk(models, faults=plane_d, **PAGED)
+    bd.submit([4, 4], max_new_tokens=4)
+    with pytest.raises(InjectedFault):
+        bd.run()
+    assert plane_d.rules[0].fired == 1
+
+
+def test_spec_metrics_accrue(models):
+    r0 = METRICS.get_counter("batcher.spec.rounds")
+    a0 = METRICS.get_counter("batcher.spec.accepted_tokens")
+    b = _mk(models, self_draft=True, **PAGED)
+    _run(b, [([7, 1, 9], 10)])
+    assert METRICS.get_counter("batcher.spec.rounds") > r0
+    assert METRICS.get_counter("batcher.spec.accepted_tokens") > a0
+    assert 0.0 <= METRICS.get_gauge("batcher.spec.acceptance") <= 1.0
+
+
+# -- rejections stay clear --------------------------------------------------
+
+
+def test_spec_rejections_still_clear(models):
+    cfg, params, dcfg, dparams = models
+    spec = dict(draft_params=dparams, draft_cfg=dcfg)
+    # chunked prefill: the draft admission prefills monolithically.
+    with pytest.raises(ValueError, match="chunked prefill"):
+        ContinuousBatcher(cfg, params, max_len=64, prefill_chunk=8, **spec)
+    # mesh > 1: the draft/verify chain has no SPMD rule.
+    fake_mesh = types.SimpleNamespace(shape={"data": 1, "model": 1})
+    fake_pm = types.SimpleNamespace(pipelined=False, seq_parallel=False,
+                                    mesh=fake_mesh, kv_dtype=None)
+    with pytest.raises(ValueError, match="single-device"):
+        ContinuousBatcher(cfg, params, max_len=64, parallel=fake_pm, **spec)
+    # constraints: the token mask would need to ride both models.
+    b = _mk(models, **PAGED)
+    with pytest.raises(ValueError, match="constrained"):
+        b.submit([1, 2], max_new_tokens=4,
+                 response_format={"type": "regex", "regex": "a+"})
+    # per-request sampling overrides: one static warp config per engine.
+    with pytest.raises(ValueError, match="engine-wide"):
+        b.submit([1, 2], max_new_tokens=4, temperature=0.9)

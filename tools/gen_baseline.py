@@ -94,6 +94,18 @@ def _result_cell(row: dict) -> str:
         ("device_gap_ms_on", "device-gap ms on"),
         ("gap_reduction", "gap reduction x"),
         ("dispatched_ahead_frac", "dispatched-ahead frac"),
+        ("exact_spec_vs_plain", "spec byte-exact"),
+        ("tok_per_s_plain", "tok/s spec-off"),
+        ("tok_per_s_spec", "tok/s spec-on"),
+        ("itl_p50_ms_plain", "ITL p50 ms spec-off"),
+        ("itl_p50_ms_spec", "ITL p50 ms spec-on"),
+        ("acceptance_frac", "acceptance frac"),
+        ("spec_rounds", "spec rounds"),
+        ("k_downshifts", "k downshifts"),
+        ("rows_contig_spec", "rows @contiguous-spec"),
+        ("rows_paged_spec", "rows @paged-spec"),
+        ("capacity_factor", "capacity factor"),
+        ("pool_kib", "pool KiB"),
         ("itl_p95_ms_alternate", "ITL p95 ms (alternate)"),
         ("itl_p95_ms_mixed", "ITL p95 ms (mixed)"),
         ("itl_p95_gain", "ITL p95 gain x"),
@@ -150,6 +162,7 @@ def generate(ladder_path: str) -> str:
         "serving-latency", "continuous-batching", "local-proc-batching",
         "chunked-prefill", "prefix-cache-ttft", "fault-recovery",
         "overload-goodput", "kv-tiering", "decode-overlap", "mixed-step",
+        "spec-paged",
         "constrained-decode", "mesh-paged", "replica-failover",
         "disagg-handoff", "compile-stability", "analysis-wall",
         "ragged-decode-8k", "ragged-decode-win-8k", "quant-matmul-bw",

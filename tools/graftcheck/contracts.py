@@ -614,6 +614,63 @@ def _mixed_step_cases() -> list[OpCase]:
     return cases
 
 
+def _spec_chunk_paged_cases() -> list[OpCase]:
+    """The paged speculative round (spec x paged tentpole): across spec_k
+    values and BOTH pool widths, the round keeps [B, k+1] int32 tokens +
+    f32 logprobs and [B] int32 commit counts (``commit_clamp``'s
+    pos/length rollback output), the POOL leaves keep pool-storage dtypes
+    (the scratch-tail window writes must not widen int8 data or f32
+    scales), and the contiguous DRAFT cache keeps its dtype.  ``k_row``
+    (the adaptive downshift) and the page tables are engaged in every
+    case — the shapes the engine actually dispatches."""
+    import jax.numpy as jnp
+
+    from distributed_llms_tpu.runtime import batcher as batcher_lib
+
+    cfg = preset("llama-tiny", dtype="bfloat16")
+    l, kvh, hd = cfg.num_layers, cfg.num_kv_heads, cfg.head_dim_
+    b, s, nb, blk, p = 2, 128, 16, 16, 8
+    params = abstract_params(cfg)
+    draft = abstract_cache(cfg, b, s)
+
+    def pick(out):
+        # (toks, m, lps, cache', draft_cache') — the carry vectors are
+        # pinned by the GC4 chaining scenario; counts is None here.
+        return out[0], out[1], out[2], out[3], out[4]
+
+    cases = []
+    for k in (2, 4):
+        head = (((b, k + 1), "int32"), ((b,), "int32"),
+                ((b, k + 1), "float32"))
+        draft_want = (((l, b, s, kvh, hd), "bfloat16"),) * 2
+        for kv_bits in (16, 8):
+            if kv_bits == 8:
+                pool = abstract_quant_pool(cfg, nb, blk)
+                pool_want = (
+                    ((l, nb, blk, kvh, hd), "int8"),
+                    ((l, nb, blk, kvh, hd), "int8"),
+                    ((l, nb, blk, kvh), "float32"),
+                    ((l, nb, blk, kvh), "float32"),
+                )
+            else:
+                pool = abstract_pool(cfg, nb, blk)
+                pool_want = (((l, nb, blk, kvh, hd), "bfloat16"),) * 2
+            cases.append(OpCase(
+                label=f"k{k} kv{kv_bits}",
+                fn=(lambda prm, dprm, c, dc, lt, rl, va, ac, bu, tb, kr,
+                    _k=k:
+                    pick(batcher_lib.spec_chunk(
+                        prm, cfg, dprm, cfg, c, dc, lt, rl, va, ac, bu,
+                        k=_k, tables=tb, k_row=kr))),
+                args=(params, params, pool, draft, sds((b,), jnp.int32),
+                      sds((b,), jnp.int32), sds((b, s), jnp.bool_),
+                      sds((b,), jnp.bool_), sds((b,), jnp.int32),
+                      sds((b, p), jnp.int32), sds((b,), jnp.int32)),
+                want=head + pool_want + draft_want,
+            ))
+    return cases
+
+
 def _sampling_cases() -> list[OpCase]:
     from distributed_llms_tpu.runtime import sampling
 
@@ -713,6 +770,12 @@ def op_contracts() -> list[OpContract]:
                    "prefill row shape+dtype preserved, splice logits "
                    "[1,V] f32 (contiguous + paged, bite-bucket sweep)",
                    _mixed_step_cases),
+        OpContract("batcher.spec_chunk_paged", P_BATCHER,
+                   "paged speculative round: toks [B,k+1] i32 / commit "
+                   "counts [B] i32 (the rollback clamp) / lps f32, pool "
+                   "storage dtypes preserved (bf16 + int8-with-scales "
+                   "scratch-tail page writes), draft cache dtype kept",
+                   _spec_chunk_paged_cases),
     ]
 
 
@@ -1198,6 +1261,49 @@ def recompile_scenarios() -> list[RecompileScenario]:
         trace=mixed_step_trace,
     ))
 
+    # -- paged speculative round (spec x paged tentpole): the draft scan,
+    # the (k+1)-token paged verify window (scratch-tail page writes +
+    # per-offset prefix reads), the rollback clamp, AND the adaptive
+    # k_row downshift are ONE compiled program — depths, page tables,
+    # per-row clamp values, and row mixes are all traced values, never
+    # shapes.  A second key would mean a downshift (or a new resident
+    # depth) pays an XLA trace on the engine thread mid-span — the
+    # ladder of k_row values the scheduler emits must be compile-free.
+    def spec_paged_trace(width: int) -> str:
+        from distributed_llms_tpu.runtime import batcher as batcher_lib
+
+        b, nb, blk, p = 4, 16, 16, 8
+        params = abstract_params(cfg)
+        pool = abstract_pool(cfg, nb, blk)
+        draft = abstract_cache(cfg, b, s_cap)
+        return jaxpr_hash(
+            lambda prm, dprm, c, dc, lt, rl, va, ac, bu, tb, kr, prr, frr,
+            cnt:
+                batcher_lib.spec_chunk(
+                    prm, cfg, dprm, cfg, c, dc, lt, rl, va, ac, bu, k=4,
+                    tables=tb, k_row=kr, pres_row=prr, freq_row=frr,
+                    counts=cnt),
+            params, params, pool, draft, sds((b,), jnp.int32),
+            sds((b,), jnp.int32), sds((b, s_cap), jnp.bool_),
+            sds((b,), jnp.bool_), sds((b,), jnp.int32),
+            sds((b, p), jnp.int32), sds((b,), jnp.int32),
+            sds((b,), jnp.float32), sds((b,), jnp.float32),
+            sds((b, cfg.vocab_size), jnp.int32),
+            statics={"cfg": cfg, "draft_cfg": cfg, "k": 4},
+        )
+
+    out.append(RecompileScenario(
+        name="batcher.spec_chunk_paged", path=P_BATCHER,
+        doc="paged draft/verify round (page tables, adaptive k_row, "
+            "penalties engaged) stays ONE program across the spec_k "
+            "ladder, every resident depth, and every row mix",
+        ladder=_GC4_LADDER,
+        width_of=lambda n: s_cap,
+        allowed_widths=(s_cap,),
+        max_keys=1,
+        trace=spec_paged_trace,
+    ))
+
     # -- whole-batch generate: the engine pads T up the ladder under the
     # sequence budget; every padded width is one compile key.
     n_new, limit = 8, s_cap
@@ -1351,6 +1457,31 @@ def donation_contracts() -> list[DonationContract]:
         "batcher.spec_chunk", P_BATCHER,
         "speculative round donates BOTH target and draft caches",
         build_spec_chunk, must_donate=("cache", "draft_cache"),
+        may_keep=("params", "draft_params"),
+        static_args=("cfg", "draft_cfg")))
+
+    def build_spec_chunk_paged():
+        from distributed_llms_tpu.runtime import batcher as batcher_lib
+
+        b, s, nb, blk, p = 2, 128, 16, 16, 8
+        return (batcher_lib.spec_chunk, [
+            ("params", abstract_params(cfg)), ("cfg", cfg),
+            ("draft_params", abstract_params(cfg)), ("draft_cfg", cfg),
+            ("cache", abstract_pool(cfg, nb, blk)),
+            ("draft_cache", abstract_cache(cfg, b, s)),
+            ("last_tok", sds((b,), jnp.int32)),
+            ("real_lens", sds((b,), jnp.int32)),
+            ("valid", sds((b, s), jnp.bool_)),
+            ("active", sds((b,), jnp.bool_)),
+            ("budget", sds((b,), jnp.int32)),
+        ], {"k": 3, "tables": sds((b, p), jnp.int32),
+            "k_row": sds((b,), jnp.int32)})
+
+    out.append(DonationContract(
+        "batcher.spec_chunk_paged", P_BATCHER,
+        "paged speculative round donates the pool and the draft cache "
+        "(tables/k_row ride as read-only inputs)",
+        build_spec_chunk_paged, must_donate=("cache", "draft_cache"),
         may_keep=("params", "draft_params"),
         static_args=("cfg", "draft_cfg")))
     return out
