@@ -26,6 +26,7 @@ from __future__ import annotations
 import argparse
 import functools
 import json
+import math
 import os
 import subprocess
 import sys
@@ -1833,6 +1834,217 @@ def _measure_overload_goodput(
     return out
 
 
+def _measure_tenant_qos(
+    preset: str | None = None, dtype: str = "bfloat16",
+    page_size: int = 16,
+) -> dict:
+    """Elastic multi-tenant serving (ISSUE 15), two scenes:
+
+    (a) NOISY NEIGHBOR: the traffic harness (runtime/workload.py)
+    replays the same two-tenant trace — an aggressor offering 5x its
+    token-rate quota in a storm-then-calm diurnal square wave, next to
+    a steadily pacing victim — against one server with tenant QoS OFF
+    (tenant-blind FIFO) and ON (weighted-fair TenantScheduler +
+    per-tenant rate quota).  Stamped: the victim's goodput (SLO-met
+    tokens/s), p95 ITL, and SLO attainment under both, plus the
+    aggressor's structured-shed fraction — the isolation claim is
+    victim goodput ON >= 2x OFF while the aggressor throttles via
+    429+Retry-After instead of starving anyone silently.
+
+    (b) ELASTIC CYCLE: a min=1/max=2 fleet under the autoscaler; a
+    burst drives one scale-up (recovery = burst start -> second replica
+    healthy) and the idle tail one graceful scale-down.  Host-
+    scheduling effects, honestly measurable on any platform."""
+    import asyncio
+
+    from distributed_llms_tpu.cluster.autoscale import Autoscaler
+    from distributed_llms_tpu.cluster.fleet import ReplicaFleet
+    from distributed_llms_tpu.core.observability import METRICS
+    from distributed_llms_tpu.models import model as model_lib
+    from distributed_llms_tpu.models.presets import get_preset
+    from distributed_llms_tpu.runtime import workload
+    from distributed_llms_tpu.runtime.batcher import ContinuousBatcher
+    from distributed_llms_tpu.runtime.router import ReplicaRouter
+    from distributed_llms_tpu.runtime.server import InferenceServer
+    from distributed_llms_tpu.runtime.tokenizer import ByteTokenizer
+
+    # llama-tiny at the byte vocab (259 = bytes + specials): every
+    # sampled id is visible text, so streamed chars == tokens and the
+    # harness's TTFT/ITL/goodput are real.  Bigger presets only add
+    # decode time on CPU — the queueing/fairness effects this row
+    # measures are host-side.
+    del preset
+    cfg = get_preset("llama-tiny", vocab_size=259, max_seq_len=256,
+                     dtype=dtype)
+    params = model_lib.init_params(jax.random.key(0), cfg)
+    tok = ByteTokenizer()
+    slots, max_len, pool_pages = 2, 12 * page_size, 26
+    weights = {"vic": 2.0, "agg": 1.0}
+    window_s = 2.0
+
+    def make_batcher(fair: bool):
+        return ContinuousBatcher(
+            cfg, params, tokenizer=tok, eos_id=tok.eos_id, pad_id=tok.pad_id,
+            batch_slots=slots, max_len=max_len, chunk_steps=4,
+            paged_pages=pool_pages, page_size=page_size,
+            tenant_weights=("vic:2,agg:1" if fair else None),
+            tenant_max_rows=(1 if fair else None),
+        )
+
+    # One trace, replayed against both legs: a STORM phase (the
+    # aggressor floods at ~2-3x the engine's loaded service rate, so
+    # the tenant-blind queue is pinned at the cost-gate bound the whole
+    # phase) then a CALM tail (aggressor near-idle, the backlog drains)
+    # — the two-phase square wave a diurnal peak looks like at bench
+    # timescale, and the calm tail is the measurement's own CONTROL:
+    # the victim demonstrably meets its SLO on an uncrowded engine even
+    # with fairness off, so the storm-phase misses are crowding, not
+    # model/SLO miscalibration.  The victim paces steadily across both
+    # phases.  The quota pins "aggressor at 5x ITS quota" BY
+    # CONSTRUCTION: quota = the trace's offered aggressor token rate / 5.
+    import dataclasses
+
+    horizon, storm_s = 8.0, 6.0
+    agg_spec = workload.TenantSpec(
+        "agg", rate_rps=50.0, burst_rate_x=1.5, burst_enter_hz=0.3,
+        burst_exit_hz=0.6, prompt_len=(24, 40), output_len=(64, 96),
+        shared_frac=0.25,
+    )
+    storm = workload.generate([agg_spec], storm_s, seed=3)
+    calm = workload.generate(
+        [dataclasses.replace(agg_spec, rate_rps=1.0)],
+        horizon - storm_s, seed=4,
+    )
+    vic = workload.generate(
+        [workload.TenantSpec("vic", rate_rps=3.0, prompt_len=(12, 24),
+                             output_len=(6, 10))],
+        horizon, seed=3,
+    )
+    arrivals = (storm
+                + [dataclasses.replace(a, t=a.t + storm_s) for a in calm]
+                + vic)
+    arrivals.sort(key=lambda a: (a.t, a.tenant, a.prompt))
+    agg_offered_tokens = sum(
+        len(a.prompt) + a.max_tokens for a in arrivals if a.tenant == "agg"
+    )
+    quota_tps = agg_offered_tokens / horizon / 5.0
+    offered_x = agg_offered_tokens / (quota_tps * horizon)  # vs ITS quota
+    ttft_slo_s = 0.3
+
+    def make_server(fair: bool):
+        return InferenceServer(
+            make_batcher(fair), model_name="bench", host="127.0.0.1",
+            port=0, batcher_factory=lambda: make_batcher(fair),
+            # Same deep queue BOTH legs (the only asymmetry is the
+            # tenant knobs): at the 2.0 default the global cost gate
+            # caps the backlog near one SLO of work and shields the
+            # victim from FIFO queueing — the very effect the OFF leg
+            # must exhibit.
+            shed_cost_factor=8.0,
+            tenant_weights=(dict(weights) if fair else None),
+            tenant_quota_tps=(quota_tps if fair else None),
+            tenant_rate_window_s=window_s,
+        )
+
+    # Warm the compiled programs outside every timing window.
+    warm = make_batcher(True)
+    warm.submit("warm me up", max_new_tokens=24)
+    warm.run()
+
+    async def leg(fair: bool) -> dict:
+        srv = make_server(fair)
+        host, port = await srv.start()
+        try:
+            recs = await workload.replay(host, port, arrivals)
+        finally:
+            for _ in range(200):  # drain before the audit
+                if all(r.rid is None for r in srv.batcher.rows):
+                    break
+                await asyncio.sleep(0.05)
+            srv.batcher.assert_pool_consistent()
+            await srv.stop()
+        return workload.summarize(recs, horizon, ttft_slo_s=ttft_slo_s)
+
+    off = asyncio.run(leg(False))
+    on = asyncio.run(leg(True))
+
+    # (b) one autoscale up/down cycle on a live min=1/max=2 fleet.
+    async def cycle() -> tuple[float, float]:
+        fleet = ReplicaFleet([lambda: make_server(True)],
+                             probe_interval_s=0.05)
+        router = ReplicaRouter(fleet, host="127.0.0.1", port=0,
+                               tokenizer=tok, page_size=page_size)
+        await fleet.start()
+        host, port = await router.start()
+        scaler = Autoscaler(fleet, min_replicas=1, max_replicas=2,
+                            up_load=0.2, down_load=0.05, hysteresis=2,
+                            cooldown_s=0.2, drain_timeout_s=20.0,
+                            replica_capacity_tokens=(pool_pages - 1)
+                            * page_size)
+        try:
+            await fleet.wait_healthy(timeout_s=60.0)
+            burst = asyncio.ensure_future(
+                workload.replay(host, port, arrivals[:10])
+            )
+            t0 = time.perf_counter()
+            up_s = down_s = float("nan")
+            for _ in range(600):
+                await asyncio.sleep(0.02)
+                await scaler.tick()
+                if len(fleet.replicas) == 2:
+                    up_s = time.perf_counter() - t0
+                    break
+            await burst
+            t1 = time.perf_counter()
+            # Only time the drain if the fleet actually grew: keying on
+            # replica count alone would stamp a bogus ~0s "scale-down"
+            # when the burst never drove a scale-up.
+            if math.isfinite(up_s):
+                for _ in range(600):
+                    await asyncio.sleep(0.02)
+                    await scaler.tick()
+                    if len(fleet.replicas) == 1:
+                        down_s = time.perf_counter() - t1
+                        break
+            return up_s, down_s
+        finally:
+            await router.stop()
+            await fleet.stop()
+
+    up_s, down_s = asyncio.run(cycle())
+    vic_on, vic_off = on["vic"], off["vic"]
+    agg_on = on["agg"]
+    gain = (vic_on["goodput_tok_s"] / vic_off["goodput_tok_s"]
+            if vic_off["goodput_tok_s"] > 0 else float("inf"))
+    return {
+        "preset": "llama-tiny",
+        "platform": jax.devices()[0].platform,
+        "ttft_slo_s": ttft_slo_s,
+        "aggressor_offered_x": round(offered_x, 2),
+        "victim_goodput_off": round(vic_off["goodput_tok_s"], 1),
+        "victim_goodput_on": round(vic_on["goodput_tok_s"], 1),
+        "victim_goodput_gain": (round(gain, 2)
+                                if gain != float("inf") else "inf"),
+        "victim_slo_off": round(vic_off["slo_attainment"], 3),
+        "victim_slo_on": round(vic_on["slo_attainment"], 3),
+        "victim_itl_p95_ms_off": (
+            round(vic_off["itl_p95_s"] * 1e3, 1)
+            if vic_off["itl_p95_s"] is not None else None),
+        "victim_itl_p95_ms_on": (
+            round(vic_on["itl_p95_s"] * 1e3, 1)
+            if vic_on["itl_p95_s"] is not None else None),
+        "aggressor_shed_frac": round(
+            agg_on["shed"] / max(1, agg_on["offered"]), 3),
+        "aggressor_sheds_with_retry_after": agg_on["shed_with_retry_after"],
+        # None (renders as JSON null), never NaN: a cycle that timed out
+        # would otherwise stamp bare NaN — invalid JSON — into the ladder.
+        "scale_up_s": round(up_s, 2) if math.isfinite(up_s) else None,
+        "scale_down_s": round(down_s, 2) if math.isfinite(down_s) else None,
+        "autoscale_failures": int(
+            METRICS.get_counter("autoscale.scale_failures")),
+    }
+
+
 def _measure_kv_tiering(
     preset: str | None = None, dtype: str = "bfloat16", page_size: int = 16,
 ) -> dict:
@@ -2818,7 +3030,7 @@ def run_ladder(args, degraded: str | None) -> list[dict]:
             "fault-recovery", "overload-goodput", "compile-stability",
             "replica-failover", "disagg-handoff", "analysis-wall",
             "kv-tiering", "decode-overlap", "constrained-decode",
-            "mesh-paged", "mixed-step", "spec-paged",
+            "mesh-paged", "mixed-step", "spec-paged", "tenant-qos",
         }
         unknown = only - known
         if unknown:  # a typo must not masquerade as a clean zero-row run
@@ -2951,6 +3163,14 @@ def run_ladder(args, degraded: str | None) -> list[dict]:
         # growth plane took — a host-scheduling effect, meaningful on any
         # platform.
         ("overload-goodput", lambda: _measure_overload_goodput(dtype=dtype)),
+        # Elastic multi-tenant serving: the traffic harness replays one
+        # bursty aggressor+victim trace with tenant QoS off vs on
+        # (weighted-fair + per-tenant rate quota) — victim goodput/p95
+        # ITL/SLO attainment both ways, aggressor structured-shed
+        # fraction — plus one autoscale up/down cycle's recovery times
+        # on a live min=1/max=2 fleet.  Host-scheduling effects,
+        # meaningful on any platform.
+        ("tenant-qos", lambda: _measure_tenant_qos(dtype=dtype)),
         # KV memory tiering: concurrent capacity per pool byte at int8 vs
         # bf16, swap-restore vs recompute latency for a long-prefix
         # preemption victim, and spill-hit TTFT after eviction — memory

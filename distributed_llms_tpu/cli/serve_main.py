@@ -47,6 +47,9 @@ _RUNTIME_FLAGS: dict[str, str] = {
     "spec-decode": "spec_decode",
     "spec-k": "spec_k",
     "spec-adaptive-k": "spec_adaptive_k",
+    "tenant-weights": "tenant_weights",
+    "tenant-quota-tps": "tenant_quota_tps",
+    "tenant-max-rows": "tenant_max_rows",
     "fault": "faults",
 }
 # Server plumbing with no RuntimeConfig twin (transport, process, and
@@ -57,6 +60,9 @@ _SERVER_ONLY_FLAGS = frozenset({
     "max-pending", "drain-timeout", "watchdog-timeout", "platform",
     "replicas", "probe-interval", "failover-retries",
     "disaggregate", "prefill-replicas", "decode-replicas",
+    "replicas-min", "replicas-max", "autoscale-interval",
+    "autoscale-up-load", "autoscale-down-load", "autoscale-cooldown",
+    "autoscale-hysteresis",
 })
 
 
@@ -141,6 +147,8 @@ def _server_factory(args, engine, default_name, rt, faults, *,
                      else args.overlap == "on"),
             schedule=args.schedule,
             token_budget=args.token_budget,
+            tenant_weights=args.tenant_weights,
+            tenant_max_rows=args.tenant_max_rows,
             faults=faults,
         )
 
@@ -153,6 +161,19 @@ def _server_factory(args, engine, default_name, rt, faults, *,
         args.constrain_cache if args.constrain_cache is not None
         else rt.constrain_cache_size
     )
+
+    # Tenant QoS (the gateway half): flag wins, config-file field is the
+    # fallback, exactly like every _RUNTIME_FLAGS knob.  Weights parse
+    # ONCE here so a typo'd spec fails the boot in milliseconds.
+    from ..runtime.scheduler import parse_tenant_weights
+
+    tenant_weights = parse_tenant_weights(
+        args.tenant_weights if args.tenant_weights is not None
+        else rt.tenant_weights
+    )
+    tenant_quota_tps = (args.tenant_quota_tps
+                        if args.tenant_quota_tps is not None
+                        else rt.tenant_quota_tps)
 
     def make_server():
         return InferenceServer(
@@ -172,6 +193,8 @@ def _server_factory(args, engine, default_name, rt, faults, *,
             role=role,
             constrained=(args.constrained if args.constrained is not None
                          else rt.constrained_decoding),
+            tenant_weights=tenant_weights,
+            tenant_quota_tps=tenant_quota_tps,
         )
 
     return make_server
@@ -192,7 +215,12 @@ def build_fleet(args):
     the router hands prompts to the prefill tier and ships finished KV
     pages to the decode replica before forwarding (degrading to
     colocated prefill whenever the handoff cannot complete).
-    Returns (fleet, router)."""
+    ``--replicas-min/--replicas-max`` boot an ELASTIC colocated fleet:
+    replicas-min stacks now, a signal-driven autoscaler
+    (cluster/autoscale.py) growing to replicas-max on router
+    committed-token load and shrinking back via graceful drain only.
+    Returns (fleet, router, autoscaler-or-None)."""
+    from ..cluster.autoscale import Autoscaler
     from ..cluster.fleet import ReplicaFleet
     from ..runtime.router import ReplicaRouter
 
@@ -241,7 +269,8 @@ def build_fleet(args):
         names = [f"p{i}" for i in range(args.prefill_replicas)] \
             + [f"d{i}" for i in range(args.decode_replicas)]
     else:
-        factories = [replica_factory] * args.replicas
+        n = args.replicas_min if args.replicas_max else args.replicas
+        factories = [replica_factory] * n
         names = None
     fleet = ReplicaFleet(
         factories, names=names,
@@ -260,7 +289,36 @@ def build_fleet(args):
         # salt would read as a digest mismatch on every handoff.
         kv_bits=(args.kv_bits if args.kv_bits is not None else rt.kv_bits),
     )
-    return fleet, router
+    autoscaler = None
+    if args.replicas_max:
+        if args.disaggregate:
+            raise SystemExit(
+                "--replicas-min/--replicas-max autoscale the colocated "
+                "fleet; --disaggregate sizes its tiers explicitly"
+            )
+        if args.replicas_max < args.replicas_min:
+            raise SystemExit(
+                f"--replicas-max {args.replicas_max} < --replicas-min "
+                f"{args.replicas_min}"
+            )
+        if args.replicas != 1:
+            raise SystemExit(
+                "--replicas fixes the fleet size; an elastic fleet is "
+                "sized by --replicas-min/--replicas-max"
+            )
+        autoscaler = Autoscaler(
+            fleet,
+            min_replicas=args.replicas_min,
+            max_replicas=args.replicas_max,
+            interval_s=args.autoscale_interval,
+            up_load=args.autoscale_up_load,
+            down_load=args.autoscale_down_load,
+            hysteresis=args.autoscale_hysteresis,
+            cooldown_s=args.autoscale_cooldown,
+            drain_timeout_s=args.drain_timeout,
+            faults=faults,
+        )
+    return fleet, router, autoscaler
 
 
 async def _serve(args) -> None:
@@ -274,8 +332,8 @@ async def _serve(args) -> None:
 
     for sig in (signal.SIGINT, signal.SIGTERM):
         loop.add_signal_handler(sig, on_signal)
-    if args.replicas > 1 or args.disaggregate:
-        fleet, router = build_fleet(args)
+    if args.replicas > 1 or args.disaggregate or args.replicas_max:
+        fleet, router, autoscaler = build_fleet(args)
         await fleet.start()
         host, port = await router.start()
         # Replicas boot in state "starting" and only a healthy probe makes
@@ -315,10 +373,16 @@ async def _serve(args) -> None:
             restart_task[0] = asyncio.ensure_future(run())
 
         loop.add_signal_handler(signal.SIGHUP, on_hup)
+        if autoscaler is not None:
+            await autoscaler.start()
+            log.info("elastic fleet: %d..%d replicas on load signals",
+                     autoscaler.min_replicas, autoscaler.max_replicas)
         log.info("fleet of %d ready on http://%s:%s (SIGHUP = rolling "
                  "restart; Ctrl-C to stop)", len(fleet.replicas), host, port)
         await stop.wait()
         log.info("shutting down fleet...")
+        if autoscaler is not None:
+            await autoscaler.stop()
         await router.stop()
         await fleet.stop()
         return
@@ -440,6 +504,51 @@ def main(argv=None) -> None:
                     help="prefill-role replicas under --disaggregate")
     ap.add_argument("--decode-replicas", type=int, default=2,
                     help="decode-role replicas under --disaggregate")
+    ap.add_argument("--replicas-min", type=int, default=1,
+                    help="elastic fleet floor: boot this many colocated "
+                         "replicas and never drain below it (used with "
+                         "--replicas-max; the autoscaler scales between "
+                         "the two on router committed-token load)")
+    ap.add_argument("--replicas-max", type=int, default=None,
+                    help="elastic fleet ceiling: arm the autoscaler "
+                         "(cluster/autoscale.py) to grow the colocated "
+                         "fleet up to this many replicas under load and "
+                         "shrink back via graceful drain — in-flight "
+                         "requests finish byte-exact, stragglers migrate "
+                         "through the router's exact failover (unset = "
+                         "fixed-size fleet)")
+    ap.add_argument("--autoscale-interval", type=float, default=1.0,
+                    help="autoscaler tick cadence in seconds")
+    ap.add_argument("--autoscale-up-load", type=float, default=0.8,
+                    help="scale up when committed-token load (fraction "
+                         "of aggregate KV capacity) stays above this")
+    ap.add_argument("--autoscale-down-load", type=float, default=0.25,
+                    help="scale down when load stays below this")
+    ap.add_argument("--autoscale-hysteresis", type=int, default=3,
+                    help="consecutive ticks past a threshold before the "
+                         "autoscaler acts (noise filter)")
+    ap.add_argument("--autoscale-cooldown", type=float, default=10.0,
+                    help="quiet seconds after every scale action (or "
+                         "failed attempt) before the next one")
+    ap.add_argument("--tenant-weights", default=None,
+                    help="multi-tenant weighted-fair serving: "
+                         "\"gold:4,free:1\"-style shares (\"*\" sets the "
+                         "default weight).  Requests carry X-Tenant (or "
+                         "a \"tenant\" body field); admission serves "
+                         "tenants by virtual token counter — a flooding "
+                         "tenant cannot crowd out a lighter one's share "
+                         "(default: runtime.tenant_weights)")
+    ap.add_argument("--tenant-quota-tps", type=float, default=None,
+                    help="per-tenant token-rate quota at the gateway: "
+                         "admitted prompt+budget tokens/s per unit "
+                         "weight; a tenant over its rate sheds 429 with "
+                         "its OWN Retry-After (0 disables; default: "
+                         "runtime.tenant_quota_tps)")
+    ap.add_argument("--tenant-max-rows", type=int, default=None,
+                    help="per-tenant resident-row cap in the batcher: a "
+                         "tenant at the cap defers admission while "
+                         "others admit past it (0 = uncapped; default: "
+                         "runtime.tenant_max_rows)")
     ap.add_argument("--probe-interval", type=float, default=0.25,
                     help="fleet health-probe interval in seconds "
                          "(replica /healthz polling cadence)")
@@ -516,6 +625,23 @@ def main(argv=None) -> None:
                          "plugin ignores JAX_PLATFORMS, so this sets "
                          "jax.config before backend init")
     args = ap.parse_args(argv)
+    if args.replicas_max is not None and args.replicas_max < 1:
+        raise SystemExit(f"--replicas-max must be >= 1, got "
+                         f"{args.replicas_max}")
+    if args.replicas_max is None:
+        # --replicas-max is THE elastic-fleet switch: the floor and every
+        # autoscale knob mean nothing without it — reject loudly instead
+        # of booting a fixed fleet the operator believes is elastic.
+        stray = [f"--{k.replace('_', '-')}" for k in (
+            "replicas_min", "autoscale_interval", "autoscale_up_load",
+            "autoscale_down_load", "autoscale_hysteresis",
+            "autoscale_cooldown",
+        ) if getattr(args, k) != ap.get_default(k)]
+        if stray:
+            raise SystemExit(
+                f"{', '.join(stray)} need --replicas-max "
+                "(the elastic-fleet switch)"
+            )
     if args.platform:
         import jax
 

@@ -1,0 +1,269 @@
+"""Signal-driven autoscaler: the fleet grows and shrinks with load.
+
+PRs 6-7 made the fleet fault-tolerant but left its SIZE a boot-time
+constant: a diurnal trough pays for idle replicas, a flash crowd sheds
+work a larger fleet would have served.  This module closes ROADMAP item
+4's elastic half — a control loop over the existing
+:class:`~.fleet.ReplicaFleet` drain/respawn machinery:
+
+- **Signals.**  Router committed-token mass (the same per-replica
+  accounting placement uses: prompt + budget per in-flight request) and
+  router queue depth (in-flight proxies), read off the fleet handles the
+  router already maintains — no new wires.  ``load`` is committed tokens
+  over the fleet's aggregate KV capacity: the fraction of the fleet's
+  token budget already spoken for.
+- **Decisions.**  Scale UP when load has exceeded ``up_load`` for
+  ``hysteresis`` consecutive ticks; scale DOWN below ``down_load`` the
+  same way; never outside ``[min_replicas, max_replicas]``; and a
+  ``cooldown_s`` quiet period follows every action (including a FAILED
+  one) — hysteresis filters noise, the cooldown prevents oscillation
+  while a just-booted replica warms its compile caches.
+- **Mechanics.**  Up = ``fleet.add_replica`` (the factory builds off the
+  event loop; a boot failure registers nothing).  Down = ``fleet.
+  remove_replica``: GRACEFUL drain only — in-flight requests finish
+  byte-exact, stragglers past the deadline migrate through the router's
+  exact-failover path, and the drained-away replica's capacity returns.
+  The least-committed routable replica is chosen (its drain is the
+  cheapest), never below the floor.
+- **Chaos.**  The ``fleet.scale_up`` / ``fleet.scale_down`` fault sites
+  fire before each action (tag = replica name where known):  ``raise``/
+  ``drop`` fail or veto the action — the loop degrades cleanly (counts
+  the failure, keeps serving at the current size, retries after the
+  cooldown), exactly how a cloud API erroring a provision call must be
+  absorbed.  ``delay`` is returned un-slept (this loop must never
+  block); chaos drills stall the scaled REPLICA, not the controller.
+
+Everything here is event-loop confined (the fleet's model); the control
+loop never blocks it — factory builds ride ``asyncio.to_thread`` inside
+``fleet._boot`` and every fault-site fire defers stalls.
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+from ..core.observability import METRICS, get_logger
+from ..runtime.faults import InjectedFault
+
+log = get_logger("autoscale")
+
+
+class Autoscaler:
+    """Control loop over a :class:`~.fleet.ReplicaFleet`.
+
+    ``replica_capacity_tokens`` is one replica's KV capacity (the
+    denominator of the load signal); None reads it off the first live
+    replica's batcher at tick time — a host read of a static number.
+    ``factory`` overrides the fleet's default replica factory for
+    scale-ups (tests inject light stubs)."""
+
+    def __init__(
+        self,
+        fleet,
+        min_replicas: int = 1,
+        max_replicas: int = 4,
+        interval_s: float = 1.0,
+        up_load: float = 0.8,
+        down_load: float = 0.25,
+        hysteresis: int = 3,
+        cooldown_s: float = 10.0,
+        drain_timeout_s: float = 30.0,
+        replica_capacity_tokens: int | None = None,
+        factory=None,
+        faults=None,
+    ) -> None:
+        if min_replicas < 1:
+            raise ValueError(f"min_replicas must be >= 1, got {min_replicas}")
+        if max_replicas < min_replicas:
+            raise ValueError(
+                f"max_replicas {max_replicas} < min_replicas {min_replicas}"
+            )
+        if not 0.0 <= down_load < up_load:
+            raise ValueError(
+                f"need 0 <= down_load < up_load, got "
+                f"{down_load} / {up_load}"
+            )
+        if hysteresis < 1:
+            raise ValueError(f"hysteresis must be >= 1, got {hysteresis}")
+        self.fleet = fleet
+        self.min_replicas = min_replicas
+        self.max_replicas = max_replicas
+        self.interval_s = interval_s
+        self.up_load = up_load
+        self.down_load = down_load
+        self.hysteresis = hysteresis
+        self.cooldown_s = cooldown_s
+        self.drain_timeout_s = drain_timeout_s
+        self.replica_capacity_tokens = replica_capacity_tokens
+        self.factory = factory
+        self.faults = faults
+        self._up_streak = 0      # consecutive ticks above up_load
+        self._down_streak = 0    # consecutive ticks below down_load
+        self._cooldown_until = 0.0  # loop-clock quiet period
+        self._task: asyncio.Task | None = None
+        self._loop: asyncio.AbstractEventLoop | None = None
+
+    # -- lifecycle ---------------------------------------------------------
+
+    async def start(self) -> None:
+        self._loop = asyncio.get_running_loop()
+        self._task = asyncio.create_task(self._run())
+        log.info(
+            "autoscaler on: %d..%d replicas, up at load>%.2f, down at "
+            "load<%.2f (x%d ticks, %.1fs cooldown)",
+            self.min_replicas, self.max_replicas, self.up_load,
+            self.down_load, self.hysteresis, self.cooldown_s,
+        )
+
+    async def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass
+            self._task = None
+
+    async def _run(self) -> None:
+        while True:
+            await asyncio.sleep(self.interval_s)
+            try:
+                await self.tick()
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                # The controller must outlive any one bad tick: a scale
+                # action failing mid-flight is a degraded fleet, not a
+                # dead autoscaler.
+                log.exception("autoscaler tick failed")
+
+    # -- signals -----------------------------------------------------------
+
+    def _capacity(self) -> int:
+        if self.replica_capacity_tokens is not None:
+            return self.replica_capacity_tokens
+        for h in self.fleet.replicas:
+            server = getattr(h, "server", None)
+            if server is not None and getattr(server, "batcher", None) \
+                    is not None:
+                return max(1, server.batcher.capacity_tokens())
+        return 1
+
+    def signals(self) -> dict:
+        """The tick's inputs, also published as gauges: committed token
+        mass and queue depth summed over ROUTABLE replicas (the work the
+        router can actually spread), live replica count, and the load
+        fraction against aggregate capacity."""
+        now = self._loop.time() if self._loop is not None else 0.0
+        live = [h for h in self.fleet.replicas if h.state != "dead"]
+        routable = [h for h in live if h.routable(now)]
+        committed = sum(h.committed_tokens for h in routable)
+        depth = sum(len(h.inflight) for h in routable)
+        cap = self._capacity() * max(1, len(routable))
+        load = committed / cap
+        METRICS.set_gauges({
+            "autoscale.replicas": len(live),
+            "autoscale.load": load,
+            "autoscale.queue_depth": depth,
+        })
+        return {"replicas": len(live), "routable": len(routable),
+                "committed_tokens": committed, "queue_depth": depth,
+                "load": load}
+
+    # -- the control loop --------------------------------------------------
+
+    async def tick(self) -> str | None:
+        """One decision: returns "up"/"down" when an action was TAKEN,
+        None otherwise (tests drive this directly for determinism —
+        tick() binds the loop itself, no start() required)."""
+        if self._loop is None:
+            self._loop = asyncio.get_running_loop()
+        sig = self.signals()
+        n = sig["replicas"]
+        self._up_streak = self._up_streak + 1 \
+            if sig["load"] >= self.up_load else 0
+        self._down_streak = self._down_streak + 1 \
+            if sig["load"] <= self.down_load else 0
+        now = self._loop.time()
+        if now < self._cooldown_until:
+            return None
+        if (self._up_streak >= self.hysteresis and n < self.max_replicas
+                and sig["routable"] > 0):
+            self._up_streak = 0
+            self._cooldown_until = now + self.cooldown_s
+            return "up" if await self._scale_up(sig) else None
+        if self._down_streak >= self.hysteresis and n > self.min_replicas:
+            self._down_streak = 0
+            self._cooldown_until = now + self.cooldown_s
+            return "down" if await self._scale_down(sig) else None
+        return None
+
+    @staticmethod
+    def _vetoed(fire_one) -> bool:
+        """Whether a scale-action fault rule vetoed/failed the action
+        (``raise``, ``drop``, or ``close`` — the caller degrades
+        cleanly); every other outcome proceeds."""
+        try:
+            rule = fire_one()
+        except InjectedFault:
+            return True
+        return rule is not None and rule.action in ("drop", "close")
+
+    async def _scale_up(self, sig: dict) -> bool:
+        # defer_stall on every scale-site fire: this loop runs next to
+        # probing and routing — a stall rule must not freeze failure
+        # detection; chaos drills stall replicas, not the controller.
+        if self.faults is not None and self._vetoed(
+            lambda: self.faults.fire("fleet.scale_up", defer_stall=True)
+        ):
+            METRICS.inc("autoscale.scale_failures")
+            log.warning(
+                "scale-up failed (injected); serving at %d replica(s), "
+                "retry after cooldown", sig["replicas"],
+            )
+            return False
+        t0 = self._loop.time()
+        try:
+            h = await self.fleet.add_replica(factory=self.factory)
+        except Exception:
+            # A real provision failure (factory OOM, port exhaustion):
+            # same degrade as the drill — the fleet is unchanged
+            # (add_replica registers nothing on failure), serving
+            # continues at the current size, the cooldown spaces retries.
+            METRICS.inc("autoscale.scale_failures")
+            log.exception("scale-up failed; serving at current size")
+            return False
+        METRICS.inc("autoscale.scale_ups")
+        METRICS.observe("autoscale.scale_seconds", self._loop.time() - t0)
+        log.info(
+            "scaled up: replica %s joined (%s) at load %.2f — %d live",
+            h.name, h.state, sig["load"], len(self.fleet.replicas),
+        )
+        return True
+
+    async def _scale_down(self, sig: dict) -> bool:
+        now = self._loop.time()
+        cands = [h for h in self.fleet.replicas if h.routable(now)]
+        if len(cands) <= self.min_replicas:
+            return False  # only unroutable excess — draining those is
+            #               the probe/respawn plane's job, not scaling's
+        victim = min(cands, key=lambda h: (h.committed_tokens,
+                                           len(h.inflight), h.name))
+        if self.faults is not None and self._vetoed(
+            lambda: self.faults.fire("fleet.scale_down", tag=victim.name,
+                                     defer_stall=True)
+        ):
+            METRICS.inc("autoscale.scale_failures")
+            log.warning("scale-down of %s vetoed (injected)", victim.name)
+            return False
+        t0 = self._loop.time()
+        await self.fleet.remove_replica(
+            victim.name, drain_timeout_s=self.drain_timeout_s
+        )
+        METRICS.inc("autoscale.scale_downs")
+        METRICS.observe("autoscale.scale_seconds", self._loop.time() - t0)
+        log.info(
+            "scaled down: replica %s drained away at load %.2f — %d live",
+            victim.name, sig["load"], len(self.fleet.replicas),
+        )
+        return True

@@ -90,7 +90,8 @@ class ServingClient:
                  backoff_base_s: float = 0.25, backoff_cap_s: float = 8.0,
                  retry_after_cap_s: float | None = None,
                  rng: random.Random | None = None,
-                 endpoints: "list[tuple[str, int]] | None" = None) -> None:
+                 endpoints: "list[tuple[str, int]] | None" = None,
+                 tenant: str | None = None) -> None:
         # Client-side failover: pass ``endpoints`` (a list of (host, port)
         # pairs — e.g. every replica of a fleet, or several routers) and a
         # connect error or 429/503 ROTATES to the next endpoint for the
@@ -112,6 +113,25 @@ class ServingClient:
         self.retry_after_cap_s = retry_after_cap_s
         self.retries_taken = 0
         self.failovers = 0  # endpoint rotations taken (tests/bench)
+        # Multi-tenant QoS: every request this client sends carries the
+        # tenant id as the X-Tenant header; a per-tenant 429
+        # (reason "tenant_quota") is retried on the SERVER's per-tenant
+        # Retry-After through the existing backoff, and the last shed's
+        # machine-readable reason is surfaced for callers/bench.
+        # The id is interpolated into the raw request preamble, so it
+        # must pass the gateway's canonical rule (one definition — a
+        # crafted value could otherwise inject headers and desync the
+        # HTTP framing).
+        from ..runtime.server import valid_tenant_id
+
+        if tenant is not None and not valid_tenant_id(tenant):
+            raise ValueError(
+                f"tenant must be 1-64 chars of [A-Za-z0-9._-] "
+                f"('-' is reserved), got {tenant!r}"
+            )
+        self.tenant = tenant
+        self.last_shed_reason: str | None = None
+        self.tenant_sheds = 0  # 429s with reason tenant_quota observed
         self._ep = 0
         self._rng = rng if rng is not None else random.Random()
 
@@ -124,8 +144,11 @@ class ServingClient:
         reader, writer = await asyncio.open_connection(self.host, self.port)
         try:
             payload = json.dumps(body).encode()
+            tenant_line = (f"X-Tenant: {self.tenant}\r\n"
+                           if self.tenant else "")
             writer.write(
                 f"POST {path} HTTP/1.1\r\nHost: {self.host}\r\n"
+                f"{tenant_line}"
                 f"Content-Length: {len(payload)}\r\n\r\n".encode() + payload
             )
             await writer.drain()
@@ -172,6 +195,18 @@ class ServingClient:
                 status, headers, out = await self._once(path, body)
             except (ConnectionError, OSError, IndexError, ValueError):
                 status, out = None, {}
+            if status in (429, 503) and isinstance(out, dict):
+                # Surface the shed's machine-readable reason (the server
+                # stamps it next to the overloaded_error): callers can
+                # tell "MY tenant quota is exhausted" (honor Retry-After
+                # instead of hot-retrying; quota ledgers are PER REPLICA,
+                # so a rotation may find headroom elsewhere — see the
+                # README's quota note) from generic fleet overload.
+                reason = (out.get("error") or {}).get("reason")
+                if reason is not None:
+                    self.last_shed_reason = reason
+                    if reason == "tenant_quota":
+                        self.tenant_sheds += 1
             if status is not None and status not in (429, 503):
                 return status, out
             if attempt >= self.max_retries:

@@ -119,6 +119,12 @@ class ReplicaFleet:
             raise ValueError(f"duplicate replica names: {names}")
         self.replicas = [ReplicaHandle(n, f) for n, f in zip(names, factories)]
         self._by_name = {h.name: h for h in self.replicas}
+        # Elastic serving (cluster/autoscale.py): the factory new
+        # replicas boot from when add_replica is called without one, and
+        # a monotone counter so scaled-up names never collide with a
+        # drained-away predecessor's.
+        self._default_factory = factories[0] if factories else None
+        self._next_name = len(self.replicas)
         self.probe_interval_s = probe_interval_s
         self.probe_failures = probe_failures
         self.probe_timeout_s = probe_timeout_s
@@ -138,7 +144,12 @@ class ReplicaFleet:
         self._probe_task = asyncio.create_task(self._probe_loop())
 
     async def _boot(self, h: ReplicaHandle) -> None:
-        h.server = h.factory()
+        # The factory builds a full server/batcher stack — model jits and
+        # pool allocation measured in wall-clock — so it runs OFF the
+        # event loop: probing, routing, and failure detection for every
+        # OTHER replica must not freeze while a new one warms up (the
+        # autoscaler boots replicas while the fleet is at its busiest).
+        h.server = await asyncio.to_thread(h.factory)
         h.host, h.port = await h.server.start()
         h.role = getattr(h.server, "role", "colocated")
         h.kv_port = getattr(h.server, "kv_bound_port", None)
@@ -264,11 +275,16 @@ class ReplicaFleet:
             # must not delay every OTHER replica's failure detection —
             # serial ticks would couple failover latency to the slowest
             # replica in the fleet.
+            # ONE snapshot for both the gather and the attribution zip:
+            # the autoscaler may add/remove replicas mid-gather, and a
+            # re-snapshot would misalign handles with results (a probe
+            # failure logged against the wrong replica, or dropped).
+            handles = list(self.replicas)
             results = await asyncio.gather(
-                *[self._tick_one(h) for h in list(self.replicas)],
+                *[self._tick_one(h) for h in handles],
                 return_exceptions=True,
             )
-            for h, r in zip(list(self.replicas), results):
+            for h, r in zip(handles, results):
                 if isinstance(r, BaseException):
                     log.error("probe tick for replica %s failed",
                               h.name, exc_info=r)
@@ -343,6 +359,72 @@ class ReplicaFleet:
             "router.replicas_healthy",
             sum(1 for h in self.replicas if h.routable(now)),
         )
+
+    # -- elastic scaling (cluster/autoscale.py drives these) ---------------
+
+    def _fresh_name(self) -> str:
+        while True:
+            name = f"r{self._next_name}"
+            self._next_name += 1
+            if name not in self._by_name:
+                return name
+
+    async def add_replica(self, factory=None, name: str | None = None,
+                          wait_healthy_s: float = 60.0) -> ReplicaHandle:
+        """Scale UP: boot one more replica (fresh server/batcher stack on
+        an ephemeral port) and register it with the fleet once its boot
+        SUCCEEDED — a factory/start failure raises with nothing
+        registered, so a failed scale-up leaves the fleet exactly as it
+        was (no half-booted handle for the router to trip on).  Returns
+        after the replica's first healthy probe (or ``wait_healthy_s``;
+        the caller reads ``handle.state``)."""
+        factory = factory or self._default_factory
+        if factory is None:
+            raise ValueError("fleet has no replica factory to scale with")
+        if name is not None and name in self._by_name:
+            raise ValueError(f"replica name {name!r} already exists")
+        h = ReplicaHandle(name or self._fresh_name(), factory)
+        await self._boot(h)  # raises -> nothing registered (clean failure)
+        self.replicas.append(h)
+        self._by_name[h.name] = h
+        METRICS.inc("autoscale.replicas_added")
+        self._publish_health()
+        deadline = self._loop.time() + wait_healthy_s
+        while h.state != "healthy" and self._loop.time() < deadline:
+            await asyncio.sleep(self.probe_interval_s / 2)
+        return h
+
+    async def remove_replica(self, name: str,
+                             drain_timeout_s: float = 30.0) -> None:
+        """Scale DOWN, gracefully: stop new placement (state
+        ``draining``), let the router's in-flight requests on the replica
+        FINISH (byte-exact — nothing is cut mid-decode), abort stragglers
+        at the deadline (zero-streamed ones migrate via the router's
+        exact failover), stop the server, and drop the handle from the
+        fleet.  Unlike :meth:`drain`, nothing respawns — the capacity is
+        returned."""
+        h = self._by_name[name]
+        log.info("scaling down: draining replica %s away", h.name)
+        h.state = "draining"
+        METRICS.inc("autoscale.replicas_removed")
+        self._publish_health()
+        deadline = self._loop.time() + drain_timeout_s
+        while h.inflight and self._loop.time() < deadline:
+            await asyncio.sleep(0.02)
+        h.abort_inflight()
+        try:
+            if h.server is not None:
+                await h.server.stop(
+                    drain_timeout=max(0.0, deadline - self._loop.time())
+                )
+        finally:
+            # The handle leaves the fleet even if the server's stop
+            # raised — a zombie entry would keep the router placing
+            # against a dead replica forever.
+            h.state = "dead"
+            self.replicas.remove(h)
+            del self._by_name[h.name]
+            self._publish_health()
 
     # -- rolling drain/respawn ---------------------------------------------
 

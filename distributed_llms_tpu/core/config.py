@@ -329,6 +329,23 @@ class RuntimeConfig:
     # automaton cache: each entry holds two [n_states, vocab] tables, so
     # the capacity bounds host RAM spent on remembered schemas.
     constrain_cache_size: int = 64
+    # Multi-tenant QoS (runtime/scheduler.py TenantScheduler + the
+    # serving gateway's per-tenant quota gate).  tenant_weights turns on
+    # weighted-fair admission: "gold:4,free:1"-style shares ("*" sets
+    # the default weight unknown/anonymous tenants serve at), billed via
+    # per-tenant virtual token counters — a tenant flooding the queue
+    # advances its own counter and cannot crowd out a lighter tenant's
+    # share.  None/"" = tenant-blind scheduling.
+    tenant_weights: str | None = None
+    # Per-tenant token-RATE quota at the serving gateway: admitted token
+    # mass (prompt + budget) per second, PER UNIT WEIGHT — a tenant over
+    # its rate sheds 429 with a per-tenant Retry-After before any
+    # admission state exists.  None/0 disables rate quotas.
+    tenant_quota_tps: float | None = None
+    # Per-tenant RESIDENT-row cap in the batcher: a tenant at the cap
+    # defers admission (others admit past it), so one tenant can never
+    # hold every batch slot.  None/0 = uncapped.
+    tenant_max_rows: int | None = None
 
 
 @dataclass(frozen=True)

@@ -368,6 +368,38 @@ METRIC_DOCS: dict[str, str] = {
     "coordinator.tasks_retried": "tasks requeued after worker failure",
     "coordinator.tasks_failed": "tasks failed after max attempts",
     "coordinator.shards_reassigned": "shards moved off evicted workers",
+    # -- multi-tenant QoS (runtime/scheduler.py + runtime/server.py) --
+    "tenant.requests.*": "requests accepted past every shed gate, per "
+                         "tenant",
+    "tenant.admitted_tokens.*": "admission-time token mass (prompt + "
+                                "budget) accepted per tenant — the "
+                                "rate-quota gate's currency",
+    "tenant.shed.*": "requests shed 429 by the per-tenant token-rate "
+                     "quota gate (each carries the tenant's own "
+                     "Retry-After)",
+    "tenant.vtc.*": "weighted-fair virtual token counter per tenant "
+                    "(gauge; runtime/scheduler.py TenantScheduler — "
+                    "admission serves the lowest counter first)",
+    "tenant.resident_rows.*": "batch rows currently resident per tenant "
+                              "(gauge; capped by tenant_max_rows)",
+    # -- elastic fleet autoscaling (cluster/autoscale.py) --
+    "autoscale.replicas": "live (non-dead) replicas in the fleet (gauge)",
+    "autoscale.load": "committed token mass over aggregate routable KV "
+                      "capacity — the scale signal (gauge)",
+    "autoscale.queue_depth": "router in-flight proxies summed over "
+                             "routable replicas (gauge)",
+    "autoscale.scale_ups": "replicas added by the autoscaler",
+    "autoscale.scale_downs": "replicas drained away by the autoscaler",
+    "autoscale.scale_failures": "scale actions that failed or were "
+                                "vetoed (injected or real provision "
+                                "failure) — the fleet kept its size",
+    "autoscale.scale_seconds": "wall time of one scale action, decision "
+                               "to done (histogram; up = boot + first "
+                               "healthy wait, down = graceful drain)",
+    "autoscale.replicas_added": "replicas registered by "
+                                "ReplicaFleet.add_replica",
+    "autoscale.replicas_removed": "replicas drained away by "
+                                  "ReplicaFleet.remove_replica",
     # -- fault injection (runtime/faults.py) --
     "faults.fired": "injected faults triggered, total",
     "faults.fired.*": "injected faults triggered, by action",

@@ -1436,6 +1436,11 @@ class _Request:
     # Absolute time.perf_counter() deadline: a request still QUEUED past it
     # is shed (results empty, shed[rid] set) instead of admitted doomed.
     deadline: float | None = None
+    # Multi-tenant QoS (runtime/scheduler.py TenantScheduler): the tenant
+    # this request bills against.  None = the anonymous bucket.  The
+    # weighted-fair admission order, virtual token counters, and
+    # resident-row caps all key on it; a preempted resume keeps it.
+    tenant: str | None = None
     # Preemption-with-recompute state: tokens this request already emitted
     # (and streamed) in a previous residency.  ``ids`` then holds
     # prompt + resume_emitted, so re-admission prefills the full context
@@ -1959,6 +1964,17 @@ class ContinuousBatcher:
         # stay prefill_chunk-sized; set, it also auto-chunks any prompt
         # longer than the budget even when prefill_chunk is unset.
         token_budget: int | None = None,
+        # Multi-tenant weighted-fair admission (runtime/scheduler.py
+        # TenantScheduler): "gold:4,free:1"-style weights (or a parsed
+        # dict; "*" sets the default weight) turn the mixed policy into
+        # per-tenant virtual-token-counter fairness — submit(tenant=)
+        # bills each request against its tenant's counter.  None keeps
+        # the tenant-blind policies.
+        tenant_weights: "str | dict | None" = None,
+        # Per-tenant RESIDENT-row cap: a tenant at the cap defers
+        # admission (others admit past it), so one tenant can never hold
+        # every batch slot.  None = uncapped.
+        tenant_max_rows: int | None = None,
     ) -> None:
         # Snapshot the constructor arguments FIRST (before any local
         # variables or normalization appear) so respawn() can rebuild an
@@ -2117,6 +2133,7 @@ class ContinuousBatcher:
             prefill_concurrency=prefill_concurrency,
             token_budget=token_budget, speculative=self.speculative,
             spec_adaptive=bool(spec_adaptive_k),
+            tenant_weights=tenant_weights, tenant_max_rows=tenant_max_rows,
         )
         self._prefills: dict[int, _PendingPrefill] = {}  # slot -> pending
         self.draft_params = draft_params
@@ -2661,6 +2678,10 @@ class ContinuousBatcher:
         #   passes the automaton itself, closing the window where an LRU
         #   eviction between its compile and this submit would force a
         #   synchronous rebuild on the caller's thread
+        tenant: str | None = None,  # multi-tenant QoS: the tenant this
+        #   request bills against (weighted-fair admission order, virtual
+        #   token counters, resident-row caps — runtime/scheduler.py
+        #   TenantScheduler).  None = the anonymous bucket.
     ) -> int:
         """Queue a request.  ``temperature``/``top_p``/``top_k`` override
         the batcher's sampling config FOR THIS REQUEST (serving
@@ -2755,6 +2776,13 @@ class ContinuousBatcher:
             raise ValueError(
                 f"priority must be an int in [-2**31, 2**31), got {priority!r}"
             )
+        if tenant is not None and (
+            not isinstance(tenant, str) or not tenant or len(tenant) > 64
+        ):
+            raise ValueError(
+                f"tenant must be a non-empty string of <= 64 chars, "
+                f"got {tenant!r}"
+            )
         if deadline is not None:
             import math
 
@@ -2815,7 +2843,7 @@ class ContinuousBatcher:
                 frequency_penalty=float(frequency_penalty),
                 constraint=constraint,
                 prefix_cache=prefix_cache, priority=priority,
-                deadline=deadline,
+                deadline=deadline, tenant=tenant,
             ))
         return rid
 
@@ -2876,6 +2904,8 @@ class ContinuousBatcher:
                 # A chunked prefill in flight just drops its transient row
                 # cache — nothing was spliced into the shared cache yet.
                 self._prefills.pop(i, None)
+                if row.req is not None:
+                    self.sched.note_freed(row.req, len(row.emitted))
                 self.rows[i] = _RowState()
                 self.active[i] = False
                 self.budget[i] = 0
@@ -2910,9 +2940,15 @@ class ContinuousBatcher:
 
     def _unqueue(self, req: "_Request") -> None:
         """Remove an admitted request from the queue (identity compare —
-        _Request is eq=False) under the submission lock."""
+        _Request is eq=False) under the submission lock.  This is the
+        ONE admission-commit point (plain, chunked-start, and swap-
+        restore paths all pass through it), so the scheduler's tenant
+        accounting charges exactly once per residency here — the paired
+        ``note_freed`` fires wherever the row later releases its slot
+        (completion sweep, cancel, preemption)."""
         with self._lock:
             self.queue.remove(req)
+        self.sched.note_admitted(req, len(req.ids) + req.max_new_tokens)
 
     def _shed_expired_queued(self) -> None:
         """Drop queued requests whose deadline has already passed: a
@@ -3016,7 +3052,8 @@ class ContinuousBatcher:
                 # byte-exact under the same masks.
                 constraint=req.constraint,
                 prefix_cache=req.prefix_cache, priority=req.priority,
-                deadline=req.deadline, resume_emitted=list(row.emitted),
+                deadline=req.deadline, tenant=req.tenant,
+                resume_emitted=list(row.emitted),
                 resume_lps=list(row.lps),
             )
             # SWAP tier (host_pages): park the victim's raw pages on the
@@ -3038,6 +3075,9 @@ class ContinuousBatcher:
         if row.pages:
             self._release_pages(row.pages)
             self.tables[i] = 0
+        # Tenant accounting: this residency ends (the requeued resume
+        # re-charges at its own re-admission).
+        self.sched.note_freed(req, len(row.emitted))
         self.rows[i] = _RowState()
         self.active[i] = False
         self.budget[i] = 0
@@ -3896,6 +3936,8 @@ class ContinuousBatcher:
                     self._release_pages(row.pages)
                     self.tables[i] = 0
                 final_lps = row.lps[row.streamed:]
+                if row.req is not None:  # tenant true-up at completion
+                    self.sched.note_freed(row.req, len(row.emitted))
                 self.rows[i] = _RowState()
                 METRICS.inc("batcher.completed")
                 if self._on_tokens is not None:

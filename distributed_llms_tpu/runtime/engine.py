@@ -506,6 +506,13 @@ class InferenceEngine:
         #   per-step token budget the mixed policy sizes prefill bites
         #   against (decode legs claim n_active of it first).  0/None =
         #   prefill_chunk-sized bites.
+        tenant_weights: "str | dict | None" = None,  # None ->
+        #   rt.tenant_weights; "gold:4,free:1"-style weights turn the
+        #   mixed policy into per-tenant weighted-fair admission
+        #   (runtime/scheduler.py TenantScheduler) — submit(tenant=)
+        #   bills each request's virtual token counter.  "" disables.
+        tenant_max_rows: int | None = None,  # None -> rt.tenant_max_rows;
+        #   per-tenant resident-row cap (0 = uncapped).
     ):
         """A ContinuousBatcher over this engine's model: requests admit into
         an in-flight decode batch as rows free up (runtime/batcher.py) —
@@ -623,6 +630,14 @@ class InferenceEngine:
             token_budget = self.rt.token_budget
         if token_budget == 0:  # the CLI/config "disable" spelling
             token_budget = None
+        if tenant_weights is None:
+            tenant_weights = self.rt.tenant_weights
+        if tenant_weights == "":  # the CLI/config "disable" spelling
+            tenant_weights = None
+        if tenant_max_rows is None:
+            tenant_max_rows = self.rt.tenant_max_rows
+        if tenant_max_rows == 0:
+            tenant_max_rows = None
         if self.parallel is not None:
             # The shared cache shards its batch over 'data'; round the slot
             # count up so every mesh shape serves (extra slots are harmless
@@ -658,6 +673,18 @@ class InferenceEngine:
                 "configured (prefill_chunk=%d) and the speculative draft "
                 "admission prefills monolithically; serving plain",
                 prefill_chunk,
+            )
+            speculative = False
+        if speculative and (tenant_weights or tenant_max_rows) \
+                and not explicit_spec:
+            # Same config-inherited degrade: tenant weighted-fair
+            # scheduling and the speculative round ledger do not compose
+            # yet (make_scheduler rejects the pair loudly when
+            # speculative=True is explicit).
+            log.warning(
+                "runtime.spec_decode ignored: tenant weighted-fair "
+                "scheduling is configured and does not compose with "
+                "speculative rounds yet; serving plain",
             )
             speculative = False
         spec_kwargs = {}
@@ -699,6 +726,7 @@ class InferenceEngine:
             kv_bits=kv_bits, host_pages=int(host_pages),
             overlap=bool(overlap),
             schedule=schedule, token_budget=token_budget,
+            tenant_weights=tenant_weights, tenant_max_rows=tenant_max_rows,
         )
 
     # -- speculative decoding (runtime/speculative.py): greedy-exact at

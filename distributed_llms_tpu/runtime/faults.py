@@ -179,6 +179,22 @@ FAULT_SITES: dict[str, str] = {
         "(plain eviction / cold prefill — correct, just slower), "
         "'corrupt' flips spilled bytes so restore verification rejects "
         "them",
+    "fleet.scale_up":
+        "the autoscaler about to boot one more replica "
+        "(cluster/autoscale.py): 'raise' or 'drop' fails the provision — "
+        "the controller degrades cleanly (counts the failure, keeps "
+        "serving at the current size, retries after its cooldown), the "
+        "cloud-API-errored drill",
+    "fleet.scale_down":
+        "the autoscaler about to drain a replica away (tag = the chosen "
+        "replica): 'raise'/'drop' vetoes the drain — the fleet keeps its "
+        "size; scale-down is graceful-only, so there is no abrupt leg to "
+        "drill here (replica.crash covers that)",
+    "tenant.quota":
+        "the serving gateway's per-tenant token-rate gate (tag = "
+        "tenant): 'exhaust' forces the over-quota path — the request "
+        "sheds 429 with the tenant's own Retry-After even under its "
+        "rate, the per-tenant-shed drill",
 }
 
 

@@ -79,6 +79,7 @@ from .batcher import PrefixCache
 # both tiers — the router must shed/parse exactly like the replicas do.
 from .server import (
     _MAX_BODY, _MAX_HEADERS, _MAX_REQUEST_LINE, _REASONS, _err_body,
+    valid_tenant_id,
 )
 
 log = get_logger("router")
@@ -440,7 +441,7 @@ class ReplicaRouter:
     # -- the proxy core ----------------------------------------------------
 
     async def _proxy(self, writer, method: str, path: str, body: bytes,
-                     chat: bool) -> None:
+                     chat: bool, tenant: str | None = None) -> None:
         try:
             req = json.loads(body or b"{}")
             req = req if isinstance(req, dict) else {}
@@ -448,8 +449,25 @@ class ReplicaRouter:
             req = {}  # the replica answers the 400; placement needs no parse
         prompt_ids, est = self._estimate(req, chat)
         digests = self._digests(prompt_ids)
+        # The X-Tenant header rides the re-built upstream request (bodies
+        # forward verbatim, headers do not): the replica's tenant gate and
+        # weighted-fair scheduler must see the same identity the client
+        # sent.  A malformed id 400s HERE with the replica's own message —
+        # rewriting it could collapse onto (and bill) a DIFFERENT tenant,
+        # and the shared charset is header-safe by construction, so the
+        # router cannot become a header-injection vector either way.
+        tenant_line = ""
+        if tenant:
+            if not valid_tenant_id(tenant):
+                await self._json(writer, 400, _err_body(
+                    "'tenant' must be 1-64 chars of [A-Za-z0-9._-] "
+                    "(X-Tenant header or body field)"
+                ))
+                return
+            tenant_line = f"X-Tenant: {tenant}\r\n"
         payload = (
             f"{method} {path} HTTP/1.1\r\nHost: replica\r\n"
+            f"{tenant_line}"
             f"Content-Length: {len(body)}\r\n\r\n"
         ).encode() + body
         METRICS.inc("router.requests")
@@ -685,8 +703,8 @@ class ReplicaRouter:
             )
             if parsed is None:
                 return
-            method, path, body = parsed
-            await self._route(writer, method, path, body)
+            method, path, body, tenant = parsed
+            await self._route(writer, method, path, body, tenant)
         except (asyncio.TimeoutError, ConnectionError, OSError, ValueError,
                 EOFError):
             pass
@@ -705,6 +723,7 @@ class ReplicaRouter:
             return None
         method, path = parts[0], parts[1]
         content_len = 0
+        tenant: str | None = None
         for _ in range(_MAX_HEADERS):
             h = await reader.readline()
             if h in (b"\r\n", b"\n", b""):
@@ -723,6 +742,10 @@ class ReplicaRouter:
                 # EMPTY body and surface as a misleading replica-side 400.
                 await self._plain(writer, 501, "chunked bodies not supported")
                 return None
+            elif hname == "x-tenant":
+                # Forwarded to the chosen replica (bodies are verbatim;
+                # headers are re-built) — tenant QoS is decided there.
+                tenant = value.strip()
         else:
             await self._plain(writer, 431, "too many headers")
             return None
@@ -730,10 +753,10 @@ class ReplicaRouter:
             await self._plain(writer, 413, "body too large")
             return None
         body = await reader.readexactly(content_len) if content_len else b""
-        return method, path, body
+        return method, path, body, tenant
 
     async def _route(self, writer, method: str, path: str,
-                     body: bytes) -> None:
+                     body: bytes, tenant: str | None = None) -> None:
         if method == "GET" and path == "/healthz":
             report = self.fleet.report()
             code = 200 if report["healthy"] > 0 else 503
@@ -752,7 +775,7 @@ class ReplicaRouter:
         elif method == "POST" and path in ("/v1/completions",
                                            "/v1/chat/completions"):
             await self._proxy(writer, method, path, body,
-                              chat="chat" in path)
+                              chat="chat" in path, tenant=tenant)
         elif method not in ("GET", "POST"):
             await self._plain(writer, 405, "method not allowed")
         else:

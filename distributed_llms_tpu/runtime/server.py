@@ -83,6 +83,7 @@ import time
 import uuid
 
 from ..core.observability import METRICS, get_logger
+from .scheduler import ANON_TENANT
 
 log = get_logger("server")
 
@@ -161,6 +162,29 @@ class BadRequest(ValueError):
     pass
 
 
+# The ONE tenant-id charset, shared with the router (which forwards valid
+# ids verbatim and 400s the rest — never rewrites, so router and replica
+# agree on what a malformed id means).  ASCII-only on purpose: an id is a
+# metric label, a scheduler key, and a header value — Unicode lookalikes
+# would split one tenant's accounting into mojibake buckets.
+_TENANT_CHARS = frozenset(
+    "ABCDEFGHIJKLMNOPQRSTUVWXYZabcdefghijklmnopqrstuvwxyz0123456789._-"
+)
+
+# Rate-ledger cardinality cap: admitting a NEW tenant id past this first
+# ages every ledger and drops the empties (see _tenant_charge) — ids are
+# client-minted, so the map must not grow with distinct-id count.
+_TENANT_LEDGER_CAP = 4096
+
+
+def valid_tenant_id(tenant) -> bool:
+    # "-" (scheduler.ANON_TENANT) is reserved: a client claiming it would
+    # alias its quota/fairness accounting onto all untagged traffic.
+    return (isinstance(tenant, str) and 0 < len(tenant) <= 64
+            and tenant != ANON_TENANT
+            and all(c in _TENANT_CHARS for c in tenant))
+
+
 def _field(req: dict, name: str, default, kind, *, minimum=None):
     v = req.get(name, default)
     if kind is int and isinstance(v, bool):  # bool passes isinstance(int)
@@ -228,6 +252,18 @@ class InferenceServer:
         # kill-switch: RuntimeConfig.constrained_decoding /
         # dlt-serve --no-constrained).
         constrained: bool = True,
+        # Multi-tenant QoS (the gateway half; runtime/scheduler.py
+        # TenantScheduler owns admission fairness).  Requests carry a
+        # tenant id as the X-Tenant header or "tenant" body field
+        # (header wins).  tenant_weights ({name: weight}, "*" = default)
+        # scale the RATE quota: a tenant whose admitted token mass
+        # (prompt + budget) over the trailing window would exceed
+        # weight * tenant_quota_tps tokens/s sheds 429 with a PER-TENANT
+        # Retry-After (when its own window frees) before any admission
+        # state exists.  None disables the rate gate.
+        tenant_weights: "dict[str, float] | None" = None,
+        tenant_quota_tps: float | None = None,
+        tenant_rate_window_s: float = 10.0,
     ) -> None:
         if batcher.tokenizer is None:
             raise ValueError(
@@ -266,6 +302,23 @@ class InferenceServer:
         self.xfer_max_retries = xfer_max_retries
         self.max_inflight_transfers = max_inflight_transfers
         self.constrained = bool(constrained)
+        if tenant_quota_tps is not None and tenant_quota_tps <= 0:
+            tenant_quota_tps = None  # the CLI/config "disable" spelling
+        if tenant_rate_window_s <= 0:
+            raise ValueError(
+                f"tenant_rate_window_s must be > 0, got {tenant_rate_window_s}"
+            )
+        self.tenant_weights = dict(tenant_weights or {})
+        self.tenant_default_weight = self.tenant_weights.pop("*", 1.0)
+        self.tenant_quota_tps = tenant_quota_tps
+        self.tenant_rate_window_s = tenant_rate_window_s
+        # Trailing-window admitted-token-mass ledger per tenant, for the
+        # rate quota: deque of (perf_counter ts, est tokens), appended at
+        # admission, aged out lazily.  Only the loop thread (the one
+        # running every handler) touches it.
+        from collections import deque
+
+        self._tenant_window: dict[str, "deque[tuple[float, int]]"] = {}  # guarded-by: event-loop
         self._xfer_sem: asyncio.Semaphore | None = None  # made on start()
         self._kv_server: asyncio.base_events.Server | None = None
         from ..cluster.kv_transfer import ReceiverStats
@@ -455,6 +508,102 @@ class InferenceServer:
         [1, 30] — a coarse, monotone backoff signal, not a promise."""
         cap = max(1, self.batcher.capacity_tokens())
         return int(min(30, max(1, -(-self._pending_token_mass() // cap))))
+
+    # -- multi-tenant QoS: the gateway's rate-quota half -------------------
+
+    @staticmethod
+    def _parse_tenant(req: dict, tenant_hdr: str | None) -> str | None:
+        """The request's tenant id: X-Tenant header first (proxies stamp
+        identity), "tenant" body field as the fallback.  None = the
+        anonymous bucket.  Malformed ids 400 — a tenant id becomes a
+        metric label and a scheduler key, so the charset is tight."""
+        tenant = tenant_hdr if tenant_hdr else req.get("tenant")
+        if tenant is None or tenant == "":
+            return None
+        if not valid_tenant_id(tenant):
+            raise BadRequest(
+                "'tenant' must be 1-64 chars of [A-Za-z0-9._-] "
+                "(X-Tenant header or body field)"
+            )
+        return tenant
+
+    def _tenant_weight(self, tenant: str) -> float:
+        return self.tenant_weights.get(tenant, self.tenant_default_weight)
+
+    # graftlint: holds(event-loop)
+    def _tenant_retry_after(self, tenant: str, est: int) -> int | None:
+        """Per-tenant token-rate gate (loop thread only).  Returns None
+        when ``est`` more admission tokens fit the tenant's trailing-
+        window quota (weight x tenant_quota_tps tokens/s), else the
+        PER-TENANT Retry-After: when the tenant's own window has aged
+        out enough room — unlike the global ``_retry_after_s`` hint,
+        this is a promise about this tenant's ledger, not fleet load.
+        The ``tenant.quota`` fault site (tag = tenant) can force the
+        over-quota path for drills (action ``exhaust``)."""
+        if self.tenant_quota_tps is None:
+            return None
+        win = self.tenant_rate_window_s
+        allowed = self._tenant_weight(tenant) * self.tenant_quota_tps * win
+        now = time.perf_counter()
+        ledger = self._tenant_window.get(tenant)
+        forced = False
+        plane = self.batcher.faults
+        if plane is not None:
+            # defer_stall: this gate runs on the event loop (a stall rule
+            # must not freeze every handler and the fleet's probes).
+            rule = plane.fire("tenant.quota", tag=tenant, defer_stall=True)
+            forced = rule is not None and rule.action == "exhaust"
+        if ledger:
+            while ledger and ledger[0][0] <= now - win:
+                ledger.popleft()
+            if not ledger:  # fully aged out: drop the deque itself too
+                del self._tenant_window[tenant]
+                ledger = None
+        used = sum(n for _, n in ledger) if ledger else 0
+        if not forced and used + est <= allowed:
+            return None
+        # Walk the tenant's own ledger oldest-first: the hint is when
+        # enough of ITS charges age out that est fits again.
+        room_needed = used + est - allowed
+        freed = 0.0
+        hint = win
+        for ts, n in (ledger or ()):
+            freed += n
+            if freed >= room_needed:
+                hint = ts + win - now
+                break
+        return int(min(60, max(1, math.ceil(hint))))
+
+    # graftlint: holds(event-loop)
+    def _tenant_charge(self, tenant: str | None, est: int) -> None:
+        """Record an accepted request's admission-time token mass on its
+        tenant's trailing window (loop thread only) + per-tenant
+        counters.  Anonymous requests bill the shared ANON bucket's
+        ledger (the rate gate checks it) but mint no per-tenant
+        metrics."""
+        if tenant is not None:
+            METRICS.inc(f"tenant.requests.{tenant}")
+            METRICS.inc(f"tenant.admitted_tokens.{tenant}", est)
+        if self.tenant_quota_tps is None:
+            return  # no rate gate -> nothing ever ages the ledger; keep none
+        from collections import deque
+
+        key = tenant if tenant is not None else ANON_TENANT
+        if key not in self._tenant_window \
+                and len(self._tenant_window) >= _TENANT_LEDGER_CAP:
+            # Cardinality bound: tenant ids are client-minted, so a new id
+            # must not grow the map past the cap without first aging every
+            # ledger and dropping the empties.  Ids still inside their
+            # window are genuine concurrent tenants — those stay.
+            cutoff = time.perf_counter() - self.tenant_rate_window_s
+            for t in list(self._tenant_window):
+                d = self._tenant_window[t]
+                while d and d[0][0] <= cutoff:
+                    d.popleft()
+                if not d:
+                    del self._tenant_window[t]
+        ledger = self._tenant_window.setdefault(key, deque())
+        ledger.append((time.perf_counter(), est))
 
     def _engine_loop(self) -> None:
         while True:
@@ -702,12 +851,13 @@ class InferenceServer:
                 # Deadline covers the parse phase only: generation itself
                 # may legitimately exceed any fixed request timeout.
                 # (wait_for, not asyncio.timeout: pyproject allows 3.10.)
-                method, path, body = await asyncio.wait_for(
+                method, path, body, tenant_hdr = await asyncio.wait_for(
                     self._read_request(writer, reader), 30.0
                 )
             except _Responded:
                 return
-            await self._route(writer, method, path, body, t0)
+            await self._route(writer, method, path, body, t0,
+                              tenant_hdr=tenant_hdr)
         except (asyncio.TimeoutError, ConnectionError, OSError, ValueError,
                 EOFError):  # IncompleteReadError: client hung up mid-body
             pass
@@ -715,7 +865,9 @@ class InferenceServer:
             self._conns.discard(writer)
             writer.close()
 
-    async def _read_request(self, writer, reader) -> tuple[str, str, bytes]:
+    async def _read_request(
+        self, writer, reader
+    ) -> tuple[str, str, bytes, str | None]:
         line = await reader.readline()
         if len(line) > _MAX_REQUEST_LINE:
             await self._plain(writer, 431, "request line too long")
@@ -726,6 +878,7 @@ class InferenceServer:
             raise _Responded
         method, path = parts[0], parts[1]
         content_len = 0
+        tenant_hdr: str | None = None
         for _ in range(_MAX_HEADERS):
             h = await reader.readline()
             if h in (b"\r\n", b"\n", b""):
@@ -744,6 +897,11 @@ class InferenceServer:
                 # "'prompt' missing" 400.
                 await self._plain(writer, 501, "chunked bodies not supported")
                 raise _Responded
+            elif hname == "x-tenant":
+                # Multi-tenant QoS: the tenant id a request bills against
+                # (header form; a "tenant" body field is the fallback —
+                # the header wins so proxies can stamp identity).
+                tenant_hdr = value.strip()
         else:
             await self._plain(writer, 431, "too many headers")
             raise _Responded
@@ -751,7 +909,7 @@ class InferenceServer:
             await self._plain(writer, 413, "body too large")
             raise _Responded
         body = await reader.readexactly(content_len) if content_len else b""
-        return method, path, body
+        return method, path, body, tenant_hdr
 
     def health(self) -> tuple[int, dict]:
         """Readiness/liveness report behind GET /healthz.  Non-200 while
@@ -796,7 +954,7 @@ class InferenceServer:
         }
 
     async def _route(self, writer, method: str, path: str, body: bytes,
-                     t0: float) -> None:
+                     t0: float, tenant_hdr: str | None = None) -> None:
         if method == "GET" and path == "/healthz":
             code, report = self.health()
             # Every non-200 carries Retry-After: probes and load balancers
@@ -834,7 +992,7 @@ class InferenceServer:
                 if not isinstance(req, dict):
                     raise BadRequest("request body must be a JSON object")
                 await self._completions(writer, req, chat="chat" in path,
-                                        t0=t0)
+                                        t0=t0, tenant_hdr=tenant_hdr)
             except (BadRequest, json.JSONDecodeError) as e:
                 await self._json(writer, 400, _err_body(str(e)))
         elif method == "POST" and path == "/v1/prefill":
@@ -935,7 +1093,8 @@ class InferenceServer:
         return out[0], out[1], want_k, out[2], out[3]
 
     async def _completions(self, writer, req: dict, chat: bool,
-                           t0: float | None = None) -> None:
+                           t0: float | None = None,
+                           tenant_hdr: str | None = None) -> None:
         if t0 is None:
             t0 = time.perf_counter()
         prompt_ids, _ = self._parse_prompt(req, chat)
@@ -1021,6 +1180,12 @@ class InferenceServer:
         if (isinstance(priority, bool) or not isinstance(priority, int)
                 or not -(2**31) <= priority < 2**31):
             raise BadRequest("'priority' must be an integer")
+        tenant = self._parse_tenant(req, tenant_hdr)
+        # THE admission-token estimate (prompt + budget per choice) — the
+        # cost gate, the tenant rate gate, and the accepted request's
+        # ledger charge all read this one value, so what is gated is
+        # exactly what is billed.
+        est = n * (len(prompt_ids) + max_tokens)
         # Shed gates, all BEFORE any delivery state is registered: a shed
         # request must leave zero trace (no _Mailbox, no batcher queue
         # entry) — the leak-check test pins this.
@@ -1034,14 +1199,45 @@ class InferenceServer:
             # resident prompt+budget) plus this request against the KV
             # capacity.  Sustained overload 429s at the front door — the
             # cheap place — instead of queueing work doomed to time out.
-            mass = self._pending_token_mass() \
-                + n * (len(prompt_ids) + max_tokens)
+            mass = self._pending_token_mass() + est
             cap = self.batcher.capacity_tokens()
             if mass > self.shed_cost_factor * cap:
                 await self._shed_json(
                     writer, 429,
                     f"server overloaded: {mass} tokens of work queued "
                     f"against {cap}-token KV capacity", "cost_gate",
+                )
+                return
+        if self.tenant_quota_tps is not None:
+            # Per-tenant token-rate quota: shed with the TENANT's own
+            # Retry-After (when its trailing window frees) — the other
+            # tenants' headroom is none of this request's business.
+            # Untagged requests bill the shared ANONYMOUS bucket at the
+            # default weight (scheduler parity) — dropping the X-Tenant
+            # header is not an escape hatch from the rate gate.
+            key = tenant if tenant is not None else ANON_TENANT
+            allowed = self._tenant_weight(key) * self.tenant_quota_tps \
+                * self.tenant_rate_window_s
+            if est > allowed:
+                # Bigger than the tenant's ENTIRE window allowance: a 429
+                # would promise a Retry-After that can never come true
+                # (the ledger can't free room the quota doesn't hold) —
+                # this is a malformed-for-this-tenant request, not load.
+                await self._json(writer, 400, _err_body(
+                    f"request needs {est} admission tokens but tenant "
+                    f"{key!r}'s quota window holds at most {int(allowed)}"
+                ))
+                return
+            hint = self._tenant_retry_after(key, est)
+            if hint is not None:
+                if tenant is not None:
+                    METRICS.inc(f"tenant.shed.{tenant}")
+                await self._shed_json(
+                    writer, 429,
+                    f"tenant {key!r} over its token-rate quota "
+                    f"({est} tokens would exceed the "
+                    f"{self.tenant_rate_window_s:g}s window)",
+                    "tenant_quota", retry_after=hint,
                 )
                 return
         if self._draining and not self._stopping:
@@ -1080,7 +1276,7 @@ class InferenceServer:
             presence_penalty=pres_pen, frequency_penalty=freq_pen,
             prefix_cache=use_cache, priority=priority, deadline=deadline,
             response_format=response_format, logit_bias=logit_bias,
-            banned_tokens=banned_tokens,
+            banned_tokens=banned_tokens, tenant=tenant,
         )
         subs: list[tuple[int, int, _Mailbox]] = []  # (choice index, rid, mbox)
         sub_err: Exception | None = None
@@ -1107,7 +1303,7 @@ class InferenceServer:
                         prefix_cache=use_cache, priority=priority,
                         deadline=deadline, response_format=response_format,
                         logit_bias=logit_bias, banned_tokens=banned_tokens,
-                        constraint=dfa,
+                        constraint=dfa, tenant=tenant,
                     )
                     assert got == rid
                 except (ValueError, KeyError) as e:
@@ -1137,6 +1333,11 @@ class InferenceServer:
             self._work.set()  # let an idle engine drain the flags
             await self._json(writer, 400, _err_body(str(sub_err)))
             return
+        # The rate-quota ledger charges the ACCEPTED request — after the
+        # last gate AND a fully successful submit: a 400 from the batcher
+        # (oversized prefix, unknown cache id) must not burn the tenant's
+        # window for zero service.
+        self._tenant_charge(tenant, est)
         self._work.set()
         METRICS.inc("server.requests")
         try:
@@ -1765,16 +1966,25 @@ class InferenceServer:
         )
 
     async def _shed_json(self, writer, code: int, msg: str,
-                         reason: str) -> None:
+                         reason: str, retry_after: int | None = None) -> None:
         """Answer a shed request (429 too-busy / 503 not-yet-admitted):
         structured overloaded_error body + a Retry-After header so clients
         and load balancers back off instead of retrying hot, and the shed
-        counters the dashboards alarm on."""
+        counters the dashboards alarm on.  The body carries the machine-
+        readable ``reason`` (queue_full / cost_gate / tenant_quota / ...)
+        so clients can distinguish "the server is busy" from "MY quota is
+        exhausted"; ``retry_after`` overrides the global hint with a
+        per-tenant one."""
         METRICS.inc("server.requests_shed_total")
         METRICS.inc(f"server.requests_shed.{reason}")
+        body = _err_body(msg, "overloaded_error")
+        body["error"]["reason"] = reason
         await self._json(
-            writer, code, _err_body(msg, "overloaded_error"),
-            headers={"Retry-After": str(self._retry_after_s())},
+            writer, code, body,
+            headers={"Retry-After": str(
+                retry_after if retry_after is not None
+                else self._retry_after_s()
+            )},
         )
 
     async def _respond(self, writer, code: int, ctype: str, payload: bytes,

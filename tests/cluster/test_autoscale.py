@@ -1,0 +1,478 @@
+"""Elastic fleet autoscaling (cluster/autoscale.py + the fleet's
+add_replica/remove_replica) — ROADMAP item 4's elastic half.
+
+Two layers:
+
+1. DECISIONS (no servers): the control loop against a stub fleet —
+   load-signal arithmetic, hysteresis streaks, the cooldown quiet
+   period, min/max clamps, least-committed victim choice, and the
+   fleet.scale_up / fleet.scale_down drill semantics (a failed or
+   vetoed action degrades cleanly and retries after the cooldown).
+2. CHAOS ACCEPTANCE (tiny model, live fleet + router): a bursty
+   3-tenant storm drives at least one scale-UP and one graceful
+   scale-DOWN mid-storm, with one injected ``fleet.scale_up`` failure
+   absorbed cleanly before the retry succeeds; every completed request
+   is byte-exact vs an unfaulted FIXED-fleet reference, every shed is a
+   structured 429/503 with (per-tenant) Retry-After, and every
+   surviving replica's page pool audits clean.
+"""
+
+import asyncio
+import json
+
+import pytest
+
+import jax
+
+from distributed_llms_tpu.cluster.autoscale import Autoscaler
+from distributed_llms_tpu.cluster.fleet import ReplicaFleet
+from distributed_llms_tpu.core.observability import METRICS
+from distributed_llms_tpu.models import model as model_lib, presets
+from distributed_llms_tpu.runtime.batcher import ContinuousBatcher
+from distributed_llms_tpu.runtime.faults import FaultPlane
+from distributed_llms_tpu.runtime.router import ReplicaRouter
+from distributed_llms_tpu.runtime.server import InferenceServer
+from distributed_llms_tpu.runtime.tokenizer import ByteTokenizer
+
+PAGE = 16
+
+
+# -- decision logic against a stub fleet (no servers) ------------------------
+
+
+class _StubHandle:
+    def __init__(self, name, committed=0, inflight=0, state="healthy"):
+        self.name = name
+        self.committed_tokens = committed
+        self.inflight = set(range(inflight))
+        self.state = state
+
+    def routable(self, now):
+        return self.state == "healthy"
+
+
+class _StubFleet:
+    """The surface Autoscaler consumes: handles + add/remove."""
+
+    def __init__(self, *handles):
+        self.replicas = list(handles)
+        self.added = 0
+        self.removed: list[str] = []
+        self.fail_adds = 0  # > 0: the next add_replica raises (real
+        #                     provision failure, not a drill)
+
+    async def add_replica(self, factory=None, name=None):
+        if self.fail_adds > 0:
+            self.fail_adds -= 1
+            raise RuntimeError("provision failed")
+        self.added += 1
+        h = _StubHandle(name or f"r{len(self.replicas)}")
+        self.replicas.append(h)
+        return h
+
+    async def remove_replica(self, name, drain_timeout_s=30.0):
+        self.removed.append(name)
+        self.replicas = [h for h in self.replicas if h.name != name]
+
+
+def _scaler(fleet, **kw):
+    kw.setdefault("min_replicas", 1)
+    kw.setdefault("max_replicas", 3)
+    kw.setdefault("up_load", 0.8)
+    kw.setdefault("down_load", 0.2)
+    kw.setdefault("hysteresis", 2)
+    kw.setdefault("cooldown_s", 0.0)
+    kw.setdefault("replica_capacity_tokens", 100)
+    return Autoscaler(fleet, **kw)
+
+
+def _run(coro):
+    return asyncio.run(coro)
+
+
+async def _ticks(sc, n, settle=0.0):
+    sc._loop = asyncio.get_running_loop()
+    out = []
+    for _ in range(n):
+        out.append(await sc.tick())
+        if settle:
+            await asyncio.sleep(settle)
+    return out
+
+
+def test_signals_and_load_arithmetic():
+    async def fn():
+        fleet = _StubFleet(_StubHandle("a", committed=60, inflight=2),
+                           _StubHandle("b", committed=20, inflight=1),
+                           _StubHandle("dead", state="dead"))
+        sc = _scaler(fleet)
+        sc._loop = asyncio.get_running_loop()
+        sig = sc.signals()
+        assert sig["replicas"] == 2          # dead handles don't count
+        assert sig["routable"] == 2
+        assert sig["committed_tokens"] == 80
+        assert sig["queue_depth"] == 3
+        assert sig["load"] == pytest.approx(80 / 200)
+        assert METRICS.get_gauge("autoscale.load") == pytest.approx(0.4)
+        assert METRICS.get_gauge("autoscale.replicas") == 2
+
+    _run(fn())
+
+
+def test_hysteresis_then_scale_up_and_max_clamp():
+    async def fn():
+        fleet = _StubFleet(_StubHandle("a", committed=95))
+        sc = _scaler(fleet, max_replicas=2, hysteresis=3)
+        # Two hot ticks: streak building, no action yet (noise filter).
+        assert await _ticks(sc, 2) == [None, None]
+        assert fleet.added == 0
+        # Third consecutive hot tick: scale up.
+        assert (await _ticks(sc, 1)) == ["up"]
+        assert fleet.added == 1 and len(fleet.replicas) == 2
+        # At max_replicas: hot forever, never past the ceiling.  (The
+        # new stub replica holds no tokens, so load halves — pin it hot.)
+        fleet.replicas[1].committed_tokens = 95
+        assert all(a is None for a in await _ticks(sc, 5))
+        assert len(fleet.replicas) == 2
+
+    _run(fn())
+
+
+def test_scale_down_graceful_least_committed_and_min_clamp():
+    async def fn():
+        fleet = _StubFleet(_StubHandle("busy", committed=30, inflight=2),
+                           _StubHandle("idle", committed=1))
+        sc = _scaler(fleet, hysteresis=2)
+        acts = await _ticks(sc, 2)
+        assert acts == [None, "down"]
+        assert fleet.removed == ["idle"]     # least committed drains away
+        # At the floor: cold forever, never below min_replicas.
+        assert all(a is None for a in await _ticks(sc, 5))
+        assert len(fleet.replicas) == 1
+
+    _run(fn())
+
+
+def test_cooldown_spaces_actions():
+    async def fn():
+        fleet = _StubFleet(_StubHandle("a", committed=95))
+        sc = _scaler(fleet, max_replicas=4, hysteresis=1, cooldown_s=0.2)
+        assert (await _ticks(sc, 1))[0] == "up"
+        fleet.replicas[-1].committed_tokens = 95  # still hot
+        # Inside the cooldown: hot ticks take no action.
+        assert all(a is None for a in await _ticks(sc, 3))
+        assert fleet.added == 1
+        await asyncio.sleep(0.25)
+        assert (await _ticks(sc, 1))[0] == "up"  # cooldown lapsed
+
+    _run(fn())
+
+
+def test_scale_up_drill_and_real_failure_degrade_cleanly():
+    """An injected fleet.scale_up raise AND a real provision failure
+    both: count autoscale.scale_failures, leave the fleet unchanged,
+    and retry after the cooldown — the controller never dies."""
+    async def fn():
+        plane = FaultPlane.parse("fleet.scale_up:raise@1")
+        fleet = _StubFleet(_StubHandle("a", committed=95))
+        sc = _scaler(fleet, max_replicas=3, hysteresis=1, cooldown_s=0.05,
+                     faults=plane)
+        f0 = METRICS.get_counter("autoscale.scale_failures")
+        assert (await _ticks(sc, 1))[0] is None  # drill ate attempt 1
+        assert fleet.added == 0 and plane.rules[0].fired == 1
+        assert METRICS.get_counter("autoscale.scale_failures") == f0 + 1
+        await asyncio.sleep(0.06)
+        # Real provision failure on attempt 2: same clean degrade.
+        fleet.fail_adds = 1
+        assert (await _ticks(sc, 1))[0] is None
+        assert METRICS.get_counter("autoscale.scale_failures") == f0 + 2
+        assert len(fleet.replicas) == 1
+        await asyncio.sleep(0.06)
+        # Attempt 3 lands.
+        assert (await _ticks(sc, 1))[0] == "up"
+        assert fleet.added == 1
+
+    _run(fn())
+
+
+def test_scale_down_veto_drill():
+    async def fn():
+        plane = FaultPlane.parse("fleet.scale_down:drop@1")
+        fleet = _StubFleet(_StubHandle("a"), _StubHandle("b"))
+        sc = _scaler(fleet, hysteresis=1, cooldown_s=0.0, faults=plane)
+        assert (await _ticks(sc, 1))[0] is None  # vetoed
+        assert len(fleet.replicas) == 2
+        assert (await _ticks(sc, 1))[0] == "down"  # next attempt drains
+        assert len(fleet.replicas) == 1
+
+    _run(fn())
+
+
+def test_autoscaler_validation():
+    fleet = _StubFleet(_StubHandle("a"))
+    with pytest.raises(ValueError, match="min_replicas"):
+        Autoscaler(fleet, min_replicas=0)
+    with pytest.raises(ValueError, match="max_replicas"):
+        Autoscaler(fleet, min_replicas=3, max_replicas=2)
+    with pytest.raises(ValueError, match="down_load"):
+        Autoscaler(fleet, up_load=0.5, down_load=0.6)
+    with pytest.raises(ValueError, match="hysteresis"):
+        Autoscaler(fleet, hysteresis=0)
+
+
+# -- chaos acceptance: live elastic fleet under a 3-tenant storm -------------
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    cfg = presets.get_preset("llama-tiny", vocab_size=512)
+    params = model_lib.init_params(jax.random.key(0), cfg)
+    return cfg, params
+
+
+def _replica_batcher(tiny, faults=None):
+    cfg, params = tiny
+    tok = ByteTokenizer()
+    return ContinuousBatcher(
+        cfg, params, tokenizer=tok, eos_id=tok.eos_id, pad_id=tok.pad_id,
+        batch_slots=2, max_len=96, chunk_steps=4,
+        paged_pages=8, page_size=PAGE, prefix_cache=True,
+        tenant_weights="gold:4,agg:1,free:1", tenant_max_rows=2,
+    )
+
+
+@pytest.fixture(scope="module")
+def warmed(tiny):
+    """Warm the process-wide jit cache with the replicas' program shapes
+    so scaled-up replicas probe healthy in milliseconds, not compile
+    time (the test_router pattern)."""
+    b = _replica_batcher(tiny)
+    for prompt in ("warm short", "a much longer warming prompt xxxx",
+                   "warm short"):
+        b.submit(prompt, max_new_tokens=4)
+        b.run()
+    return tiny
+
+
+def _server_factory(tiny):
+    def make_server():
+        return InferenceServer(
+            _replica_batcher(tiny), model_name="tiny", host="127.0.0.1",
+            port=0, batcher_factory=lambda: _replica_batcher(tiny),
+            watchdog_timeout_s=5.0,
+            tenant_weights={"gold": 4.0, "agg": 1.0, "free": 1.0},
+            # agg's allowance: 1 x 30 tok/s x 2 s = 60 tokens per window
+            # — the storm offers it ~5x that, so real per-tenant sheds
+            # happen mid-storm.
+            tenant_quota_tps=30.0, tenant_rate_window_s=2.0,
+        )
+
+    return make_server
+
+
+async def _request(host, port, body, tenant=None):
+    reader, writer = await asyncio.open_connection(host, port)
+    payload = json.dumps(body).encode()
+    hdr = f"X-Tenant: {tenant}\r\n" if tenant else ""
+    writer.write(
+        f"POST /v1/completions HTTP/1.1\r\nHost: t\r\n{hdr}"
+        f"Content-Length: {len(payload)}\r\n\r\n".encode() + payload
+    )
+    await writer.drain()
+    status = int((await reader.readline()).split()[1])
+    headers = {}
+    while True:
+        line = await reader.readline()
+        if line in (b"\r\n", b"\n", b""):
+            break
+        k, _, v = line.decode("latin-1").partition(":")
+        headers[k.strip().lower()] = v.strip()
+    raw = await reader.read()
+    writer.close()
+    return status, headers, json.loads(raw) if raw.strip() else {}
+
+
+def _expected_texts(tiny, reqs):
+    """Unfaulted FIXED-fleet reference: one roomy batcher serves every
+    prompt solo — exactness at temp 0 is batching-, replica-, and
+    fleet-size-invariant, so every storm completion must match these
+    bytes whatever replica (original or scaled-up) served it."""
+    cfg, params = tiny
+    tok = ByteTokenizer()
+    b = ContinuousBatcher(
+        cfg, params, tokenizer=tok, eos_id=tok.eos_id, pad_id=tok.pad_id,
+        batch_slots=4, max_len=96, chunk_steps=4, paged_pages=40,
+        page_size=PAGE,
+    )
+    rids = [b.submit(p, max_new_tokens=n) for p, n in reqs]
+    res = b.run()
+    return {p: tok.decode(res[rid]) for rid, (p, n) in zip(rids, reqs)}
+
+
+def test_elastic_fleet_chaos_storm(warmed):
+    """THE acceptance test (ISSUE 15): bursty 3-tenant storm against a
+    min=1/max=2 elastic fleet.  One injected fleet.scale_up failure
+    degrades cleanly, the retry scales up mid-storm, the tail's idle
+    ticks drain a replica away gracefully while a trickle still serves;
+    completions byte-exact, sheds structured, pools audit clean."""
+    tiny = warmed
+    gold = [(f"gold tenant request {i} !!", 8) for i in range(3)]
+    free = [(f"free rider number {i}", 6) for i in range(3)]
+    agg = [(f"aggressor flood item {i} padded out to length", 10)
+           for i in range(8)]
+    wants = _expected_texts(tiny, gold + free + agg)
+    plane = FaultPlane.parse("fleet.scale_up:raise@1")
+
+    async def driver():
+        fleet = ReplicaFleet([_server_factory(tiny)],
+                             probe_interval_s=0.05, probe_timeout_s=2.0,
+                             faults=plane)
+        router = ReplicaRouter(fleet, host="127.0.0.1", port=0,
+                               tokenizer=ByteTokenizer(), page_size=PAGE,
+                               faults=plane)
+        await fleet.start()
+        host, port = await router.start()
+        scaler = Autoscaler(
+            fleet, min_replicas=1, max_replicas=2, up_load=0.15,
+            down_load=0.05, hysteresis=2, cooldown_s=0.05,
+            drain_timeout_s=20.0, replica_capacity_tokens=112,
+            faults=plane,
+        )
+        scaler._loop = asyncio.get_running_loop()
+        try:
+            assert await fleet.wait_healthy(timeout_s=60.0)
+            results: dict[str, tuple[int, dict, dict]] = {}
+
+            async def one(prompt, n, tenant):
+                results[prompt] = await _request(
+                    host, port, {"prompt": prompt, "max_tokens": n},
+                    tenant=tenant,
+                )
+
+            # Storm: gold + free pace out; the aggressor BURSTS (its
+            # offered token mass ~5x its quota window).
+            tasks = []
+
+            async def storm():
+                for i, (p, n) in enumerate(agg):
+                    tasks.append(asyncio.ensure_future(one(p, n, "agg")))
+                    await asyncio.sleep(0.03)
+                for (p, n), (q, m) in zip(gold, free):
+                    tasks.append(asyncio.ensure_future(one(p, n, "gold")))
+                    tasks.append(asyncio.ensure_future(one(q, m, "free")))
+                    await asyncio.sleep(0.05)
+
+            storm_task = asyncio.ensure_future(storm())
+            # Mid-storm control ticks: committed-token load crosses
+            # up_load -> hysteresis x2 -> attempt 1 is EATEN by the
+            # injected fleet.scale_up raise (clean degrade), the retry
+            # after the cooldown scales up for real.
+            f0 = METRICS.get_counter("autoscale.scale_failures")
+            scaled_up = False
+            for _ in range(300):
+                await asyncio.sleep(0.02)
+                await scaler.tick()
+                if len(fleet.replicas) == 2:
+                    scaled_up = True
+                    break
+            assert scaled_up, "the storm never drove a scale-up"
+            assert plane.rules[0].fired == 1, "the drill never fired"
+            assert METRICS.get_counter(
+                "autoscale.scale_failures") >= f0 + 1
+            await storm_task
+            await asyncio.gather(*tasks)
+            # Scale-down mid-traffic: a trickle keeps the fleet serving
+            # while the idle ticks drain one replica away GRACEFULLY.
+            trickle = [(f"tail trickle {i}", 4) for i in range(3)]
+            twants = _expected_texts(tiny, trickle)
+
+            async def tail():
+                for p, n in trickle:
+                    await one(p, n, "gold")
+                    await asyncio.sleep(0.1)
+
+            tail_task = asyncio.ensure_future(tail())
+            scaled_down = False
+            for _ in range(400):
+                await asyncio.sleep(0.02)
+                await scaler.tick()
+                if len(fleet.replicas) == 1:
+                    scaled_down = True
+                    break
+            assert scaled_down, "the idle tail never drove a scale-down"
+            assert METRICS.get_counter("autoscale.scale_downs") >= 1
+            await tail_task
+            # -- the acceptance ledger ---------------------------------
+            completed = sheds = 0
+            for prompt, (status, headers, body) in results.items():
+                n_want = dict(gold + free + agg + trickle)[prompt]
+                if status == 200:
+                    completed += 1
+                    want = {**wants, **twants}[prompt]
+                    assert body["choices"][0]["text"] == want, prompt
+                else:
+                    # Every shed is STRUCTURED: 429/503 + Retry-After +
+                    # machine-readable overloaded_error.
+                    sheds += 1
+                    assert status in (429, 503), (prompt, status)
+                    assert "retry-after" in headers, prompt
+                    assert body["error"]["type"] == "overloaded_error"
+            assert completed >= len(gold) + len(free) + len(trickle), \
+                "storm starved the paced tenants"
+            # The aggressor really was throttled by ITS quota (not
+            # silently starved): per-tenant sheds carry the reason.
+            tenant_sheds = [
+                r for r in results.values()
+                if r[0] == 429 and r[2]["error"].get("reason")
+                == "tenant_quota"
+            ]
+            assert tenant_sheds, "aggressor was never quota-shed"
+            assert METRICS.get_counter("tenant.shed.agg") >= 1
+            # Surviving replicas' pools audit clean.
+            for h in fleet.replicas:
+                h.server.batcher.assert_pool_consistent()
+        finally:
+            await router.stop()
+            await fleet.stop()
+
+    asyncio.run(asyncio.wait_for(driver(), 550))
+
+
+def test_fleet_add_remove_replica_live(warmed):
+    """ReplicaFleet.add_replica boots + registers a routable replica
+    (served through the router); remove_replica drains it away
+    gracefully and returns the capacity — no respawn, handle gone."""
+    tiny = warmed
+
+    async def driver():
+        fleet = ReplicaFleet([_server_factory(tiny)],
+                             probe_interval_s=0.05, probe_timeout_s=2.0)
+        router = ReplicaRouter(fleet, host="127.0.0.1", port=0,
+                               tokenizer=ByteTokenizer(), page_size=PAGE)
+        await fleet.start()
+        host, port = await router.start()
+        try:
+            assert await fleet.wait_healthy(timeout_s=60.0)
+            h = await fleet.add_replica()
+            assert h.name == "r1" and len(fleet.replicas) == 2
+            assert h.state == "healthy"  # add_replica waits for the probe
+            s, _, b = await _request(
+                host, port, {"prompt": "served elastically",
+                             "max_tokens": 4})
+            assert s == 200, b
+            await fleet.remove_replica("r1", drain_timeout_s=10.0)
+            assert len(fleet.replicas) == 1
+            assert "r1" not in fleet._by_name
+            # Still serving on the survivor.
+            s, _, _ = await _request(
+                host, port, {"prompt": "still here", "max_tokens": 4})
+            assert s == 200
+            # Scaled-up names never collide with drained-away ones.
+            h2 = await fleet.add_replica()
+            assert h2.name == "r2"
+            await fleet.remove_replica("r2")
+        finally:
+            await router.stop()
+            await fleet.stop()
+
+    asyncio.run(asyncio.wait_for(driver(), 300))

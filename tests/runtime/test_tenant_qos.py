@@ -1,0 +1,550 @@
+"""Multi-tenant QoS: weighted-fair scheduling, quotas, and the traffic
+harness (runtime/scheduler.py TenantScheduler + runtime/workload.py +
+the serving gateway's tenant surface).
+
+Three layers, mirroring PR 13's policy/mechanism split:
+
+1. POLICY (no model, no device): the TenantScheduler hooks — virtual-
+   token-counter weighted fairness under skewed offered load, admission-
+   charge/true-up accounting, the VTC starvation-guard lift, and
+   resident-row caps — unit-tested with plain host data.
+2. HARNESS (no model): the traffic-replay generator is deterministic,
+   actually bursty, stamps shared prefixes, and its goodput/SLO scoring
+   does the arithmetic the bench ladder stamps.
+3. MECHANISM (tiny model, live HTTP): the tenant id rides the X-Tenant
+   header (and the "tenant" body-field fallback) through the gateway
+   into the batcher; the per-tenant token-rate gate sheds structured
+   429s with the TENANT's own Retry-After (and the tenant.quota drill
+   forces one); weighted-fair admission really reorders a skewed
+   backlog; ServingClient sends the header and surfaces shed reasons.
+"""
+
+import asyncio
+import json
+import random
+
+import pytest
+
+import jax
+
+from distributed_llms_tpu.cluster.client import ServingClient
+from distributed_llms_tpu.core.observability import METRICS
+from distributed_llms_tpu.models import model as model_lib, presets
+from distributed_llms_tpu.runtime import workload
+from distributed_llms_tpu.runtime.batcher import ContinuousBatcher
+from distributed_llms_tpu.runtime.faults import FaultPlane
+from distributed_llms_tpu.runtime.scheduler import (
+    HOOKS, MixedScheduler, Scheduler, TenantScheduler, make_scheduler,
+    parse_tenant_weights,
+)
+from distributed_llms_tpu.runtime.server import InferenceServer
+from distributed_llms_tpu.runtime.tokenizer import ByteTokenizer
+
+
+# -- policy: TenantScheduler hooks without a model ---------------------------
+
+
+class _Req:
+    """Queue-entry stand-in: the tenant hooks consume only
+    (rid, priority, tenant, ids, max_new_tokens)."""
+
+    _next = [0]
+
+    def __init__(self, tenant=None, priority=0, prompt=10, budget=10):
+        _Req._next[0] += 1
+        self.rid = _Req._next[0]
+        self.priority = priority
+        self.tenant = tenant
+        self.ids = [0] * prompt
+        self.max_new_tokens = budget
+
+
+def _serve_loop(sched, offered, rounds, emit=None):
+    """Drive admission rounds against a standing backlog: each round
+    admits one request, charges it, frees it (emitting its full budget
+    unless ``emit`` says otherwise), and refills the tenant's backlog —
+    the steady-state skewed-offered-load shape.  Returns per-tenant
+    service counts."""
+    queue = [_Req(t) for t, n in offered.items() for _ in range(n)]
+    served = {t: 0 for t in offered}
+    for _ in range(rounds):
+        req = sched.admission_order(queue)
+        assert req is not None
+        served[req.tenant] += 1
+        sched.note_admitted(req, len(req.ids) + req.max_new_tokens)
+        sched.note_freed(req, req.max_new_tokens if emit is None
+                         else emit(req))
+        queue.remove(req)
+        queue.append(_Req(req.tenant))
+    return served
+
+
+def test_tenant_hooks_declared_on_every_policy():
+    """The accounting hooks are DECLARED (HOOKS registry) and exist on
+    every policy — tenant-blind ones as no-ops, so the batcher's
+    delegation never branches on the policy class."""
+    assert "note_admitted" in HOOKS and "note_freed" in HOOKS
+    for cls in (Scheduler, MixedScheduler, TenantScheduler):
+        pol = cls()
+        for hook in HOOKS:
+            assert callable(getattr(pol, hook)), (cls.__name__, hook)
+    # Tenant-blind policies really are no-ops (no state accretes).
+    base = MixedScheduler()
+    base.note_admitted(_Req("a"), 100)
+    base.note_freed(_Req("a"), 0)
+
+
+def test_weighted_fair_order_under_skewed_load():
+    """Both tenants keep a standing backlog; weights 4:1 must split
+    service ~4:1 no matter that the aggressor offers 10x the requests —
+    offered load buys NOTHING past your weighted share (VTC's claim)."""
+    s = make_scheduler("mixed", tenant_weights="gold:4,free:1")
+    assert isinstance(s, TenantScheduler)
+    served = _serve_loop(s, {"gold": 2, "free": 20}, rounds=100)
+    assert served["gold"] == 80 and served["free"] == 20
+    # Equal weights, equal split — the aggressor's 20-deep backlog is
+    # irrelevant.
+    s2 = make_scheduler("mixed", tenant_weights="a:1,b:1")
+    served = _serve_loop(s2, {"a": 1, "b": 20}, rounds=50)
+    assert served["a"] == 25 and served["b"] == 25
+
+
+def test_quota_accounting_charge_and_refund():
+    """The admission charge is prompt + FULL budget over weight; the
+    release true-up refunds what was never emitted, so a short
+    completion is not billed like a long one."""
+    s = TenantScheduler(tenant_weights={"a": 2.0})
+    r = _Req("a", prompt=10, budget=30)
+    s.note_admitted(r, 40)
+    assert s._vtc["a"] == pytest.approx(20.0)   # 40 / weight 2
+    assert s._resident["a"] == 1
+    s.note_freed(r, 4)                          # emitted 4 of 30
+    assert s._vtc["a"] == pytest.approx(7.0)    # (10+4)/2
+    assert s._resident["a"] == 0
+    # Unpaired / double frees are inert (preempt + resume re-pairs).
+    s.note_freed(r, 4)
+    assert s._vtc["a"] == pytest.approx(7.0)
+    # The gauges rode along.
+    assert METRICS.get_gauge("tenant.vtc.a") == pytest.approx(7.0)
+
+
+def test_starvation_guard_vtc_lift():
+    """A tenant idle through an aggressor's long run is LIFTED to the
+    live minimum on return: it gets immediate service (lowest counter
+    among backlogged tenants is the aggressor's own floor) but cannot
+    monopolize the engine for its whole idle deficit — and the
+    continuously-backlogged aggressor is never starved."""
+    s = TenantScheduler(tenant_weights={"agg": 1.0, "late": 1.0})
+    served = _serve_loop(s, {"agg": 4}, rounds=40)
+    assert served == {"agg": 40}
+    floor = s._vtc["agg"]
+    # The late tenant arrives with an empty history...
+    s.admission_order([_Req("late"), _Req("agg")])
+    # ...lifted to the aggressor's floor, not credited 40 rounds of idle.
+    assert s._vtc["late"] >= floor
+    # From here service alternates (equal weights), rather than "late"
+    # drawing down a 40-round deficit while "agg" starves.
+    served = _serve_loop(s, {"agg": 4, "late": 4}, rounds=20)
+    assert served == {"agg": 10, "late": 10}
+
+
+def test_resident_row_cap_defers_not_shed():
+    """A tenant at tenant_max_rows defers (its queue entries wait;
+    OTHER tenants admit past it); with every backlogged tenant capped,
+    admission back-pressures (None) until a release frees a row."""
+    s = TenantScheduler(tenant_weights={"a": 8.0, "b": 1.0},
+                        tenant_max_rows=1)
+    a1, a2, b1 = _Req("a"), _Req("a"), _Req("b")
+    first = s.admission_order([a1, a2, b1])
+    assert first is a1  # weight 8 -> "a" first
+    s.note_admitted(a1, 20)
+    # "a" is at its cap: its second request defers, "b" admits past it.
+    second = s.admission_order([a2, b1])
+    assert second is b1
+    s.note_admitted(b1, 20)
+    assert s.admission_order([a2]) is None  # everyone capped: defer
+    s.note_freed(a1, 10)
+    assert s.admission_order([a2]) is a2
+
+
+def test_anonymous_and_priority_within_tenant():
+    """Requests without a tenant share one anonymous bucket at the
+    default weight; within a tenant the base order (priority desc, FIFO
+    rid) still applies."""
+    s = TenantScheduler(tenant_weights={"*": 2.0, "a": 2.0})
+    lo, hi = _Req("a"), _Req("a", priority=5)
+    anon = _Req(None)
+    assert s.weight(None) == 2.0
+    assert s.admission_order([lo, hi, anon]).rid in (hi.rid, anon.rid)
+    # Within tenant "a": priority wins over FIFO.
+    s2 = TenantScheduler(tenant_weights={"a": 1.0})
+    assert s2.admission_order([lo, hi]) is hi
+
+
+def test_tenant_config_validation():
+    assert parse_tenant_weights(None) == {}
+    assert parse_tenant_weights("a:4, b:1.5") == {"a": 4.0, "b": 1.5}
+    assert parse_tenant_weights({"a": 2}) == {"a": 2.0}
+    with pytest.raises(ValueError, match="name:weight"):
+        parse_tenant_weights("a=4")
+    with pytest.raises(ValueError, match="finite and > 0"):
+        parse_tenant_weights("a:0")
+    with pytest.raises(ValueError, match="not a number"):
+        parse_tenant_weights("a:lots")
+    with pytest.raises(ValueError, match="mixed"):
+        make_scheduler("alternate", tenant_weights="a:1")
+    with pytest.raises(ValueError, match="speculative"):
+        make_scheduler("mixed", tenant_weights="a:1", speculative=True)
+    with pytest.raises(ValueError, match="tenant_max_rows"):
+        TenantScheduler(tenant_max_rows=0)
+
+
+# -- harness: the traffic generator + scoring (no model) ---------------------
+
+
+def _specs():
+    return [
+        workload.TenantSpec("agg", rate_rps=4.0, burst_rate_x=5.0,
+                            burst_enter_hz=0.3, burst_exit_hz=0.5,
+                            shared_frac=0.5),
+        workload.TenantSpec("vic", rate_rps=1.0, prompt_len=(8, 24),
+                            output_len=(4, 8)),
+    ]
+
+
+def test_workload_deterministic_and_sorted():
+    a = workload.generate(_specs(), 15.0, seed=7,
+                          diurnal_period_s=10.0, diurnal_amp=0.4)
+    b = workload.generate(_specs(), 15.0, seed=7,
+                          diurnal_period_s=10.0, diurnal_amp=0.4)
+    assert a == b  # byte-identical offered load across serving legs
+    assert a and all(x.t <= y.t for x, y in zip(a, a[1:]))
+    assert {x.tenant for x in a} == {"agg", "vic"}
+    assert workload.generate(_specs(), 15.0, seed=8) != a  # seed matters
+
+
+def test_workload_bursts_raise_rate_and_prefixes_share():
+    bursty = workload.generate([_specs()[0]], 60.0, seed=1)
+    calm = workload.generate([workload.TenantSpec("agg", rate_rps=4.0)],
+                             60.0, seed=1)
+    # Burst state multiplies the rate 5x for ~38% of the time: the MMPP
+    # trace must carry substantially more arrivals than the calm one.
+    assert len(bursty) > 1.5 * len(calm)
+    pfx = workload.shared_prefix(_specs()[0], 1)
+    shared = [a for a in bursty if a.shared]
+    assert shared and all(a.prompt.startswith(pfx) for a in shared)
+    frac = len(shared) / len(bursty)
+    assert 0.35 < frac < 0.65  # spec says 0.5
+    # Output budgets respect the per-tenant mix.
+    assert all(8 <= a.max_tokens <= 32 for a in bursty)
+
+
+def test_workload_slo_scoring_arithmetic():
+    R = workload.Record
+    recs = [
+        R(tenant="v", t_arrival=0, status=200, ttft_s=0.1, latency_s=0.5,
+          tokens=20, itl_s=[0.01, 0.02]),
+        R(tenant="v", t_arrival=1, status=200, ttft_s=3.0, latency_s=4.0,
+          tokens=20),                                   # misses TTFT SLO
+        R(tenant="v", t_arrival=2, status=429, retry_after=2.0,
+          shed_reason="tenant_quota"),
+        R(tenant="v", t_arrival=3, status=0),           # transport failure
+    ]
+    s = workload.summarize(recs, horizon_s=10.0, ttft_slo_s=1.0)["v"]
+    assert s["offered"] == 4 and s["completed"] == 2
+    assert s["shed"] == 1 and s["shed_with_retry_after"] == 1
+    assert s["failed"] == 1
+    assert s["slo_attainment"] == 0.5
+    assert s["goodput_tok_s"] == pytest.approx(2.0)   # only the SLO-met 20
+    assert s["tok_s"] == pytest.approx(4.0)
+    assert s["itl_p95_s"] == pytest.approx(0.02)
+
+
+def test_workload_validation():
+    with pytest.raises(ValueError, match="rate_rps"):
+        workload.TenantSpec("x", rate_rps=0.0)
+    with pytest.raises(ValueError, match="shared_frac"):
+        workload.TenantSpec("x", rate_rps=1.0, shared_frac=1.5)
+    with pytest.raises(ValueError, match="burst_rate_x"):
+        workload.TenantSpec("x", rate_rps=1.0, burst_rate_x=0.5)
+    with pytest.raises(ValueError, match="duplicate"):
+        workload.generate([workload.TenantSpec("x", rate_rps=1.0)] * 2, 1.0)
+    with pytest.raises(ValueError, match="horizon"):
+        workload.generate([workload.TenantSpec("x", rate_rps=1.0)], 0.0)
+
+
+# -- mechanism: the gateway's tenant surface over live HTTP ------------------
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    cfg = presets.get_preset("llama-tiny", vocab_size=512)
+    params = model_lib.init_params(jax.random.key(0), cfg)
+    return cfg, params
+
+
+def _batcher(tiny, **kw):
+    cfg, params = tiny
+    tok = ByteTokenizer()
+    kw.setdefault("batch_slots", 2)
+    kw.setdefault("max_len", 96)
+    kw.setdefault("chunk_steps", 4)
+    return ContinuousBatcher(cfg, params, tokenizer=tok, eos_id=tok.eos_id,
+                             pad_id=tok.pad_id, **kw)
+
+
+async def _post(host, port, body, tenant=None, path="/v1/completions"):
+    reader, writer = await asyncio.open_connection(host, port)
+    payload = json.dumps(body).encode()
+    hdr = f"X-Tenant: {tenant}\r\n" if tenant else ""
+    writer.write(
+        f"POST {path} HTTP/1.1\r\nHost: t\r\n{hdr}"
+        f"Content-Length: {len(payload)}\r\n\r\n".encode() + payload
+    )
+    await writer.drain()
+    status = int((await reader.readline()).split()[1])
+    headers = {}
+    while True:
+        line = await reader.readline()
+        if line in (b"\r\n", b"\n", b""):
+            break
+        k, _, v = line.decode("latin-1").partition(":")
+        headers[k.strip().lower()] = v.strip()
+    raw = await reader.read()
+    writer.close()
+    return status, headers, json.loads(raw) if raw.strip() else {}
+
+
+def _serve(tiny, fn, *, batcher_kw=None, **srv_kw):
+    async def driver():
+        srv = InferenceServer(
+            _batcher(tiny, **(batcher_kw or {})), model_name="tiny",
+            host="127.0.0.1", port=0,
+            batcher_factory=lambda: _batcher(tiny, **(batcher_kw or {})),
+            **srv_kw,
+        )
+        host, port = await srv.start()
+        try:
+            return await asyncio.wait_for(fn(host, port, srv), 300)
+        finally:
+            await srv.stop()
+
+    return asyncio.run(driver())
+
+
+def test_tenant_header_body_field_and_validation(tiny):
+    """X-Tenant header and "tenant" body field both bill the request
+    (header wins); malformed ids 400 before any admission state."""
+    kw = dict(tenant_weights="gold:4,free:1")
+
+    async def fn(host, port, srv):
+        s, _, b = await _post(host, port,
+                              {"prompt": "hello there", "max_tokens": 4},
+                              tenant="gold")
+        assert s == 200, b
+        s, _, _ = await _post(
+            host, port,
+            {"prompt": "hi!", "max_tokens": 4, "tenant": "free"})
+        assert s == 200
+        # Header beats the body field: the charge lands on "gold".
+        g0 = METRICS.get_counter("tenant.requests.gold")
+        s, _, _ = await _post(
+            host, port,
+            {"prompt": "hi!", "max_tokens": 4, "tenant": "free"},
+            tenant="gold")
+        assert s == 200
+        assert METRICS.get_counter("tenant.requests.gold") == g0 + 1
+        for bad in ("no spaces!", "x" * 65, 7):
+            s, _, b = await _post(
+                host, port,
+                {"prompt": "hi!", "max_tokens": 4, "tenant": bad})
+            assert s == 400
+            assert "tenant" in b["error"]["message"]
+        assert srv._inflight() == 0  # nothing leaked by the 400s
+        # The scheduler accounted both tenants (vtc gauges live).
+        assert METRICS.get_counter("tenant.requests.free") >= 1
+
+    _serve(tiny, fn, batcher_kw=kw,
+           tenant_weights={"gold": 4.0, "free": 1.0})
+
+
+def test_tenant_rate_quota_sheds_with_per_tenant_retry_after(tiny):
+    """A tenant over weight x quota_tps x window admitted-token mass
+    sheds a structured 429: overloaded_error + reason "tenant_quota" +
+    the TENANT's own Retry-After — while an under-quota tenant on the
+    same server keeps serving, and the window aging out re-admits."""
+
+    async def fn(host, port, srv):
+        # free allowance: weight 1 x 5 tok/s x 2 s = 10 tokens.
+        s, _, _ = await _post(host, port,
+                              {"prompt": "four", "max_tokens": 4},
+                              tenant="free")  # 9 tokens: fits
+        assert s == 200
+        # Fits the allowance alone (10 tokens) but not the used window:
+        # the retryable shed — 429 + the tenant's OWN Retry-After.
+        s, h, b = await _post(host, port,
+                              {"prompt": "hello", "max_tokens": 4},
+                              tenant="free")
+        assert s == 429
+        assert b["error"]["type"] == "overloaded_error"
+        assert b["error"]["reason"] == "tenant_quota"
+        ra = int(h["retry-after"])
+        assert 1 <= ra <= 3  # the tenant's OWN window, not fleet load
+        # BIGGER than free's entire window allowance: un-retryable — a
+        # 400, never a 429 whose Retry-After could not come true.
+        s, _, b = await _post(host, port,
+                              {"prompt": "hello over quota",
+                               "max_tokens": 30}, tenant="free")
+        assert s == 400
+        assert b["error"]["type"] == "invalid_request_error"
+        assert "quota window holds at most" in b["error"]["message"]
+        # gold (weight 4: 40-token allowance) is untouched by free's shed.
+        s, _, _ = await _post(host, port,
+                              {"prompt": "gold still serves",
+                               "max_tokens": 8}, tenant="gold")
+        assert s == 200
+        assert METRICS.get_counter("tenant.shed.free") >= 1
+        # The window ages out: free serves again after its Retry-After.
+        await asyncio.sleep(ra + 0.2)
+        s, _, _ = await _post(host, port,
+                              {"prompt": "four", "max_tokens": 4},
+                              tenant="free")
+        assert s == 200
+
+    _serve(tiny, fn, tenant_weights={"gold": 4.0, "free": 1.0},
+           tenant_quota_tps=5.0, tenant_rate_window_s=2.0)
+
+
+def test_tenant_quota_drill_forces_shed(tiny):
+    """The tenant.quota fault site (action exhaust, tag = tenant)
+    forces the over-quota path for exactly the tagged tenant — the
+    per-tenant-shed drill used by the chaos acceptance storm."""
+    plane = FaultPlane.parse("tenant.quota/free:exhaust@1")
+
+    async def fn(host, port, srv):
+        s, h, b = await _post(host, port,
+                              {"prompt": "tiny", "max_tokens": 2},
+                              tenant="free")  # far under quota — forced
+        assert s == 429 and b["error"]["reason"] == "tenant_quota"
+        assert "retry-after" in h
+        s, _, _ = await _post(host, port,
+                              {"prompt": "tiny", "max_tokens": 2},
+                              tenant="gold")  # untagged tenant unaffected
+        assert s == 200
+        assert plane.rules[0].fired == 1
+
+    _serve(tiny, fn, batcher_kw=dict(faults=plane),
+           tenant_weights={"gold": 1.0, "free": 1.0},
+           tenant_quota_tps=1000.0)
+
+
+def test_weighted_fair_admission_reorders_backlog(tiny):
+    """End to end through the engine: with one decode slot and a deep
+    aggressor backlog queued FIRST, the victim's single request (higher
+    weight, lower counter) admits ahead of most of it — rid order would
+    have served it last."""
+    b = _batcher(tiny, batch_slots=1,
+                 tenant_weights="vic:4,agg:1", tenant_max_rows=1)
+    order = []
+    agg = [b.submit("aggressor flood " + str(i), max_new_tokens=6,
+                    tenant="agg") for i in range(4)]
+    vic = b.submit("victim!", max_new_tokens=6, tenant="vic")
+
+    def cb(rid, new, done, lps):
+        if done:
+            order.append(rid)
+
+    b.run(on_tokens=cb)
+    assert set(order) == set(agg) | {vic}
+    # The victim outranked at least the tail of the earlier-rid flood.
+    assert order.index(vic) < 2, order
+
+
+def test_serving_client_sends_tenant_and_surfaces_shed_reason():
+    """ServingClient(tenant=): the X-Tenant header rides every request;
+    a per-tenant 429 is retried on the server's Retry-After and its
+    machine-readable reason is surfaced."""
+
+    seen = {"tenants": [], "n": 0}
+
+    async def fn():
+        async def handle(reader, writer):
+            req = await reader.readuntil(b"\r\n\r\n")
+            headers = req.decode("latin-1").lower()
+            for line in headers.split("\r\n"):
+                if line.startswith("x-tenant:"):
+                    seen["tenants"].append(line.split(":", 1)[1].strip())
+            clen = 0
+            for line in headers.split("\r\n"):
+                if line.startswith("content-length:"):
+                    clen = int(line.split(":", 1)[1])
+            if clen:
+                await reader.readexactly(clen)
+            seen["n"] += 1
+            if seen["n"] == 1:  # first hit: per-tenant shed
+                body = json.dumps({"error": {
+                    "message": "tenant 'acme' over its token-rate quota",
+                    "type": "overloaded_error", "reason": "tenant_quota",
+                }}).encode()
+                writer.write(
+                    b"HTTP/1.1 429 Too Many Requests\r\n"
+                    b"Content-Type: application/json\r\n"
+                    b"Retry-After: 0\r\n"
+                    + f"Content-Length: {len(body)}\r\n\r\n".encode()
+                    + body)
+            else:
+                body = b'{"ok": true}'
+                writer.write(
+                    b"HTTP/1.1 200 OK\r\nContent-Type: application/json\r\n"
+                    + f"Content-Length: {len(body)}\r\n\r\n".encode()
+                    + body)
+            await writer.drain()
+            writer.close()
+
+        server = await asyncio.start_server(handle, "127.0.0.1", 0)
+        port = server.sockets[0].getsockname()[1]
+        client = ServingClient("127.0.0.1", port, tenant="acme",
+                               retry_after_cap_s=0.0, backoff_base_s=0.0,
+                               rng=random.Random(0))
+        status, out = await client.completions(
+            {"prompt": "x", "max_tokens": 1})
+        server.close()
+        await server.wait_closed()
+        assert status == 200 and out == {"ok": True}
+        assert seen["tenants"] == ["acme", "acme"]  # header on BOTH tries
+        assert client.retries_taken == 1            # honored Retry-After
+        assert client.last_shed_reason == "tenant_quota"
+        assert client.tenant_sheds == 1
+
+    asyncio.run(fn())
+
+
+def test_engine_and_cli_plumbing(tiny):
+    """RuntimeConfig.tenant_* thread through engine.continuous_batcher
+    (explicit args win; ""/0 disable), the CLI declares the flags, and
+    respawn rebuilds the tenant policy from the ctor snapshot."""
+    import dataclasses
+
+    from distributed_llms_tpu.cli.serve_main import _RUNTIME_FLAGS
+    from distributed_llms_tpu.core.config import RuntimeConfig
+    from distributed_llms_tpu.runtime.engine import InferenceEngine
+
+    assert RuntimeConfig().tenant_weights is None
+    assert RuntimeConfig().tenant_quota_tps is None
+    assert RuntimeConfig().tenant_max_rows is None
+    assert _RUNTIME_FLAGS["tenant-weights"] == "tenant_weights"
+    assert _RUNTIME_FLAGS["tenant-quota-tps"] == "tenant_quota_tps"
+    assert _RUNTIME_FLAGS["tenant-max-rows"] == "tenant_max_rows"
+    rt = dataclasses.replace(RuntimeConfig(), max_seq_len=64,
+                             tenant_weights="a:2,b:1", tenant_max_rows=1)
+    eng = InferenceEngine.from_preset("llama-tiny", rt=rt, vocab_size=512)
+    b = eng.continuous_batcher(batch_slots=2, max_len=64)
+    assert isinstance(b.sched, TenantScheduler)
+    assert b.sched.tenant_weights == {"a": 2.0, "b": 1.0}
+    assert b.sched.tenant_max_rows == 1
+    # respawn(): fresh counters, same policy.
+    assert isinstance(b.respawn().sched, TenantScheduler)
+    # Explicit "" disables the config weights.
+    b2 = eng.continuous_batcher(batch_slots=2, max_len=64,
+                                tenant_weights="", tenant_max_rows=0)
+    assert not isinstance(b2.sched, TenantScheduler)

@@ -66,6 +66,17 @@ def _result_cell(row: dict) -> str:
         ("handoff_ms_p50", "handoff p50 ms"),
         ("fallback_recovery_ms", "prefill-kill fallback ms"),
         ("goodput_tok_per_s", "goodput tok/s"),
+        ("aggressor_offered_x", "aggressor offered x quota"),
+        ("victim_goodput_off", "victim goodput tok/s (QoS off)"),
+        ("victim_goodput_on", "victim goodput tok/s (QoS on)"),
+        ("victim_goodput_gain", "victim goodput gain x"),
+        ("victim_slo_off", "victim SLO attainment (off)"),
+        ("victim_slo_on", "victim SLO attainment (on)"),
+        ("victim_itl_p95_ms_off", "victim ITL p95 ms (off)"),
+        ("victim_itl_p95_ms_on", "victim ITL p95 ms (on)"),
+        ("aggressor_shed_frac", "aggressor shed frac"),
+        ("scale_up_s", "scale-up s"),
+        ("scale_down_s", "scale-down s"),
         ("offered_x", "offered load x"),
         ("shed_frac", "shed frac"),
         ("preemptions", "preemptions"),
@@ -161,8 +172,8 @@ def generate(ladder_path: str) -> str:
         # Aux rows run_ladder appends after the decode configs.
         "serving-latency", "continuous-batching", "local-proc-batching",
         "chunked-prefill", "prefix-cache-ttft", "fault-recovery",
-        "overload-goodput", "kv-tiering", "decode-overlap", "mixed-step",
-        "spec-paged",
+        "overload-goodput", "tenant-qos", "kv-tiering", "decode-overlap",
+        "mixed-step", "spec-paged",
         "constrained-decode", "mesh-paged", "replica-failover",
         "disagg-handoff", "compile-stability", "analysis-wall",
         "ragged-decode-8k", "ragged-decode-win-8k", "quant-matmul-bw",
