@@ -2045,6 +2045,219 @@ def _measure_tenant_qos(
     }
 
 
+def _measure_fleet_goodput(
+    preset: str | None = None, dtype: str = "bfloat16",
+    replicas: int = 4, horizon_s: float = 12.0, new_tokens: int = 16,
+    page_size: int = 16,
+) -> dict:
+    """Fleet control plane at 4+ replicas (runtime/router.py +
+    cluster/fleet.py): ONE deterministic two-tenant trace from the
+    runtime/workload.py harness (MMPP arrivals, seed-pinned prompts)
+    replayed open-loop against a COLOCATED 4-replica fleet and against a
+    DISAGGREGATED 2-prefill + 2-decode fleet (verified handoff), goodput
+    from workload.summarize + byte-exactness both legs.  Then
+    cross-replica KV reuse on the colocated fleet: one replica's prompts
+    are re-requested while it drains — the fleet digest directory steers
+    each pull to the sibling that holds the pages (hit rate + pages
+    shipped), and the identical re-requests with the pull plane OFF
+    re-prefill locally.  Both probes complete ONE token, so their walls
+    read as TTFT: the pull-vs-reprefill delta is what the directory buys
+    on a prompt whose pages live on a sibling.  Host-scheduling +
+    transfer effects, honestly measurable on any platform."""
+    import asyncio
+
+    from distributed_llms_tpu.cluster.fleet import ReplicaFleet
+    from distributed_llms_tpu.core.observability import METRICS
+    from distributed_llms_tpu.models import model as model_lib
+    from distributed_llms_tpu.models.presets import get_preset
+    from distributed_llms_tpu.runtime import workload
+    from distributed_llms_tpu.runtime.batcher import ContinuousBatcher
+    from distributed_llms_tpu.runtime.router import ReplicaRouter
+    from distributed_llms_tpu.runtime.server import InferenceServer
+    from distributed_llms_tpu.runtime.tokenizer import ByteTokenizer
+
+    # Byte-vocab tiny model (tenant-qos idiom): the served tokens ARE
+    # bytes, so the streamed text is non-vacuous and byte-exactness
+    # against the reference is a real check — a word-vocab checkpoint
+    # decodes to '' under the byte tokenizer and every comparison
+    # trivially passes while goodput reads zero.
+    del preset
+    cfg = get_preset("llama-tiny", vocab_size=259, max_seq_len=256,
+                     dtype=dtype)
+    params = model_lib.init_params(jax.random.key(0), cfg)
+    tok = ByteTokenizer()
+    max_len = 12 * page_size
+    slots = 2
+
+    def make_batcher():
+        # ignore-eos (serving-bench convention): every request emits
+        # exactly its max_tokens, so goodput measures fleet scheduling
+        # and transfer — not where this checkpoint happens to stop on
+        # the trace's synthetic prompts.
+        return ContinuousBatcher(
+            cfg, params, tokenizer=tok, eos_id=-1, pad_id=tok.pad_id,
+            batch_slots=slots, max_len=max_len, chunk_steps=4,
+            paged_pages=2 * slots * (max_len // page_size) + 1,
+            page_size=page_size, prefix_cache=True,
+        )
+
+    def make_server(role="colocated"):
+        def factory():
+            # 4 full engines share one host: generous watchdog and
+            # transfer deadlines keep scheduling contention from reading
+            # as replica death (failover is replica-failover's row).
+            return InferenceServer(
+                make_batcher(), model_name="bench", host="127.0.0.1",
+                port=0, batcher_factory=make_batcher,
+                watchdog_timeout_s=30.0, role=role,
+                xfer_attempt_s=10.0,
+            )
+
+        return factory
+
+    # The deterministic multi-tenant trace of record: two tenants with
+    # pinned seeds, prompt sizes that always span >= 2 KV pages and fit
+    # the 192-token slots, output pinned so every arrival has exactly
+    # one reference text.  Same (specs, horizon, seed) -> same bytes on
+    # every platform, which is what makes the two legs comparable.
+    specs = [
+        workload.TenantSpec(name="gold", rate_rps=0.45, weight=2.0,
+                            prompt_len=(64, 96),
+                            output_len=(new_tokens, new_tokens)),
+        workload.TenantSpec(name="std", rate_rps=0.45,
+                            prompt_len=(64, 96),
+                            output_len=(new_tokens, new_tokens)),
+    ]
+    arrivals = workload.generate(specs, horizon_s=horizon_s, seed=0)
+    prompts = list(dict.fromkeys(a.prompt for a in arrivals))
+    ref = make_batcher()
+    rids = [ref.submit(p, max_new_tokens=new_tokens) for p in prompts]
+    ref_res = ref.run()
+    wants = {p: tok.decode(ref_res[r]) for p, r in zip(prompts, rids)}
+    # Warm the CACHE-HIT admission shape too (the path every pulled or
+    # re-requested prompt takes): its first compile on a contended host
+    # would otherwise read as a wedged engine mid-measurement.
+    ref.submit(prompts[0], max_new_tokens=1)
+    ref.run()
+
+    async def storm(host, port):
+        records = await workload.replay(host, port, arrivals,
+                                        request_timeout_s=120.0)
+        summary = workload.summarize(records, horizon_s=horizon_s)
+        done = [(a.prompt, r) for a, r in zip(arrivals, records)
+                if r.status == 200]
+        exact = sum(1 for p, r in done if r.text == wants[p])
+        goodput = sum(s["goodput_tok_s"] for s in summary.values())
+        return len(done), exact, goodput
+
+    async def colocated_leg() -> dict:
+        fleet = ReplicaFleet([make_server()] * replicas,
+                             probe_interval_s=0.2, probe_timeout_s=8.0,
+                             probe_failures=4)
+        router = ReplicaRouter(fleet, host="127.0.0.1", port=0,
+                               tokenizer=tok, page_size=page_size)
+        await fleet.start()
+        host, port = await router.start()
+        assert await fleet.wait_healthy(timeout_s=120.0)
+        done, exact, goodput = await storm(host, port)
+
+        def holder(p):
+            digs = router._digests(tok.encode(p))
+            got = router._affinity.get(digs[-1]) if digs else None
+            return got[0] if got else None
+
+        by_holder: dict[str, list[str]] = {}
+        for p in prompts:
+            if holder(p):
+                by_holder.setdefault(holder(p), []).append(p)
+        # Drain the SINGLE largest holder and split its prompts: half
+        # re-requested with the pull plane ON (a draining replica stays
+        # reachable, so the directory steers each pull at it), half with
+        # the plane OFF (re-prefill on whichever sibling placement
+        # picks).  Robust to any placement skew — an uncontended trace
+        # can land every prompt on one replica.
+        src = max(by_holder, key=lambda n: len(by_holder[n]))
+        held = by_holder[src]
+        assert len(held) >= 2, f"holder {src} holds {len(held)} prompt(s)"
+        half = (len(held) + 1) // 2
+
+        async def reuse(subset, pull_on):
+            router.pull = pull_on
+            fleet[src].state = "draining"
+            walls = []
+            cached = 0
+            for p in subset:
+                t0 = time.perf_counter()
+                status, out = await _serving_post(
+                    host, port, {"prompt": p, "max_tokens": 1})
+                walls.append(time.perf_counter() - t0)
+                if status == 200:
+                    cached += out["usage"]["prompt_tokens_details"][
+                        "cached_tokens"]
+            fleet[src].state = "healthy"
+            router.pull = True
+            return sum(walls) / len(walls) * 1e3, cached
+
+        lk0 = METRICS.get_counter("directory.lookups")
+        hit0 = METRICS.get_counter("directory.hits")
+        pg0 = METRICS.get_counter("directory.pulled_pages")
+        fb0 = METRICS.get_counter("directory.pull_fallbacks")
+        pull_ms, pulled_cached = await reuse(held[:half], pull_on=True)
+        lookups = METRICS.get_counter("directory.lookups") - lk0
+        hits = METRICS.get_counter("directory.hits") - hit0
+        reprefill_ms, _ = await reuse(held[half:], pull_on=False)
+        assert pulled_cached > 0, "no pull ever served cached tokens"
+        await router.stop()
+        await fleet.stop()
+        return {
+            "completed": done,
+            "exact": exact,
+            "goodput_tok_per_s_colocated": round(goodput, 1),
+            "directory_hit_rate": round(hits / max(1, lookups), 3),
+            "pulled_pages": int(
+                METRICS.get_counter("directory.pulled_pages") - pg0),
+            "pull_fallbacks": int(
+                METRICS.get_counter("directory.pull_fallbacks") - fb0),
+            "pull_ttft_ms": round(pull_ms, 1),
+            "reprefill_ttft_ms": round(reprefill_ms, 1),
+            "pull_ttft_speedup": round(reprefill_ms / max(1e-9, pull_ms), 2),
+        }
+
+    async def disagg_leg() -> dict:
+        n_pre = replicas // 2
+        factories = [make_server("prefill")] * n_pre \
+            + [make_server("decode")] * (replicas - n_pre)
+        names = [f"p{i}" for i in range(n_pre)] \
+            + [f"d{i}" for i in range(replicas - n_pre)]
+        fleet = ReplicaFleet(factories, names=names, probe_interval_s=0.2,
+                             probe_timeout_s=8.0, probe_failures=4)
+        router = ReplicaRouter(fleet, host="127.0.0.1", port=0,
+                               tokenizer=tok, page_size=page_size,
+                               handoff=True)
+        await fleet.start()
+        host, port = await router.start()
+        assert await fleet.wait_healthy(timeout_s=120.0)
+        h0 = METRICS.get_counter("router.handoffs")
+        done, exact, goodput = await storm(host, port)
+        await router.stop()
+        await fleet.stop()
+        return {
+            "completed_disagg": done,
+            "exact_disagg": exact,
+            "goodput_tok_per_s_disagg": round(goodput, 1),
+            "handoffs": int(METRICS.get_counter("router.handoffs") - h0),
+        }
+
+    out = {"replicas": replicas, "requests": len(arrivals),
+           "tenants": len(specs), "horizon_s": horizon_s,
+           "new_tokens": new_tokens}
+    out.update(asyncio.run(colocated_leg()))
+    out.update(asyncio.run(disagg_leg()))
+    out.update({"preset": "llama-tiny(byte-vocab)",
+                "platform": jax.devices()[0].platform})
+    return out
+
+
 def _measure_kv_tiering(
     preset: str | None = None, dtype: str = "bfloat16", page_size: int = 16,
 ) -> dict:
@@ -3031,6 +3244,7 @@ def run_ladder(args, degraded: str | None) -> list[dict]:
             "replica-failover", "disagg-handoff", "analysis-wall",
             "kv-tiering", "decode-overlap", "constrained-decode",
             "mesh-paged", "mixed-step", "spec-paged", "tenant-qos",
+            "fleet-goodput",
         }
         unknown = only - known
         if unknown:  # a typo must not masquerade as a clean zero-row run
@@ -3213,6 +3427,12 @@ def run_ladder(args, degraded: str | None) -> list[dict]:
         # completed request — a host-scheduling effect, meaningful on any
         # platform.
         ("replica-failover", lambda: _measure_replica_failover(dtype=dtype)),
+        # Fleet control plane at 4 replicas: the same storm colocated vs
+        # disaggregated (2 prefill + 2 decode), plus cross-replica KV
+        # reuse — directory hit rate and 1-token pull-vs-reprefill TTFT
+        # while the page-holding replica drains.  Host-scheduling +
+        # transfer effects, meaningful on any platform.
+        ("fleet-goodput", lambda: _measure_fleet_goodput(dtype=dtype)),
         # Disaggregated prefill/decode: the same long+short storm served
         # colocated then disaggregated — short-request latency under
         # long-prompt interference, verified-handoff latency, and the

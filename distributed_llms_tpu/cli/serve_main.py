@@ -60,6 +60,7 @@ _SERVER_ONLY_FLAGS = frozenset({
     "max-pending", "drain-timeout", "watchdog-timeout", "platform",
     "replicas", "probe-interval", "failover-retries",
     "disaggregate", "prefill-replicas", "decode-replicas",
+    "prefill-replicas-max", "decode-replicas-max",
     "replicas-min", "replicas-max", "autoscale-interval",
     "autoscale-up-load", "autoscale-down-load", "autoscale-cooldown",
     "autoscale-hysteresis",
@@ -123,7 +124,8 @@ def _build_engine(args):
 
 
 def _server_factory(args, engine, default_name, rt, faults, *,
-                    host=None, port=None, role="colocated"):
+                    host=None, port=None, role="colocated",
+                    backstop_x=None):
     """() -> a fresh, unstarted InferenceServer over a fresh batcher.
     Replicas share the engine's weights by reference; each gets its own
     pool/caches/supervisor."""
@@ -195,6 +197,7 @@ def _server_factory(args, engine, default_name, rt, faults, *,
                          else rt.constrained_decoding),
             tenant_weights=tenant_weights,
             tenant_quota_tps=tenant_quota_tps,
+            tenant_backstop_x=backstop_x,
         )
 
     return make_server
@@ -219,10 +222,19 @@ def build_fleet(args):
     replicas-min stacks now, a signal-driven autoscaler
     (cluster/autoscale.py) growing to replicas-max on router
     committed-token load and shrinking back via graceful drain only.
+    With ``--disaggregate``, ``--replicas-max`` (or the per-tier
+    ``--prefill-replicas-max``/``--decode-replicas-max``) arms the
+    TIERED autoscaler instead: each tier scales independently between
+    its boot count and its ceiling — prefill on handoff queue depth,
+    decode on committed-token mass.  In every fleet mode the ROUTER
+    owns the tenant rate ledger (one admission-commit point, so a
+    fleet of N admits 1x quota); replica gateways keep a loose 2x
+    backstop so a bypassed router gate never leaves an unmetered path.
     Returns (fleet, router, autoscaler-or-None)."""
-    from ..cluster.autoscale import Autoscaler
+    from ..cluster.autoscale import Autoscaler, TieredAutoscaler, TierPolicy
     from ..cluster.fleet import ReplicaFleet
     from ..runtime.router import ReplicaRouter
+    from ..runtime.scheduler import parse_tenant_weights
 
     engine, default_name, rt, faults, fault_spec = _build_engine(args)
 
@@ -240,8 +252,15 @@ def build_fleet(args):
             from ..runtime.faults import FaultPlane
 
             plane = FaultPlane.parse(fault_spec, strict=True)
+        # backstop_x: behind a router the replica gateway is NOT the
+        # admission-commit point — the router's fleet ledger is.  The
+        # replica keeps a loose ~2x-fair-share backstop so a drilled or
+        # bypassed router gate still meters (never a silent unmetered
+        # path), without double-shedding honest traffic the router
+        # already admitted.
         return _server_factory(args, engine, default_name, rt, plane,
-                               host="127.0.0.1", port=0, role=role)()
+                               host="127.0.0.1", port=0, role=role,
+                               backstop_x=2.0)()
 
     if args.disaggregate:
         if args.prefill_replicas < 1 or args.decode_replicas < 1:
@@ -277,6 +296,16 @@ def build_fleet(args):
         probe_interval_s=args.probe_interval,
         faults=faults,
     )
+    # The router is the fleet's one admission-commit point: the tenant
+    # rate ledger lives HERE (quota conserved at any fleet size), with
+    # the same flag-wins-else-config resolution the gateways use.
+    tenant_weights = parse_tenant_weights(
+        args.tenant_weights if args.tenant_weights is not None
+        else rt.tenant_weights
+    )
+    tenant_quota_tps = (args.tenant_quota_tps
+                        if args.tenant_quota_tps is not None
+                        else rt.tenant_quota_tps)
     router = ReplicaRouter(
         fleet, host=args.host, port=args.port,
         tokenizer=engine.tokenizer,
@@ -288,14 +317,53 @@ def build_fleet(args):
         # which are salted by the KV width (--kv-bits) — a mismatched
         # salt would read as a digest mismatch on every handoff.
         kv_bits=(args.kv_bits if args.kv_bits is not None else rt.kv_bits),
+        tenant_weights=tenant_weights,
+        tenant_quota_tps=tenant_quota_tps,
     )
     autoscaler = None
-    if args.replicas_max:
-        if args.disaggregate:
+    if args.disaggregate:
+        # Tier ceilings: the per-tier flag wins, --replicas-max is the
+        # shared spelling, the boot count means "fixed tier".
+        p_max = (args.prefill_replicas_max or args.replicas_max
+                 or args.prefill_replicas)
+        d_max = (args.decode_replicas_max or args.replicas_max
+                 or args.decode_replicas)
+        if p_max < args.prefill_replicas or d_max < args.decode_replicas:
             raise SystemExit(
-                "--replicas-min/--replicas-max autoscale the colocated "
-                "fleet; --disaggregate sizes its tiers explicitly"
+                f"tier ceiling below its boot count: prefill "
+                f"{args.prefill_replicas}..{p_max}, decode "
+                f"{args.decode_replicas}..{d_max}"
             )
+        if p_max > args.prefill_replicas or d_max > args.decode_replicas:
+            import functools
+
+            autoscaler = TieredAutoscaler(
+                fleet,
+                prefill=TierPolicy(
+                    min_replicas=args.prefill_replicas,
+                    max_replicas=p_max,
+                    up_load=args.autoscale_up_load,
+                    down_load=args.autoscale_down_load,
+                    hysteresis=args.autoscale_hysteresis,
+                    cooldown_s=args.autoscale_cooldown,
+                ),
+                decode=TierPolicy(
+                    min_replicas=args.decode_replicas,
+                    max_replicas=d_max,
+                    up_load=args.autoscale_up_load,
+                    down_load=args.autoscale_down_load,
+                    hysteresis=args.autoscale_hysteresis,
+                    cooldown_s=args.autoscale_cooldown,
+                ),
+                prefill_factory=functools.partial(replica_factory,
+                                                  "prefill"),
+                decode_factory=functools.partial(replica_factory,
+                                                 "decode"),
+                interval_s=args.autoscale_interval,
+                drain_timeout_s=args.drain_timeout,
+                faults=faults,
+            )
+    elif args.replicas_max:
         if args.replicas_max < args.replicas_min:
             raise SystemExit(
                 f"--replicas-max {args.replicas_max} < --replicas-min "
@@ -374,9 +442,8 @@ async def _serve(args) -> None:
 
         loop.add_signal_handler(signal.SIGHUP, on_hup)
         if autoscaler is not None:
+            # Flat or tiered — each logs its own bounds in start().
             await autoscaler.start()
-            log.info("elastic fleet: %d..%d replicas on load signals",
-                     autoscaler.min_replicas, autoscaler.max_replicas)
         log.info("fleet of %d ready on http://%s:%s (SIGHUP = rolling "
                  "restart; Ctrl-C to stop)", len(fleet.replicas), host, port)
         await stop.wait()
@@ -501,9 +568,26 @@ def main(argv=None) -> None:
                          "Requires --paged-pages and --prefix-cache; "
                          "ignores --replicas")
     ap.add_argument("--prefill-replicas", type=int, default=1,
-                    help="prefill-role replicas under --disaggregate")
+                    help="prefill-role replicas under --disaggregate "
+                         "(the tier's floor when a ceiling arms the "
+                         "tiered autoscaler)")
     ap.add_argument("--decode-replicas", type=int, default=2,
-                    help="decode-role replicas under --disaggregate")
+                    help="decode-role replicas under --disaggregate "
+                         "(the tier's floor when a ceiling arms the "
+                         "tiered autoscaler)")
+    ap.add_argument("--prefill-replicas-max", type=int, default=None,
+                    help="elastic prefill-tier ceiling under "
+                         "--disaggregate: the tiered autoscaler grows "
+                         "the tier on handoff queue depth and shrinks "
+                         "it via graceful drain, never below "
+                         "--prefill-replicas (default: --replicas-max, "
+                         "else fixed at the boot count)")
+    ap.add_argument("--decode-replicas-max", type=int, default=None,
+                    help="elastic decode-tier ceiling under "
+                         "--disaggregate: scales on committed-token "
+                         "mass over tier KV capacity, never below "
+                         "--decode-replicas (default: --replicas-max, "
+                         "else fixed at the boot count)")
     ap.add_argument("--replicas-min", type=int, default=1,
                     help="elastic fleet floor: boot this many colocated "
                          "replicas and never drain below it (used with "
@@ -515,8 +599,12 @@ def main(argv=None) -> None:
                          "fleet up to this many replicas under load and "
                          "shrink back via graceful drain — in-flight "
                          "requests finish byte-exact, stragglers migrate "
-                         "through the router's exact failover (unset = "
-                         "fixed-size fleet)")
+                         "through the router's exact failover.  With "
+                         "--disaggregate this is the PER-TIER ceiling "
+                         "(each tier scales independently between its "
+                         "boot count and this; --prefill/--decode-"
+                         "replicas-max override per tier).  Unset = "
+                         "fixed-size fleet")
     ap.add_argument("--autoscale-interval", type=float, default=1.0,
                     help="autoscaler tick cadence in seconds")
     ap.add_argument("--autoscale-up-load", type=float, default=0.8,
@@ -628,9 +716,19 @@ def main(argv=None) -> None:
     if args.replicas_max is not None and args.replicas_max < 1:
         raise SystemExit(f"--replicas-max must be >= 1, got "
                          f"{args.replicas_max}")
-    if args.replicas_max is None:
-        # --replicas-max is THE elastic-fleet switch: the floor and every
-        # autoscale knob mean nothing without it — reject loudly instead
+    for k in ("prefill_replicas_max", "decode_replicas_max"):
+        v = getattr(args, k)
+        flag = f"--{k.replace('_', '-')}"
+        if v is not None and v < 1:
+            raise SystemExit(f"{flag} must be >= 1, got {v}")
+        if v is not None and not args.disaggregate:
+            # Tier ceilings without tiers is config drift — reject in
+            # milliseconds, before the model loads.
+            raise SystemExit(f"{flag} needs --disaggregate")
+    if args.replicas_max is None and args.prefill_replicas_max is None \
+            and args.decode_replicas_max is None:
+        # A max ceiling is THE elastic-fleet switch: the floor and every
+        # autoscale knob mean nothing without one — reject loudly instead
         # of booting a fixed fleet the operator believes is elastic.
         stray = [f"--{k.replace('_', '-')}" for k in (
             "replicas_min", "autoscale_interval", "autoscale_up_load",
@@ -639,9 +737,16 @@ def main(argv=None) -> None:
         ) if getattr(args, k) != ap.get_default(k)]
         if stray:
             raise SystemExit(
-                f"{', '.join(stray)} need --replicas-max "
-                "(the elastic-fleet switch)"
+                f"{', '.join(stray)} need --replicas-max (or a "
+                "--prefill/--decode-replicas-max tier ceiling)"
             )
+    if args.disaggregate and args.replicas_min != ap.get_default(
+            "replicas_min"):
+        raise SystemExit(
+            "--replicas-min sizes the colocated elastic fleet; "
+            "--disaggregate tiers floor at --prefill-replicas/"
+            "--decode-replicas"
+        )
     if args.platform:
         import jax
 

@@ -83,6 +83,10 @@ class ReplicaHandle:
         self.restarts = 0
         self.committed_tokens = 0
         self.inflight: set = set()  # router _Inflight records
+        # In-flight prefill handoff RPCs the router has outstanding on
+        # this handle (prefill role only) — the prefill tier's
+        # queue-depth signal for cluster/autoscale.py.
+        self.handoffs = 0
         self.last_report: dict = {}
 
     def routable(self, now: float) -> bool:
@@ -362,28 +366,33 @@ class ReplicaFleet:
 
     # -- elastic scaling (cluster/autoscale.py drives these) ---------------
 
-    def _fresh_name(self) -> str:
+    def _fresh_name(self, prefix: str = "r") -> str:
         while True:
-            name = f"r{self._next_name}"
+            name = f"{prefix}{self._next_name}"
             self._next_name += 1
             if name not in self._by_name:
                 return name
 
     async def add_replica(self, factory=None, name: str | None = None,
-                          wait_healthy_s: float = 60.0) -> ReplicaHandle:
+                          wait_healthy_s: float = 60.0,
+                          role: str | None = None) -> ReplicaHandle:
         """Scale UP: boot one more replica (fresh server/batcher stack on
         an ephemeral port) and register it with the fleet once its boot
         SUCCEEDED — a factory/start failure raises with nothing
         registered, so a failed scale-up leaves the fleet exactly as it
         was (no half-booted handle for the router to trip on).  Returns
         after the replica's first healthy probe (or ``wait_healthy_s``;
-        the caller reads ``handle.state``)."""
+        the caller reads ``handle.state``).  ``role`` only picks the
+        minted name's prefix (``p``/``d`` for prefill/decode, matching
+        the CLI's boot-time names) — the handle's actual role is read
+        off the server the factory builds, same as every boot."""
         factory = factory or self._default_factory
         if factory is None:
             raise ValueError("fleet has no replica factory to scale with")
         if name is not None and name in self._by_name:
             raise ValueError(f"replica name {name!r} already exists")
-        h = ReplicaHandle(name or self._fresh_name(), factory)
+        prefix = {"prefill": "p", "decode": "d"}.get(role, "r")
+        h = ReplicaHandle(name or self._fresh_name(prefix), factory)
         await self._boot(h)  # raises -> nothing registered (clean failure)
         self.replicas.append(h)
         self._by_name[h.name] = h

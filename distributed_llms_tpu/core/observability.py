@@ -400,6 +400,56 @@ METRIC_DOCS: dict[str, str] = {
                                 "ReplicaFleet.add_replica",
     "autoscale.replicas_removed": "replicas drained away by "
                                   "ReplicaFleet.remove_replica",
+    # -- fleet control plane (runtime/router.py, ISSUE 18) --
+    "router.ledger.charges": "admissions charged to the router's fleet "
+                             "tenant ledger at placement (the one "
+                             "admission-commit point)",
+    "router.ledger.charged_tokens": "token mass (prompt + budget) charged "
+                                    "to the fleet ledger",
+    "router.ledger.refunds": "fleet-ledger charges refunded — the request "
+                             "shed or failed without service rendered",
+    "router.ledger.sheds": "requests shed 429 by the fleet-ledger gate "
+                           "(each carries the tenant's own fleet-ledger "
+                           "Retry-After)",
+    "router.ledger.shed.*": "fleet-ledger sheds per tenant",
+    "router.ledger.bypasses": "requests that bypassed the fleet-ledger "
+                              "gate (the router.ledger drop drill) — the "
+                              "replica gateways' loose backstop still "
+                              "meters them, never a silent unmetered path",
+    "router.ledger.tenants": "tenants live in the fleet ledger map "
+                             "(gauge; cardinality-capped)",
+    "directory.lookups": "fleet prefix-digest directory lookups at "
+                         "placement (cold replica, warm sibling?)",
+    "directory.hits": "lookups that found an epoch-valid sibling holding "
+                      "a cached run the placed replica lacks",
+    "directory.stale_drops": "directory entries dropped lazily at lookup "
+                             "(epoch mismatch — the holder drained or "
+                             "respawned since recording)",
+    "directory.pulls": "cross-replica KV pulls attempted (sibling cache "
+                       "-> placed replica over the checksummed KV_PAGES "
+                       "plane)",
+    "directory.pulled_pages": "KV pages landed on the placed replica by "
+                              "completed cross-replica pulls",
+    "directory.pull_bytes": "KV payload bytes shipped by completed pulls",
+    "directory.pull_seconds": "one pull's cached-export + verified "
+                              "transfer latency (histogram)",
+    "directory.pull_fallbacks": "pulls degraded to local recompute "
+                                "(byte-exact, just slower)",
+    "directory.pull_fallbacks.*": "pull fallbacks by reason (stale, "
+                                  "not_cached, error, timeout, rejected, "
+                                  "no_kv_target)",
+    # -- disaggregated autoscaling (cluster/autoscale.py, per tier) --
+    "autoscale.*.replicas": "live replicas in the tier (gauge; * = "
+                            "prefill/decode)",
+    "autoscale.*.load": "the tier's scale signal (gauge): decode = "
+                        "committed-token mass over tier KV capacity, "
+                        "prefill = in-flight handoffs per replica",
+    "autoscale.*.scale_ups": "replicas added to the tier by the "
+                             "autoscaler",
+    "autoscale.*.scale_downs": "replicas drained away from the tier "
+                               "(graceful-only)",
+    "autoscale.*.scale_failures": "tier scale actions that failed or "
+                                  "were vetoed — the tier kept its size",
     # -- fault injection (runtime/faults.py) --
     "faults.fired": "injected faults triggered, total",
     "faults.fired.*": "injected faults triggered, by action",

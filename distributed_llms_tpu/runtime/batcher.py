@@ -2367,6 +2367,11 @@ class ContinuousBatcher:
         # device call and the pool/prefix-cache bookkeeping is
         # engine-owned, exactly like admission.
         self._kv_imports: deque = deque()  # guarded-by: self._lock
+        # Cross-replica pull plane: cached-run export requests queued by
+        # the serving loop (/v1/kv_export), gathered by the ENGINE thread
+        # at the next round boundary — the pool gather is a device call,
+        # same ownership rule as imports.
+        self._kv_exports: deque = deque()  # guarded-by: self._lock
 
     # -- prefix caching ------------------------------------------------------
 
@@ -2576,6 +2581,37 @@ class ContinuousBatcher:
                 on_done(ok, reason)
             except Exception:
                 log.exception("kv-import completion callback raised")
+
+    def has_kv_exports(self) -> bool:
+        """Whether a cached-run export awaits the engine (any thread)."""
+        with self._lock:
+            return bool(self._kv_exports)
+
+    def submit_kv_export(self, ids: list[int], on_done) -> None:
+        """Queue a cached-run export for the engine thread (any thread —
+        the serving loop's /v1/kv_export handler calls this).  The engine
+        gathers the prompt's longest cached full-page run at its next
+        round boundary and calls ``on_done(payload_or_None)`` from there
+        (the :meth:`export_prefix_pages` result); the caller is
+        responsible for waking the engine."""
+        with self._lock:
+            self._kv_exports.append((list(ids), on_done))
+
+    def _drain_kv_exports(self) -> None:
+        """ENGINE THREAD, at a scheduling-round boundary: serve every
+        queued cross-replica export.  Purely a cache read — nothing is
+        admitted, no row state changes; a prompt whose run is not
+        resident answers None (the puller recomputes locally)."""
+        while True:
+            with self._lock:
+                if not self._kv_exports:
+                    return
+                ids, on_done = self._kv_exports.popleft()
+            payload = self.export_prefix_pages(ids)
+            try:
+                on_done(payload)
+            except Exception:
+                log.exception("kv-export completion callback raised")
 
     def _import_kv_pages(self, digests, k_pages, v_pages):
         """Adopt one transfer: allocate pool pages, scatter the payload,
@@ -3474,8 +3510,11 @@ class ContinuousBatcher:
             # Injection site "batcher.admit": one hit per admission round.
             self.faults.fire("batcher.admit")
         # Adopt handed-off KV pages FIRST: a transfer that raced this
-        # round's admissions should be matchable by them.
+        # round's admissions should be matchable by them.  Then serve
+        # cross-replica export requests — after imports, so a freshly
+        # landed run is immediately re-exportable.
         self._drain_kv_imports()
+        self._drain_kv_exports()
         self._shed_expired_queued()
         # Advance pending chunked prefills.  ALTERNATE: one serialized
         # prefill_chunk_step bite per prefill per round (up to
@@ -3993,7 +4032,7 @@ class ContinuousBatcher:
         #                          chunk follows no observed completion
         while self.has_queued() or bool(self.active.any()) or any(
             r.rid is not None for r in self.rows
-        ) or self.has_kv_imports():
+        ) or self.has_kv_imports() or self.has_kv_exports():
             self._admit_pending()
             if self.paged:
                 # Chunk-boundary growth: rows about to write past their
@@ -4010,6 +4049,7 @@ class ContinuousBatcher:
                     np.zeros((self.b, 0), np.int32), was_active
                 )
                 if not self.has_queued() and not self.has_kv_imports() \
+                        and not self.has_kv_exports() \
                         and all(r.rid is None for r in self.rows):
                     break
                 continue

@@ -195,6 +195,29 @@ FAULT_SITES: dict[str, str] = {
         "tenant): 'exhaust' forces the over-quota path — the request "
         "sheds 429 with the tenant's own Retry-After even under its "
         "rate, the per-tenant-shed drill",
+    "router.ledger":
+        "the router's fleet-wide tenant-ledger gate, the one admission-"
+        "commit point (tag = tenant): 'exhaust' forces the over-quota "
+        "path (429 + the tenant's fleet-ledger Retry-After), 'stall:<s>' "
+        "wedges the gate (deferred — the admission path slows, never the "
+        "event loop), 'drop' BYPASSES the gate and its charge — the "
+        "replica gateways' loose backstop must still meter, never a "
+        "silent unmetered path",
+    "directory.lookup":
+        "the router's fleet-wide prefix-digest directory about to answer "
+        "a placement lookup: 'drop' makes every entry read stale (a "
+        "directory miss — the decode replica recomputes locally, "
+        "exactly), 'corrupt' mis-steers the lookup to a sibling that "
+        "does not hold the pages (the pull finds nothing exportable and "
+        "degrades to local recompute)",
+    "xfer.pull":
+        "a cross-replica KV pull about to ship off the source replica's "
+        "cache (tag = transfer id): 'drop' refuses the export (the "
+        "router degrades to local recompute), 'corrupt' flips payload "
+        "bytes post-checksum so the pull target's verify NACKs every "
+        "attempt, 'dup' ships the verified frame twice (idempotent "
+        "absorb), 'delay:<s>' stalls the pull toward the router's "
+        "deadline (deferred)",
 }
 
 

@@ -78,8 +78,8 @@ from .batcher import PrefixCache
 # One definition of the HTTP front-door limits/reasons/error shape for
 # both tiers — the router must shed/parse exactly like the replicas do.
 from .server import (
-    _MAX_BODY, _MAX_HEADERS, _MAX_REQUEST_LINE, _REASONS, _err_body,
-    valid_tenant_id,
+    _MAX_BODY, _MAX_HEADERS, _MAX_REQUEST_LINE, _REASONS,
+    _TENANT_LEDGER_CAP, ANON_TENANT, _err_body, valid_tenant_id,
 )
 
 log = get_logger("router")
@@ -130,6 +130,22 @@ class ReplicaRouter:
         kv_bits: int = 16,  # the replicas' pool width — page digests are
         #   salted by it (PrefixCache.page_digests), and router-side
         #   affinity/handoff digests must match the fleet's
+        # Fleet-wide tenant ledger: the router is the ONE admission-commit
+        # point, so a tenant's token-rate quota holds at any fleet size
+        # (elastic scale-up must not multiply it).  Same knobs and
+        # semantics as the replica gateway's rate gate — which, behind
+        # this ledger, should run as a LOOSE BACKSTOP (the server's
+        # tenant_backstop_x) so a bypassed or drilled router gate still
+        # never yields a silent unmetered path.  None disables the gate.
+        tenant_weights: "dict[str, float] | None" = None,
+        tenant_quota_tps: float | None = None,
+        tenant_rate_window_s: float = 10.0,
+        # Cross-replica KV reuse: on an affinity miss, pull the prompt's
+        # cached page run from the sibling the digest directory says
+        # holds it (over the checksummed KV_PAGES plane) instead of
+        # re-prefilling; every failure degrades to local recompute.
+        pull: bool = True,
+        pull_deadline_s: float = 5.0,
     ) -> None:
         self.fleet = fleet
         self.host = host
@@ -143,6 +159,18 @@ class ReplicaRouter:
         self.faults = faults
         self.handoff = handoff
         self.handoff_deadline_s = handoff_deadline_s
+        if tenant_quota_tps is not None and tenant_quota_tps <= 0:
+            tenant_quota_tps = None  # the CLI/config "disable" spelling
+        if tenant_rate_window_s <= 0:
+            raise ValueError(
+                f"tenant_rate_window_s must be > 0, got {tenant_rate_window_s}"
+            )
+        self.tenant_weights = dict(tenant_weights or {})
+        self.tenant_default_weight = self.tenant_weights.pop("*", 1.0)
+        self.tenant_quota_tps = tenant_quota_tps
+        self.tenant_rate_window_s = tenant_rate_window_s
+        self.pull = pull
+        self.pull_deadline_s = pull_deadline_s
         # digest -> (replica name, replica epoch), most-recently-used
         # last; event-loop confined like every router/fleet structure (no
         # engine thread ever touches it).  The epoch pins the entry to
@@ -153,6 +181,14 @@ class ReplicaRouter:
         from collections import OrderedDict
 
         self._affinity: "OrderedDict[bytes, tuple[str, int]]" = OrderedDict()
+        # The FLEET tenant ledger: trailing-window (ts, est) charges per
+        # tenant, the same shape as the replica gateway's — but there is
+        # exactly ONE of these per fleet, so what it admits is what the
+        # fleet admits.  Charged after the gate passes, REFUNDED when the
+        # request ends shed/failed without service (a shed must not burn
+        # the tenant's window).  Cardinality-capped like the replica's
+        # (_TENANT_LEDGER_CAP): ids are client-minted.
+        self._tenant_window: "dict[str, object]" = {}  # guarded-by: event-loop
         self._server: asyncio.base_events.Server | None = None
         self._loop: asyncio.AbstractEventLoop | None = None
         self._conns: set[asyncio.StreamWriter] = set()
@@ -204,7 +240,12 @@ class ReplicaRouter:
         name, epoch = got
         h = self.fleet._by_name.get(name)
         if h is None or h.epoch != epoch:
+            # Epoch mismatch = the replica drained/respawned since this
+            # entry was recorded: its pool is cold, the entry is a lie.
+            # This is also the digest DIRECTORY's self-invalidation (the
+            # cross-replica pull plane reads the same map).
             del self._affinity[d]
+            METRICS.inc("directory.stale_drops")
             return None
         return name
 
@@ -291,6 +332,99 @@ class ReplicaRouter:
             return None, 16
         return ids, n_prompt + budget
 
+    # -- the fleet tenant ledger (the one admission-commit point) ----------
+
+    def _tenant_weight(self, tenant: str) -> float:
+        return self.tenant_weights.get(tenant, self.tenant_default_weight)
+
+    def _tenant_allowance(self, tenant: str) -> float:
+        """Token mass the tenant's trailing window may hold, FLEET-WIDE —
+        the same weight x quota x window product the replica gateways
+        compute, held once for all of them."""
+        return (self._tenant_weight(tenant) * self.tenant_quota_tps
+                * self.tenant_rate_window_s)
+
+    # graftlint: holds(event-loop)
+    def _ledger_retry_after(self, tenant: str, est: int,
+                            forced: bool = False) -> int | None:
+        """The fleet-ledger rate gate (loop thread only).  None = ``est``
+        more tokens fit the tenant's window; else the PER-TENANT
+        Retry-After walked off the FLEET ledger oldest-first — a promise
+        about when this tenant's own fleet-wide charges age out, not a
+        load guess.  ``forced`` is the ``router.ledger:exhaust`` drill."""
+        import math
+
+        win = self.tenant_rate_window_s
+        allowed = self._tenant_allowance(tenant)
+        now = time.perf_counter()
+        ledger = self._tenant_window.get(tenant)
+        if ledger:
+            while ledger and ledger[0][0] <= now - win:
+                ledger.popleft()
+            if not ledger:  # fully aged out: drop the deque itself too
+                del self._tenant_window[tenant]
+                ledger = None
+        used = sum(n for _, n in ledger) if ledger else 0
+        if not forced and used + est <= allowed:
+            return None
+        room_needed = used + est - allowed
+        freed = 0.0
+        hint = win
+        for ts, n in (ledger or ()):
+            freed += n
+            if freed >= room_needed:
+                hint = ts + win - now
+                break
+        return int(min(60, max(1, math.ceil(hint))))
+
+    # graftlint: holds(event-loop)
+    def _ledger_charge(self, tenant: str, est: int) -> None:
+        """Commit an admitted request's token mass to the fleet ledger
+        (loop thread only) — charged once placement is about to happen,
+        refunded by ``_ledger_refund`` if the request ends shed or failed
+        without service."""
+        from collections import deque
+
+        if tenant not in self._tenant_window \
+                and len(self._tenant_window) >= _TENANT_LEDGER_CAP:
+            # Cardinality bound, exactly like the replica gateway's: age
+            # every ledger first; ids still inside their window are
+            # genuine concurrent tenants and stay.
+            cutoff = time.perf_counter() - self.tenant_rate_window_s
+            for t in list(self._tenant_window):
+                d = self._tenant_window[t]
+                while d and d[0][0] <= cutoff:
+                    d.popleft()
+                if not d:
+                    del self._tenant_window[t]
+        self._tenant_window.setdefault(tenant, deque()).append(
+            (time.perf_counter(), est)
+        )
+        METRICS.inc("router.ledger.charges")
+        METRICS.inc("router.ledger.charged_tokens", est)
+        METRICS.set_gauge("router.ledger.tenants", len(self._tenant_window))
+
+    # graftlint: holds(event-loop)
+    def _ledger_refund(self, tenant: str, est: int) -> None:
+        """Give a charge back (loop thread only): the request was shed or
+        failed before any service — billed tokens that bought nothing
+        would silently shrink the tenant's real quota.  Walks the
+        tenant's ledger NEWEST-first (the refund undoes the charge just
+        taken, not some hours-old admission)."""
+        ledger = self._tenant_window.get(tenant)
+        remaining = est
+        while ledger and remaining > 0:
+            ts, n = ledger.pop()
+            if n > remaining:
+                ledger.append((ts, n - remaining))
+                remaining = 0
+            else:
+                remaining -= n
+        if ledger is not None and not ledger:
+            del self._tenant_window[tenant]
+        METRICS.inc("router.ledger.refunds")
+        METRICS.set_gauge("router.ledger.tenants", len(self._tenant_window))
+
     # -- disaggregated prefill handoff -------------------------------------
 
     def _pick_prefill(self, exclude: set) -> "object | None":
@@ -351,12 +485,15 @@ class ReplicaRouter:
         # prefill work.
         charge = len(prompt_ids) + 1
         p.committed_tokens += charge
+        # Handoff count doubles as the prefill tier's queue-depth signal
+        # (cluster/autoscale.py TieredAutoscaler reads it off the handle).
+        p.handoffs += 1
         METRICS.set_gauge(
             f"router.committed_tokens.{p.name}", p.committed_tokens
         )
         try:
             out = await asyncio.wait_for(
-                self._prefill_rpc(p, body), self.handoff_deadline_s
+                self._rpc(p, "/v1/prefill", body), self.handoff_deadline_s
             )
         except asyncio.TimeoutError:
             return self._handoff_fallback(
@@ -375,6 +512,7 @@ class ReplicaRouter:
             )
         finally:
             p.committed_tokens -= charge
+            p.handoffs -= 1
             METRICS.set_gauge(
                 f"router.committed_tokens.{p.name}", p.committed_tokens
             )
@@ -411,12 +549,13 @@ class ReplicaRouter:
         )
         return True
 
-    async def _prefill_rpc(self, p, body: bytes) -> tuple[int, dict]:
-        """POST /v1/prefill to a prefill replica; returns (status, JSON)."""
+    async def _rpc(self, p, path: str, body: bytes) -> tuple[int, dict]:
+        """POST one control-plane JSON RPC (/v1/prefill, /v1/kv_export)
+        to a replica; returns (status, JSON)."""
         reader, writer = await asyncio.open_connection(p.host, p.port)
         try:
             writer.write(
-                f"POST /v1/prefill HTTP/1.1\r\nHost: router\r\n"
+                f"POST {path} HTTP/1.1\r\nHost: router\r\n"
                 f"Content-Length: {len(body)}\r\n\r\n".encode() + body
             )
             await writer.drain()
@@ -437,6 +576,134 @@ class ReplicaRouter:
             return status, resp if isinstance(resp, dict) else {}
         finally:
             writer.close()
+
+    # -- cross-replica KV reuse (the fleet prefix-digest directory) --------
+
+    def _pull_fallback(self, reason: str, detail: str) -> bool:
+        METRICS.inc("directory.pull_fallbacks")
+        METRICS.inc(f"directory.pull_fallbacks.{reason}")
+        log.warning("cross-replica pull degraded to local recompute "
+                    "(%s): %s", reason, detail)
+        return False
+
+    async def _directory_pull(self, decode_h, prompt_ids: list[int],
+                              digests: list[bytes]) -> bool:
+        """Cross-replica KV reuse for a request about to land COLD on
+        ``decode_h``: ask the fleet-wide prefix-digest directory (the
+        affinity map itself — epoch-keyed, so a drained/respawned sibling
+        self-invalidates into a miss) which SIBLING already holds the
+        prompt's cached page run, and have that sibling ship the pages to
+        ``decode_h``'s KV listener (``POST /v1/kv_export`` -> the
+        checksummed KV_PAGES plane) instead of re-prefilling content the
+        fleet already computed.  The shipped digests must be a prefix of
+        the digests THIS router hashed from the prompt, exactly like the
+        prefill handoff — a mis-steered or lying source must not poison
+        the decode cache.  Returns True when pages landed; EVERY failure
+        — stale directory answer (``directory.lookup:drop``), mis-steer
+        (``:corrupt``), nothing cached, corrupt frame, sender crash
+        mid-pull, deadline — returns False and the caller forwards the
+        request unchanged: local recompute, byte-exact either way."""
+        import uuid
+
+        METRICS.inc("directory.lookups")
+        now = self._loop.time()
+        src = None
+        for i in range(len(digests) - 1, -1, -1):  # longest cached run first
+            name = self._affinity_lookup(digests[i])
+            if name is not None and name != decode_h.name:
+                h = self.fleet._by_name.get(name)
+                if h is not None and h.reachable(now):
+                    src = h
+                    break
+        if src is None:
+            return False  # a plain miss: nothing to pull, nothing to count
+        METRICS.inc("directory.hits")
+        if self.faults is not None:
+            # defer_stall: this plane runs on the router's event loop; a
+            # stall rule is applied as an awaited delay below, never a
+            # blocking sleep.
+            rule = self.faults.fire("directory.lookup", tag=src.name,
+                                    defer_stall=True)
+            if rule is not None and rule.action == "drop":
+                METRICS.inc("directory.stale_drops")
+                return self._pull_fallback(
+                    "stale",
+                    f"directory answer for {src.name} read stale (drill)",
+                )
+            if rule is not None and rule.action == "corrupt":
+                # Mis-steer: the lookup answers a sibling that does NOT
+                # hold the pages — its export finds nothing (or ships a
+                # run whose digests diverge) and the pull degrades.
+                wrong = [h for h in self.fleet.replicas
+                         if h.name not in (src.name, decode_h.name)
+                         and h.reachable(now)]
+                if not wrong:
+                    METRICS.inc("directory.stale_drops")
+                    return self._pull_fallback(
+                        "stale", "mis-steer drill found no other replica"
+                    )
+                src = min(wrong, key=lambda h: h.name)
+            if rule is not None and rule.action in ("delay", "stall"):
+                await asyncio.sleep(rule.arg or 0.0)
+        if decode_h.kv_port is None:
+            return self._pull_fallback(
+                "no_kv_target",
+                f"decode replica {decode_h.name} has no KV listener",
+            )
+        METRICS.inc("directory.pulls")
+        transfer_id = uuid.uuid4().hex[:16]
+        body = json.dumps({
+            "prompt": list(prompt_ids),
+            "kv_host": decode_h.host,
+            "kv_port": decode_h.kv_port,
+            "transfer_id": transfer_id,
+        }).encode()
+        t0 = time.perf_counter()
+        try:
+            status, resp = await asyncio.wait_for(
+                self._rpc(src, "/v1/kv_export", body), self.pull_deadline_s
+            )
+        except asyncio.TimeoutError:
+            return self._pull_fallback(
+                "timeout",
+                f"source replica {src.name} exceeded the "
+                f"{self.pull_deadline_s:g}s pull deadline",
+            )
+        except (ConnectionError, OSError, EOFError, ValueError, IndexError,
+                asyncio.IncompleteReadError) as e:
+            # Sender crash / partition mid-pull surfaces as a severed or
+            # unreachable connection (an empty status line from a
+            # half-dead socket parses as IndexError/ValueError).
+            return self._pull_fallback(
+                "error",
+                f"source replica {src.name}: {type(e).__name__}: {e}",
+            )
+        if status != 200 or not isinstance(resp, dict) or not resp.get("ok"):
+            why = resp.get("reason") if isinstance(resp, dict) else None
+            reason = ("not_cached" if why == "nothing to export"
+                      else "rejected")
+            return self._pull_fallback(
+                reason, f"source replica {src.name}: {why or status}"
+            )
+        shipped = resp.get("digests") or []
+        want = [d.hex() for d in digests[: len(shipped)]]
+        if not shipped or shipped != want:
+            return self._pull_fallback(
+                "rejected",
+                f"source replica {src.name} shipped {len(shipped)} page(s) "
+                "whose digests diverge from the request's",
+            )
+        el = time.perf_counter() - t0
+        METRICS.observe("directory.pull_seconds", el)
+        METRICS.inc("directory.pulled_pages", int(resp.get("pages", 0)))
+        METRICS.inc("directory.pull_bytes", int(resp.get("bytes", 0)))
+        log.info(
+            "pull %s: %d page(s), %d token(s) shipped %s -> %s in %.1f ms "
+            "(%d transfer attempt(s))", transfer_id,
+            int(resp.get("pages", 0)), int(resp.get("tokens", 0)),
+            src.name, decode_h.name, el * 1e3, int(resp.get("attempts", 1)),
+        )
+        return True
 
     # -- the proxy core ----------------------------------------------------
 
@@ -471,12 +738,69 @@ class ReplicaRouter:
             f"Content-Length: {len(body)}\r\n\r\n"
         ).encode() + body
         METRICS.inc("router.requests")
+        # The FLEET tenant-ledger gate — the one admission-commit point.
+        # Charged here (before placement), refunded on every outcome that
+        # served the tenant nothing; the replica gateways behind it run
+        # their own ledgers as a LOOSE backstop only.
+        key = tenant if tenant else ANON_TENANT
+        charged = False
+        if self.tenant_quota_tps is not None and method == "POST":
+            rule = None
+            if self.faults is not None:
+                # defer_stall: the gate runs on the router's event loop —
+                # a stall rule slows THIS admission as an awaited delay,
+                # never the loop (probes and other tenants keep moving).
+                rule = self.faults.fire("router.ledger", tag=key,
+                                       defer_stall=True)
+            if rule is not None and rule.action in ("delay", "stall"):
+                await asyncio.sleep(rule.arg or 0.0)
+            if rule is not None and rule.action == "drop":
+                # The drill that bypasses the gate AND its charge: the
+                # replica gateways' backstop is now the only meter — the
+                # ladder's "never a silent unmetered path" leg.
+                METRICS.inc("router.ledger.bypasses")
+                log.warning(
+                    "fleet ledger gate bypassed for tenant %r (drill); "
+                    "replica backstop still meters", key,
+                )
+            else:
+                forced = rule is not None and rule.action == "exhaust"
+                allowed = self._tenant_allowance(key)
+                if est > allowed:
+                    # Bigger than the tenant's ENTIRE fleet window: no
+                    # Retry-After could come true — malformed for this
+                    # tenant, not load (the replica gate's own contract).
+                    await self._json(writer, 400, _err_body(
+                        f"request needs {est} admission tokens but tenant "
+                        f"{key!r}'s fleet quota window holds at most "
+                        f"{int(allowed)}"
+                    ))
+                    return
+                hint = self._ledger_retry_after(key, est, forced=forced)
+                if hint is not None:
+                    METRICS.inc("router.ledger.sheds")
+                    METRICS.inc(f"router.ledger.shed.{key}")
+                    shed = _err_body(
+                        f"tenant {key!r} over its fleet token-rate quota "
+                        f"({est} tokens would exceed the "
+                        f"{self.tenant_rate_window_s:g}s window)",
+                        "overloaded_error",
+                    )
+                    shed["error"]["reason"] = "tenant_quota"
+                    await self._json(writer, 429, shed,
+                                     headers={"Retry-After": str(hint)})
+                    return
+                self._ledger_charge(key, est)
+                charged = True
         tried: set[str] = set()
         attempts = 0
         t_fail: float | None = None
         while True:
             h = self._place(digests, est, exclude=tried)
             if h is None:
+                if charged:
+                    charged = False
+                    self._ledger_refund(key, est)
                 if attempts:
                     # The request actually FAILED on a replica and no
                     # healthy candidate remains: that is an engine
@@ -504,23 +828,44 @@ class ReplicaRouter:
             # which would trivially satisfy the check.
             warm = bool(digests) and \
                 self._affinity_lookup(digests[-1]) == h.name
-            self._record_affinity(digests, h)
             try:
-                if self.handoff and digests and method == "POST" \
-                        and not chat:
-                    # Disaggregated prefill: best-effort BY DESIGN — every
-                    # failure mode inside degrades to colocated prefill on
-                    # the decode replica; the verbatim forward below is
-                    # identical either way (byte-exact both paths).  Chat
-                    # requests skip the plane: the replica tokenizes them
-                    # through its chat template, so router-side ids (and
-                    # therefore the shipped digests) would never match
-                    # the admission's — pages would import dead.
-                    if warm:
-                        METRICS.inc("router.handoff_skips")
-                    else:
+                if digests and method == "POST" and not chat and not warm:
+                    # The request lands COLD here.  Cheapest source of its
+                    # pages first: a SIBLING's cache via the fleet digest
+                    # directory (cross-replica pull), then the prefill
+                    # tier (disaggregated handoff).  Both are best-effort
+                    # BY DESIGN — every failure mode inside degrades to
+                    # colocated prefill on this replica; the verbatim
+                    # forward below is identical either way (byte-exact
+                    # all three paths).  Chat requests skip both planes:
+                    # the replica tokenizes them through its chat
+                    # template, so router-side ids (and therefore the
+                    # shipped digests) would never match the admission's
+                    # — pages would import dead.
+                    pulled = False
+                    if self.pull and prompt_ids is not None:
+                        pulled = await self._directory_pull(
+                            h, prompt_ids, digests
+                        )
+                    if not pulled and self.handoff:
                         await self._handoff(h, prompt_ids, digests)
-                await self._forward(writer, h, payload, rec)
+                elif warm and self.handoff and digests \
+                        and method == "POST" and not chat:
+                    METRICS.inc("router.handoff_skips")
+                # Record AFTER sourcing: the directory lookup above must
+                # see who held the pages BEFORE this placement — writing
+                # first would overwrite the source entry with the cold
+                # replica and turn every pull into a self-referential
+                # miss.
+                self._record_affinity(digests, h)
+                status = await self._forward(writer, h, payload, rec)
+                if charged and status >= 400:
+                    # The replica answered but served nothing (its own
+                    # structured shed passing through, or a 400): the
+                    # fleet ledger must not bill tokens that bought no
+                    # service.
+                    charged = False
+                    self._ledger_refund(key, est)
                 if t_fail is not None:
                     # Failover recovery latency: failure observed ->
                     # re-placed request fully answered.
@@ -547,6 +892,9 @@ class ReplicaRouter:
                     "failover attempt %d", h.name, e, attempts,
                 )
                 if attempts > self.max_failover_retries:
+                    if charged:
+                        charged = False
+                        self._ledger_refund(key, est)
                     await self._exhausted(
                         writer, attempts,
                         f"request failed on {attempts} replica(s); "
@@ -586,10 +934,12 @@ class ReplicaRouter:
             raise _UpstreamFailed(f"{type(e).__name__}: {e}") from e
 
     async def _forward(self, writer, h, payload: bytes,
-                       rec: _Inflight) -> None:
-        """One upstream leg.  Raises :class:`_UpstreamFailed` when the
-        replica failed us; client-side socket errors propagate as-is
-        (they must never trigger a failover re-send)."""
+                       rec: _Inflight) -> int:
+        """One upstream leg; returns the upstream HTTP status (the fleet
+        ledger refunds on >= 400 — the replica served nothing).  Raises
+        :class:`_UpstreamFailed` when the replica failed us; client-side
+        socket errors propagate as-is (they must never trigger a failover
+        re-send)."""
         now = self._loop.time()
         if not h.reachable(now) or rec.abort.is_set():
             raise _UpstreamFailed("replica unreachable")
@@ -625,7 +975,7 @@ class ReplicaRouter:
                     if not chunk:
                         if first:
                             raise _UpstreamFailed("stream died before data")
-                        return
+                        return status
                     if first:
                         writer.write(head)
                         first = False
@@ -651,6 +1001,7 @@ class ReplicaRouter:
             writer.write(head + body)
             await writer.drain()
             rec.streamed = True
+            return status
         finally:
             up_w.close()
 

@@ -264,6 +264,15 @@ class InferenceServer:
         tenant_weights: "dict[str, float] | None" = None,
         tenant_quota_tps: float | None = None,
         tenant_rate_window_s: float = 10.0,
+        # Fleet mode: when a fronting router runs the AUTHORITATIVE
+        # fleet-wide tenant ledger (runtime/router.py), this gateway's
+        # per-replica ledger degrades to a LOOSE BACKSTOP — the allowance
+        # is multiplied by this factor (~2x fair share), so a bypassed or
+        # drilled router gate still never yields a silent unmetered path,
+        # while ordinary traffic (already metered once, at the router)
+        # is not double-gated at full strictness.  None = this gateway
+        # is the authority (single-replica serving).
+        tenant_backstop_x: float | None = None,
     ) -> None:
         if batcher.tokenizer is None:
             raise ValueError(
@@ -308,10 +317,16 @@ class InferenceServer:
             raise ValueError(
                 f"tenant_rate_window_s must be > 0, got {tenant_rate_window_s}"
             )
+        if tenant_backstop_x is not None and tenant_backstop_x < 1.0:
+            raise ValueError(
+                f"tenant_backstop_x must be >= 1 (a backstop looser than "
+                f"the authority) or None, got {tenant_backstop_x}"
+            )
         self.tenant_weights = dict(tenant_weights or {})
         self.tenant_default_weight = self.tenant_weights.pop("*", 1.0)
         self.tenant_quota_tps = tenant_quota_tps
         self.tenant_rate_window_s = tenant_rate_window_s
+        self.tenant_backstop_x = tenant_backstop_x
         # Trailing-window admitted-token-mass ledger per tenant, for the
         # rate quota: deque of (perf_counter ts, est tokens), appended at
         # admission, aged out lazily.  Only the loop thread (the one
@@ -360,10 +375,17 @@ class InferenceServer:
         self._loop = asyncio.get_running_loop()
         self._server = await asyncio.start_server(self._handle, self.host, self.port)
         self._xfer_sem = asyncio.Semaphore(self.max_inflight_transfers)
-        if self.role == "decode":
+        if self.role == "decode" or (
+            self.role == "colocated"
+            and getattr(self.batcher, "pool", None) is not None
+            and getattr(self.batcher, "prefix_cache", None) is not None
+        ):
             # The KV import listener: prefill-role peers ship finished
             # pages here over cluster/kv_transfer.py framing (always an
-            # ephemeral port; the fleet records where it landed).
+            # ephemeral port; the fleet records where it landed).  A
+            # paged+prefix-cache COLOCATED replica listens too — it is a
+            # cross-replica pull target (the router's digest directory
+            # ships a sibling's cached run here instead of re-prefilling).
             self._kv_server = await asyncio.start_server(
                 self._handle_kv, self.host, 0
             )
@@ -380,8 +402,9 @@ class InferenceServer:
 
     @property
     def kv_bound_port(self) -> int | None:
-        """Where the decode role's KV import listener landed (None on
-        other roles)."""
+        """Where the KV import listener landed (decode role, or a
+        paged+prefix-cache colocated replica — a pull target either way;
+        None when this replica cannot import pages)."""
         if self._kv_server is None:
             return None
         return self._kv_server.sockets[0].getsockname()[1]
@@ -482,7 +505,7 @@ class InferenceServer:
         # contract).  The queue read goes through the batcher's lock, and
         # a verified KV handoff awaiting adoption counts as work too (the
         # engine must wake to import it).
-        return (b.has_queued() or b.has_kv_imports()
+        return (b.has_queued() or b.has_kv_imports() or b.has_kv_exports()
                 or any(r.rid is not None for r in list(b.rows)))
 
     def _pending_token_mass(self) -> int:
@@ -530,6 +553,18 @@ class InferenceServer:
     def _tenant_weight(self, tenant: str) -> float:
         return self.tenant_weights.get(tenant, self.tenant_default_weight)
 
+    def _tenant_allowance(self, tenant: str) -> float:
+        """Token mass the tenant's trailing window may hold HERE.  With a
+        fronting router running the authoritative fleet ledger, the
+        backstop factor loosens this gateway's cap (~2x fair share): it
+        only trips when the router gate was bypassed or drilled — never
+        a silent unmetered path, never a double gate at full strictness."""
+        allowed = (self._tenant_weight(tenant) * self.tenant_quota_tps
+                   * self.tenant_rate_window_s)
+        if self.tenant_backstop_x is not None:
+            allowed *= self.tenant_backstop_x
+        return allowed
+
     # graftlint: holds(event-loop)
     def _tenant_retry_after(self, tenant: str, est: int) -> int | None:
         """Per-tenant token-rate gate (loop thread only).  Returns None
@@ -543,7 +578,7 @@ class InferenceServer:
         if self.tenant_quota_tps is None:
             return None
         win = self.tenant_rate_window_s
-        allowed = self._tenant_weight(tenant) * self.tenant_quota_tps * win
+        allowed = self._tenant_allowance(tenant)
         now = time.perf_counter()
         ledger = self._tenant_window.get(tenant)
         forced = False
@@ -718,9 +753,21 @@ class InferenceServer:
             with old._lock:
                 pending_imports = list(old._kv_imports)
                 old._kv_imports.clear()
+                pending_exports = list(old._kv_exports)
+                old._kv_exports.clear()
             if pending_imports:
                 with new._lock:
                     new._kv_imports.extend(pending_imports)
+            # Queued cross-replica EXPORTS cannot transplant: the crashed
+            # pool's cached pages died with it, and the fresh pool is
+            # cold — answer each waiting /v1/kv_export handler "nothing
+            # to export" now (the router recomputes locally) instead of
+            # stranding it for the full export timeout.
+            for _ids, on_done in pending_exports:
+                try:
+                    on_done(None)
+                except Exception:
+                    log.exception("kv-export completion callback raised")
             self.batcher = new
         self._restarts += 1
         if retried:
@@ -1009,6 +1056,18 @@ class InferenceServer:
                 await self._prefill(writer, req)
             except (BadRequest, json.JSONDecodeError) as e:
                 await self._json(writer, 400, _err_body(str(e)))
+        elif method == "POST" and path == "/v1/kv_export":
+            # Cross-replica pull source (any role with a paged prefix
+            # cache): export a prompt's CACHED page run to a sibling's KV
+            # listener — no admission, no recompute; "nothing to export"
+            # when the run is not resident.
+            try:
+                req = json.loads(body or b"{}")
+                if not isinstance(req, dict):
+                    raise BadRequest("request body must be a JSON object")
+                await self._kv_export(writer, req)
+            except (BadRequest, json.JSONDecodeError) as e:
+                await self._json(writer, 400, _err_body(str(e)))
         elif method not in ("GET", "POST"):
             await self._plain(writer, 405, "method not allowed")
         else:
@@ -1216,8 +1275,7 @@ class InferenceServer:
             # default weight (scheduler parity) — dropping the X-Tenant
             # header is not an escape hatch from the rate gate.
             key = tenant if tenant is not None else ANON_TENANT
-            allowed = self._tenant_weight(key) * self.tenant_quota_tps \
-                * self.tenant_rate_window_s
+            allowed = self._tenant_allowance(key)
             if est > allowed:
                 # Bigger than the tenant's ENTIRE window allowance: a 429
                 # would promise a Retry-After that can never come true
@@ -1232,12 +1290,17 @@ class InferenceServer:
             if hint is not None:
                 if tenant is not None:
                     METRICS.inc(f"tenant.shed.{tenant}")
+                # A backstop trip is a DIFFERENT event from an ordinary
+                # quota shed: the authoritative (router) gate let ~2x
+                # fair share through — it was bypassed, drilled, or is
+                # misconfigured — and dashboards must see that class.
                 await self._shed_json(
                     writer, 429,
                     f"tenant {key!r} over its token-rate quota "
                     f"({est} tokens would exceed the "
                     f"{self.tenant_rate_window_s:g}s window)",
-                    "tenant_quota", retry_after=hint,
+                    "tenant_backstop" if self.tenant_backstop_x is not None
+                    else "tenant_quota", retry_after=hint,
                 )
                 return
         if self._draining and not self._stopping:
@@ -1514,6 +1577,125 @@ class InferenceServer:
                 attempt_s=self.xfer_attempt_s,
                 max_retries=self.xfer_max_retries,
             )
+        await self._json(writer, 200, {
+            "ok": res.ok, "reason": res.reason, "attempts": res.attempts,
+            "pages": len(digests),
+            "tokens": len(digests) * self.batcher.page_size,
+            "bytes": res.bytes_sent,
+            "digests": [d.hex() for d in digests],
+        })
+
+    async def _kv_export(self, writer, req: dict) -> None:
+        """Cross-replica pull source (``POST /v1/kv_export``, from the
+        router's fleet digest directory): gather the prompt's longest
+        CACHED page run — engine thread, at a round boundary; nothing is
+        admitted or recomputed here — and ship it to the pulling decode
+        replica's KV listener over cluster/kv_transfer.py, verified and
+        retried exactly like a prefill handoff.  The ``xfer.pull`` fault
+        site (tag = transfer id) drills the ship path: 'drop' refuses the
+        export, 'corrupt' flips payload bytes after the checksum (the
+        puller-side verify NACKs every attempt), 'dup' ships the verified
+        frame twice (the receiver absorbs the duplicate), 'delay' stalls
+        toward the router's pull deadline.  Every outcome is a structured
+        JSON answer; anything but ``ok: true`` makes the router degrade
+        to local recompute — byte-exact regardless."""
+        from ..cluster import kv_transfer
+
+        prompt_ids, _ = self._parse_prompt(req, chat=False)
+        kv_host = req.get("kv_host")
+        kv_port = req.get("kv_port")
+        transfer_id = req.get("transfer_id")
+        if not isinstance(kv_host, str) or not kv_host:
+            raise BadRequest("'kv_host' must be a non-empty string")
+        if (isinstance(kv_port, bool) or not isinstance(kv_port, int)
+                or not 0 < kv_port < 65536):
+            raise BadRequest("'kv_port' must be a TCP port")
+        if not isinstance(transfer_id, str) or not transfer_id:
+            raise BadRequest("'transfer_id' must be a non-empty string")
+        if self._stopping or self._draining or self._engine_dead:
+            await self._json(writer, 200, {
+                "ok": False, "reason": "replica unavailable", "pages": 0,
+            })
+            return
+        plane = self.batcher.faults
+        rule = None
+        if plane is not None:
+            # defer_stall: this handler runs on the serving event loop —
+            # a delay/stall rule is applied as an awaited sleep below.
+            rule = plane.fire("xfer.pull", tag=transfer_id,
+                              defer_stall=True)
+        if rule is not None and rule.action == "drop":
+            await self._json(writer, 200, {
+                "ok": False, "reason": "pull dropped (drill)", "pages": 0,
+            })
+            return
+        if rule is not None and rule.action in ("delay", "stall"):
+            await asyncio.sleep(rule.arg or 0.0)
+        loop = asyncio.get_running_loop()
+        fut: asyncio.Future = loop.create_future()
+
+        def on_done(payload) -> None:
+            # Engine thread -> loop: same crossing as mailbox deliveries.
+            def settle() -> None:
+                if not fut.done():
+                    fut.set_result(payload)
+
+            loop.call_soon_threadsafe(settle)
+
+        with self._submit_lock:
+            self.batcher.submit_kv_export(list(prompt_ids), on_done)
+        self._work.set()
+        try:
+            # Bounded so a crashed engine cannot wedge the router's pull
+            # (which has its own, shorter deadline) or leak this handler.
+            payload = await asyncio.wait_for(fut, 30.0)
+        except asyncio.TimeoutError:
+            await self._json(writer, 200, {
+                "ok": False, "reason": "export timed out", "pages": 0,
+            })
+            return
+        if payload is None:
+            # Run not resident: prompt under one full page, caching off,
+            # or the pages were evicted since the directory entry was
+            # recorded (a stale answer).  Not an error — the router
+            # recomputes locally.
+            await self._json(writer, 200, {
+                "ok": False, "reason": "nothing to export", "pages": 0,
+            })
+            return
+        digests, k_pages, v_pages = payload
+        # b64 of a multi-MB payload runs off the loop: this same loop
+        # answers the fleet's /healthz probes.
+        msg = await asyncio.to_thread(
+            kv_transfer.encode_kv_pages, kv_transfer.KVTransferPayload(
+                transfer_id=transfer_id,
+                token_ids=list(
+                    prompt_ids[: len(digests) * self.batcher.page_size]
+                ),
+                page_size=self.batcher.page_size,
+                digests=digests, k_pages=k_pages, v_pages=v_pages,
+            ),
+        )
+        if rule is not None and rule.action == "corrupt":
+            # Post-checksum bit-flip: the frame parses but can never
+            # verify — the pull target NACKs every attempt and the
+            # router degrades to local recompute, cache unpoisoned.
+            msg = kv_transfer.corrupt_payload(msg)
+        async with self._xfer_sem:
+            res = await kv_transfer.send_kv_pages(
+                kv_host, kv_port, msg, faults=plane,
+                attempt_s=self.xfer_attempt_s,
+                max_retries=self.xfer_max_retries,
+            )
+            if res.ok and rule is not None and rule.action == "dup":
+                # Deliver the verified frame AGAIN: the receiver's digest
+                # check absorbs it ("duplicate" ack), pinning pull-path
+                # idempotence.
+                await kv_transfer.send_kv_pages(
+                    kv_host, kv_port, msg, faults=plane,
+                    attempt_s=self.xfer_attempt_s,
+                    max_retries=self.xfer_max_retries,
+                )
         await self._json(writer, 200, {
             "ok": res.ok, "reason": res.reason, "attempts": res.attempts,
             "pages": len(digests),

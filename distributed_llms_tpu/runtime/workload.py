@@ -199,6 +199,9 @@ class Record:
     itl_s: list[float] = field(default_factory=list)  # inter-token gaps
     retry_after: float | None = None  # the shed's Retry-After hint
     shed_reason: str | None = None    # machine-readable shed reason
+    text: str = ""           # concatenated completion deltas — lets a
+    #                          caller check byte-exactness against a
+    #                          reference, not just count tokens
 
 
 async def _one_request(host: str, port: int, arr: Arrival,
@@ -284,6 +287,7 @@ async def _one_request(host: str, port: int, arr: Arrival,
                         # byte tokenizer every bench/test replica runs;
                         # a close proxy elsewhere.
                         rec.tokens += len(text)
+                        rec.text += text
         await asyncio.wait_for(drive(), timeout_s)
     except (asyncio.TimeoutError, ConnectionError, OSError, EOFError,
             ValueError, IndexError, asyncio.IncompleteReadError):

@@ -1,0 +1,746 @@
+"""Fleet-global control plane (ISSUE 18): router-resident tenant
+ledger, tiered (prefill/decode) autoscaling, and cross-replica KV reuse
+via the fleet prefix-digest directory.
+
+Three layers:
+
+1. UNITS (no servers): fleet-ledger arithmetic (charge / refund /
+   per-tenant Retry-After walked off the ledger), epoch-keyed directory
+   self-invalidation, and the TieredAutoscaler's per-role decision loop
+   against a stub fleet (independent streaks/cooldowns, role-scoped
+   graceful scale-down, per-tier veto drills, TierPolicy validation).
+2. LIVE invariants (tiny model): quota is CONSERVED under elasticity —
+   a fleet of 2 admits exactly 1x a tenant's quota, pinned before and
+   after a live scale-up; a decode replica on an affinity miss PULLS
+   cached pages from the sibling that holds them (``cached_tokens`` > 0
+   on the cold sibling, byte-exact), and a mis-steered directory answer
+   (``directory.lookup:corrupt``) degrades to local recompute,
+   byte-exact, counted.
+3. CHAOS ACCEPTANCE: a multi-tenant storm against a disaggregated
+   ELASTIC fleet (1 prefill + 2 decode, tiered autoscaler armed) drives
+   a prefill-tier scale-up mid-storm and a graceful decode-tier drain in
+   the tail, absorbs one ``router.ledger:stall`` and one
+   ``directory.lookup:corrupt`` drill, sheds the aggressor with
+   fleet-ledger Retry-Afters, self-invalidates directory entries for the
+   drained-away replica — and completes every request byte-exact vs an
+   unfaulted fixed-fleet reference, pools auditing clean on survivors.
+"""
+
+import asyncio
+import json
+
+import pytest
+
+import jax
+
+from distributed_llms_tpu.cluster.autoscale import (
+    TieredAutoscaler, TierPolicy,
+)
+from distributed_llms_tpu.cluster.fleet import ReplicaFleet
+from distributed_llms_tpu.core.observability import METRICS
+from distributed_llms_tpu.models import model as model_lib, presets
+from distributed_llms_tpu.runtime.batcher import ContinuousBatcher
+from distributed_llms_tpu.runtime.faults import FaultPlane
+from distributed_llms_tpu.runtime.router import ReplicaRouter
+from distributed_llms_tpu.runtime.server import InferenceServer
+from distributed_llms_tpu.runtime.tokenizer import ByteTokenizer
+
+PAGE = 16
+
+
+def _run(coro):
+    return asyncio.run(coro)
+
+
+# -- units: the fleet tenant ledger -----------------------------------------
+
+
+class _LedgerFleet:
+    """The minimal fleet surface the router's ledger/directory units
+    touch: named handles only."""
+
+    def __init__(self, *handles):
+        self.replicas = list(handles)
+        self._by_name = {h.name: h for h in self.replicas}
+
+
+class _Handle:
+    def __init__(self, name, role="colocated", epoch=1, committed=0,
+                 handoffs=0, inflight=0, state="healthy"):
+        self.name = name
+        self.role = role
+        self.epoch = epoch
+        self.committed_tokens = committed
+        self.handoffs = handoffs
+        self.inflight = set(range(inflight))
+        self.state = state
+        self.partitioned_until = 0.0
+
+    def routable(self, now):
+        return self.state == "healthy" and now >= self.partitioned_until
+
+    def reachable(self, now):
+        return self.state != "dead" and now >= self.partitioned_until
+
+
+def _ledger_router(**kw):
+    kw.setdefault("tenant_quota_tps", 5.0)
+    kw.setdefault("tenant_rate_window_s", 10.0)
+    return ReplicaRouter(_LedgerFleet(), tokenizer=ByteTokenizer(),
+                         page_size=PAGE, **kw)
+
+
+def test_fleet_ledger_charge_refund_and_retry_after():
+    r = _ledger_router(tenant_weights={"gold": 4.0})
+    # Allowance = weight x quota x window, fleet-wide.
+    assert r._tenant_allowance("free") == pytest.approx(50.0)
+    assert r._tenant_allowance("gold") == pytest.approx(200.0)
+    # Under the window: admits (no hint), then the committed charges
+    # fill the window and the NEXT request walks its own Retry-After
+    # off the fleet ledger (1..60s, never a load guess).
+    assert r._ledger_retry_after("free", 20) is None
+    r._ledger_charge("free", 20)
+    r._ledger_charge("free", 30)
+    hint = r._ledger_retry_after("free", 20)
+    assert isinstance(hint, int) and 1 <= hint <= 10
+    # A refund reopens the window (a shed must not burn quota)...
+    r._ledger_refund("free", 30)
+    assert r._ledger_retry_after("free", 20) is None
+    # ... and a fully-refunded tenant leaves the (capped) map entirely.
+    r._ledger_refund("free", 20)
+    assert "free" not in r._tenant_window
+    # The exhaust drill forces the over-quota path even under quota.
+    assert r._ledger_retry_after("free", 1, forced=True) >= 1
+    # Per-tenant isolation: gold's window is untouched by free's.
+    assert r._ledger_retry_after("gold", 150) is None
+
+
+def test_fleet_ledger_oversized_request_has_no_retry_after_path():
+    r = _ledger_router()  # anon weight 1.0 -> allowance 50
+    # est > the ENTIRE window allowance: the gate's caller answers 400
+    # (no Retry-After could come true); the arithmetic here just shows
+    # the window can never free enough.
+    assert r._tenant_allowance("-") == pytest.approx(50.0)
+    hint = r._ledger_retry_after("-", 60)
+    assert hint is not None  # capped, structured, finite
+    assert 1 <= hint <= 60
+
+
+def test_directory_epoch_invalidation_after_respawn():
+    """An affinity/directory entry recorded against an older epoch (the
+    replica drained/respawned since: cold pool) reads as a MISS and is
+    dropped + counted — stale directory answers can never steer a pull
+    at a cache that no longer holds the pages."""
+    h = _Handle("d0", role="decode", epoch=3)
+    r = ReplicaRouter(_LedgerFleet(h), tokenizer=ByteTokenizer(),
+                      page_size=PAGE)
+    d = b"\x01" * 32
+    r._affinity[d] = ("d0", 3)
+    assert r._affinity_lookup(d) == "d0"
+    s0 = METRICS.get_counter("directory.stale_drops")
+    h.epoch = 4  # the respawn
+    assert r._affinity_lookup(d) is None
+    assert d not in r._affinity
+    assert METRICS.get_counter("directory.stale_drops") == s0 + 1
+    # A handle gone from the fleet entirely (drained away) is the same
+    # self-invalidating miss.
+    r._affinity[d] = ("gone", 1)
+    assert r._affinity_lookup(d) is None
+    assert METRICS.get_counter("directory.stale_drops") == s0 + 2
+
+
+# -- units: the tiered autoscaler -------------------------------------------
+
+
+class _TierFleet:
+    """The surface TieredAutoscaler consumes: role-tagged handles plus
+    role-aware add/remove."""
+
+    def __init__(self, *handles):
+        self.replicas = list(handles)
+        self.added: list[str] = []
+        self.removed: list[str] = []
+
+    async def add_replica(self, factory=None, name=None, role=None):
+        self.added.append(role)
+        h = _Handle(name or f"{role[:1]}{len(self.replicas)}", role=role)
+        self.replicas.append(h)
+        return h
+
+    async def remove_replica(self, name, drain_timeout_s=30.0):
+        self.removed.append(name)
+        self.replicas = [h for h in self.replicas if h.name != name]
+
+
+def _tiered(fleet, **kw):
+    kw.setdefault("prefill", TierPolicy(
+        min_replicas=1, max_replicas=2, up_load=0.8, down_load=0.2,
+        hysteresis=2, cooldown_s=0.0,
+    ))
+    kw.setdefault("decode", TierPolicy(
+        min_replicas=1, max_replicas=3, up_load=0.8, down_load=0.2,
+        hysteresis=2, cooldown_s=0.0,
+    ))
+    kw.setdefault("replica_capacity_tokens", 100)
+    return TieredAutoscaler(fleet, **kw)
+
+
+def test_tier_signals_are_role_scoped():
+    async def fn():
+        fleet = _TierFleet(
+            _Handle("p0", role="prefill", handoffs=3),
+            _Handle("d0", role="decode", committed=60, inflight=2),
+            _Handle("d1", role="decode", committed=20, inflight=1),
+            _Handle("dead", role="decode", state="dead"),
+        )
+        sc = _tiered(fleet)
+        sc._loop = asyncio.get_running_loop()
+        pre = sc.signals("prefill")
+        # Prefill load = in-flight handoffs per routable prefill replica
+        # (handoff charges are transient; the RPC count IS the queue).
+        assert pre["replicas"] == 1 and pre["load"] == pytest.approx(3.0)
+        dec = sc.signals("decode")
+        assert dec["replicas"] == 2          # dead handles don't count
+        assert dec["committed_tokens"] == 80
+        assert dec["load"] == pytest.approx(80 / 200)
+        assert METRICS.get_gauge("autoscale.prefill.load") \
+            == pytest.approx(3.0)
+        assert METRICS.get_gauge("autoscale.decode.replicas") == 2
+
+    _run(fn())
+
+
+def test_tiers_scale_independently_with_own_streaks():
+    async def fn():
+        fleet = _TierFleet(
+            _Handle("p0", role="prefill", handoffs=2),   # hot: load 2.0
+            _Handle("d0", role="decode", committed=95),  # hot: load 0.95
+        )
+        sc = _tiered(fleet)
+        # Tick 1: both streaks build, nothing acts (hysteresis 2).
+        acts = await sc.tick()
+        assert acts == {"prefill": None, "decode": None}
+        # Tick 2: BOTH tiers scale up, each on its own signal/streak.
+        acts = await sc.tick()
+        assert acts == {"prefill": "up", "decode": "up"}
+        assert fleet.added == ["prefill", "decode"]
+        # Prefill at its max (2): hot forever, never past the ceiling —
+        # while decode (max 3) may keep growing on ITS signal.
+        fleet.replicas[2].handoffs = 2       # keep prefill tier hot
+        fleet.replicas[3].committed_tokens = 95
+        acts = [await sc.tick() for _ in range(2)]
+        assert all(a["prefill"] is None for a in acts)
+        assert acts[-1]["decode"] == "up"
+        assert fleet.added.count("decode") == 2
+
+    _run(fn())
+
+
+def test_tier_scale_down_is_role_scoped_and_floored():
+    async def fn():
+        fleet = _TierFleet(
+            _Handle("p0", role="prefill"),               # idle
+            _Handle("d0", role="decode", committed=30, inflight=2),
+            _Handle("d1", role="decode", committed=1),   # least committed
+        )
+        sc = _tiered(fleet)
+        await sc.tick()
+        acts = await sc.tick()
+        # Decode drains its LEAST-COMMITTED replica; prefill sits at its
+        # floor (min 1) and is never touched by the decode decision.
+        assert acts["decode"] == "down" and acts["prefill"] is None
+        assert fleet.removed == ["d1"]
+        assert [h.name for h in fleet.replicas] == ["p0", "d0"]
+        # Both tiers at their floors: cold forever, nothing drains.
+        for _ in range(4):
+            acts = await sc.tick()
+            assert acts == {"prefill": None, "decode": None}
+        assert fleet.removed == ["d1"]
+
+    _run(fn())
+
+
+def test_tier_veto_drills_are_per_role():
+    async def fn():
+        plane = FaultPlane.parse("fleet.scale_up/prefill:drop@1")
+        fleet = _TierFleet(
+            _Handle("p0", role="prefill", handoffs=2),
+            _Handle("d0", role="decode", committed=95),
+        )
+        sc = _tiered(fleet, faults=plane)
+        f0 = METRICS.get_counter("autoscale.prefill.scale_failures")
+        await sc.tick()
+        acts = await sc.tick()
+        # The tag=prefill drop vetoes ONLY the prefill tier's growth;
+        # decode scales on the same tick.
+        assert acts == {"prefill": None, "decode": "up"}
+        assert fleet.added == ["decode"]
+        assert METRICS.get_counter("autoscale.prefill.scale_failures") \
+            == f0 + 1
+        # The prefill tier retries after its own (zero) cooldown.
+        fleet.replicas[0].handoffs = 2
+        for _ in range(3):
+            acts = await sc.tick()
+            if acts["prefill"] == "up":
+                break
+        assert fleet.added.count("prefill") == 1
+
+    _run(fn())
+
+
+def test_tier_policy_validation():
+    with pytest.raises(ValueError, match="min_replicas"):
+        TierPolicy(min_replicas=0)
+    with pytest.raises(ValueError, match="max_replicas"):
+        TierPolicy(min_replicas=3, max_replicas=2)
+    with pytest.raises(ValueError, match="down_load"):
+        TierPolicy(up_load=0.5, down_load=0.6)
+    with pytest.raises(ValueError, match="hysteresis"):
+        TierPolicy(hysteresis=0)
+
+
+def test_fleet_mints_role_prefixed_names():
+    fleet = ReplicaFleet([])
+    assert fleet._fresh_name() == "r0"
+    assert fleet._fresh_name("p") == "p1"
+    assert fleet._fresh_name("d") == "d2"
+
+
+# -- live fixtures -----------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    cfg = presets.get_preset("llama-tiny", vocab_size=512)
+    params = model_lib.init_params(jax.random.key(0), cfg)
+    return cfg, params
+
+
+def _replica_batcher(tiny, pages=12):
+    cfg, params = tiny
+    tok = ByteTokenizer()
+    return ContinuousBatcher(
+        cfg, params, tokenizer=tok, eos_id=tok.eos_id, pad_id=tok.pad_id,
+        batch_slots=2, max_len=96, chunk_steps=4,
+        paged_pages=pages, page_size=PAGE, prefix_cache=True,
+    )
+
+
+@pytest.fixture(scope="module")
+def warmed(tiny):
+    """Warm the process-wide jit cache with the replicas' program shapes
+    (paged admission, cache-hit admission — the pulled request's path —
+    and decode) so fast watchdogs never mistake a compile for a wedge."""
+    b = _replica_batcher(tiny)
+    for prompt in ("warm short", "a much longer warming prompt xxxx!!",
+                   "a much longer warming prompt xxxx!!"):
+        b.submit(prompt, max_new_tokens=4)
+        b.run()
+    return tiny
+
+
+def _factory(tiny, role="colocated"):
+    def make_server():
+        return InferenceServer(
+            _replica_batcher(tiny), model_name="tiny", host="127.0.0.1",
+            port=0, batcher_factory=lambda: _replica_batcher(tiny),
+            watchdog_timeout_s=5.0, role=role,
+        )
+
+    return make_server
+
+
+async def _request(host, port, body, tenant=None):
+    reader, writer = await asyncio.open_connection(host, port)
+    payload = json.dumps(body).encode()
+    hdr = f"X-Tenant: {tenant}\r\n" if tenant else ""
+    writer.write(
+        f"POST /v1/completions HTTP/1.1\r\nHost: t\r\n{hdr}"
+        f"Content-Length: {len(payload)}\r\n\r\n".encode() + payload
+    )
+    await writer.drain()
+    status = int((await reader.readline()).split()[1])
+    headers = {}
+    while True:
+        line = await reader.readline()
+        if line in (b"\r\n", b"\n", b""):
+            break
+        k, _, v = line.decode("latin-1").partition(":")
+        headers[k.strip().lower()] = v.strip()
+    raw = await reader.read()
+    writer.close()
+    return status, headers, json.loads(raw) if raw.strip() else {}
+
+
+def expected_texts(tiny, reqs):
+    """Unfaulted FIXED-fleet reference: one roomy batcher serves every
+    prompt solo — byte-exactness at temp 0 must be invariant to fleet
+    size, elasticity, and where the KV pages came from."""
+    cfg, params = tiny
+    tok = ByteTokenizer()
+    b = ContinuousBatcher(
+        cfg, params, tokenizer=tok, eos_id=tok.eos_id, pad_id=tok.pad_id,
+        batch_slots=4, max_len=96, chunk_steps=4, paged_pages=40,
+        page_size=PAGE,
+    )
+    rids = [b.submit(p, max_new_tokens=n) for p, n in reqs]
+    res = b.run()
+    return {p: tok.decode(res[rid]) for rid, (p, n) in zip(rids, reqs)}
+
+
+def _audit_all(fleet):
+    for h in fleet.replicas:
+        if h.server is not None and h.server._engine is not None \
+                and h.server._engine.is_alive():
+            h.server.batcher.assert_pool_consistent()
+
+
+LONG = "disaggregate this considerable prompt please! "  # > 2 full pages
+
+
+# -- live: quota conservation under elasticity -------------------------------
+
+
+def test_quota_conserved_across_live_scale_up(warmed):
+    """THE conservation pin: the router's fleet ledger admits a tenant
+    exactly 1x (weight x quota x window) whether the fleet runs 1 or 2
+    replicas — a live mid-window scale-up must not reopen the window,
+    and the over-quota sheds carry the tenant's own fleet-ledger
+    Retry-After."""
+    tiny = warmed
+    prompts = [f"quota prompt {i:02d} xx" for i in range(5)]
+    ids_len = len(ByteTokenizer().encode(prompts[0]))
+    est = ids_len + 4  # the router's admission estimate per request
+    # Allowance = 2.5x est over a window far longer than the test: the
+    # first two admit, the third sheds, and nothing ages out mid-test.
+    window = 120.0
+    quota = (2.5 * est) / window
+
+    async def driver():
+        fleet = ReplicaFleet([_factory(tiny)], probe_interval_s=0.05,
+                             probe_timeout_s=2.0)
+        router = ReplicaRouter(
+            fleet, host="127.0.0.1", port=0, tokenizer=ByteTokenizer(),
+            page_size=PAGE, tenant_quota_tps=quota,
+            tenant_rate_window_s=window,
+        )
+        await fleet.start()
+        host, port = await router.start()
+        try:
+            assert await fleet.wait_healthy(timeout_s=60.0)
+            c0 = METRICS.get_counter("router.ledger.charges")
+            s0 = METRICS.get_counter("router.ledger.sheds")
+
+            async def one(p, tenant="capped"):
+                return await _request(
+                    host, port, {"prompt": p, "max_tokens": 4},
+                    tenant=tenant)
+
+            st1, _, _ = await one(prompts[0])
+            st2, _, _ = await one(prompts[1])
+            assert (st1, st2) == (200, 200)
+            st3, hdr3, body3 = await one(prompts[2])
+            assert st3 == 429, body3
+            assert body3["error"]["reason"] == "tenant_quota"
+            assert int(hdr3["retry-after"]) >= 1
+            assert METRICS.get_counter("router.ledger.charges") == c0 + 2
+            assert METRICS.get_counter("router.ledger.sheds") >= s0 + 1
+            # Live scale-up mid-window: the fleet doubles, the tenant's
+            # fleet allowance does NOT.
+            h = await fleet.add_replica()
+            assert h.state == "healthy" and len(fleet.replicas) == 2
+            st4, hdr4, body4 = await one(prompts[3])
+            assert st4 == 429, body4
+            assert body4["error"]["reason"] == "tenant_quota"
+            assert int(hdr4["retry-after"]) >= 1
+            # Per-tenant isolation: a different tenant's window is its
+            # own — it admits on the grown fleet while "capped" sheds.
+            st5, _, body5 = await one(prompts[4], tenant="other")
+            assert st5 == 200, body5
+            # No silent unmetered admits: every 200 was charged.
+            assert METRICS.get_counter("router.ledger.charges") == c0 + 3
+        finally:
+            await router.stop()
+            await fleet.stop()
+
+    asyncio.run(asyncio.wait_for(driver(), 300))
+
+
+# -- live: cross-replica pull + its degradation ladder -----------------------
+
+
+def test_directory_pull_serves_sibling_cache_and_falls_back_exact(warmed):
+    """A request landing COLD on one replica pulls the prompt's cached
+    pages from the sibling that holds them (``cached_tokens`` proves no
+    re-prefill; bytes exact), and a mis-steered directory answer
+    (``directory.lookup:corrupt`` pointing at a replica that holds
+    NOTHING) degrades to local recompute — byte-exact, counted."""
+    tiny = warmed
+    # Distinct FIRST bytes: chained page digests must share nothing, or
+    # the second prompt rides the first's affinity instead of exercising
+    # its own cold-placement + pull path.
+    p_pull = "pull leg! " + LONG
+    p_miss = "steer leg " + LONG
+    wants = expected_texts(tiny, [(p_pull, 8), (p_miss, 8)])
+    plane = FaultPlane()
+    corrupt = plane.add("directory.lookup", "corrupt", when="2")
+
+    async def driver():
+        fleet = ReplicaFleet([_factory(tiny)] * 3, probe_interval_s=0.05,
+                             probe_timeout_s=2.0)
+        router = ReplicaRouter(
+            fleet, host="127.0.0.1", port=0, tokenizer=ByteTokenizer(),
+            page_size=PAGE, faults=plane,
+        )
+        await fleet.start()
+        host, port = await router.start()
+        try:
+            assert await fleet.wait_healthy(timeout_s=60.0)
+            # Serve p_pull once: sequential + all-idle placement picks
+            # r0 (least committed, min name); its pages cache there and
+            # the router records the digest run against r0.
+            st, _, body = await _request(
+                host, port, {"prompt": p_pull, "max_tokens": 8})
+            assert st == 200 and body["choices"][0]["text"] == wants[p_pull]
+            # r0 stops taking new work (drains) but stays reachable: the
+            # re-request must land on a COLD sibling.
+            fleet["r0"].state = "draining"
+            hits0 = METRICS.get_counter("directory.hits")
+            pulls0 = METRICS.get_counter("directory.pulls")
+            imp0 = METRICS.get_counter("batcher.kv_pages_imported")
+            st, _, body = await _request(
+                host, port, {"prompt": p_pull, "max_tokens": 8})
+            assert st == 200, body
+            assert body["choices"][0]["text"] == wants[p_pull]
+            # The cold sibling served the PULLED pages, not a re-prefill.
+            cached = body["usage"]["prompt_tokens_details"]["cached_tokens"]
+            assert cached >= PAGE, body["usage"]
+            assert METRICS.get_counter("directory.hits") > hits0
+            assert METRICS.get_counter("directory.pulls") > pulls0
+            assert METRICS.get_counter("batcher.kv_pages_imported") > imp0
+            fleet["r0"].state = "healthy"
+            # The mis-steer drill: p_miss caches on r0 (all idle again),
+            # then r0 drains and the fired ``corrupt`` rule steers the
+            # pull at r2 — which holds NOTHING for this prompt.  The
+            # pull degrades to local recompute on r1: exact bytes, a
+            # counted fallback, and no poisoned cache.
+            st, _, body = await _request(
+                host, port, {"prompt": p_miss, "max_tokens": 8})
+            assert st == 200 and body["choices"][0]["text"] == wants[p_miss]
+            fleet["r0"].state = "draining"
+            fb0 = METRICS.get_counter("directory.pull_fallbacks")
+            st, _, body = await _request(
+                host, port, {"prompt": p_miss, "max_tokens": 8})
+            assert st == 200, body
+            assert body["choices"][0]["text"] == wants[p_miss]
+            assert corrupt.fired == 1
+            assert METRICS.get_counter("directory.pull_fallbacks") > fb0
+            fleet["r0"].state = "healthy"
+            _audit_all(fleet)
+        finally:
+            await router.stop()
+            await fleet.stop()
+
+    asyncio.run(asyncio.wait_for(driver(), 300))
+
+
+# -- THE chaos acceptance: disaggregated elastic fleet under storm -----------
+
+
+def test_elastic_disagg_chaos_storm(warmed):
+    """ISSUE 18 acceptance: a two-tenant storm against a 1-prefill +
+    2-decode fleet with the TIERED autoscaler armed.  Mid-storm the
+    prefill tier scales up on handoff queue depth while one
+    ``router.ledger:stall`` drill wedges (only) one admission; the
+    aggressor sheds on the FLEET ledger with per-tenant Retry-Afters; a
+    ``directory.lookup:corrupt`` drill mis-steers one pull into a
+    counted local-recompute fallback; the idle tail drains a decode
+    replica away gracefully and its directory entries self-invalidate.
+    Every completion is byte-exact vs the unfaulted fixed-fleet
+    reference and surviving pools audit clean."""
+    tiny = warmed
+    gold = [(f"gold storm {i:02d} " + LONG, 8) for i in range(4)]
+    agg = [(f"agg flood {i:02d} " + LONG, 8) for i in range(6)]
+    wants = expected_texts(tiny, gold + agg)
+    est_one = len(ByteTokenizer().encode(agg[0][0])) + 8
+    plane = FaultPlane()
+    ledger_stall = plane.add("router.ledger", "stall", when="2", arg=0.3)
+    corrupt = plane.add("directory.lookup", "corrupt", when="1")
+
+    def role_factory(role):
+        return _factory(tiny, role)
+
+    async def driver():
+        factories = [role_factory("prefill"),
+                     role_factory("decode"), role_factory("decode")]
+        fleet = ReplicaFleet(factories, names=["p0", "d0", "d1"],
+                             probe_interval_s=0.05, probe_timeout_s=2.0,
+                             faults=plane)
+        router = ReplicaRouter(
+            fleet, host="127.0.0.1", port=0, tokenizer=ByteTokenizer(),
+            page_size=PAGE, handoff=True, faults=plane,
+            tenant_weights={"gold": 2.0},
+            # agg's fleet window holds ~3 requests' mass: the 6-deep
+            # flood MUST shed on the fleet ledger mid-storm.
+            tenant_quota_tps=(3.2 * est_one) / 8.0,
+            tenant_rate_window_s=8.0,
+        )
+        scaler = TieredAutoscaler(
+            fleet,
+            prefill=TierPolicy(min_replicas=1, max_replicas=2,
+                               up_load=0.4, down_load=0.05,
+                               hysteresis=2, cooldown_s=0.05),
+            decode=TierPolicy(min_replicas=1, max_replicas=2,
+                              up_load=5.0,  # decode never scales UP here
+                              down_load=0.05, hysteresis=3,
+                              cooldown_s=0.05),
+            prefill_factory=role_factory("prefill"),
+            decode_factory=role_factory("decode"),
+            drain_timeout_s=20.0, replica_capacity_tokens=112,
+        )
+        await fleet.start()
+        for h in fleet.replicas:
+            h.server.batcher.faults = plane
+        host, port = await router.start()
+        scaler._loop = asyncio.get_running_loop()
+        try:
+            assert await fleet.wait_healthy(timeout_s=120.0)
+            results: dict[str, tuple[int, dict, dict]] = {}
+
+            async def one(p, n, tenant):
+                results[p] = await _request(
+                    host, port, {"prompt": p, "max_tokens": n},
+                    tenant=tenant)
+
+            tasks = []
+
+            async def storm():
+                for (g, n), (a, m) in zip(gold, agg):
+                    tasks.append(asyncio.ensure_future(one(a, m, "agg")))
+                    await asyncio.sleep(0.03)
+                    tasks.append(asyncio.ensure_future(one(g, n, "gold")))
+                    await asyncio.sleep(0.03)
+                for a, m in agg[len(gold):]:
+                    tasks.append(asyncio.ensure_future(one(a, m, "agg")))
+                    await asyncio.sleep(0.03)
+
+            storm_task = asyncio.ensure_future(storm())
+            # Mid-storm ticks: concurrent handoffs put the single
+            # prefill replica's queue depth >= 1 for consecutive ticks
+            # -> the PREFILL tier scales up while decode holds.
+            # Only the PREFILL tier ticks during the storm: with warm
+            # jit caches the staggered storm leaves the decode tier idle
+            # gaps long enough to build a down-streak, and draining a
+            # decode replica mid-storm would race the drill below (the
+            # tail drives full ticks and pins the drain explicitly).
+            scaled_up = False
+            for _ in range(600):
+                await asyncio.sleep(0.01)
+                await scaler.tick_tier("prefill")
+                if sum(1 for h in fleet.replicas
+                       if h.role == "prefill") == 2:
+                    scaled_up = True
+                    break
+            await storm_task
+            await asyncio.gather(*tasks)
+            assert scaled_up, "the storm never grew the prefill tier"
+            assert METRICS.get_counter("autoscale.prefill.scale_ups") >= 1
+            assert ledger_stall.fired == 1, "ledger stall never fired"
+            # -- storm ledger ------------------------------------------
+            completed = sheds = 0
+            for p, (status, headers, body) in results.items():
+                if status == 200:
+                    completed += 1
+                    assert body["choices"][0]["text"] == wants[p], p
+                else:
+                    sheds += 1
+                    assert status in (429, 503), (p, status, body)
+                    assert "retry-after" in headers, p
+                    assert body["error"]["type"] == "overloaded_error"
+            assert completed >= len(gold), "storm starved gold"
+            agg_sheds = [
+                r for r in results.values()
+                if r[0] == 429
+                and r[2]["error"].get("reason") == "tenant_quota"
+            ]
+            assert agg_sheds, "the flood was never fleet-ledger-shed"
+            assert METRICS.get_counter("router.ledger.sheds") >= 1
+            assert METRICS.get_counter("router.ledger.charges") >= completed
+            # -- the mis-steer drill -----------------------------------
+            # Re-request a completed prompt while its sticky decode
+            # replica drains and the prefill tier is partitioned away:
+            # the directory HIT fires the armed ``corrupt`` rule, which
+            # finds no other reachable sibling to steer at -> counted
+            # stale fallback -> local recompute, byte-exact (and the
+            # empty prefill tier is a counted handoff fallback, the
+            # bottomed-out-tier ladder).
+            victim_p = next(p for p, r in results.items() if r[0] == 200)
+            digs = router._digests(ByteTokenizer().encode(victim_p))
+            src_name = router._affinity[digs[-1]][0]
+            now = asyncio.get_running_loop().time()
+            fleet[src_name].state = "draining"
+            import math as _math
+
+            pre_handles = [h for h in fleet.replicas
+                           if h.role == "prefill"]
+            for h in pre_handles:
+                h.partitioned_until = _math.inf
+            fb0 = METRICS.get_counter("directory.pull_fallbacks")
+            hf0 = METRICS.get_counter(
+                "router.handoff_fallbacks.no_prefill_replica")
+            st, _, body = await _request(
+                host, port, {"prompt": victim_p, "max_tokens":
+                             dict(gold + agg)[victim_p]}, tenant="gold")
+            assert st == 200, body
+            assert body["choices"][0]["text"] == wants[victim_p]
+            assert corrupt.fired == 1, "mis-steer drill never fired"
+            assert METRICS.get_counter("directory.pull_fallbacks") > fb0
+            assert METRICS.get_counter(
+                "router.handoff_fallbacks.no_prefill_replica") > hf0
+            fleet[src_name].state = "healthy"
+            for h in pre_handles:
+                h.partitioned_until = 0.0
+            # -- graceful decode drain in the tail ---------------------
+            sd0 = METRICS.get_counter("autoscale.decode.scale_downs")
+            drained = False
+            for _ in range(600):
+                await asyncio.sleep(0.02)
+                await scaler.tick()
+                if sum(1 for h in fleet.replicas
+                       if h.role == "decode") == 1:
+                    drained = True
+                    break
+            assert drained, "the idle tail never drained the decode tier"
+            assert METRICS.get_counter(
+                "autoscale.decode.scale_downs") == sd0 + 1
+            # Directory entries for the drained-away replica
+            # self-invalidate into counted misses; the survivor serves
+            # the same bytes via local recompute or its own cache.
+            gone = next(n for n in ("d0", "d1")
+                        if n not in fleet._by_name)
+            stale_p = next(
+                (p for p, r in results.items() if r[0] == 200
+                 and router._affinity.get(
+                     router._digests(ByteTokenizer().encode(p))[-1],
+                     (None,))[0] == gone),
+                None)
+            if stale_p is not None:
+                s0 = METRICS.get_counter("directory.stale_drops")
+                st, _, body = await _request(
+                    host, port, {"prompt": stale_p, "max_tokens":
+                                 dict(gold + agg)[stale_p]},
+                    tenant="gold")
+                assert st == 200, body
+                assert body["choices"][0]["text"] == wants[stale_p]
+                assert METRICS.get_counter("directory.stale_drops") > s0
+            # -- steady state ------------------------------------------
+            for _ in range(400):
+                if all(not h.inflight for h in fleet.replicas):
+                    break
+                await asyncio.sleep(0.02)
+            _audit_all(fleet)
+        finally:
+            await router.stop()
+            await fleet.stop()
+
+    asyncio.run(asyncio.wait_for(driver(), 550))

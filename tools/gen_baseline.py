@@ -77,6 +77,16 @@ def _result_cell(row: dict) -> str:
         ("aggressor_shed_frac", "aggressor shed frac"),
         ("scale_up_s", "scale-up s"),
         ("scale_down_s", "scale-down s"),
+        ("goodput_tok_per_s_colocated", "goodput tok/s (colocated)"),
+        ("goodput_tok_per_s_disagg", "goodput tok/s (disagg)"),
+        ("exact_disagg", "byte-exact (disagg)"),
+        ("handoffs", "handoffs"),
+        ("directory_hit_rate", "directory hit rate"),
+        ("pulled_pages", "pages pulled"),
+        ("pull_fallbacks", "pull fallbacks"),
+        ("pull_ttft_ms", "pull TTFT ms"),
+        ("reprefill_ttft_ms", "re-prefill TTFT ms"),
+        ("pull_ttft_speedup", "pull TTFT speedup"),
         ("offered_x", "offered load x"),
         ("shed_frac", "shed frac"),
         ("preemptions", "preemptions"),
@@ -175,7 +185,8 @@ def generate(ladder_path: str) -> str:
         "overload-goodput", "tenant-qos", "kv-tiering", "decode-overlap",
         "mixed-step", "spec-paged",
         "constrained-decode", "mesh-paged", "replica-failover",
-        "disagg-handoff", "compile-stability", "analysis-wall",
+        "fleet-goodput", "disagg-handoff", "compile-stability",
+        "analysis-wall",
         "ragged-decode-8k", "ragged-decode-win-8k", "quant-matmul-bw",
         "spec-decode", "spec-decode-7b-int8", "spec-batching",
         "paged-batching", "prefill-flash-2048", "prefill-flash-8192",
