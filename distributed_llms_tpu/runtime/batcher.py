@@ -1975,6 +1975,19 @@ class ContinuousBatcher:
         # admission (others admit past it), so one tenant can never hold
         # every batch slot.  None = uncapped.
         tenant_max_rows: int | None = None,
+        # The LOCKSTEP CLOCK: the one time source scheduling DECISIONS
+        # may consult (today: queue-deadline shedding in
+        # _shed_expired_queued — submit(deadline=) timestamps are read
+        # against it).  Defaults to time.perf_counter for single-process
+        # engines; a multi-process harness injects a deterministic clock
+        # (e.g. derived from the scheduling round counter) so every
+        # process sheds the same requests in the same round — decision
+        # paths reading the wall clock directly are a graftsync GS101
+        # finding (LOCKSTEP_DECISIONS, runtime/scheduler.py).  Metrics
+        # and timer stamps (_t_complete, host-lag) are observability,
+        # not decisions, and stay on the wall clock at the declared
+        # HOST_SYNC_SITES.
+        clock: "Callable[[], float] | None" = None,
     ) -> None:
         # Snapshot the constructor arguments FIRST (before any local
         # variables or normalization appear) so respawn() can rebuild an
@@ -1983,6 +1996,11 @@ class ContinuousBatcher:
         self._ctor_args = {
             k: v for k, v in locals().items() if k not in ("self", "__class__")
         }
+        # Injectable lockstep clock (see the ``clock`` parameter note):
+        # decisions read self._clock(), never time.perf_counter() —
+        # the reference (not a call) below is the single default-wiring
+        # point.
+        self._clock = clock if clock is not None else time.perf_counter
         if max_len > cfg.max_seq_len:
             raise ValueError(
                 f"max_len {max_len} exceeds model max_seq_len {cfg.max_seq_len}"
@@ -2996,12 +3014,16 @@ class ContinuousBatcher:
         streamed tokens, so it finishes with that partial output (the
         serving layer's own deadline reports ``finish_reason: "timeout"``)
         — shedding it would discard delivered work and falsely tell the
-        client a retry is safe.  Wall-clock dependent, so multi-process
-        meshes skip it (host clocks diverge and the admission loop must
-        stay lockstep)."""
+        client a retry is safe.  Reads the INJECTED lockstep clock
+        (``self._clock``, default perf_counter), never the wall clock
+        directly — the graftsync GS101 contract for this declared
+        decision (LOCKSTEP_DECISIONS).  Multi-process meshes still skip
+        it outright: the default clock diverges across hosts, and the
+        admission loop must stay lockstep unless the harness injected a
+        deterministic clock AND owns the deadline semantics."""
         if self.pm is not None:
             return
-        now = time.perf_counter()
+        now = self._clock()
         # Collect expired requests under the submission lock, then deliver
         # OUTSIDE it: the on_tokens callback may re-enter this class
         # (serving's cancel sweep calls cancel_row), which takes the lock.
@@ -4135,8 +4157,15 @@ class ContinuousBatcher:
                 and self.rows[i].req.constraint is not None
             ]
             if con:
+                # Memo key: (slot, rid) per constrained row.  rids are
+                # minted monotonically and a row's constraint is fixed
+                # for its whole residency, so the pair identifies the
+                # stacked automata exactly — and deterministically,
+                # unlike the id()-based key this replaces (object
+                # addresses diverge across lockstep processes; graftsync
+                # GS101 audits _span_plan as a declared decision).
                 key = tuple(
-                    (i, id(self.rows[i].req.constraint)) for i in con
+                    (i, self.rows[i].rid) for i in con
                 )
                 if self._con_stack is None or self._con_stack[0] != key:
                     dfas = [self.rows[i].req.constraint for i in con]
@@ -4145,9 +4174,9 @@ class ContinuousBatcher:
                         dfas, self.cfg.vocab_size,
                         pad_states_to=_bucket(total),
                     )
-                    # The memo HOLDS the automata: the key compares ids,
-                    # and a reference pins them so a freed automaton's id
-                    # can never be recycled into a stale-key match.
+                    # The memo keeps the automata alongside the device
+                    # tables (the rid key no longer needs an id pin;
+                    # they document what the stack was built from).
                     self._con_stack = (
                         key, jnp.asarray(bias), jnp.asarray(nxt), offs,
                         dfas,

@@ -85,6 +85,69 @@ HOOKS: dict[str, str] = {
         "budget refunds and resident-row caps decrement (base: no-op)",
 }
 
+# The declared LOCKSTEP decision surfaces — the registry tools/graftsync
+# audits (GS1 taint, GS3 set-ordering, GS4 drift).  On a multi-process
+# mesh every process must take the SAME scheduling decision in the same
+# round or SPMD dispatch deadlocks/diverges, so every function named here
+# (and everything it transitively calls) must be deterministic in
+# scheduling state alone: no wall clocks, no global-state RNG, no
+# id()/hash(), no env reads, no unordered-set iteration.  "Owner.name"
+# binds the method on the named class AND every subclass override.
+# Adding a scheduler hook without declaring it here is GS402 drift;
+# naming a function nothing declares is GS401.
+LOCKSTEP_DECISIONS: dict[str, str] = {
+    "Scheduler.admission_order":
+        "which queued request admits next — identical pick per process",
+    "Scheduler.chunk_threshold":
+        "monolithic-vs-chunked admission path selection",
+    "Scheduler.prefill_bite":
+        "prefill tokens the next step consumes (budget arithmetic)",
+    "Scheduler.fuse_prefill":
+        "fused-vs-serialized prefill program selection",
+    "Scheduler.select_victim":
+        "which resident row preempts under pool pressure",
+    "Scheduler.pressure_rungs":
+        "the ordered memory-pressure escalation ladder",
+    "Scheduler.sync_triggers":
+        "which conditions end a dispatch-ahead span (host-sync decision)",
+    "Scheduler.spec_round_k":
+        "per-row speculative commit bound (k_row clamp vector)",
+    "Scheduler.note_admitted":
+        "admission-commit accounting feeding later admission_order picks",
+    "Scheduler.note_freed":
+        "release true-up accounting feeding later admission_order picks",
+    "ContinuousBatcher._shed_expired_queued":
+        "queue-deadline shedding: reads the injected lockstep clock "
+        "(self._clock), never the wall clock directly; meshes skip it",
+    "ContinuousBatcher._overlap_ok":
+        "the dispatch-ahead gate (sync_triggers over a SyncView snapshot)",
+    "ContinuousBatcher._span_plan":
+        "compile-key static args for the span's chunks — program "
+        "selection must match across processes or compiled dispatch "
+        "diverges",
+}
+
+# The declared host<->device sync points — the registry tools/graftsync
+# GS2 audits.  Every jax.device_get / block_until_ready in runtime/ must
+# sit in a function named here: the dispatch-ahead overlap plane earns
+# its throughput by syncing at exactly these boundaries, so adding a
+# sync is a reviewed registry line, never a silent per-chunk round-trip.
+# These are also the ONE place wall-clock/timer reads are exempt from
+# GS1 (the host is already serialized against the device here — the
+# lockstep clock policy's "clock reads only at declared sync points").
+HOST_SYNC_SITES: dict[str, str] = {
+    "ContinuousBatcher._fetch_chunk":
+        "one batched D2H per dispatched chunk (tokens+logprobs+activity)",
+    "ContinuousBatcher._sync_carry":
+        "span exit: the whole scheduling carry returns to host mirrors",
+    "ContinuousBatcher._decode_span":
+        "span boundary: automaton state read-back + host-lag stamping",
+    "ContinuousBatcher.register_prefix":
+        "prefix registration materializes the row cache once, at admit",
+    "engine._to_host":
+        "generation output D2H (allgathers mesh-sharded tiles first)",
+}
+
 # Rung names of the declared pressure ladder (PR-9's order).  "evict_spill"
 # is implicit in pool accounting (available() counts evictable cached
 # pages, spilling them to the host tier first); the preempt rungs gate
@@ -483,9 +546,14 @@ class TenantScheduler(MixedScheduler):
         # Starvation guard (the VTC lift): a tenant re-entering from idle
         # is lifted to the minimum counter among tenants already live —
         # idle time banks no credit, and the lift never REDUCES anyone.
+        # sorted(): _live is a set, and this list feeds a decision —
+        # iteration order must not depend on PYTHONHASHSEED / insertion
+        # history, or lockstep processes could diverge (graftsync GS301;
+        # min() below is order-insensitive today, but keep the closure
+        # deterministic by construction, not by accident).
         live_counters = [
             self._vtc.get(t, 0.0)
-            for t in self._live
+            for t in sorted(self._live)
             if t in by_tenant or self._resident.get(t, 0) > 0
         ]
         floor = min(live_counters, default=0.0)

@@ -1,0 +1,146 @@
+"""Lockstep-determinism property test — the DYNAMIC witness for what
+graftsync (tools/graftsync) checks statically.
+
+A multi-process mesh takes every scheduling decision in lockstep: the
+same queue + admission history must produce the SAME admission order,
+victim choice, bite sizes, spec-round clamps, and sync-trigger lists in
+every process, or SPMD dispatch deadlocks.  The sneakiest way to break
+that is hash/set order: ``PYTHONHASHSEED`` differs per process unless
+pinned, string hashes (tenant ids!) differ with it, and any decision
+that leaks set-iteration order diverges even on identical state.
+
+So the witness is run as SUBPROCESSES (the hash seed is fixed at
+interpreter start and cannot be changed in-process): one fixed scenario
+replayed under PYTHONHASHSEED=0 and PYTHONHASHSEED=1 must print
+byte-identical decision traces.  The scenario leans on the surfaces
+where hash order could plausibly leak — ``TenantScheduler``'s ``_live``
+set and per-tenant buckets keyed by client-minted strings — plus the
+mixed/spec hooks (bite sizing, victim selection, round clamps, sync
+triggers) over fixed ``SyncView`` snapshots.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+ROOT = Path(__file__).resolve().parents[2]
+
+# The driver replays one deterministic scheduling scenario and prints the
+# decision trace.  It runs in a fresh interpreter so PYTHONHASHSEED takes
+# effect; anything nondeterministic in a decision shows up as a trace
+# diff between seeds.
+DRIVER = '''
+import sys
+from types import SimpleNamespace
+
+from distributed_llms_tpu.runtime.scheduler import (
+    MixedScheduler, SpecMixedScheduler, SyncView, TenantScheduler)
+
+
+def req(rid, priority=0, tenant=None, prompt=4):
+    return SimpleNamespace(rid=rid, priority=priority, tenant=tenant,
+                           ids=[1] * prompt)
+
+
+out = []
+
+# -- tenant-fair admission: the surface most exposed to hash order -----
+# (string tenant ids bucket into dicts and the _live set; the VTC lift
+# reduces over live counters).  Tenant names chosen so their hashes --
+# and therefore any leaked set order -- differ across PYTHONHASHSEED.
+sched = TenantScheduler(tenant_weights={"gold": 4.0, "free": 1.0},
+                        tenant_max_rows=2, token_budget=64)
+queue = [
+    req(1, 0, "gold"), req(2, 1, "free"), req(3, 0, "bronze"),
+    req(4, 2, None), req(5, 0, "gold", 8), req(6, 1, "zinc"),
+    req(7, 0, "free"), req(8, 3, "bronze"), req(9, 0, "iron"),
+    req(10, 1, "gold"),
+]
+admitted = []
+while queue:
+    pick = sched.admission_order(queue)
+    if pick is None:
+        # Every backlogged tenant sits at its row cap: free the oldest
+        # resident (chunk boundary) and retry -- also exercises the
+        # true-up/refund path mid-scenario.
+        r, emitted = admitted.pop(0)
+        sched.note_freed(r, emitted)
+        out.append(f"freed rid={r.rid}")
+        continue
+    queue.remove(pick)
+    sched.note_admitted(pick, est_tokens=len(pick.ids) + 16)
+    admitted.append((pick, 5))
+    out.append(f"admit rid={pick.rid} tenant={pick.tenant}")
+for r, emitted in admitted:
+    sched.note_freed(r, emitted)
+out.append("vtc " + ",".join(
+    f"{t}={v:.4f}" for t, v in sorted(sched._vtc.items())))
+
+# -- mixed policy hooks over fixed inputs ------------------------------
+m = MixedScheduler(token_budget=32, chunk_steps=8)
+for remaining, n_active in [(100, 0), (100, 4), (7, 31), (64, 32)]:
+    out.append(f"bite {remaining},{n_active} -> "
+               f"{m.prefill_bite(remaining, n_active)}")
+cands = [(0, 1, 3), (1, 0, 5), (2, 0, 4), (3, 2, 1)]
+out.append(f"victim -> {m.select_victim(cands)}")
+out.append(f"victim<1 -> {m.select_victim(cands, below_priority=1)}")
+
+s = SpecMixedScheduler(token_budget=24, speculative=True)
+out.append(f"spec_k -> {s.spec_round_k(4, [1.0, 0.4, 0.75, 0.1], 3)}")
+
+views = [
+    SyncView(any_active=True, cancel_dirty=False, queued=True,
+             kv_imports=False, prefills=1, head_prefill_left=0,
+             live_budgets=(4, 9), chunks_ahead=1,
+             grow_blocked=lambda: False),
+    SyncView(any_active=True, cancel_dirty=False, queued=False,
+             kv_imports=False, prefills=1, head_prefill_left=12,
+             live_budgets=(40, 90), chunks_ahead=1,
+             grow_blocked=lambda: True),
+    SyncView(any_active=False, cancel_dirty=True, queued=False,
+             kv_imports=True, prefills=0, head_prefill_left=0,
+             live_budgets=(), chunks_ahead=0,
+             grow_blocked=lambda: False),
+]
+for v in views:
+    out.append("sync " + ",".join(m.sync_triggers(v)))
+
+sys.stdout.write("\\n".join(out) + "\\n")
+'''
+
+
+def _trace(tmp_path: Path, hashseed: str) -> str:
+    driver = tmp_path / "lockstep_driver.py"
+    driver.write_text(DRIVER, encoding="utf-8")
+    env = dict(os.environ,
+               PYTHONHASHSEED=hashseed,
+               PYTHONPATH=str(ROOT),
+               JAX_PLATFORMS="cpu")
+    r = subprocess.run([sys.executable, str(driver)], capture_output=True,
+                       text=True, env=env, cwd=ROOT, timeout=120)
+    assert r.returncode == 0, r.stdout + r.stderr
+    return r.stdout
+
+
+def test_decision_traces_identical_across_hash_seeds(tmp_path):
+    t0 = _trace(tmp_path, "0")
+    t1 = _trace(tmp_path, "1")
+    # The scenario actually ran (all ten admissions + the hook probes).
+    assert t0.count("admit rid=") == 10
+    assert "sync " in t0 and "spec_k" in t0
+    # THE property: different hash seeds, byte-identical decisions.
+    assert t0 == t1, (
+        "scheduling decisions diverged under PYTHONHASHSEED skew -- a "
+        "hash/set-order dependency leaked onto the lockstep decision "
+        "path:\n--- seed 0 ---\n" + t0 + "--- seed 1 ---\n" + t1
+    )
+
+
+def test_trace_is_stable_within_a_seed(tmp_path):
+    """Same seed twice -> same trace: the scenario itself carries no
+    incidental nondeterminism (so a cross-seed diff above can only mean
+    a hash-order leak, not a flaky driver)."""
+    assert _trace(tmp_path, "0") == _trace(tmp_path, "0")

@@ -259,6 +259,30 @@ def test_expired_preempted_request_finishes_with_partial_not_shed(tiny):
     b.assert_pool_consistent()
 
 
+def test_shed_decision_reads_the_injected_lockstep_clock(tiny):
+    """The queue-deadline shed is a declared LOCKSTEP_DECISIONS surface
+    (graftsync GS101): it reads the injected lockstep clock, never the
+    wall clock, so mesh processes fed the same clock value shed
+    identically.  Witness both directions: a deadline long expired by
+    WALL time stays alive while the injected clock sits before it, and
+    advancing the injected clock past a wall-clock-future deadline
+    sheds."""
+    cfg, params = tiny
+    t = {"now": 0.0}
+    b = _paged(cfg, params, batch_slots=1, clock=lambda: t["now"])
+    r1 = b.submit([1, 2, 3], max_new_tokens=4,
+                  deadline=time.perf_counter() - 0.5)  # wall: expired
+    res = b.run()
+    assert res[r1] == solo(cfg, params, [1, 2, 3], 4)
+    assert r1 not in b.shed, "shed consulted the wall clock"
+    r2 = b.submit([4, 5, 6], max_new_tokens=4,
+                  deadline=time.perf_counter() + 3600.0)  # wall: far future
+    t["now"] = time.perf_counter() + 7200.0
+    res2 = b.run()
+    assert res2[r2] == [] and b.shed[r2].startswith("queue deadline")
+    b.assert_pool_consistent()
+
+
 # -- chunked prefill over the paged pool ------------------------------------
 
 

@@ -1,0 +1,482 @@
+"""graftsync self-tests: every rule family proven to fire on a seeded
+violation (and stay quiet on the sanctioned shapes), suppressions honored
+only with a reason, and THE tier-1 gate — the repo itself must be clean
+modulo the checked-in (EMPTY) baseline.
+
+Fixture trees use the real scope suffix (pkg/runtime/...) so the analyzer
+treats them exactly like the shipped package: the registry module is any
+file ending in runtime/scheduler.py, and taint scope is everything under
+a runtime/ segment.
+"""
+
+from __future__ import annotations
+
+import subprocess
+import sys
+from pathlib import Path
+
+ROOT = Path(__file__).resolve().parents[2]
+if str(ROOT) not in sys.path:
+    sys.path.insert(0, str(ROOT))
+
+from tools.graftsync import (  # noqa: E402
+    load_project, read_baseline, run_project, split_new,
+)
+from tools.graftsync import drift, ordering, syncs, taint  # noqa: E402
+
+
+def _project(tmp_path: Path, files: dict[str, str]):
+    for rel, text in files.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(text, encoding="utf-8")
+    return load_project(tmp_path)
+
+
+def _rules(findings):
+    return sorted(f.rule for f in findings)
+
+
+# The fixture registry: the decision surfaces and sync sites the seeded
+# violations play against (same literal-dict shape as the real
+# runtime/scheduler.py registries).
+REGISTRY_SRC = '''
+LOCKSTEP_DECISIONS: dict[str, str] = {
+    "Scheduler.admission_order": "queue pick",
+    "ContinuousBatcher._shed_expired_queued": "queue-deadline shedding",
+}
+
+HOST_SYNC_SITES: dict[str, str] = {
+    "ContinuousBatcher._fetch_chunk": "per-chunk D2H",
+}
+'''
+
+
+# -- GS1xx lockstep taint ---------------------------------------------------
+
+def test_pr19_wall_clock_shed_is_now_a_gate(tmp_path):
+    """The finding this whole tool was born from — ``now =
+    time.perf_counter()`` inside the batcher's queue-deadline shed (a
+    declared admission decision), reproduced as source, caught by GS1.
+    The real batcher now reads the injectable lockstep clock instead."""
+    findings = taint.check(_project(tmp_path, {
+        "pkg/runtime/scheduler.py": REGISTRY_SRC,
+        "pkg/runtime/batcher.py": (
+            "import time\n"
+            "class ContinuousBatcher:\n"
+            "    def _shed_expired_queued(self):\n"
+            "        now = time.perf_counter()\n"   # the bug, verbatim shape
+            "        for req in list(self.queued):\n"
+            "            if req.deadline < now:\n"
+            "                self.queued.remove(req)\n"
+        ),
+    }))
+    assert _rules(findings) == ["GS101"]
+    assert "time.perf_counter" in findings[0].message
+    assert "_shed_expired_queued" in findings[0].message
+    assert findings[0].line == 4
+
+
+def test_gs1_taint_through_the_call_graph(tmp_path):
+    """The RNG draw hides one hop below the declared decision — only
+    interprocedural propagation sees it, and the message names the
+    helper the taint flowed through."""
+    findings = taint.check(_project(tmp_path, {
+        "pkg/runtime/scheduler.py": REGISTRY_SRC,
+        "pkg/runtime/policy.py": (
+            "import random\n"
+            "class Scheduler:\n"
+            "    def admission_order(self, queue):\n"
+            "        self._jitter()\n"
+            "        return queue[0]\n"
+            "    def _jitter(self):\n"
+            "        return random.random()\n"
+        ),
+    }))
+    assert _rules(findings) == ["GS101"]
+    assert "random.random" in findings[0].message
+    assert "via Scheduler._jitter" in findings[0].message
+
+
+def test_gs1_subclass_override_is_bound_by_the_entry(tmp_path):
+    """A registry entry on the base class binds every subclass override
+    — hash() (PYTHONHASHSEED-dependent) in a subclass's admission hook
+    fires even though only Scheduler.admission_order is declared."""
+    findings = taint.check(_project(tmp_path, {
+        "pkg/runtime/scheduler.py": REGISTRY_SRC,
+        "pkg/runtime/policy.py": (
+            "class Scheduler:\n"
+            "    def admission_order(self, queue):\n"
+            "        return queue[0]\n"
+            "class TenantScheduler(Scheduler):\n"
+            "    def admission_order(self, queue):\n"
+            "        return max(queue, key=lambda r: hash(r.tenant))\n"
+        ),
+    }))
+    assert _rules(findings) == ["GS101"]
+    assert "'hash'" in findings[0].message
+    assert "TenantScheduler.admission_order" in findings[0].message
+
+
+def test_gs1_env_read_fires(tmp_path):
+    findings = taint.check(_project(tmp_path, {
+        "pkg/runtime/scheduler.py": REGISTRY_SRC,
+        "pkg/runtime/policy.py": (
+            "import os\n"
+            "class Scheduler:\n"
+            "    def admission_order(self, queue):\n"
+            "        if os.environ[\"DEBUG_PICK\"]:\n"
+            "            return queue[-1]\n"
+            "        return queue[0]\n"
+        ),
+    }))
+    assert _rules(findings) == ["GS101"]
+    assert "os.environ[]" in findings[0].message
+
+
+def test_gs1_metrics_arguments_are_allowlisted(tmp_path):
+    """A clock read that only feeds a metrics/log call's arguments is
+    observability plumbing — exempt BY ALLOWLIST (METRICS_BOUNDARY),
+    never via suppression comments."""
+    findings = taint.check(_project(tmp_path, {
+        "pkg/runtime/scheduler.py": REGISTRY_SRC,
+        "pkg/runtime/batcher.py": (
+            "import time\n"
+            "class ContinuousBatcher:\n"
+            "    def _shed_expired_queued(self):\n"
+            "        METRICS.observe(\"batcher.shed_scan_ms\",\n"
+            "                        (time.perf_counter() - self._t0) * 1e3)\n"
+            "        LOG.debug(\"shed at %s\", time.monotonic())\n"
+            "        return None\n"
+        ),
+    }))
+    assert findings == []
+
+
+def test_gs1_declared_sync_site_is_exempt(tmp_path):
+    """Timer reads inside a HOST_SYNC_SITES function are the sanctioned
+    place for wall clocks (the host is already serialized against the
+    device there) — and the device_get inside it is a declared sync, so
+    GS2 stays quiet too."""
+    project = _project(tmp_path, {
+        "pkg/runtime/scheduler.py": REGISTRY_SRC,
+        "pkg/runtime/batcher.py": (
+            "import time\n"
+            "import jax\n"
+            "class ContinuousBatcher:\n"
+            "    def _shed_expired_queued(self):\n"
+            "        return self._fetch_chunk()\n"
+            "    def _fetch_chunk(self):\n"
+            "        t0 = time.perf_counter()\n"
+            "        out = jax.device_get(self._carry)\n"
+            "        self._t_complete = time.perf_counter()\n"
+            "        return out\n"
+        ),
+    })
+    assert taint.check(project) == []
+    assert syncs.check(project) == []
+
+
+def test_gs1_source_outside_the_closure_is_clean(tmp_path):
+    """Wall clocks in functions no decision reaches (stats endpoints,
+    logging helpers) are not lockstep hazards."""
+    findings = taint.check(_project(tmp_path, {
+        "pkg/runtime/scheduler.py": REGISTRY_SRC,
+        "pkg/runtime/batcher.py": (
+            "import time\n"
+            "class ContinuousBatcher:\n"
+            "    def _shed_expired_queued(self):\n"
+            "        return len(self.queued)\n"
+            "    def stats(self):\n"
+            "        return {\"now\": time.time()}\n"
+        ),
+    }))
+    assert findings == []
+
+
+# -- GS2xx undeclared host<->device syncs -----------------------------------
+
+def test_gs2_undeclared_device_get_fires(tmp_path):
+    findings = syncs.check(_project(tmp_path, {
+        "pkg/runtime/scheduler.py": REGISTRY_SRC,
+        "pkg/runtime/batcher.py": (
+            "import jax\n"
+            "class ContinuousBatcher:\n"
+            "    def _grow_ahead(self):\n"
+            "        flags = jax.device_get(self._flags)\n"  # stray sync
+            "        return flags\n"
+            "    def _fetch_chunk(self):\n"
+            "        return jax.device_get(self._carry)\n"   # declared site
+        ),
+    }))
+    assert _rules(findings) == ["GS201"]
+    assert "jax.device_get" in findings[0].message
+    assert "ContinuousBatcher._grow_ahead" in findings[0].message
+
+
+def test_gs2_method_form_and_module_level_fire(tmp_path):
+    """.block_until_ready() spelled as a method call is the same sync,
+    and import-time device work is attributed to <module> — never a
+    sanctioned sync point."""
+    findings = syncs.check(_project(tmp_path, {
+        "pkg/runtime/scheduler.py": REGISTRY_SRC,
+        "pkg/runtime/engine.py": (
+            "import jax\n"
+            "_WARM = jax.device_get(_PROBE)\n"               # module level
+            "class Engine:\n"
+            "    def step(self):\n"
+            "        self._carry.block_until_ready()\n"      # method form
+        ),
+    }))
+    assert _rules(findings) == ["GS201", "GS201"]
+    assert any("<module>" in f.message for f in findings)
+    assert any("<..>.block_until_ready" in f.message for f in findings)
+
+
+def test_gs2_out_of_scope_files_are_not_checked(tmp_path):
+    """The lockstep contract binds runtime/ — a device_get in a bench or
+    cluster helper outside the scope segment is not this rule's
+    business."""
+    findings = syncs.check(_project(tmp_path, {
+        "pkg/runtime/scheduler.py": REGISTRY_SRC,
+        "pkg/cluster/probe.py": (
+            "import jax\n"
+            "def probe(x):\n"
+            "    return jax.device_get(x)\n"
+        ),
+    }))
+    assert findings == []
+
+
+# -- GS3xx unordered-set iteration ------------------------------------------
+
+def test_gs3_for_over_set_attribute_fires(tmp_path):
+    findings = ordering.check(_project(tmp_path, {
+        "pkg/runtime/scheduler.py": REGISTRY_SRC,
+        "pkg/runtime/policy.py": (
+            "class Scheduler:\n"
+            "    def __init__(self):\n"
+            "        self._live = set()\n"
+            "    def admission_order(self, queue):\n"
+            "        for t in self._live:\n"
+            "            if t:\n"
+            "                return t\n"
+            "        return None\n"
+        ),
+    }))
+    assert _rules(findings) == ["GS301"]
+    assert "for loop" in findings[0].message
+    assert findings[0].line == 5
+
+
+def test_gs3_sorted_and_set_comprehensions_are_clean(tmp_path):
+    """sorted() IS the fix, and a set-producing comprehension over a set
+    is order-insensitive — neither may fire or the rule teaches people
+    to suppress instead of sort."""
+    findings = ordering.check(_project(tmp_path, {
+        "pkg/runtime/scheduler.py": REGISTRY_SRC,
+        "pkg/runtime/policy.py": (
+            "class Scheduler:\n"
+            "    def __init__(self):\n"
+            "        self._live = set()\n"
+            "    def admission_order(self, queue):\n"
+            "        order = sorted(self._live)\n"
+            "        still = {t for t in self._live if t}\n"
+            "        return order[0] if order else len(still)\n"
+        ),
+    }))
+    assert findings == []
+
+
+def test_gs3_local_set_materialized_with_list_fires(tmp_path):
+    """Set-typedness propagates to locals: a set comprehension assigned
+    to a name, then list()-materialized, is the same hazard one
+    statement later."""
+    findings = ordering.check(_project(tmp_path, {
+        "pkg/runtime/scheduler.py": REGISTRY_SRC,
+        "pkg/runtime/policy.py": (
+            "class Scheduler:\n"
+            "    def admission_order(self, queue):\n"
+            "        pending = {r.tenant for r in queue}\n"
+            "        names = list(pending)\n"
+            "        return names[0] if names else None\n"
+        ),
+    }))
+    assert _rules(findings) == ["GS301"]
+    assert "list()" in findings[0].message
+    assert findings[0].line == 4
+
+
+def test_gs3_base_class_set_seen_from_subclass_override(tmp_path):
+    """The set lives on the BASE class; the subclass override iterating
+    it still fires — attr typing is closed over AST-visible bases."""
+    findings = ordering.check(_project(tmp_path, {
+        "pkg/runtime/scheduler.py": REGISTRY_SRC,
+        "pkg/runtime/policy.py": (
+            "class Scheduler:\n"
+            "    def __init__(self):\n"
+            "        self._live: set[str] = set()\n"
+            "    def admission_order(self, queue):\n"
+            "        return queue[0]\n"
+            "class TenantScheduler(Scheduler):\n"
+            "    def admission_order(self, queue):\n"
+            "        return [t for t in self._live]\n"
+        ),
+    }))
+    assert _rules(findings) == ["GS301"]
+    assert "comprehension" in findings[0].message
+
+
+# -- GS4xx registry drift ----------------------------------------------------
+
+def test_gs4_dead_registry_entry_fires(tmp_path):
+    findings = drift.check(_project(tmp_path, {
+        "pkg/runtime/scheduler.py": (
+            'LOCKSTEP_DECISIONS: dict[str, str] = {\n'
+            '    "Scheduler.admission_order": "real",\n'
+            '    "Ghost._vanished": "nothing declares this",\n'
+            '}\n'
+            'HOST_SYNC_SITES: dict[str, str] = {}\n'
+            'class Scheduler:\n'
+            '    def admission_order(self, queue):\n'
+            '        return queue[0]\n'
+        ),
+    }))
+    assert _rules(findings) == ["GS401"]
+    assert "Ghost._vanished" in findings[0].message
+
+
+def test_gs4_undeclared_hook_fires(tmp_path):
+    findings = drift.check(_project(tmp_path, {
+        "pkg/runtime/scheduler.py": (
+            'HOOKS: dict[str, str] = {\n'
+            '    "admission_order": "queue pick",\n'
+            '    "mystery_hook": "added without a lockstep declaration",\n'
+            '}\n'
+            'LOCKSTEP_DECISIONS: dict[str, str] = {\n'
+            '    "Scheduler.admission_order": "queue pick",\n'
+            '}\n'
+            'HOST_SYNC_SITES: dict[str, str] = {}\n'
+            'class Scheduler:\n'
+            '    def admission_order(self, queue):\n'
+            '        return queue[0]\n'
+            '    def mystery_hook(self):\n'
+            '        return None\n'
+        ),
+    }))
+    assert _rules(findings) == ["GS402"]
+    assert "mystery_hook" in findings[0].message
+
+
+def test_gs4_consistent_registries_are_clean(tmp_path):
+    findings = drift.check(_project(tmp_path, {
+        "pkg/runtime/scheduler.py": (
+            'HOOKS: dict[str, str] = {"admission_order": "queue pick"}\n'
+            'LOCKSTEP_DECISIONS: dict[str, str] = {\n'
+            '    "Scheduler.admission_order": "queue pick",\n'
+            '}\n'
+            'HOST_SYNC_SITES: dict[str, str] = {\n'
+            '    "Scheduler.sync_now": "declared",\n'
+            '}\n'
+            'class Scheduler:\n'
+            '    def admission_order(self, queue):\n'
+            '        return queue[0]\n'
+            '    def sync_now(self):\n'
+            '        return None\n'
+        ),
+    }))
+    assert findings == []
+
+
+# -- suppressions -----------------------------------------------------------
+
+def test_suppressions_require_a_reason(tmp_path):
+    """# graftsync: lockstep-ok(<reason>) suppresses on the line; an
+    EMPTY reason is inert; rule-scoped ignore[GSxxx] only matches its
+    rule — graftlint's escape semantics, verbatim."""
+    findings = taint.check(_project(tmp_path, {
+        "pkg/runtime/scheduler.py": REGISTRY_SRC,
+        "pkg/runtime/batcher.py": (
+            "import time\n"
+            "class ContinuousBatcher:\n"
+            "    def _shed_expired_queued(self):\n"
+            "        a = time.perf_counter()  "
+            "# graftsync: lockstep-ok(local log only, never compared)\n"
+            "        b = time.perf_counter()  # graftsync: lockstep-ok()\n"
+            "        c = time.perf_counter()  "
+            "# graftsync: ignore[GS101](pre-mesh fast path)\n"
+            "        d = time.perf_counter()  "
+            "# graftsync: ignore[GS201](wrong rule)\n"
+            "        return (a, b, c, d)\n"
+        ),
+    }))
+    assert [f.line for f in findings] == [5, 7]  # b (no reason), d (wrong rule)
+
+
+# -- THE tier-1 gate --------------------------------------------------------
+
+def test_repo_is_clean():
+    """Zero non-baselined findings over the real tree.  A wall clock or
+    RNG on a decision path, a stray device_get, a set iteration feeding
+    admission, or registry drift fails tier-1 right here."""
+    project = load_project(ROOT)
+    findings = run_project(project)
+    new, _accepted = split_new(findings, read_baseline(ROOT))
+    assert not new, "new graftsync findings:\n" + "\n".join(
+        f.render() for f in new
+    )
+
+
+def test_cli_exit_codes(tmp_path):
+    # Dirty fixture tree -> exit 1 and the finding on stdout ...
+    reg = tmp_path / "pkg" / "runtime" / "scheduler.py"
+    reg.parent.mkdir(parents=True)
+    reg.write_text(REGISTRY_SRC, encoding="utf-8")
+    (reg.parent / "batcher.py").write_text(
+        "import time\n"
+        "class ContinuousBatcher:\n"
+        "    def _shed_expired_queued(self):\n"
+        "        return time.perf_counter()\n"
+        "    def _fetch_chunk(self):\n"
+        "        return None\n"
+        "class Scheduler:\n"
+        "    def admission_order(self, queue):\n"
+        "        return queue[0]\n", encoding="utf-8")
+    r = subprocess.run(
+        [sys.executable, "-m", "tools.graftsync", "--root", str(tmp_path)],
+        capture_output=True, text=True, cwd=ROOT,
+    )
+    assert r.returncode == 1
+    assert "GS101" in r.stdout
+    # ... --baseline-write accepts the debt, after which the gate passes.
+    subprocess.run(
+        [sys.executable, "-m", "tools.graftsync", "--root", str(tmp_path),
+         "--baseline-write"],
+        capture_output=True, text=True, cwd=ROOT, check=True,
+    )
+    r2 = subprocess.run(
+        [sys.executable, "-m", "tools.graftsync", "--root", str(tmp_path)],
+        capture_output=True, text=True, cwd=ROOT,
+    )
+    assert r2.returncode == 0, r2.stdout + r2.stderr
+    # --only scoping rejects unknown families.
+    r3 = subprocess.run(
+        [sys.executable, "-m", "tools.graftsync", "--root", str(tmp_path),
+         "--only", "GS9"],
+        capture_output=True, text=True, cwd=ROOT,
+    )
+    assert r3.returncode == 2
+
+
+def test_check_front_door_scopes_across_tools():
+    """python -m tools.check --only GS2,GF2 runs exactly the graftflow +
+    graftsync families over the real tree (clean), skipping the tools
+    with no selected family."""
+    r = subprocess.run(
+        [sys.executable, "-m", "tools.check", "--root", str(ROOT),
+         "--only", "GS2,GF2"],
+        capture_output=True, text=True, cwd=ROOT,
+    )
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "graftsync" in r.stderr and "graftflow" in r.stderr
+    assert "graftcheck" not in r.stderr and "graftlint" not in r.stderr

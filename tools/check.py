@@ -1,10 +1,11 @@
 """Unified static-analysis front door: ``python -m tools.check``.
 
-Runs ALL THREE checkers over the repo and merges their exit codes:
+Runs ALL FOUR checkers over the repo and merges their exit codes:
 
 - graftlint  (tools/graftlint)  — AST rules GL1xx-GL5xx;
 - graftcheck (tools/graftcheck) — semantic contracts GC1xx-GC5xx + GCD;
-- graftflow  (tools/graftflow)  — CFG/dataflow rules GF1xx-GF4xx + GFD.
+- graftflow  (tools/graftflow)  — CFG/dataflow rules GF1xx-GF4xx + GFD;
+- graftsync  (tools/graftsync)  — lockstep taint rules GS1xx-GS4xx + GSD.
 
 ``--only`` scopes a run to rule families ACROSS the tools
 (``--only GF2,GC4,GL3``): tools with no selected family are skipped
@@ -41,6 +42,7 @@ FAMILIES = {
     **{f"GL{i}": "graftlint" for i in range(1, 6)},
     **{f"GC{i}": "graftcheck" for i in range(1, 6)}, "GCD": "graftcheck",
     **{f"GF{i}": "graftflow" for i in range(1, 5)}, "GFD": "graftflow",
+    **{f"GS{i}": "graftsync" for i in range(1, 5)}, "GSD": "graftsync",
 }
 
 _BASELINE_RULE_RE = re.compile(r":\s*(G[A-Z]{1,2}\d+)\b")
@@ -93,8 +95,8 @@ def main(argv=None) -> int:
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
     ap = argparse.ArgumentParser(
         prog="python -m tools.check",
-        description="run graftlint + graftcheck + graftflow with merged "
-                    "exit codes",
+        description="run graftlint + graftcheck + graftflow + graftsync "
+                    "with merged exit codes",
     )
     ap.add_argument("--root", default=".", help="repo root to analyze")
     ap.add_argument("--only", default=None,
@@ -149,6 +151,21 @@ def main(argv=None) -> int:
         walls.append(("graftflow", wall))
         new, stale = _report("graftflow", findings,
                              graftflow.read_baseline(root), only, wall)
+        rc |= 1 if (new or stale) else 0
+
+    # -- graftsync (lockstep taint) ----------------------------------------
+    if want("graftsync"):
+        from tools import graftsync
+
+        t0 = time.perf_counter()
+        gs_only = ({f for f in only if FAMILIES[f] == "graftsync"}
+                   if only is not None else None)
+        findings = graftsync.run_project(graftsync.load_project(root),
+                                         only=gs_only)
+        wall = time.perf_counter() - t0
+        walls.append(("graftsync", wall))
+        new, stale = _report("graftsync", findings,
+                             graftsync.read_baseline(root), only, wall)
         rc |= 1 if (new or stale) else 0
 
     # -- graftcheck (semantic; imports + traces, the expensive one) --------

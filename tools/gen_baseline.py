@@ -150,6 +150,7 @@ def _result_cell(row: dict) -> str:
         ("graftlint_wall_ms", "graftlint ms"),
         ("graftcheck_wall_ms", "graftcheck ms"),
         ("graftflow_wall_ms", "graftflow ms"),
+        ("graftsync_wall_ms", "graftsync ms"),
         ("analysis_wall_ms", "combined analysis ms"),
     ):
         if row.get(k) is not None:

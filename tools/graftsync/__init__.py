@@ -1,0 +1,73 @@
+"""graftsync — lockstep-determinism & host-sync audit.
+
+The fourth static-analysis tier: graftlint (PR 4) checks statements,
+graftcheck (PR 5) traces tensor contracts, graftflow (PR 16) checks
+concurrency/resource interactions — graftsync checks the one invariant
+every multi-process mesh feature rests on: host-side scheduling decisions
+must be byte-identical across lockstep processes.  Taint analysis over
+graftflow's call-graph resolution, from nondeterminism sources to the
+``LOCKSTEP_DECISIONS`` decision surfaces (tools/graftsync/core.py):
+
+- GS1xx nondeterminism taint          (tools/graftsync/taint.py)
+- GS2xx undeclared host<->device sync (tools/graftsync/syncs.py)
+- GS3xx unordered-set iteration       (tools/graftsync/ordering.py)
+- GS4xx registry drift                (tools/graftsync/drift.py)
+- GSD01 README rules-table drift      (tools/graftsync/docs.py)
+
+Run as ``python -m tools.graftsync`` (exit 0 = clean) or through the
+unified front door ``python -m tools.check``; the tier-1 pytest gate is
+tests/tools/test_graftsync.py::test_repo_is_clean.  Accepted debt lives
+in ``graftsync_baseline.txt`` (checked in EMPTY; graftlint's normalized
+line-free multiset format).
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+
+from .core import BASELINE_NAME, Finding, Project, load_project, split_new
+from tools.graftlint.core import read_baseline as _read_baseline
+from tools.graftlint.core import write_baseline as _write_baseline
+
+FAMILIES = ("GS1", "GS2", "GS3", "GS4", "GSD")
+
+
+def write_baseline(root, findings):
+    return _write_baseline(Path(root), findings, name=BASELINE_NAME,
+                           tool="graftsync")
+
+
+def read_baseline(root):
+    return _read_baseline(Path(root), name=BASELINE_NAME)
+
+
+def run_project(project: Project,
+                only: set[str] | None = None) -> list[Finding]:
+    """Run every rule family (or the ``only`` subset of FAMILIES)."""
+    from . import docs, drift, ordering, syncs, taint
+
+    def want(fam: str) -> bool:
+        return only is None or fam in only
+
+    findings: list[Finding] = []
+    if want("GS1"):
+        findings += taint.check(project)
+    if want("GS2"):
+        findings += syncs.check(project)
+    if want("GS3"):
+        findings += ordering.check(project)
+    if want("GS4"):
+        findings += drift.check(project)
+    if want("GSD"):
+        findings += docs.check_docs(project.root)
+    return sorted(findings, key=lambda f: (f.path, f.line, f.rule, f.message))
+
+
+def run(root, only: set[str] | None = None) -> list[Finding]:
+    return run_project(load_project(root), only=only)
+
+
+__all__ = [
+    "BASELINE_NAME", "FAMILIES", "Finding", "Project", "load_project",
+    "read_baseline", "run", "run_project", "split_new", "write_baseline",
+]

@@ -1,0 +1,94 @@
+"""CLI: ``python -m tools.graftsync [--root DIR] [--only GS1,GS4]``.
+
+Exit status mirrors graftlint/graftcheck/graftflow: 0 when every finding
+is absent or baselined, 1 when NEW findings exist, 2 on usage errors.
+
+- ``--only``: comma-separated rule families (GS1..GS4, GSD) — scoped runs
+  for fast iteration; the gate and the front door run everything.
+- ``--baseline-write``: accept current findings into
+  ``graftsync_baseline.txt``.
+- ``--write-docs``: regenerate the README "Lockstep determinism" rule
+  table.
+- ``--all``: also print baselined findings.
+
+Pure AST over ``--root`` (like graftlint/graftflow, unlike graftcheck):
+no imports, no tracing — well under a second on this tree (the
+``analysis-wall`` bench row stamps the measured number).
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from pathlib import Path
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.graftsync",
+        description="lockstep-determinism & host-sync audit "
+                    "(see tools/graftsync/)",
+    )
+    ap.add_argument("--root", default=".", help="repo root to analyze")
+    ap.add_argument("--only", default=None,
+                    help="comma-separated families, e.g. GS1,GS4")
+    ap.add_argument("--baseline-write", action="store_true",
+                    help="accept current findings into the baseline")
+    ap.add_argument("--write-docs", action="store_true",
+                    help="regenerate the README rules table, then exit")
+    ap.add_argument("--all", action="store_true",
+                    help="also print baselined (accepted) findings")
+    args = ap.parse_args(argv)
+
+    root = Path(args.root).resolve()
+    if not root.is_dir():
+        print(f"graftsync: --root {root} is not a directory",
+              file=sys.stderr)
+        return 2
+
+    from tools.graftsync import (FAMILIES, load_project, read_baseline,
+                                 run_project, split_new, write_baseline)
+
+    only = None
+    if args.only:
+        only = {s.strip() for s in args.only.split(",") if s.strip()}
+        unknown = only - set(FAMILIES)
+        if unknown:
+            print(f"graftsync: unknown families {sorted(unknown)}; "
+                  f"have {FAMILIES}", file=sys.stderr)
+            return 2
+
+    if args.write_docs:
+        from tools.graftsync.docs import write_docs
+
+        done = write_docs(root)
+        print("graftsync: rewrote README rules table" if done
+              else "graftsync: no rules marker block found")
+        return 0
+
+    findings = run_project(load_project(root), only=only)
+    if args.baseline_write:
+        path = write_baseline(root, findings)
+        print(f"graftsync: wrote {len(findings)} finding(s) to {path.name}")
+        return 0
+
+    baseline = read_baseline(root)
+    new, accepted = split_new(findings, baseline)
+    for f in new:
+        print(f.render())
+    if args.all:
+        for f in accepted:
+            print(f"{f.render()}  [baselined]")
+    from tools.graftlint.core import stale_entries
+
+    stale = stale_entries(findings, baseline)
+    print(f"graftsync: {len(new)} new finding(s), {len(accepted)} "
+          f"baselined, {len(stale)} stale baseline "
+          f"entr{'y' if len(stale) == 1 else 'ies'}", file=sys.stderr)
+    for s in stale:
+        print(f"  stale: {s}", file=sys.stderr)
+    return 1 if new else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,305 @@
+"""graftsync core: the lockstep-determinism taint scope — declared
+decision surfaces, declared host-sync sites, nondeterminism sources, and
+the interprocedural decision closure the GS rule families share.
+
+Every multi-process mesh feature in this engine (overlap dispatch-ahead,
+SPMD paged serving, the scheduler hooks) rests on ONE invariant: host-side
+scheduling decisions are **byte-identical across lockstep processes**, or
+SPMD dispatch deadlocks/diverges.  Until now that invariant lived in
+prose ("no wall clocks — mesh lockstep safe").  graftsync machine-checks
+it:
+
+- **sources** are nondeterminism: wall clocks (``time.time`` /
+  ``perf_counter`` / ``monotonic``), ``random`` / ``np.random`` /
+  ``os.urandom`` / ``uuid`` / ``secrets``, ``id()`` / ``hash()`` of
+  objects (PYTHONHASHSEED- and allocator-dependent), environment reads,
+  and thread/future completion order (``as_completed``);
+- **sinks** are the decision surfaces declared in the
+  ``LOCKSTEP_DECISIONS`` registry (``runtime/scheduler.py``,
+  LOCK_ORDER-style ``"Owner.name" -> doc``): the scheduler hooks plus the
+  batcher's span planner / overlap gate / deadline shed;
+- taint propagates interprocedurally over graftflow's call-graph
+  resolution (a source anywhere in a sink's transitive callees taints the
+  decision).
+
+Host↔device sync points get the same registry treatment
+(``HOST_SYNC_SITES``): every ``jax.device_get`` / ``block_until_ready``
+in ``runtime/`` must sit in a declared site function, so a future PR
+cannot silently add a per-chunk sync the overlap loop pays for.  Clock
+reads inside a declared sync site are exempt from GS1 — the lockstep
+policy is *clock reads only at declared sync points*; metrics/timer
+plumbing is exempt via the :data:`METRICS_BOUNDARY` allowlist, never via
+suppressions.
+
+Suppressions (both REQUIRE a non-empty reason or they are inert,
+graftlint's escape semantics):
+
+- ``# graftsync: lockstep-ok(<reason>)`` on the finding line suppresses
+  any GS rule there;
+- ``# graftsync: ignore[GS101](<reason>)`` suppresses only the named
+  rule(s).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from dataclasses import dataclass
+
+from tools.graftlint.core import (Finding, Project, SourceFile,  # noqa: F401
+                                  dotted_name, load_project, read_baseline,
+                                  split_new, stale_entries, write_baseline)
+from tools.graftflow.core import (FnInfo, FnKey,  # noqa: F401
+                                  collect_functions, literal_strdict,
+                                  local_aliases, resolve_call)
+
+BASELINE_NAME = "graftsync_baseline.txt"
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*graftsync:\s*"
+    r"(?:(lockstep-ok)|ignore\[([A-Z]+\d+(?:\s*,\s*[A-Z]+\d+)*)\])"
+    r"\(([^)]*)\)"
+)
+
+
+def suppressed(sf: SourceFile, rule: str, line: int) -> bool:
+    """Whether ``rule`` is suppressed on ``line`` (trailing comment, or a
+    standalone comment directly above).  A suppression with an EMPTY
+    reason is deliberately inert: accepted nondeterminism must say why it
+    is lockstep-safe."""
+    for m in _SUPPRESS_RE.finditer(sf._comment_for(line)):
+        if not m.group(3).strip():
+            continue  # reasonless suppressions don't count
+        if m.group(1):
+            return True
+        if rule in re.split(r"\s*,\s*", m.group(2)):
+            return True
+    return False
+
+
+# -- scope / registries ------------------------------------------------------
+
+# The lockstep contract binds the ENGINE layer: everything under
+# runtime/ (scheduler policy, batcher mechanism, engine entry).  The
+# gateway/fleet layer (server, router, cluster/) runs per-process by
+# design — its clocks never cross a mesh — but server.py/router.py live
+# in runtime/ and their functions are simply never reachable from a
+# declared decision, so the closure keeps them out naturally.  Matching
+# is by path segment so the self-test fixture trees (pkg/runtime/...)
+# land in scope exactly like the real package.
+SCOPE_SEGMENT = "runtime/"
+
+# The registry module and the three dict[str, str] literals graftsync
+# reads from it (parsed with graftlint's registry parser, so the tools
+# can never disagree on what a registry contains).
+REGISTRY_MODULE = "runtime/scheduler.py"
+DECISIONS_NAME = "LOCKSTEP_DECISIONS"
+SYNC_SITES_NAME = "HOST_SYNC_SITES"
+HOOKS_NAME = "HOOKS"
+
+# Metrics/logging boundary: calls through these attribute names are
+# observability plumbing — their return value is None and nothing they
+# compute feeds back into a decision, so (a) taint traversal never
+# descends into them and (b) a clock read that only feeds their
+# arguments (``METRICS.observe("...", time.perf_counter() - t0)``) is
+# exempt BY ALLOWLIST, not by suppression.  This is the "metrics/timer
+# reads stay exempt" half of the lockstep clock policy.
+METRICS_BOUNDARY = frozenset({
+    "inc", "observe", "set_gauge", "set_gauges",
+    "info", "debug", "warning", "error", "exception", "log",
+})
+
+
+def scope_files(project: Project) -> list[SourceFile]:
+    return [sf for sf in project.package_files() if SCOPE_SEGMENT in sf.rel]
+
+
+def registry_file(files: list[SourceFile]) -> SourceFile | None:
+    return next((f for f in files if f.rel.endswith(REGISTRY_MODULE)), None)
+
+
+def load_registries(project: Project) -> tuple[
+        SourceFile | None, dict[str, str], dict[str, str], dict[str, str]]:
+    """-> (registry file, LOCKSTEP_DECISIONS, HOST_SYNC_SITES, HOOKS)."""
+    reg = registry_file(scope_files(project))
+    if reg is None:
+        return None, {}, {}, {}
+    return (reg,
+            literal_strdict(reg, DECISIONS_NAME) or {},
+            literal_strdict(reg, SYNC_SITES_NAME) or {},
+            literal_strdict(reg, HOOKS_NAME) or {})
+
+
+def module_stem(rel: str) -> str:
+    return rel.rsplit("/", 1)[-1].removesuffix(".py")
+
+
+def subclass_closure(files: list[SourceFile]) -> dict[str, set[str]]:
+    """class name -> {itself + every (transitive) AST-visible subclass} —
+    a registry entry on ``Scheduler.admission_order`` must also bind the
+    MixedScheduler/TenantScheduler/SpecMixedScheduler overrides, or a
+    subclass override would silently leave the audit."""
+    bases: dict[str, set[str]] = {}
+    for sf in files:
+        for node in sf.tree.body:
+            if isinstance(node, ast.ClassDef):
+                bases[node.name] = {
+                    b.id for b in node.bases if isinstance(b, ast.Name)
+                }
+    out: dict[str, set[str]] = {c: {c} for c in bases}
+    changed = True
+    while changed:
+        changed = False
+        for cls, bs in bases.items():
+            for b in bs:
+                for root, members in out.items():
+                    if b in members and cls not in members:
+                        members.add(cls)
+                        changed = True
+    return out
+
+
+def entry_functions(entry: str, fns: dict[FnKey, FnInfo],
+                    subclasses: dict[str, set[str]]) -> list[FnKey]:
+    """Functions a registry entry ``"Owner.name"`` binds: the method on
+    the named class AND on every subclass that overrides it, or the
+    module-level function when ``Owner`` is a module stem."""
+    owner, _, name = entry.rpartition(".")
+    if not owner:
+        return []
+    classes = subclasses.get(owner, {owner})
+    out = [k for k in fns
+           if k.name == name and k.cls is not None and k.cls in classes]
+    out += [k for k in fns
+            if k.name == name and k.cls is None
+            and module_stem(k.rel) == owner]
+    return out
+
+
+def in_sync_sites(key: FnKey, sync_sites: dict[str, str]) -> bool:
+    """Whether ``key`` is a declared host-sync site ("Class.method" or
+    "module_stem.function")."""
+    owner = key.cls if key.cls is not None else module_stem(key.rel)
+    return f"{owner}.{key.name}" in sync_sites
+
+
+# -- nondeterminism sources --------------------------------------------------
+
+_SOURCE_DOTTED = frozenset({
+    "time.time", "time.time_ns",
+    "time.perf_counter", "time.perf_counter_ns",
+    "time.monotonic", "time.monotonic_ns", "time.process_time",
+    "datetime.now", "datetime.utcnow", "datetime.datetime.now",
+    "datetime.datetime.utcnow",
+    "os.urandom", "os.getenv", "os.environ.get",
+    "uuid.uuid1", "uuid.uuid4",
+})
+# jax.random is KEYED (deterministic given the carried key) and is the
+# sanctioned way to sample — only the stdlib/numpy global-state RNGs are
+# nondeterminism.
+_SOURCE_PREFIXES = ("random.", "np.random.", "numpy.random.", "secrets.")
+_SOURCE_BUILTINS = frozenset({"id", "hash"})
+_SOURCE_ATTRS = frozenset({"as_completed"})  # future completion order
+
+
+def source_name(call: ast.Call) -> str | None:
+    """The nondeterminism source a call reads, or None."""
+    name = dotted_name(call.func)
+    if name in _SOURCE_DOTTED:
+        return name
+    if name is not None and name.startswith(_SOURCE_PREFIXES):
+        return name
+    if isinstance(call.func, ast.Name) and call.func.id in _SOURCE_BUILTINS:
+        return call.func.id
+    if isinstance(call.func, ast.Attribute) \
+            and call.func.attr in _SOURCE_ATTRS:
+        return f"<..>.{call.func.attr}"
+    return None
+
+
+def env_subscript(node: ast.AST) -> str | None:
+    """``os.environ[...]`` reads (a Subscript, not a Call)."""
+    if isinstance(node, ast.Subscript) \
+            and dotted_name(node.value) == "os.environ":
+        return "os.environ[]"
+    return None
+
+
+def metrics_nested_calls(fn: ast.AST) -> set[int]:
+    """ids of AST nodes nested inside a METRICS_BOUNDARY call's
+    arguments (the allowlisted positions for clock/source reads)."""
+    out: set[int] = set()
+    for node in ast.walk(fn):
+        if (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in METRICS_BOUNDARY):
+            for arg in node.args + [kw.value for kw in node.keywords]:
+                for sub in ast.walk(arg):
+                    out.add(id(sub))
+    return out
+
+
+# -- decision closure --------------------------------------------------------
+
+@dataclass(frozen=True)
+class ClosureEntry:
+    entry: FnKey        # the declared decision fn this one is reachable from
+    declared: str       # the LOCKSTEP_DECISIONS key that declared it
+
+
+def _resolve(call: ast.Call, caller: FnKey, aliases: dict[str, str],
+             fns: dict[FnKey, FnInfo],
+             sched_classes: set[str]) -> list[FnKey]:
+    """graftflow's call resolution plus the one edge graftsync needs that
+    the collaborator map doesn't carry: ``self.sched.<hook>()`` — the
+    batcher's policy field fans out to EVERY scheduler class (the
+    concrete policy is chosen at runtime)."""
+    out = resolve_call(call, caller, aliases, fns)
+    f = call.func
+    if (isinstance(f, ast.Attribute) and isinstance(f.value, ast.Attribute)
+            and isinstance(f.value.value, ast.Name)
+            and f.value.value.id == "self" and f.value.attr == "sched"):
+        out += [k for k in fns
+                if k.name == f.attr and k.cls in sched_classes]
+    return out
+
+
+def decision_closure(project: Project) -> tuple[
+        dict[FnKey, FnInfo], dict[FnKey, ClosureEntry], dict[str, str]]:
+    """-> (all scope functions, {reachable fn: its declaring entry},
+    LOCKSTEP_DECISIONS).  The closure is every declared decision function
+    plus its transitive callees (graftflow's under-approximating call
+    resolution: a missed edge can hide a finding, never invent one),
+    minus the metrics/logging boundary, which taint never crosses."""
+    files = scope_files(project)
+    fns = collect_functions(files)
+    _, decisions, _, _ = load_registries(project)
+    subclasses = subclass_closure(files)
+    sched_classes = subclasses.get("Scheduler", set())
+
+    closure: dict[FnKey, ClosureEntry] = {}
+    work: list[tuple[FnKey, FnKey, str]] = []
+    for declared in decisions:
+        for k in entry_functions(declared, fns, subclasses):
+            work.append((k, k, declared))
+    # Deterministic attribution: sort, then a function is scanned once
+    # for the first entry that reached it.
+    work.sort(key=lambda t: (t[0].rel, t[0].cls or "", t[0].name, t[2]),
+              reverse=True)
+    while work:
+        key, entry, declared = work.pop()
+        if key in closure or key not in fns:
+            continue
+        closure[key] = ClosureEntry(entry=entry, declared=declared)
+        info = fns[key]
+        aliases = local_aliases(info.node)
+        for node in ast.walk(info.node):
+            if not isinstance(node, ast.Call):
+                continue
+            if (isinstance(node.func, ast.Attribute)
+                    and node.func.attr in METRICS_BOUNDARY):
+                continue  # observability boundary: taint never crosses
+            for callee in _resolve(node, key, aliases, fns, sched_classes):
+                if callee not in closure:
+                    work.append((callee, entry, declared))
+    return fns, closure, decisions

@@ -1,0 +1,95 @@
+"""GSD01 — README rule-table drift.
+
+The README's "Lockstep determinism" section carries a generated table of
+the GS rule families between ``<!-- graftsync:rules:begin/end -->``
+markers (the graftlint/graftcheck/graftflow convention): ``python -m
+tools.graftsync --write-docs`` regenerates it, and GSD01 fails the gate
+when the table diverges from :data:`RULE_DOCS` — the one place each
+rule's one-line contract lives.
+"""
+
+from __future__ import annotations
+
+import re
+from pathlib import Path
+
+from .core import Finding
+
+RULE_DRIFT = "GSD01"
+
+# rule id -> (family, one-line contract).  The README table renders from
+# this dict; keep entries in rule order.
+RULE_DOCS: dict[str, tuple[str, str]] = {
+    "GS101": ("GS1 lockstep taint",
+              "no nondeterminism source (wall clock, random/urandom/uuid/"
+              "secrets, id()/hash(), env read, future completion order) "
+              "reachable from a LOCKSTEP_DECISIONS function over the call "
+              "graph; HOST_SYNC_SITES functions and metrics-argument "
+              "reads are the two structural exemptions"),
+    "GS201": ("GS2 host syncs",
+              "every jax.device_get / block_until_ready in runtime/ sits "
+              "in a declared HOST_SYNC_SITES function — adding a "
+              "host<->device sync is a reviewed registry line, never an "
+              "accident the overlap plane silently pays for"),
+    "GS301": ("GS3 set ordering",
+              "no ordered iteration (for / list-comprehension / list()) "
+              "over an unordered set inside the decision closure — set "
+              "order diverges across lockstep processes; sorted() and "
+              "set-producing comprehensions are clean"),
+    "GS401": ("GS4 registry drift",
+              "every LOCKSTEP_DECISIONS / HOST_SYNC_SITES entry names a "
+              "function something in scope declares"),
+    "GS402": ("GS4 registry drift",
+              "every scheduler HOOKS entry has a LOCKSTEP_DECISIONS "
+              "declaration — a new hook enters the lockstep audit in the "
+              "same PR"),
+}
+
+_MARKER_RE = re.compile(
+    r"<!-- graftsync:rules:begin -->\n(.*?)<!-- graftsync:rules:end -->",
+    re.S,
+)
+
+
+def render_table() -> str:
+    lines = ["| rule | family | checks |", "| --- | --- | --- |"]
+    lines += [f"| {rule} | {fam} | {doc} |"
+              for rule, (fam, doc) in RULE_DOCS.items()]
+    return "\n".join(lines)
+
+
+def check_docs(root: Path) -> list[Finding]:
+    readme = root / "README.md"
+    if not readme.exists():
+        return []
+    text = readme.read_text(encoding="utf-8")
+    m = _MARKER_RE.search(text)
+    if m is None:
+        return [Finding(
+            RULE_DRIFT, "README.md", 1,
+            "missing '<!-- graftsync:rules:begin/end -->' block — run "
+            "python -m tools.graftsync --write-docs",
+        )]
+    if m.group(1).strip() != render_table().strip():
+        line = text[: m.start()].count("\n") + 1
+        return [Finding(
+            RULE_DRIFT, "README.md", line,
+            "GS rules table is stale vs tools/graftsync/docs.py — run "
+            "python -m tools.graftsync --write-docs",
+        )]
+    return []
+
+
+def write_docs(root: Path) -> bool:
+    readme = root / "README.md"
+    if not readme.exists():
+        return False
+    text = readme.read_text(encoding="utf-8")
+    if _MARKER_RE.search(text) is None:
+        return False
+    block = (f"<!-- graftsync:rules:begin -->\n{render_table()}\n"
+             f"<!-- graftsync:rules:end -->")
+    # Callable replacement: table text must never be read as re escapes.
+    readme.write_text(_MARKER_RE.sub(lambda _m: block, text),
+                      encoding="utf-8")
+    return True

@@ -1,0 +1,55 @@
+"""GS4xx — registry drift.
+
+The two graftsync registries in ``runtime/scheduler.py`` are only worth
+trusting if they cannot rot — the GL305/GF103 lesson applied to lockstep
+state:
+
+- **GS401**: a ``LOCKSTEP_DECISIONS`` or ``HOST_SYNC_SITES`` entry names
+  a function nothing in scope declares (renamed method, deleted helper)
+  — a dead entry reads as audited coverage that no longer exists;
+- **GS402**: a scheduler ``HOOKS`` entry with no ``LOCKSTEP_DECISIONS``
+  declaration — every hook IS a lockstep decision surface by
+  construction (the batcher delegates a scheduling choice through it),
+  so a newly added hook must enter the audit in the same PR, not stay
+  prose-checked.
+"""
+
+from __future__ import annotations
+
+from .core import (Finding, Project, collect_functions, entry_functions,
+                   load_registries, scope_files, subclass_closure)
+
+RULE_DEAD = "GS401"
+RULE_HOOK = "GS402"
+
+
+def check(project: Project) -> list[Finding]:
+    reg, decisions, sync_sites, hooks = load_registries(project)
+    if reg is None:
+        return []
+    files = scope_files(project)
+    fns = collect_functions(files)
+    subclasses = subclass_closure(files)
+    findings: list[Finding] = []
+    for reg_name, registry in ((
+            "LOCKSTEP_DECISIONS", decisions), ("HOST_SYNC_SITES",
+                                               sync_sites)):
+        for entry in sorted(registry):
+            if not entry_functions(entry, fns, subclasses):
+                findings.append(Finding(
+                    RULE_DEAD, reg.rel, 1,
+                    f"{reg_name} entry '{entry}' names a function nothing "
+                    f"in scope declares — registry drift (rename/delete "
+                    f"must update the registry in the same PR)",
+                ))
+    declared_methods = {e.rpartition(".")[2] for e in decisions}
+    for hook in sorted(hooks):
+        if hook not in declared_methods:
+            findings.append(Finding(
+                RULE_HOOK, reg.rel, 1,
+                f"scheduler hook '{hook}' (HOOKS) has no "
+                f"LOCKSTEP_DECISIONS entry — every hook is a lockstep "
+                f"decision surface; declare it so the taint audit "
+                f"covers it",
+            ))
+    return findings

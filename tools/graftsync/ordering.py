@@ -1,0 +1,187 @@
+"""GS3xx — unordered-iteration audit on state feeding decisions.
+
+Python ``set`` iteration order depends on insertion history, hash values,
+and (for strings) PYTHONHASHSEED — all of which diverge across lockstep
+processes.  A ``for t in some_set`` on a decision path can therefore pick
+a different tenant / victim / trigger per process even when every process
+holds the SAME set.  Dicts are insertion-ordered, so dict iteration is
+deterministic whenever the insertions were (the taint and this audit
+together cover that); sets never are.
+
+**GS301**: iteration over a set-typed expression inside the lockstep
+decision closure — a ``for`` loop, a list/generator/dict-comprehension
+generator, or a ``list()``/``tuple()``/``enumerate()``/``reversed()``
+materialization.  Set-typedness is inferred syntactically: set literals,
+set comprehensions, ``set()``/``frozenset()`` calls, set-algebra
+``|&^-`` of set-typed operands, locals assigned from them, and ``self``
+attributes a class (or its bases) assigns a set anywhere.
+
+Deliberately NOT flagged:
+
+- ``sorted(some_set)`` — sorting is the fix; the result is a list;
+- SET comprehensions over a set (``{t for t in s}``): the produced value
+  is again order-insensitive — only an ORDERED materialization of a set
+  is a hazard;
+- order-insensitive reductions (``min``/``max``/``sum``/``any``/``all``)
+  — ties in ``min``/``max`` keyed selection still break by iteration
+  order, so prefer ``sorted`` there too, but flagging every reduction
+  would drown the signal.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .core import Finding, Project, decision_closure, suppressed
+
+RULE_ORDER = "GS301"
+
+_SET_ANN = ("set", "Set", "frozenset", "FrozenSet")
+_MATERIALIZERS = frozenset({"list", "tuple", "enumerate", "reversed"})
+_NEUTRAL = frozenset({"sorted", "min", "max", "sum", "any", "all", "len",
+                      "bool", "frozenset", "set"})
+
+
+def _ann_is_set(ann: ast.expr | None) -> bool:
+    if ann is None:
+        return False
+    base = ann.value if isinstance(ann, ast.Subscript) else ann
+    name = base.id if isinstance(base, ast.Name) else \
+        base.attr if isinstance(base, ast.Attribute) else ""
+    return name in _SET_ANN
+
+
+def class_set_attrs(files) -> dict[str, set[str]]:
+    """class name -> self attributes assigned (or annotated) a set
+    anywhere in the class body, closed over AST-visible bases."""
+    direct: dict[str, set[str]] = {}
+    bases: dict[str, set[str]] = {}
+    for sf in files:
+        for node in sf.tree.body:
+            if not isinstance(node, ast.ClassDef):
+                continue
+            bases[node.name] = {
+                b.id for b in node.bases if isinstance(b, ast.Name)
+            }
+            attrs = direct.setdefault(node.name, set())
+            for sub in ast.walk(node):
+                tgt, val, ann = None, None, None
+                if isinstance(sub, ast.Assign) and len(sub.targets) == 1:
+                    tgt, val = sub.targets[0], sub.value
+                elif isinstance(sub, ast.AnnAssign):
+                    tgt, val, ann = sub.target, sub.value, sub.annotation
+                if not (isinstance(tgt, ast.Attribute)
+                        and isinstance(tgt.value, ast.Name)
+                        and tgt.value.id == "self"):
+                    continue
+                if _ann_is_set(ann) or (val is not None
+                                        and _expr_is_set(val, set(), set())):
+                    attrs.add(tgt.attr)
+    # Close over bases (a subclass method iterating a base-class set).
+    out: dict[str, set[str]] = {}
+
+    def resolve(cls: str, seen: frozenset = frozenset()) -> set[str]:
+        if cls in out:
+            return out[cls]
+        if cls in seen:
+            return direct.get(cls, set())
+        got = set(direct.get(cls, set()))
+        for b in bases.get(cls, ()):
+            got |= resolve(b, seen | {cls})
+        out[cls] = got
+        return got
+
+    for cls in list(direct):
+        resolve(cls)
+    return out
+
+
+def _expr_is_set(expr: ast.expr, local_sets: set[str],
+                 attr_sets: set[str]) -> bool:
+    if isinstance(expr, (ast.Set, ast.SetComp)):
+        return True
+    if isinstance(expr, ast.Call) and isinstance(expr.func, ast.Name) \
+            and expr.func.id in ("set", "frozenset"):
+        return True
+    if isinstance(expr, ast.BinOp) \
+            and isinstance(expr.op, (ast.BitOr, ast.BitAnd, ast.BitXor,
+                                     ast.Sub)):
+        return (_expr_is_set(expr.left, local_sets, attr_sets)
+                or _expr_is_set(expr.right, local_sets, attr_sets))
+    if isinstance(expr, ast.IfExp):
+        return (_expr_is_set(expr.body, local_sets, attr_sets)
+                or _expr_is_set(expr.orelse, local_sets, attr_sets))
+    if isinstance(expr, ast.Name):
+        return expr.id in local_sets
+    if isinstance(expr, ast.Attribute) and isinstance(expr.value, ast.Name) \
+            and expr.value.id == "self":
+        return expr.attr in attr_sets
+    return False
+
+
+def _local_sets(fn: ast.AST, attr_sets: set[str]) -> set[str]:
+    """Locals assigned a set-typed value (two passes: x = set(); y = x)."""
+    out: set[str] = set()
+    for _ in range(2):
+        for node in ast.walk(fn):
+            if (isinstance(node, ast.Assign) and len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Name)
+                    and _expr_is_set(node.value, out, attr_sets)):
+                out.add(node.targets[0].id)
+            elif (isinstance(node, ast.AnnAssign)
+                    and isinstance(node.target, ast.Name)
+                    and _ann_is_set(node.annotation)):
+                out.add(node.target.id)
+    return out
+
+
+def check(project: Project) -> list[Finding]:
+    from .core import scope_files
+
+    files = scope_files(project)
+    fns, closure, _ = decision_closure(project)
+    attr_map = class_set_attrs(files)
+    findings: list[Finding] = []
+    seen: set[tuple[str, int]] = set()
+    for key, ce in closure.items():
+        info = fns[key]
+        attr_sets = attr_map.get(key.cls or "", set())
+        local_sets = _local_sets(info.node, attr_sets)
+
+        def is_set(e: ast.expr) -> bool:
+            return _expr_is_set(e, local_sets, attr_sets)
+
+        hits: list[tuple[int, str]] = []
+        for node in ast.walk(info.node):
+            if isinstance(node, ast.For) and is_set(node.iter):
+                hits.append((node.lineno, "for loop"))
+            elif isinstance(node, (ast.ListComp, ast.GeneratorExp,
+                                   ast.DictComp)):
+                # A SetComp's product is order-insensitive; these are not.
+                for gen in node.generators:
+                    if is_set(gen.iter):
+                        hits.append((node.lineno, "comprehension"))
+            elif (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Name)
+                    and node.func.id in _MATERIALIZERS
+                    and node.args and is_set(node.args[0])):
+                hits.append((node.lineno, f"{node.func.id}()"))
+        for line, how in hits:
+            site = (info.sf.rel, line)
+            if site in seen:
+                continue
+            seen.add(site)
+            if suppressed(info.sf, RULE_ORDER, line):
+                continue
+            via = ("" if key == ce.entry else f" in {key.pretty()}")
+            findings.append(Finding(
+                RULE_ORDER, info.sf.rel, line,
+                f"ordered iteration over an unordered set ({how}){via} "
+                f"feeds the lockstep decision "
+                f"{ce.entry.pretty()} (LOCKSTEP_DECISIONS "
+                f"'{ce.declared}') — set order diverges across processes "
+                f"(PYTHONHASHSEED / insertion history); iterate "
+                f"sorted(...) or keep the state in an insertion-ordered "
+                f"dict/list",
+            ))
+    return sorted(findings, key=lambda f: (f.path, f.line))

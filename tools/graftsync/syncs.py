@@ -1,0 +1,78 @@
+"""GS2xx — undeclared host↔device sync points.
+
+The dispatch-ahead engine loop earns its overlap by syncing host↔device
+at EXACTLY the declared boundaries: ``_fetch_chunk`` (one batched D2H per
+chunk), ``_sync_carry`` (span exit), ``_decode_span``'s automaton
+read-back, ``register_prefix``, and the engine's ``_to_host``.  A future
+PR that drops a stray ``jax.device_get`` into a helper adds a silent
+per-call host round-trip the whole overlap plane then pays for — the
+exact regression class the ``decode-overlap`` bench row exists to
+surface, caught here before it ships.
+
+**GS201**: a ``jax.device_get(...)`` / ``jax.block_until_ready(...)`` /
+``<arr>.block_until_ready()`` call in ``runtime/`` whose enclosing
+function is not declared in the ``HOST_SYNC_SITES`` registry
+(``runtime/scheduler.py``).  Declaring a new site is one registry line —
+the point is that adding a sync is a REVIEWED decision, not an accident.
+
+Module-level sync calls (outside any function) are attributed to the
+pseudo-function ``<module>`` and always flagged: import-time device work
+is never a sanctioned sync point.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .core import (Finding, FnKey, Project, collect_functions, dotted_name,
+                   in_sync_sites, load_registries, scope_files, suppressed)
+
+RULE_SYNC = "GS201"
+
+_SYNC_DOTTED = frozenset({"jax.device_get", "jax.block_until_ready"})
+_SYNC_METHODS = frozenset({"block_until_ready"})
+
+
+def _sync_name(call: ast.Call) -> str | None:
+    name = dotted_name(call.func)
+    if name in _SYNC_DOTTED:
+        return name
+    if isinstance(call.func, ast.Attribute) \
+            and call.func.attr in _SYNC_METHODS:
+        return f"<..>.{call.func.attr}"
+    return None
+
+
+def check(project: Project) -> list[Finding]:
+    files = scope_files(project)
+    fns = collect_functions(files)
+    _, _, sync_sites, _ = load_registries(project)
+    findings: list[Finding] = []
+    for sf in files:
+        owner_of: dict[int, FnKey] = {}
+        for key, info in fns.items():
+            if info.sf is not sf:
+                continue
+            for sub in ast.walk(info.node):
+                owner_of[id(sub)] = key
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            what = _sync_name(node)
+            if what is None:
+                continue
+            key = owner_of.get(id(node))
+            if key is not None and in_sync_sites(key, sync_sites):
+                continue
+            if suppressed(sf, RULE_SYNC, node.lineno):
+                continue
+            where = key.pretty() if key is not None else "<module>"
+            findings.append(Finding(
+                RULE_SYNC, sf.rel, node.lineno,
+                f"host<->device sync '{what}' in {where} is not a "
+                f"declared sync site — every device_get/block_until_ready "
+                f"the engine pays must be a reviewed HOST_SYNC_SITES "
+                f"entry (runtime/scheduler.py), or the overlap plane "
+                f"silently grows a per-call round-trip",
+            ))
+    return sorted(findings, key=lambda f: (f.path, f.line))
