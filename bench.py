@@ -2885,7 +2885,7 @@ def _measure_compile_stability() -> dict:
 def _measure_analysis_wall() -> dict:
     """Wall time of the full tier-1 static-analysis gate (graftlint AST +
     graftcheck abstract tracing + graftflow CFG/dataflow + graftsync
-    lockstep taint), each run as a
+    lockstep taint + graftmodel protocol model checking), each run as a
     fresh subprocess the way the pytest gates pay for it.  The gate's
     cost must stay visible in BASELINE.md: every PR adds rules, and a
     multi-minute gate is a gate people stop running.  Each tool must
@@ -2897,7 +2897,8 @@ def _measure_analysis_wall() -> dict:
     env = {**os.environ, "JAX_PLATFORMS": "cpu"}
     out: dict = {"platform": jax.devices()[0].platform}
     total = 0.0
-    for tool in ("graftlint", "graftcheck", "graftflow", "graftsync"):
+    for tool in ("graftlint", "graftcheck", "graftflow", "graftsync",
+                 "graftmodel"):
         t0 = time.perf_counter()
         r = subprocess.run(
             [sys.executable, "-m", f"tools.{tool}", "--root", repo],
@@ -3446,7 +3447,8 @@ def run_ladder(args, degraded: str | None) -> list[dict]:
         # tracing, meaningful on any platform.
         ("compile-stability", _measure_compile_stability),
         # Static-analysis gate wall time (graftlint + graftcheck +
-        # graftflow + graftsync as subprocesses): the tier-1 gate's own
+        # graftflow + graftsync + graftmodel as subprocesses): the tier-1
+        # gate's own
         # cost, stamped
         # so rule growth that slows every CI run shows in the trajectory.
         ("analysis-wall", _measure_analysis_wall),

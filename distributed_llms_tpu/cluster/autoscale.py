@@ -61,6 +61,114 @@ from ..runtime.faults import InjectedFault
 log = get_logger("autoscale")
 
 
+# Machine-readable transition system for one autoscaled tier plus the
+# epoch-keyed placement directory, declared next to the code it models
+# (PROTOCOL_MODELS["fleet.autoscale"], runtime/faults.py).  ``python -m
+# tools.graftmodel`` explores every interleaving of tick-driven streak /
+# hysteresis / cooldown decisions, an in-flight graceful drain, load
+# shifts, directory lookups, and the declared fleet.scale_up /
+# fleet.scale_down fault actions, checking GM4 on every reachable
+# state: the tier stays within [MIN, MAX], every scale-down goes
+# through a drain (downs == drains — no abrupt leg), a drain only runs
+# above the floor, and a stale directory entry is dropped at lookup,
+# never served.  ``t``/``lc``/``lq`` are tick / load-shift / lookup
+# budgets bounding the exploration; cooldown clears on the next tick
+# after any action (including a vetoed one).
+AUTOSCALE_MODEL = {
+    "name": "fleet.autoscale",
+    "doc": "tiered autoscaler: hysteresis + cooldown, graceful-drain-only "
+           "downs within [MIN, MAX], epoch-stale directory entries "
+           "dropped at lookup",
+    "params": {"MIN": 1, "MAX": 3, "K": 2, "TMAX": 7, "LCMAX": 3,
+               "LQ": 3},
+    "state": {"n": 1, "load": 1, "up_s": 0, "down_s": 0, "cool": 0,
+              "drain": 0, "t": 0, "lc": 0, "lq": 0, "stale": 0,
+              "downs": 0, "drains": 0, "fails": 0, "stale_drops": 0},
+    "actions": [
+        # One tick per load level: streaks grow under sustained signal,
+        # reset on the opposite signal, and the mid band resets both
+        # (hysteresis).  Every tick retires the cooldown.
+        {"name": "tick_high", "guard": "t < TMAX and load == 2",
+         "update": {"t": "t + 1", "cool": "0", "down_s": "0",
+                    "up_s": "up_s + 1 if up_s < K else up_s"}},
+        {"name": "tick_mid", "guard": "t < TMAX and load == 1",
+         "update": {"t": "t + 1", "cool": "0", "up_s": "0",
+                    "down_s": "0"}},
+        {"name": "tick_low", "guard": "t < TMAX and load == 0",
+         "update": {"t": "t + 1", "cool": "0", "up_s": "0",
+                    "down_s": "down_s + 1 if down_s < K else down_s"}},
+        {"name": "scale_up",
+         "guard": "load == 2 and up_s >= K and cool == 0 and drain == 0 "
+                  "and n < MAX",
+         "update": {"n": "n + 1", "up_s": "0", "cool": "1",
+                    "stale": "1"}},
+        # The ONLY way down: pick a routable victim, drain it
+        # gracefully, then retire it.
+        {"name": "drain_start",
+         "guard": "load == 0 and down_s >= K and cool == 0 and drain == 0 "
+                  "and n > MIN",
+         "update": {"drain": "1", "down_s": "0"}},
+        {"name": "drain_done", "guard": "drain == 1",
+         "update": {"drain": "0", "n": "n - 1", "cool": "1", "stale": "1",
+                    "downs": "downs + 1", "drains": "drains + 1"}},
+        {"name": "load_shift_up", "guard": "lc < LCMAX and load < 2",
+         "update": {"load": "load + 1", "lc": "lc + 1"}},
+        {"name": "load_shift_down", "guard": "lc < LCMAX and load > 0",
+         "update": {"load": "load - 1", "lc": "lc + 1"}},
+        # Epoch-keyed directory: scale actions bump the fleet epoch; a
+        # lookup against a stale epoch is DROPPED (counted, recompute),
+        # never served; a refresh catches the directory up.
+        {"name": "dir_refresh", "guard": "stale == 1",
+         "update": {"stale": "0"}},
+        {"name": "lookup_fresh", "guard": "stale == 0 and lq < LQ",
+         "update": {"lq": "lq + 1"}},
+        {"name": "lookup_stale_drop", "guard": "stale == 1 and lq < LQ",
+         "update": {"lq": "lq + 1", "stale_drops": "stale_drops + 1"}},
+    ],
+    "faults": [
+        # Failed provision: degrade cleanly — size kept, failure
+        # counted, cooldown armed so the retry waits a tick.
+        {"name": "up_raise", "site": "fleet.scale_up", "action": "raise",
+         "metric": "autoscale.decode.scale_failures",
+         "guard": "load == 2 and up_s >= K and cool == 0 and drain == 0 "
+                  "and n < MAX",
+         "update": {"up_s": "0", "cool": "1", "fails": "fails + 1"}},
+        {"name": "up_drop", "site": "fleet.scale_up", "action": "drop",
+         "metric": "autoscale.decode.scale_failures",
+         "guard": "load == 2 and up_s >= K and cool == 0 and drain == 0 "
+                  "and n < MAX",
+         "update": {"up_s": "0", "cool": "1", "fails": "fails + 1"}},
+        # Vetoed drain: the fleet keeps its size — there is no abrupt
+        # scale-down leg to fall back to.
+        {"name": "down_raise", "site": "fleet.scale_down", "action": "raise",
+         "metric": "autoscale.decode.scale_failures",
+         "guard": "load == 0 and down_s >= K and cool == 0 and drain == 0 "
+                  "and n > MIN",
+         "update": {"down_s": "0", "cool": "1", "fails": "fails + 1"}},
+        {"name": "down_drop", "site": "fleet.scale_down", "action": "drop",
+         "metric": "autoscale.decode.scale_failures",
+         "guard": "load == 0 and down_s >= K and cool == 0 and drain == 0 "
+                  "and n > MIN",
+         "update": {"down_s": "0", "cool": "1", "fails": "fails + 1"}},
+    ],
+    "invariants": [
+        {"rule": "GM4", "name": "size-within-bounds",
+         "expr": "MIN <= n <= MAX"},
+        {"rule": "GM4", "name": "downs-only-via-drain",
+         "expr": "downs == drains"},
+        {"rule": "GM4", "name": "drain-only-above-floor",
+         "expr": "drain == 0 or n > MIN"},
+        {"rule": "GM4", "name": "streaks-bounded",
+         "expr": "up_s <= K and down_s <= K"},
+        {"rule": "GM4", "name": "stale-lookups-dropped-not-served",
+         "expr": "stale_drops <= lq"},
+    ],
+    # The budgets bound the run: stuck states are tick-exhausted (an
+    # in-flight drain can always finish, so none is pending here).
+    "terminal": "t >= TMAX and drain == 0",
+}
+
+
 class Autoscaler:
     """Control loop over a :class:`~.fleet.ReplicaFleet`.
 

@@ -66,6 +66,192 @@ _PERMANENT_NACKS = frozenset({"bad frame", "not a decode-role engine",
                               "pool shape mismatch"})
 
 
+# Machine-readable transition system for the KV handoff plane — one push
+# handoff (``send_kv_pages`` -> ``handle_kv_connection``) and one
+# cross-replica pull (directory lookup -> ``export`` -> the same receive
+# path) running concurrently, declared next to the code it models
+# (PROTOCOL_MODELS["cluster.kv_handoff"], runtime/faults.py).  ``python
+# -m tools.graftmodel`` explores every interleaving under the declared
+# xfer.send / xfer.recv / xfer.verify / prefill.crash / xfer.pull /
+# directory.lookup fault actions and checks GM3 on every reachable
+# state: adoption is at-most-once (first-writer-wins import), an acked
+# transfer was actually imported, and every fallback is counted exactly
+# once.  Vars per transfer: ``*_s`` sender phase (0 about to attempt,
+# 1 awaiting ack, 2 adopted+acked, 3 degraded to local/colocated
+# compute), ``*_att`` attempts used (<= ATT, the retry budget),
+# ``*_fly`` frames in flight (dup can make it 2), ``*_bad`` an
+# in-flight frame is corrupt, ``*_adopted`` receiver-side imports.
+# The pull adds ``p_dir``: 0 unresolved, 1 resolved to the right
+# sibling, 2 mis-steered (corrupt — the export finds nothing and the
+# frame never flies), 3 miss (drop — degrade immediately).
+HANDOFF_MODEL = {
+    "name": "cluster.kv_handoff",
+    "doc": "KV handoff + cross-replica pull: checksummed frames, bounded "
+           "retries, at-most-once adoption, per-reason counted fallback",
+    "params": {"ATT": 2},
+    "state": {"h_s": 0, "h_att": 0, "h_fly": 0, "h_bad": 0, "h_adopted": 0,
+              "p_dir": 0, "p_s": 0, "p_att": 0, "p_fly": 0, "p_bad": 0,
+              "p_adopted": 0, "fb": 0},
+    "actions": [
+        # -- push handoff ------------------------------------------------
+        {"name": "h_send", "guard": "h_s == 0 and h_att < ATT",
+         "update": {"h_s": "1", "h_att": "h_att + 1",
+                    "h_fly": "h_fly + 1"}},
+        {"name": "h_adopt",
+         "guard": "h_s == 1 and h_fly > h_bad "
+                  "and h_adopted == 0",
+         "update": {"h_s": "2", "h_fly": "h_fly - 1",
+                    "h_adopted": "h_adopted + 1"}},
+        # A late clean frame after the sender already degraded: the
+        # receiver imports it anyway (first-writer-wins cache insert,
+        # benign) — adoption must STILL be at-most-once.
+        {"name": "h_late_adopt",
+         "guard": "h_s == 3 and h_fly > h_bad "
+                  "and h_adopted == 0",
+         "update": {"h_fly": "h_fly - 1", "h_adopted": "h_adopted + 1"}},
+        {"name": "h_dup_absorb",
+         "guard": "h_fly > h_bad and h_adopted == 1",
+         "update": {"h_fly": "h_fly - 1",
+                    "h_s": "2 if h_s == 1 else h_s"}},
+        {"name": "h_nack_retry",
+         "guard": "h_s == 1 and h_fly > 0 and h_bad == 1 and h_att < ATT",
+         "update": {"h_s": "0", "h_fly": "h_fly - 1", "h_bad": "0"}},
+        {"name": "h_nack_exhaust",
+         "guard": "h_s == 1 and h_fly > 0 and h_bad == 1 "
+                  "and h_att >= ATT",
+         "update": {"h_s": "3", "h_fly": "h_fly - 1", "h_bad": "0",
+                    "fb": "fb + 1"}},
+        {"name": "h_nack_late",
+         "guard": "h_s != 1 and h_fly > 0 and h_bad == 1",
+         "update": {"h_fly": "h_fly - 1", "h_bad": "0"}},
+        {"name": "h_timeout_retry",
+         "guard": "h_s == 1 and h_fly == 0 and h_att < ATT",
+         "update": {"h_s": "0"}},
+        {"name": "h_timeout_exhaust",
+         "guard": "h_s == 1 and h_fly == 0 and h_att >= ATT",
+         "update": {"h_s": "3", "fb": "fb + 1"}},
+        # -- cross-replica pull ------------------------------------------
+        {"name": "p_lookup", "guard": "p_dir == 0", "update": {"p_dir": "1"}},
+        {"name": "p_miss_fallback", "guard": "p_dir == 3 and p_s == 0",
+         "update": {"p_s": "3", "fb": "fb + 1"}},
+        # A mis-steered pull ships no frame (the sibling exports
+        # nothing) — the attempt burns and the timeout path retries.
+        {"name": "p_send",
+         "guard": "p_s == 0 and p_att < ATT and p_dir in (1, 2)",
+         "update": {"p_s": "1", "p_att": "p_att + 1",
+                    "p_fly": "p_fly + (1 if p_dir == 1 else 0)"}},
+        {"name": "p_adopt",
+         "guard": "p_s == 1 and p_fly > p_bad "
+                  "and p_adopted == 0",
+         "update": {"p_s": "2", "p_fly": "p_fly - 1",
+                    "p_adopted": "p_adopted + 1"}},
+        {"name": "p_late_adopt",
+         "guard": "p_s == 3 and p_fly > p_bad "
+                  "and p_adopted == 0",
+         "update": {"p_fly": "p_fly - 1", "p_adopted": "p_adopted + 1"}},
+        {"name": "p_dup_absorb",
+         "guard": "p_fly > p_bad and p_adopted == 1",
+         "update": {"p_fly": "p_fly - 1",
+                    "p_s": "2 if p_s == 1 else p_s"}},
+        {"name": "p_nack_retry",
+         "guard": "p_s == 1 and p_fly > 0 and p_bad == 1 and p_att < ATT",
+         "update": {"p_s": "0", "p_fly": "p_fly - 1", "p_bad": "0"}},
+        {"name": "p_nack_exhaust",
+         "guard": "p_s == 1 and p_fly > 0 and p_bad == 1 "
+                  "and p_att >= ATT",
+         "update": {"p_s": "3", "p_fly": "p_fly - 1", "p_bad": "0",
+                    "fb": "fb + 1"}},
+        {"name": "p_nack_late",
+         "guard": "p_s != 1 and p_fly > 0 and p_bad == 1",
+         "update": {"p_fly": "p_fly - 1", "p_bad": "0"}},
+        {"name": "p_timeout_retry",
+         "guard": "p_s == 1 and p_fly == 0 and p_att < ATT",
+         "update": {"p_s": "0"}},
+        {"name": "p_timeout_exhaust",
+         "guard": "p_s == 1 and p_fly == 0 and p_att >= ATT",
+         "update": {"p_s": "3", "fb": "fb + 1"}},
+    ],
+    "faults": [
+        # Dropping the last in-flight frame clears the corrupt bit with
+        # it; with a duplicate still flying the clean copy is assumed
+        # dropped (the surviving bad frame still NACKs — conservative).
+        {"name": "h_send_drop", "site": "xfer.send", "action": "drop",
+         "metric": "router.handoff_fallbacks.timeout",
+         "guard": "h_s == 1 and h_fly > 0",
+         "update": {"h_fly": "h_fly - 1",
+                    "h_bad": "0 if h_fly == 1 else h_bad"}},
+        {"name": "h_send_corrupt", "site": "xfer.send", "action": "corrupt",
+         "metric": "router.handoff_fallbacks.verify",
+         "guard": "h_s == 1 and h_fly > 0 and h_bad == 0",
+         "update": {"h_bad": "1"}},
+        {"name": "h_send_dup", "site": "xfer.send", "action": "dup",
+         "metric": "faults.fired.dup",
+         "guard": "h_s == 1 and h_fly == 1",
+         "update": {"h_fly": "2"}},
+        {"name": "h_recv_drop", "site": "xfer.recv", "action": "drop",
+         "metric": "router.handoff_fallbacks.timeout",
+         "guard": "h_fly > 0",
+         "update": {"h_fly": "h_fly - 1",
+                    "h_bad": "0 if h_fly == 1 else h_bad"}},
+        {"name": "h_recv_corrupt", "site": "xfer.recv", "action": "corrupt",
+         "metric": "router.handoff_fallbacks.verify",
+         "guard": "h_fly > 0 and h_bad == 0",
+         "update": {"h_bad": "1"}},
+        {"name": "h_verify_corrupt", "site": "xfer.verify",
+         "action": "corrupt",
+         "metric": "router.handoff_fallbacks.verify",
+         "guard": "h_fly > 0 and h_bad == 0",
+         "update": {"h_bad": "1"}},
+        # The prefill replica dies mid-handoff; frames already on the
+        # wire still arrive at the receiver (late adoption, benign).
+        {"name": "h_prefill_crash", "site": "prefill.crash",
+         "action": "close",
+         "metric": "router.handoff_fallbacks.prefill_crash",
+         "guard": "h_s in (0, 1)",
+         "update": {"h_s": "3", "fb": "fb + 1"}},
+        {"name": "p_dir_drop", "site": "directory.lookup", "action": "drop",
+         "metric": "directory.pull_fallbacks.stale",
+         "guard": "p_dir == 0", "update": {"p_dir": "3"}},
+        {"name": "p_dir_corrupt", "site": "directory.lookup",
+         "action": "corrupt",
+         "metric": "directory.pull_fallbacks.empty",
+         "guard": "p_dir == 0", "update": {"p_dir": "2"}},
+        {"name": "p_pull_drop", "site": "xfer.pull", "action": "drop",
+         "metric": "directory.pull_fallbacks.refused",
+         "guard": "p_s == 1 and p_fly > 0",
+         "update": {"p_fly": "p_fly - 1",
+                    "p_bad": "0 if p_fly == 1 else p_bad"}},
+        {"name": "p_pull_corrupt", "site": "xfer.pull", "action": "corrupt",
+         "metric": "directory.pull_fallbacks.verify",
+         "guard": "p_s == 1 and p_fly > 0 and p_bad == 0",
+         "update": {"p_bad": "1"}},
+        {"name": "p_pull_dup", "site": "xfer.pull", "action": "dup",
+         "metric": "faults.fired.dup",
+         "guard": "p_s == 1 and p_fly == 1",
+         "update": {"p_fly": "2"}},
+    ],
+    "invariants": [
+        {"rule": "GM3", "name": "handoff-adopted-at-most-once",
+         "expr": "h_adopted <= 1"},
+        {"rule": "GM3", "name": "pull-adopted-at-most-once",
+         "expr": "p_adopted <= 1"},
+        {"rule": "GM3", "name": "handoff-ack-implies-import",
+         "expr": "h_s != 2 or h_adopted == 1"},
+        {"rule": "GM3", "name": "pull-ack-implies-import",
+         "expr": "p_s != 2 or p_adopted == 1"},
+        {"rule": "GM3", "name": "every-fallback-counted-once",
+         "expr": "fb == (h_s == 3) + (p_s == 3)"},
+        {"rule": "GM4", "name": "handoff-retries-bounded",
+         "expr": "h_att <= ATT"},
+        {"rule": "GM4", "name": "pull-retries-bounded",
+         "expr": "p_att <= ATT"},
+    ],
+    # Stuck only once both transfers settled: adopted+acked or degraded
+    # to the byte-exact local/colocated compute path.
+    "terminal": "h_s in (2, 3) and p_s in (2, 3)",
+}
+
+
 @dataclass
 class KVTransferPayload:
     """One transfer's content, independent of the wire encoding."""

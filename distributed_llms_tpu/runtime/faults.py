@@ -221,6 +221,76 @@ FAULT_SITES: dict[str, str] = {
 }
 
 
+# THE declared fault-action surface per site (FAULT_SITES-style: site ->
+# comma-joined actions).  Keys mirror FAULT_SITES exactly (graftmodel's
+# GM503 checks both directions); the value is the set of actions the call
+# site actually handles — the actions an operator can arm and a chaos
+# drill can exercise.  Two tools consume this registry: graftmodel's GM6
+# fails the gate when a declared site x action pair has no tier-1 drill
+# test (a declared fault nobody injects is an untested recovery path),
+# and graftmodel's GM501 pins every fault edge in a PROTOCOL_MODELS
+# transition system to a pair declared here.
+SITE_ACTIONS: dict[str, str] = {
+    "batcher.admit": "raise",
+    "batcher.decode": "raise,stall",
+    "batcher.page_alloc": "exhaust",
+    "batcher.preempt": "raise",
+    "batcher.spec_verify": "raise,stall",
+    "batcher.mixed_step": "raise,stall",
+    "proto.send": "close,delay",
+    "proto.recv": "drop",
+    "worker.heartbeat": "drop",
+    "worker.result": "close",
+    "worker.handle": "raise",
+    "coordinator.dispatch": "drop",
+    "router.place": "drop",
+    "replica.crash": "close",
+    "replica.stall": "delay",
+    "replica.partition": "drop",
+    "xfer.send": "drop,corrupt,dup,delay",
+    "xfer.recv": "drop,corrupt",
+    "xfer.verify": "corrupt",
+    "prefill.crash": "close",
+    "kv.swap_out": "drop,corrupt",
+    "kv.swap_in": "drop,corrupt",
+    "kv.spill": "drop,corrupt",
+    "fleet.scale_up": "raise,drop",
+    "fleet.scale_down": "raise,drop",
+    "tenant.quota": "exhaust",
+    "router.ledger": "exhaust,stall,drop",
+    "directory.lookup": "drop,corrupt",
+    "xfer.pull": "drop,corrupt,dup",
+}
+
+
+# THE registry of control-plane protocol models (FAULT_SITES-style: model
+# name -> one-line doc).  Each entry names a ``*_MODEL`` transition-system
+# literal declared NEXT TO the code it models; ``python -m tools.graftmodel``
+# exhaustively enumerates the bounded interleavings of each machine composed
+# with its declared fault actions (the SITE_ACTIONS pairs it names) and
+# checks the GM1-GM4 safety invariants on every reachable state.  GM503
+# fails the gate when this registry and the discovered model literals
+# drift in either direction.
+PROTOCOL_MODELS: dict[str, str] = {
+    "router.ledger":
+        "fleet-wide tenant ledger: charge on placement, refund on "
+        "shed/failover, bypass metered by the gateway backstop "
+        "(LEDGER_MODEL, runtime/router.py)",
+    "cluster.kv_handoff":
+        "KV handoff + cross-replica pull attempt lifecycle: checksummed "
+        "frames, bounded retries, at-most-once adoption, per-reason "
+        "fallback (HANDOFF_MODEL, cluster/kv_transfer.py)",
+    "kv.parcels":
+        "host-tier swap/spill parcel ownership: every parked parcel "
+        "owned by exactly one queued resume or freed, budget conserved "
+        "(PARCEL_MODEL, runtime/kv_tier.py)",
+    "fleet.autoscale":
+        "tiered autoscaler drain/respawn + epoch-keyed directory: "
+        "size within [min,max], graceful-drain-only downs, stale "
+        "epochs dropped (AUTOSCALE_MODEL, cluster/autoscale.py)",
+}
+
+
 # THE declared lock hierarchy, outermost first (FAULT_SITES-style: name ->
 # one-line doc; dict order IS the order).  Every nested acquisition in the
 # serving core must follow it — tools/graftflow's GF102 builds the global

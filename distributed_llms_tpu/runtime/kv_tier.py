@@ -24,6 +24,147 @@ from ..core.observability import METRICS, get_logger
 log = get_logger("kv_tier")
 
 
+# Machine-readable transition system for host-tier parcel ownership —
+# the contract ``park_swap`` / ``take_swap`` / ``drop_swap`` and the
+# spill plane implement, declared next to the code it models
+# (PROTOCOL_MODELS["kv.parcels"], runtime/faults.py).  ``python -m
+# tools.graftmodel`` explores every interleaving of three swap parcels
+# and two spill slots over a shared page budget under the declared
+# kv.swap_out / kv.swap_in / kv.spill fault actions, checking GM2 on
+# every reachable state: a parked parcel is owned by exactly one queued
+# resume, a settled parcel by none, and the budget equals the parked
+# bytes exactly (released even when verification fails).  Swap phases:
+# 0 victim about to swap, 1 parked (owned), 2 restored byte-exact,
+# 3 degraded to exact recompute, 4 dropped (cancel/shed).  Spill
+# phases: 0 cold pages ahead of eviction, 1 spilled, 2 restored,
+# 3 evicted (plain eviction — correct, just slower).
+PARCEL_MODEL = {
+    "name": "kv.parcels",
+    "doc": "host-tier swap/spill parcels: exactly-one-owner while "
+           "parked, budget conserved, verify failure degrades to "
+           "exact recompute",
+    "params": {"PAGES": 2},
+    "state": {"w0": 0, "w1": 0, "w2": 0, "own0": 0, "own1": 0, "own2": 0,
+              "bad0": 0, "bad1": 0,
+              "s0": 0, "s1": 0, "sbad0": 0, "sbad1": 0, "used": 0},
+    "actions": [
+        {"name": "park0", "guard": "w0 == 0 and used < PAGES",
+         "update": {"w0": "1", "own0": "own0 + 1", "used": "used + 1"}},
+        {"name": "park1", "guard": "w1 == 0 and used < PAGES",
+         "update": {"w1": "1", "own1": "own1 + 1", "used": "used + 1"}},
+        {"name": "park2", "guard": "w2 == 0 and used < PAGES",
+         "update": {"w2": "1", "own2": "own2 + 1", "used": "used + 1"}},
+        # Budget dry: park_swap returns None and the victim recomputes.
+        {"name": "park_dry0", "guard": "w0 == 0 and used >= PAGES",
+         "update": {"w0": "3"}},
+        {"name": "park_dry1", "guard": "w1 == 0 and used >= PAGES",
+         "update": {"w1": "3"}},
+        {"name": "park_dry2", "guard": "w2 == 0 and used >= PAGES",
+         "update": {"w2": "3"}},
+        {"name": "take_ok0", "guard": "w0 == 1 and own0 == 1 and bad0 == 0",
+         "update": {"w0": "2", "own0": "own0 - 1", "used": "used - 1"}},
+        {"name": "take_ok1", "guard": "w1 == 1 and own1 == 1 and bad1 == 0",
+         "update": {"w1": "2", "own1": "own1 - 1", "used": "used - 1"}},
+        # Parcel 2 carries no fault edges: the plain path, kept in the
+        # composition so faulted and clean parcels interleave.
+        {"name": "take_ok2", "guard": "w2 == 1 and own2 == 1",
+         "update": {"w2": "2", "own2": "own2 - 1", "used": "used - 1"}},
+        # Checksum verify fails at take time: budget released anyway,
+        # the request recomputes exactly.
+        {"name": "take_bad0", "guard": "w0 == 1 and own0 == 1 and bad0 == 1",
+         "update": {"w0": "3", "own0": "own0 - 1", "used": "used - 1",
+                    "bad0": "0"}},
+        {"name": "take_bad1", "guard": "w1 == 1 and own1 == 1 and bad1 == 1",
+         "update": {"w1": "3", "own1": "own1 - 1", "used": "used - 1",
+                    "bad1": "0"}},
+        # Cancel/shed: drop_swap frees the parcel and its budget.
+        {"name": "cancel0", "guard": "w0 == 1 and own0 == 1",
+         "update": {"w0": "4", "own0": "own0 - 1", "used": "used - 1"}},
+        {"name": "cancel1", "guard": "w1 == 1 and own1 == 1",
+         "update": {"w1": "4", "own1": "own1 - 1", "used": "used - 1"}},
+        {"name": "spill0", "guard": "s0 == 0 and used < PAGES",
+         "update": {"s0": "1", "used": "used + 1"}},
+        {"name": "spill1", "guard": "s1 == 0 and used < PAGES",
+         "update": {"s1": "1", "used": "used + 1"}},
+        # Spills are best-effort cache: evictable any time swaps need
+        # room (oldest-first in code; order-free here).
+        {"name": "spill_evict0", "guard": "s0 == 1 and sbad0 == 0",
+         "update": {"s0": "3", "used": "used - 1"}},
+        {"name": "spill_evict1", "guard": "s1 == 1 and sbad1 == 0",
+         "update": {"s1": "3", "used": "used - 1"}},
+        {"name": "spill_restore0", "guard": "s0 == 1 and sbad0 == 0",
+         "update": {"s0": "2", "used": "used - 1"}},
+        {"name": "spill_restore1", "guard": "s1 == 1 and sbad1 == 0",
+         "update": {"s1": "2", "used": "used - 1"}},
+        # Restore verification rejects a corrupt spill: cold prefill.
+        {"name": "spill_restore_bad0", "guard": "s0 == 1 and sbad0 == 1",
+         "update": {"s0": "3", "used": "used - 1", "sbad0": "0"}},
+        {"name": "spill_restore_bad1", "guard": "s1 == 1 and sbad1 == 1",
+         "update": {"s1": "3", "used": "used - 1", "sbad1": "0"}},
+    ],
+    "faults": [
+        {"name": "swapout_drop0", "site": "kv.swap_out", "action": "drop",
+         "metric": "batcher.kv_swaps.fallback",
+         "guard": "w0 == 0", "update": {"w0": "3"}},
+        {"name": "swapout_drop1", "site": "kv.swap_out", "action": "drop",
+         "metric": "batcher.kv_swaps.fallback",
+         "guard": "w1 == 0", "update": {"w1": "3"}},
+        {"name": "swapout_corrupt0", "site": "kv.swap_out",
+         "action": "corrupt", "metric": "batcher.kv_swaps.fallback",
+         "guard": "w0 == 1 and bad0 == 0", "update": {"bad0": "1"}},
+        {"name": "swapout_corrupt1", "site": "kv.swap_out",
+         "action": "corrupt", "metric": "batcher.kv_swaps.fallback",
+         "guard": "w1 == 1 and bad1 == 0", "update": {"bad1": "1"}},
+        {"name": "swapin_drop0", "site": "kv.swap_in", "action": "drop",
+         "metric": "batcher.kv_swaps.fallback",
+         "guard": "w0 == 1 and own0 == 1",
+         "update": {"w0": "3", "own0": "own0 - 1", "used": "used - 1"}},
+        {"name": "swapin_drop1", "site": "kv.swap_in", "action": "drop",
+         "metric": "batcher.kv_swaps.fallback",
+         "guard": "w1 == 1 and own1 == 1",
+         "update": {"w1": "3", "own1": "own1 - 1", "used": "used - 1"}},
+        {"name": "swapin_corrupt0", "site": "kv.swap_in", "action": "corrupt",
+         "metric": "batcher.kv_swaps.fallback",
+         "guard": "w0 == 1 and bad0 == 0", "update": {"bad0": "1"}},
+        {"name": "swapin_corrupt1", "site": "kv.swap_in", "action": "corrupt",
+         "metric": "batcher.kv_swaps.fallback",
+         "guard": "w1 == 1 and bad1 == 0", "update": {"bad1": "1"}},
+        {"name": "spill_drop0", "site": "kv.spill", "action": "drop",
+         "metric": "batcher.host_tier.spill_evictions",
+         "guard": "s0 == 0", "update": {"s0": "3"}},
+        {"name": "spill_drop1", "site": "kv.spill", "action": "drop",
+         "metric": "batcher.host_tier.spill_evictions",
+         "guard": "s1 == 0", "update": {"s1": "3"}},
+        {"name": "spill_corrupt0", "site": "kv.spill", "action": "corrupt",
+         "metric": "batcher.kv_swaps.fallback",
+         "guard": "s0 == 1 and sbad0 == 0", "update": {"sbad0": "1"}},
+        {"name": "spill_corrupt1", "site": "kv.spill", "action": "corrupt",
+         "metric": "batcher.kv_swaps.fallback",
+         "guard": "s1 == 1 and sbad1 == 0", "update": {"sbad1": "1"}},
+    ],
+    "invariants": [
+        {"rule": "GM2", "name": "parked-implies-exactly-one-owner",
+         "expr": "(w0 != 1 or own0 == 1) and (w1 != 1 or own1 == 1) "
+                 "and (w2 != 1 or own2 == 1)"},
+        {"rule": "GM2", "name": "settled-implies-zero-owners",
+         "expr": "(w0 == 1 or own0 == 0) and (w1 == 1 or own1 == 0) "
+                 "and (w2 == 1 or own2 == 0)"},
+        {"rule": "GM2", "name": "never-multi-owned",
+         "expr": "own0 <= 1 and own1 <= 1 and own2 <= 1"},
+        {"rule": "GM2", "name": "budget-equals-parked-bytes",
+         "expr": "used == (w0 == 1) + (w1 == 1) + (w2 == 1) "
+                 "+ (s0 == 1) + (s1 == 1)"},
+        {"rule": "GM2", "name": "budget-never-oversubscribed",
+         "expr": "0 <= used <= PAGES"},
+    ],
+    # Stuck only once every parcel settled (restored / recomputed /
+    # dropped) and every spill slot resolved (restored / evicted) —
+    # a parcel parked forever with no owner is a stranded parcel.
+    "terminal": "w0 in (2, 3, 4) and w1 in (2, 3, 4) and w2 in (2, 3, 4) "
+                "and s0 in (2, 3) and s1 in (2, 3)",
+}
+
+
 @dataclass
 class _HostEntry:
     """One host-tier parcel: ``future`` resolves (on the tier's worker

@@ -104,6 +104,106 @@ class _Inflight:
         self.streamed = False
 
 
+# Machine-readable transition system for the fleet tenant ledger — the
+# protocol contract ``_ledger_retry_after`` / ``_ledger_charge`` /
+# ``_ledger_refund`` implement, declared next to the code it models
+# (PROTOCOL_MODELS["router.ledger"], runtime/faults.py).  ``python -m
+# tools.graftmodel`` exhaustively explores every interleaving of four
+# concurrent admissions composed with the declared router.ledger fault
+# actions (exhaust / stall / drop) and checks the GM1 accounting laws on
+# every reachable state: a charge on placement and only there, a refund
+# on every failure edge and only there, the gated window never over
+# quota, and a gate bypass ALWAYS metered by the replica backstop —
+# never a silent unmetered path.  Request slot phases: 0 arrived,
+# 1 placed+charged, 2 served (charge retained — tokens were consumed),
+# 3 failed+refunded (no-replica / upstream >= 400 / failover
+# exhaustion), 4 bypassed in flight (router.ledger:drop), 5 shed 429
+# (never charged), 6 bypassed + served + backstop-metered.
+LEDGER_MODEL = {
+    "name": "router.ledger",
+    "doc": "fleet tenant ledger: charge on placement, refund on failure, "
+           "shed pre-placement, bypass metered by the gateway backstop",
+    "params": {"QUOTA": 2},
+    "state": {"r0": 0, "r1": 0, "r2": 0, "r3": 0,
+              "charged": 0, "refunded": 0, "served": 0, "shed": 0,
+              "bypassed": 0, "backstopped": 0, "stalled": 0},
+    "actions": [
+        {"name": "place0", "guard": "r0 == 0 and charged - refunded < QUOTA",
+         "update": {"r0": "1", "charged": "charged + 1"}},
+        {"name": "place1", "guard": "r1 == 0 and charged - refunded < QUOTA",
+         "update": {"r1": "1", "charged": "charged + 1"}},
+        {"name": "place2", "guard": "r2 == 0 and charged - refunded < QUOTA",
+         "update": {"r2": "1", "charged": "charged + 1"}},
+        {"name": "place3", "guard": "r3 == 0 and charged - refunded < QUOTA",
+         "update": {"r3": "1", "charged": "charged + 1"}},
+        {"name": "serve0", "guard": "r0 == 1",
+         "update": {"r0": "2", "served": "served + 1"}},
+        {"name": "serve1", "guard": "r1 == 1",
+         "update": {"r1": "2", "served": "served + 1"}},
+        {"name": "serve2", "guard": "r2 == 1",
+         "update": {"r2": "2", "served": "served + 1"}},
+        {"name": "serve3", "guard": "r3 == 1",
+         "update": {"r3": "2", "served": "served + 1"}},
+        {"name": "fail_refund0", "guard": "r0 == 1",
+         "update": {"r0": "3", "refunded": "refunded + 1"}},
+        {"name": "fail_refund1", "guard": "r1 == 1",
+         "update": {"r1": "3", "refunded": "refunded + 1"}},
+        {"name": "fail_refund2", "guard": "r2 == 1",
+         "update": {"r2": "3", "refunded": "refunded + 1"}},
+        {"name": "fail_refund3", "guard": "r3 == 1",
+         "update": {"r3": "3", "refunded": "refunded + 1"}},
+        {"name": "backstop_meter0", "guard": "r0 == 4",
+         "update": {"r0": "6", "served": "served + 1",
+                    "backstopped": "backstopped + 1"}},
+        {"name": "backstop_meter1", "guard": "r1 == 4",
+         "update": {"r1": "6", "served": "served + 1",
+                    "backstopped": "backstopped + 1"}},
+        {"name": "gate_resume", "guard": "stalled == 1",
+         "update": {"stalled": "0"}},
+    ],
+    "faults": [
+        {"name": "shed0", "site": "router.ledger", "action": "exhaust",
+         "metric": "router.ledger.sheds",
+         "guard": "r0 == 0", "update": {"r0": "5", "shed": "shed + 1"}},
+        {"name": "shed1", "site": "router.ledger", "action": "exhaust",
+         "metric": "router.ledger.sheds",
+         "guard": "r1 == 0", "update": {"r1": "5", "shed": "shed + 1"}},
+        {"name": "bypass0", "site": "router.ledger", "action": "drop",
+         "metric": "router.ledger.bypasses",
+         "guard": "r0 == 0",
+         "update": {"r0": "4", "bypassed": "bypassed + 1"}},
+        {"name": "bypass1", "site": "router.ledger", "action": "drop",
+         "metric": "router.ledger.bypasses",
+         "guard": "r1 == 0",
+         "update": {"r1": "4", "bypassed": "bypassed + 1"}},
+        {"name": "gate_stall", "site": "router.ledger", "action": "stall",
+         "metric": "faults.fired.stall",
+         "guard": "stalled == 0", "update": {"stalled": "1"}},
+    ],
+    "invariants": [
+        {"rule": "GM1", "name": "charge-iff-placed",
+         "expr": "charged == (1 <= r0 <= 3) + (1 <= r1 <= 3) "
+                 "+ (1 <= r2 <= 3) + (1 <= r3 <= 3)"},
+        {"rule": "GM1", "name": "refund-iff-failed",
+         "expr": "refunded == (r0 == 3) + (r1 == 3) + (r2 == 3) "
+                 "+ (r3 == 3)"},
+        {"rule": "GM1", "name": "no-lost-refund",
+         "expr": "refunded <= charged"},
+        {"rule": "GM1", "name": "gated-window-bounded",
+         "expr": "charged - refunded <= QUOTA"},
+        {"rule": "GM1", "name": "bypass-always-backstopped",
+         "expr": "backstopped == (r0 == 6) + (r1 == 6)"},
+        {"rule": "GM1", "name": "served-counted-once",
+         "expr": "served == (r0 == 2) + (r1 == 2) + (r2 == 2) + (r3 == 2) "
+                 "+ (r0 == 6) + (r1 == 6)"},
+    ],
+    # Stuck only when every request reached a settled phase — a bypassed
+    # request parked at 4 forever would be the silent unmetered path.
+    "terminal": "r0 in (2, 3, 5, 6) and r1 in (2, 3, 5, 6) "
+                "and r2 in (2, 3, 5, 6) and r3 in (2, 3, 5, 6)",
+}
+
+
 class ReplicaRouter:
     """HTTP front door over a :class:`cluster.fleet.ReplicaFleet`."""
 

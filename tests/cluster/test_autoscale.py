@@ -208,6 +208,24 @@ def test_scale_down_veto_drill():
     _run(fn())
 
 
+def test_scale_down_raise_drill_counts_failure_and_retries():
+    """An injected fleet.scale_down raise counts autoscale.scale_failures,
+    leaves the fleet intact, and the next cold tick drains normally."""
+    async def fn():
+        plane = FaultPlane.parse("fleet.scale_down:raise@1")
+        fleet = _StubFleet(_StubHandle("a"), _StubHandle("b"))
+        sc = _scaler(fleet, hysteresis=1, cooldown_s=0.0, faults=plane)
+        f0 = METRICS.get_counter("autoscale.scale_failures")
+        assert (await _ticks(sc, 1))[0] is None  # drill ate the drain
+        assert len(fleet.replicas) == 2
+        assert plane.rules[0].fired == 1
+        assert METRICS.get_counter("autoscale.scale_failures") == f0 + 1
+        assert (await _ticks(sc, 1))[0] == "down"  # retry drains
+        assert len(fleet.replicas) == 1
+
+    _run(fn())
+
+
 def test_autoscaler_validation():
     fleet = _StubFleet(_StubHandle("a"))
     with pytest.raises(ValueError, match="min_replicas"):

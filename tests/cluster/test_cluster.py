@@ -139,6 +139,50 @@ async def test_kv_corrupt_payload_rejected_then_clean_retry_succeeds():
         server.close()
 
 
+@pytest.mark.asyncio
+async def test_kv_send_drop_swallowed_then_retry_succeeds():
+    """A sender-side drop (the wire never sees the frame) times the
+    sender out on the missing ack; the retry delivers — the mirror of the
+    receiver-side drop drill above."""
+    plane = FaultPlane()
+    rule = plane.add("xfer.send", "drop", when="1")
+    server, port, stats, imported = await _kv_receiver()
+    try:
+        msg = kv_transfer.encode_kv_pages(_kv_payload(tid="txsdrop"))
+        res = await kv_transfer.send_kv_pages(
+            "127.0.0.1", port, msg, faults=plane, attempt_s=0.3,
+            max_retries=3, backoff_base_s=0.01,
+        )
+        assert res.ok and res.attempts == 2
+        assert rule.fired == 1
+        assert stats.rejected == 0  # swallowed, never seen — not NACKed
+        assert len(imported) == 1
+    finally:
+        server.close()
+
+
+@pytest.mark.asyncio
+async def test_kv_recv_corrupt_nacked_then_clean_retry_succeeds():
+    """A receiver-side bit-flip (corruption after the wire, before
+    verify) fails the checksum and is NACKed; the byte-identical retry
+    arrives clean and imports."""
+    plane = FaultPlane()
+    rule = plane.add("xfer.recv", "corrupt", when="1")
+    server, port, stats, imported = await _kv_receiver(faults=plane)
+    try:
+        msg = kv_transfer.encode_kv_pages(_kv_payload(tid="txrcorrupt"))
+        res = await kv_transfer.send_kv_pages(
+            "127.0.0.1", port, msg, attempt_s=5.0, max_retries=2,
+            backoff_base_s=0.01,
+        )
+        assert res.ok and res.attempts == 2
+        assert rule.fired == 1
+        assert stats.rejected == 1
+        assert len(imported) == 1
+    finally:
+        server.close()
+
+
 def test_kv_digest_chain_mismatch_rejected():
     """A frame whose digests do not commit to its carried tokens (a
     sender-side hashing bug: checksum INTACT, chain wrong) must be
@@ -516,6 +560,32 @@ async def test_task_retry_on_injected_handler_fault(tmp_path):
         assert out["text"] == ["y!"]
         assert plane.rules[0].fired == 1
         assert w.worker_id in coord.workers  # handler crash, not death
+        wt.cancel()
+    finally:
+        await coord.stop()
+
+
+@pytest.mark.asyncio
+async def test_dispatch_drop_times_out_submitter_then_retry_lands(tmp_path):
+    """A coordinator.dispatch drop models the dispatch lost in flight:
+    the task stays assigned and unanswered, the submitter's wait_for
+    timeout fires, and a fresh submit dispatches normally — the
+    submitter-timeout leg of the retry contract."""
+    plane = FaultPlane.parse("coordinator.dispatch/GENERATE:drop@1")
+    coord = Coordinator(fast_cfg(), faults=plane)
+    await coord.start()
+    try:
+        w, wt = await start_worker(coord)
+        coord.plan_shards(1, store_dir=str(tmp_path))
+        await coord.place_shards()
+        with pytest.raises(asyncio.TimeoutError):
+            await coord.generate(["z"], max_new_tokens=2, timeout=1.0)
+        assert plane.rules[0].fired == 1
+        out = await asyncio.wait_for(
+            coord.generate(["z"], max_new_tokens=2), timeout=15
+        )
+        assert out["text"] == ["z!"]
+        assert w.worker_id in coord.workers  # nothing died — only the wire
         wt.cancel()
     finally:
         await coord.stop()

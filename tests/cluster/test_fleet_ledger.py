@@ -466,6 +466,68 @@ def test_quota_conserved_across_live_scale_up(warmed):
     asyncio.run(asyncio.wait_for(driver(), 300))
 
 
+def test_ledger_exhaust_and_drop_drills(warmed):
+    """The fleet-ledger gate's two remaining drills: ``exhaust`` forces
+    the over-quota shed (429 + the tenant's own Retry-After, counted,
+    nothing charged) even under a generous quota, and ``drop`` bypasses
+    the gate AND its charge (a counted unmetered admit — the replica
+    backstop is then the only meter)."""
+    tiny = warmed
+    prompts = [f"ledger drill {i} xx" for i in range(3)]
+    wants = expected_texts(tiny, [(p, 4) for p in prompts])
+    plane = FaultPlane()
+    exhaust = plane.add("router.ledger", "exhaust", when="1")
+
+    async def driver():
+        fleet = ReplicaFleet([_factory(tiny)], probe_interval_s=0.05,
+                             probe_timeout_s=2.0)
+        router = ReplicaRouter(
+            fleet, host="127.0.0.1", port=0, tokenizer=ByteTokenizer(),
+            page_size=PAGE, faults=plane,
+            tenant_quota_tps=1000.0, tenant_rate_window_s=10.0,
+        )
+        await fleet.start()
+        host, port = await router.start()
+        try:
+            assert await fleet.wait_healthy(timeout_s=60.0)
+            c0 = METRICS.get_counter("router.ledger.charges")
+            s0 = METRICS.get_counter("router.ledger.sheds")
+            b0 = METRICS.get_counter("router.ledger.bypasses")
+            # exhaust: forced shed under quota, with a real Retry-After.
+            st, hdr, body = await _request(
+                host, port, {"prompt": prompts[0], "max_tokens": 4},
+                tenant="drilled")
+            assert st == 429, body
+            assert body["error"]["reason"] == "tenant_quota"
+            assert int(hdr["retry-after"]) >= 1
+            assert exhaust.fired == 1
+            assert METRICS.get_counter("router.ledger.sheds") == s0 + 1
+            assert METRICS.get_counter("router.ledger.charges") == c0
+            # drop: the gate (and its charge) is skipped — the admit is
+            # counted as a bypass, never silently unmetered.
+            drop = plane.add("router.ledger", "drop", when="1")
+            st, _, body = await _request(
+                host, port, {"prompt": prompts[1], "max_tokens": 4},
+                tenant="drilled")
+            assert st == 200, body
+            assert body["choices"][0]["text"] == wants[prompts[1]]
+            assert drop.fired == 1
+            assert METRICS.get_counter("router.ledger.bypasses") == b0 + 1
+            assert METRICS.get_counter("router.ledger.charges") == c0
+            # The gate is back to normal metering afterwards.
+            st, _, body = await _request(
+                host, port, {"prompt": prompts[2], "max_tokens": 4},
+                tenant="drilled")
+            assert st == 200, body
+            assert body["choices"][0]["text"] == wants[prompts[2]]
+            assert METRICS.get_counter("router.ledger.charges") == c0 + 1
+        finally:
+            await router.stop()
+            await fleet.stop()
+
+    asyncio.run(asyncio.wait_for(driver(), 300))
+
+
 # -- live: cross-replica pull + its degradation ladder -----------------------
 
 
@@ -536,6 +598,95 @@ def test_directory_pull_serves_sibling_cache_and_falls_back_exact(warmed):
             assert corrupt.fired == 1
             assert METRICS.get_counter("directory.pull_fallbacks") > fb0
             fleet["r0"].state = "healthy"
+            _audit_all(fleet)
+        finally:
+            await router.stop()
+            await fleet.stop()
+
+    asyncio.run(asyncio.wait_for(driver(), 300))
+
+
+def test_pull_degradation_ladder_stale_drop_corrupt_dup(warmed):
+    """The pull path's remaining drills, one leg per fault action.  A
+    ``directory.lookup:drop`` reads the hit as stale (counted, local
+    recompute); an ``xfer.pull:drop`` refuses the export (counted
+    rejected fallback); ``:corrupt`` flips bytes post-checksum so the
+    puller NACKs every attempt (counted, cache unpoisoned); ``:dup``
+    ships the verified frame twice and the receiver absorbs the
+    duplicate — the pull still lands.  Every leg byte-exact."""
+    tiny = warmed
+    legs = {
+        "stale": "stale leg! " + LONG,
+        "drop": "drop leg!! " + LONG,
+        "corrupt": "flip leg!! " + LONG,
+        "dup": "dup leg!!! " + LONG,
+    }
+    wants = expected_texts(tiny, [(p, 8) for p in legs.values()])
+    plane = FaultPlane()
+
+    async def driver():
+        fleet = ReplicaFleet([_factory(tiny)] * 2, probe_interval_s=0.05,
+                             probe_timeout_s=2.0)
+        router = ReplicaRouter(
+            fleet, host="127.0.0.1", port=0, tokenizer=ByteTokenizer(),
+            page_size=PAGE, faults=plane,
+        )
+        await fleet.start()
+        # xfer.pull fires on the SOURCE replica's serving loop off the
+        # batcher's plane — arm the same plane fleet-wide.
+        for h in fleet.replicas:
+            h.server.batcher.faults = plane
+        host, port = await router.start()
+        try:
+            assert await fleet.wait_healthy(timeout_s=60.0)
+
+            async def serve_then_redo(p):
+                """Serve p (all idle: lands r0, caches there), drain r0,
+                re-request so the cold sibling consults the directory."""
+                st, _, body = await _request(
+                    host, port, {"prompt": p, "max_tokens": 8})
+                assert st == 200, body
+                assert body["choices"][0]["text"] == wants[p]
+                fleet["r0"].state = "draining"
+                st, _, body = await _request(
+                    host, port, {"prompt": p, "max_tokens": 8})
+                assert st == 200, body
+                assert body["choices"][0]["text"] == wants[p]
+                fleet["r0"].state = "healthy"
+                return body
+
+            # -- directory.lookup:drop: the stale-answer leg ------------
+            rule = plane.add("directory.lookup", "drop", when="1")
+            sd0 = METRICS.get_counter("directory.stale_drops")
+            fb0 = METRICS.get_counter("directory.pull_fallbacks.stale")
+            await serve_then_redo(legs["stale"])
+            assert rule.fired == 1
+            assert METRICS.get_counter("directory.stale_drops") > sd0
+            assert METRICS.get_counter(
+                "directory.pull_fallbacks.stale") == fb0 + 1
+            # -- xfer.pull:drop: the source refuses the export ----------
+            rule = plane.add("xfer.pull", "drop", when="1")
+            fb0 = METRICS.get_counter("directory.pull_fallbacks.rejected")
+            await serve_then_redo(legs["drop"])
+            assert rule.fired == 1
+            assert METRICS.get_counter(
+                "directory.pull_fallbacks.rejected") == fb0 + 1
+            # -- xfer.pull:corrupt: post-checksum flip, every attempt
+            # NACKed at the puller, recompute stays exact ---------------
+            rule = plane.add("xfer.pull", "corrupt", when="1")
+            fb0 = METRICS.get_counter("directory.pull_fallbacks.rejected")
+            await serve_then_redo(legs["corrupt"])
+            assert rule.fired == 1
+            assert METRICS.get_counter(
+                "directory.pull_fallbacks.rejected") == fb0 + 1
+            # -- xfer.pull:dup: the duplicate is absorbed, the pull lands
+            rule = plane.add("xfer.pull", "dup", when="1")
+            dd0 = METRICS.get_counter("xfer.dup_deliveries")
+            body = await serve_then_redo(legs["dup"])
+            assert rule.fired == 1
+            cached = body["usage"]["prompt_tokens_details"]["cached_tokens"]
+            assert cached >= PAGE, body["usage"]
+            assert METRICS.get_counter("xfer.dup_deliveries") == dd0 + 1
             _audit_all(fleet)
         finally:
             await router.stop()

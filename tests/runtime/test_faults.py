@@ -130,6 +130,24 @@ def test_decode_raise_propagates_and_respawn_is_exact(tiny):
     assert plane.rules[0].fired == 1  # shared plane: fired stays fired
 
 
+def test_admit_raise_propagates_and_respawn_is_exact(tiny):
+    """A crash inside the admission round (batcher.admit) propagates out
+    of run(); the respawned engine admits and decodes the same request
+    exactly — the admission leg of the crash-recovery contract."""
+    want = expected_text(tiny, "hello", 8)
+    plane = FaultPlane.parse("batcher.admit:raise@1")
+    b = make_batcher(tiny, faults=plane)
+    b.submit("hello", max_new_tokens=8)
+    with pytest.raises(InjectedFault):
+        b.run()
+    b2 = b.respawn()
+    b2._next_rid = b._next_rid
+    rid = b2.submit("hello", max_new_tokens=8)
+    assert b2.tokenizer.decode(b2.run()[rid]) == want
+    b2.assert_pool_consistent()
+    assert plane.rules[0].fired == 1
+
+
 def test_page_alloc_exhaust_backpressures_then_serves(tiny):
     """An injected dry pool takes the real back-pressure path (requeue,
     FIFO preserved) and the request completes exactly once the rule's

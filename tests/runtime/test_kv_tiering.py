@@ -467,6 +467,22 @@ def test_drill_swap_in_drop_falls_back_exact(tiny):
     assert rule.fired == 1
 
 
+def test_drill_swap_in_corrupt_detected_and_exact(tiny):
+    """A parcel corrupted on the restore path fails checksum verification
+    — the resume falls back to exact recompute instead of splicing bad
+    KV, and the fallback is metered."""
+    cfg, params = tiny
+    faults = FaultPlane()
+    rule = faults.add("kv.swap_in", "corrupt", when="1")
+    fb0 = _counter("batcher.kv_swaps.fallback")
+    b = _paged(cfg, params, host_pages=16, faults=faults)
+    rids, res = _run_storm(b)
+    for rid, (ids, n) in zip(rids, STORM):
+        assert res[rid] == solo(cfg, params, ids, n)
+    assert rule.fired == 1
+    assert _counter("batcher.kv_swaps.fallback") - fb0 >= 1
+
+
 def test_drill_spill_drop_degrades_to_cold_prefill(tiny):
     """kv.spill drop: nothing moves to the host — the later hit misses
     (cold prefill), tokens unchanged."""

@@ -325,6 +325,27 @@ def test_mixed_step_fault_site_drill(tiny):
 
 
 @pytest.mark.fragile_xla_cpu
+def test_mixed_step_stall_drill_delays_but_stays_exact(tiny):
+    """batcher.mixed_step stall drill: a fused dispatch held at the step
+    boundary delays the run measurably but moves no tokens — the
+    slow-step analog of the raise drill above."""
+    import time
+
+    ref = mk(tiny, "mixed", prefill_chunk=6)
+    r0 = ref.submit("seed an active decode row", max_new_tokens=16)
+    want = ref.run()[r0]
+    plane = FaultPlane.parse("batcher.mixed_step:stall@1:0.05")
+    b = mk(tiny, "mixed", prefill_chunk=6, faults=plane)
+    rid = b.submit("seed an active decode row", max_new_tokens=16)
+    t0 = time.perf_counter()
+    res = b.run()
+    assert time.perf_counter() - t0 >= 0.05
+    assert res[rid] == want
+    assert plane.rules[0].fired == 1
+    b.assert_pool_consistent()
+
+
+@pytest.mark.fragile_xla_cpu
 def test_kv_handoff_adopted_mid_span_exact_overlap_on_vs_off(tiny):
     """Overlap x disaggregation corner (ROADMAP: only partially pinned):
     a decode-role engine adopts a verified KV handoff arriving while a

@@ -37,7 +37,7 @@ from distributed_llms_tpu.core.observability import METRICS
 from distributed_llms_tpu.models import model as model_lib, presets
 from distributed_llms_tpu.runtime import generate as gen_lib
 from distributed_llms_tpu.runtime.batcher import ContinuousBatcher
-from distributed_llms_tpu.runtime.faults import FaultPlane
+from distributed_llms_tpu.runtime.faults import FaultPlane, InjectedFault
 from distributed_llms_tpu.runtime.server import InferenceServer
 from distributed_llms_tpu.runtime.tokenizer import ByteTokenizer
 
@@ -109,6 +109,29 @@ def test_growth_overcommit_preempts_and_stays_exact(tiny):
     assert b.preemptions >= 1
     b.assert_pool_consistent()
     assert sorted(b.free_pages) == list(range(1, 9))
+
+
+def test_preempt_raise_drill_respawn_serves_exact(tiny):
+    """A crash at the preemption decision point (batcher.preempt, fired
+    just before a victim is evicted) propagates out of run(); the respawn
+    replays the same overcommitted workload and every stream still equals
+    its solo run."""
+    cfg, params = tiny
+    plane = FaultPlane.parse("batcher.preempt:raise@1")
+    b = _paged(cfg, params, faults=plane)
+    reqs = [([7, 1, 9, 2], 44), ([4, 4, 4, 4], 44), ([9, 8, 7, 3], 44)]
+    for ids, n in reqs:
+        b.submit(ids, max_new_tokens=n)
+    with pytest.raises(InjectedFault):
+        b.run()  # overcommit forces a preemption; the drill crashes it
+    assert plane.rules[0].fired == 1
+    b2 = b.respawn()
+    rids = [b2.submit(ids, max_new_tokens=n) for ids, n in reqs]
+    res = b2.run()
+    for rid, (ids, n) in zip(rids, reqs):
+        assert res[rid] == solo(cfg, params, ids, n), f"rid {rid} diverged"
+    assert b2.preemptions >= 1
+    b2.assert_pool_consistent()
 
 
 def test_preemption_streams_resume_without_duplicates(tiny):

@@ -286,6 +286,43 @@ def test_crash_failover_zero_streamed_exact(warmed):
     run_with_fleet(tiny, 2, fn)
 
 
+def test_chaos_crash_close_drill_fails_over_exact(warmed):
+    tiny = warmed
+    """The fleet's own chaos site: a ``replica.crash ... close`` rule
+    kills the in-flight replica at the next probe tick (no direct
+    fleet.kill from the test) — the zero-streamed request re-sends
+    verbatim to the survivor and completes byte-exact."""
+    plane = FaultPlane()
+    reqs = [("chaos crash request", 32)]
+    wants = expected_texts(tiny, reqs)
+
+    async def fn(host, port, fleet, router):
+        f0 = METRICS.get_counter("router.failovers")
+        task = asyncio.create_task(_request(
+            host, port, "POST", "/v1/completions",
+            {"prompt": reqs[0][0], "max_tokens": reqs[0][1]},
+        ))
+        victim = await _wait_inflight(fleet)
+        rule = plane.add("replica.crash", "close", when="1",
+                         tag=victim.name)
+        for _ in range(400):  # the kill lands at the next probe tick
+            if rule.fired:
+                break
+            await asyncio.sleep(0.01)
+        assert rule.fired == 1
+        status, _, raw = await task
+        body = json.loads(raw)
+        assert status == 200, body
+        assert body["choices"][0]["text"] == wants[reqs[0][0]]
+        assert METRICS.get_counter("router.failovers") > f0
+        assert victim.state == "dead"
+        for h in fleet.replicas:
+            if h.state != "dead":
+                h.server.batcher.assert_pool_consistent()
+
+    run_with_fleet(tiny, 2, fn, faults=plane)
+
+
 def test_stall_past_watchdog_fails_over(warmed):
     tiny = warmed
     """A replica whose engine wedges past the watchdog flips its own

@@ -332,6 +332,23 @@ def test_spec_verify_raise_drill_respawn_exact(models):
     assert plane_d.rules[0].fired == 1
 
 
+def test_spec_verify_stall_drill_serves_exact(models):
+    """batcher.spec_verify stall drill: a slow verify (the engine thread
+    blocked at the verification boundary) delays but never corrupts —
+    tokens equal the uninjected run and the stall really slept."""
+    import time
+
+    want = _run(_mk(models, **PAGED), [([7, 1, 9], 8)])
+    plane = FaultPlane.parse("batcher.spec_verify/verify:stall@1:0.05")
+    b = _mk(models, faults=plane, **PAGED)
+    rid = b.submit([7, 1, 9], max_new_tokens=8)
+    t0 = time.perf_counter()
+    assert [b.run()[rid]] == want
+    assert time.perf_counter() - t0 >= 0.05
+    assert plane.rules[0].fired == 1
+    b.assert_pool_consistent()
+
+
 def test_spec_metrics_accrue(models):
     r0 = METRICS.get_counter("batcher.spec.rounds")
     a0 = METRICS.get_counter("batcher.spec.accepted_tokens")

@@ -1,14 +1,16 @@
 """Unified static-analysis front door: ``python -m tools.check``.
 
-Runs ALL FOUR checkers over the repo and merges their exit codes:
+Runs ALL FIVE checkers over the repo and merges their exit codes:
 
 - graftlint  (tools/graftlint)  — AST rules GL1xx-GL5xx;
 - graftcheck (tools/graftcheck) — semantic contracts GC1xx-GC5xx + GCD;
 - graftflow  (tools/graftflow)  — CFG/dataflow rules GF1xx-GF4xx + GFD;
-- graftsync  (tools/graftsync)  — lockstep taint rules GS1xx-GS4xx + GSD.
+- graftsync  (tools/graftsync)  — lockstep taint rules GS1xx-GS4xx + GSD;
+- graftmodel (tools/graftmodel) — protocol model checking GM1xx-GM6xx
+  + GMD.
 
 ``--only`` scopes a run to rule families ACROSS the tools
-(``--only GF2,GC4,GL3``): tools with no selected family are skipped
+(``--only GF2,GC4,GM1``): tools with no selected family are skipped
 entirely (graftcheck's tracing is the expensive one), and baseline /
 stale accounting is filtered to the selected families so a scoped run
 never mis-reports out-of-scope debt as stale.
@@ -43,6 +45,7 @@ FAMILIES = {
     **{f"GC{i}": "graftcheck" for i in range(1, 6)}, "GCD": "graftcheck",
     **{f"GF{i}": "graftflow" for i in range(1, 5)}, "GFD": "graftflow",
     **{f"GS{i}": "graftsync" for i in range(1, 5)}, "GSD": "graftsync",
+    **{f"GM{i}": "graftmodel" for i in range(1, 7)}, "GMD": "graftmodel",
 }
 
 _BASELINE_RULE_RE = re.compile(r":\s*(G[A-Z]{1,2}\d+)\b")
@@ -96,7 +99,7 @@ def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m tools.check",
         description="run graftlint + graftcheck + graftflow + graftsync "
-                    "with merged exit codes",
+                    "+ graftmodel with merged exit codes",
     )
     ap.add_argument("--root", default=".", help="repo root to analyze")
     ap.add_argument("--only", default=None,
@@ -166,6 +169,21 @@ def main(argv=None) -> int:
         walls.append(("graftsync", wall))
         new, stale = _report("graftsync", findings,
                              graftsync.read_baseline(root), only, wall)
+        rc |= 1 if (new or stale) else 0
+
+    # -- graftmodel (protocol model checking) ------------------------------
+    if want("graftmodel"):
+        from tools import graftmodel
+
+        t0 = time.perf_counter()
+        gm_only = ({f for f in only if FAMILIES[f] == "graftmodel"}
+                   if only is not None else None)
+        findings = graftmodel.run_project(graftmodel.load_project(root),
+                                          only=gm_only)
+        wall = time.perf_counter() - t0
+        walls.append(("graftmodel", wall))
+        new, stale = _report("graftmodel", findings,
+                             graftmodel.read_baseline(root), only, wall)
         rc |= 1 if (new or stale) else 0
 
     # -- graftcheck (semantic; imports + traces, the expensive one) --------

@@ -151,6 +151,7 @@ def _result_cell(row: dict) -> str:
         ("graftcheck_wall_ms", "graftcheck ms"),
         ("graftflow_wall_ms", "graftflow ms"),
         ("graftsync_wall_ms", "graftsync ms"),
+        ("graftmodel_wall_ms", "graftmodel ms"),
         ("analysis_wall_ms", "combined analysis ms"),
     ):
         if row.get(k) is not None:

@@ -1,0 +1,122 @@
+"""graftmodel — exhaustive fault-interleaving model checking of the
+fleet control plane.
+
+The fifth static-analysis tier, and the first that proves rather than
+scans: graftlint (PR 4) checks statements, graftcheck (PR 5) traces
+tensor contracts, graftflow (PR 16) checks concurrency interactions,
+graftsync (PR 19) audits lockstep determinism — graftmodel exhaustively
+enumerates every bounded interleaving of the control-plane protocols
+composed with their declared fault actions and checks the invariants the
+fleet rests on.  The protocols live as machine-readable ``*_MODEL``
+literals NEXT TO the code they model (registered in ``PROTOCOL_MODELS``,
+runtime/faults.py):
+
+- GM1xx ledger accounting            (tools/graftmodel/invariants.py)
+- GM2xx parcel ownership             (tools/graftmodel/invariants.py)
+- GM3xx at-most-once adoption        (tools/graftmodel/invariants.py)
+- GM4xx liveness & boundedness       (tools/graftmodel/liveness.py)
+- GM5xx model <-> code drift         (tools/graftmodel/drift.py)
+- GM6xx drill coverage               (tools/graftmodel/drills.py)
+- GMD01 README table drift           (tools/graftmodel/docs.py)
+
+Run as ``python -m tools.graftmodel`` (exit 0 = clean) or through the
+unified front door ``python -m tools.check``; the tier-1 pytest gate is
+tests/tools/test_graftmodel.py::test_repo_is_clean.  Accepted debt lives
+in ``graftmodel_baseline.txt`` (checked in EMPTY; graftlint's normalized
+line-free multiset format) — a protocol invariant violation is a bug to
+FIX, never debt to baseline.
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+
+from .core import (BASELINE_NAME, Finding, Project, discover_models,
+                   load_project, load_registries, split_new, suppressed,
+                   validate_model)
+from tools.graftlint.core import read_baseline as _read_baseline
+from tools.graftlint.core import write_baseline as _write_baseline
+
+FAMILIES = ("GM1", "GM2", "GM3", "GM4", "GM5", "GM6", "GMD")
+
+# Families whose findings come out of the shared per-model exploration.
+_EXPLORE_FAMILIES = {"GM1", "GM2", "GM3", "GM4"}
+
+
+def write_baseline(root, findings):
+    return _write_baseline(Path(root), findings, name=BASELINE_NAME,
+                           tool="graftmodel")
+
+
+def read_baseline(root):
+    return _read_baseline(Path(root), name=BASELINE_NAME)
+
+
+def run_project(project: Project, only: set[str] | None = None,
+                stats: list[dict] | None = None) -> list[Finding]:
+    """Run every rule family (or the ``only`` subset of FAMILIES).
+
+    One BFS per valid model feeds all four invariant families; pass a
+    ``stats`` list to receive ``{"model", "states", "fired"}`` per
+    explored model (the CLI prints them, the bench records them).
+    """
+    from . import docs, drift, drills, invariants, liveness
+    from .machine import compile_model, explore
+
+    def want(fam: str) -> bool:
+        return only is None or fam in only
+
+    decls, schema_findings = discover_models(project)
+    valid: list = []
+    for decl in decls:
+        errs = validate_model(decl)
+        schema_findings += errs
+        if not errs:
+            valid.append(decl)
+    regs = load_registries(project)
+
+    findings: list[Finding] = []
+    if any(want(f) for f in _EXPLORE_FAMILIES):
+        explored = []
+        for decl in valid:
+            cm = compile_model(decl)
+            res = explore(cm)
+            explored.append((decl, cm, res))
+            if stats is not None:
+                stats.append({"model": decl.name, "states": res.states,
+                              "fired": res.fired})
+        inv = invariants.check_explored(explored)
+        live = liveness.check_explored(explored)
+        if want("GM1"):
+            findings += [f for f in inv if f.rule == "GM101"]
+        if want("GM2"):
+            findings += [f for f in inv if f.rule == "GM201"]
+        if want("GM3"):
+            findings += [f for f in inv if f.rule == "GM301"]
+            findings += invariants.check_metrics_declared(valid)
+        if want("GM4"):
+            findings += live
+    if want("GM5"):
+        findings += drift.check(decls, regs)
+        findings += schema_findings
+    if want("GM6"):
+        findings += drills.check(project, regs)
+    if want("GMD"):
+        findings += docs.check_docs(project.root, decls, regs)
+
+    by_rel = {sf.rel: sf for sf in project.files}
+    findings = [f for f in findings
+                if f.path not in by_rel
+                or not suppressed(by_rel[f.path], f.rule, f.line)]
+    return sorted(findings, key=lambda f: (f.path, f.line, f.rule, f.message))
+
+
+def run(root, only: set[str] | None = None,
+        stats: list[dict] | None = None) -> list[Finding]:
+    return run_project(load_project(root), only=only, stats=stats)
+
+
+__all__ = [
+    "BASELINE_NAME", "FAMILIES", "Finding", "Project", "load_project",
+    "read_baseline", "run", "run_project", "split_new", "write_baseline",
+]

@@ -1,0 +1,102 @@
+"""CLI: ``python -m tools.graftmodel [--root DIR] [--only GM1,GM5]``.
+
+Exit status mirrors the other four tiers: 0 when every finding is absent
+or baselined, 1 when NEW findings exist, 2 on usage errors.
+
+- ``--only``: comma-separated rule families (GM1..GM6, GMD) — scoped
+  runs for fast iteration; the gate and the front door run everything.
+- ``--baseline-write``: accept current findings into
+  ``graftmodel_baseline.txt`` (protocol invariant violations should be
+  FIXED, not baselined — the file ships empty).
+- ``--write-docs``: regenerate the README models + rules tables.
+- ``--all``: also print baselined findings.
+
+Pure AST + in-memory BFS over ``--root``: no imports of the analyzed
+code, no devices.  Per-model explored-state counts go to stderr so
+"exhaustive" is a number you can watch, not an adjective.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from pathlib import Path
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.graftmodel",
+        description="exhaustive fault-interleaving model checking "
+                    "(see tools/graftmodel/)",
+    )
+    ap.add_argument("--root", default=".", help="repo root to analyze")
+    ap.add_argument("--only", default=None,
+                    help="comma-separated families, e.g. GM1,GM5")
+    ap.add_argument("--baseline-write", action="store_true",
+                    help="accept current findings into the baseline")
+    ap.add_argument("--write-docs", action="store_true",
+                    help="regenerate the README model/rule tables, then exit")
+    ap.add_argument("--all", action="store_true",
+                    help="also print baselined (accepted) findings")
+    args = ap.parse_args(argv)
+
+    root = Path(args.root).resolve()
+    if not root.is_dir():
+        print(f"graftmodel: --root {root} is not a directory",
+              file=sys.stderr)
+        return 2
+
+    from tools.graftmodel import (FAMILIES, load_project, read_baseline,
+                                  run_project, split_new, write_baseline)
+
+    only = None
+    if args.only:
+        only = {s.strip() for s in args.only.split(",") if s.strip()}
+        unknown = only - set(FAMILIES)
+        if unknown:
+            print(f"graftmodel: unknown families {sorted(unknown)}; "
+                  f"have {FAMILIES}", file=sys.stderr)
+            return 2
+
+    project = load_project(root)
+
+    if args.write_docs:
+        from tools.graftmodel.core import discover_models, load_registries
+        from tools.graftmodel.docs import write_docs
+
+        decls, _ = discover_models(project)
+        done = write_docs(root, decls, load_registries(project))
+        print("graftmodel: rewrote README model/rule tables" if done
+              else "graftmodel: no graftmodel marker blocks found")
+        return 0
+
+    stats: list[dict] = []
+    findings = run_project(project, only=only, stats=stats)
+    for s in stats:
+        print(f"graftmodel: model '{s['model']}': {s['states']} states, "
+              f"{s['fired']} transitions explored", file=sys.stderr)
+    if args.baseline_write:
+        path = write_baseline(root, findings)
+        print(f"graftmodel: wrote {len(findings)} finding(s) to {path.name}")
+        return 0
+
+    baseline = read_baseline(root)
+    new, accepted = split_new(findings, baseline)
+    for f in new:
+        print(f.render())
+    if args.all:
+        for f in accepted:
+            print(f"{f.render()}  [baselined]")
+    from tools.graftlint.core import stale_entries
+
+    stale = stale_entries(findings, baseline)
+    print(f"graftmodel: {len(new)} new finding(s), {len(accepted)} "
+          f"baselined, {len(stale)} stale baseline "
+          f"entr{'y' if len(stale) == 1 else 'ies'}", file=sys.stderr)
+    for s in stale:
+        print(f"  stale: {s}", file=sys.stderr)
+    return 1 if new else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

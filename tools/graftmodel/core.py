@@ -1,0 +1,329 @@
+"""graftmodel core: model discovery, schema, registries, suppressions.
+
+graftmodel is the fifth static-analysis tier and the first that reasons
+about *distributed interleavings* rather than single-process code.  The
+fleet control plane's correctness rests on protocol invariants — ledger
+quota conservation, exactly-one-owner parcels, at-most-once KV adoption,
+graceful-drain-only scale-downs — that chaos storms only sample.  The
+protocols are therefore declared as machine-readable transition systems
+NEXT TO the code they model (module-level ``*_MODEL`` dict literals,
+registered in ``PROTOCOL_MODELS`` in ``runtime/faults.py``), and
+``python -m tools.graftmodel`` exhaustively enumerates every bounded
+interleaving of each machine composed with its declared fault actions
+(``SITE_ACTIONS``), checking the GM invariant families on every
+reachable state — SPIN/TLA-style explicit-state exploration at the
+state-space sizes these protocols actually have.
+
+A model literal's schema (all guards/updates are Python expressions over
+``params`` + ``state``, evaluated with no builtins):
+
+- ``name``: the PROTOCOL_MODELS registry key;
+- ``doc``: one line, rendered into the README models table;
+- ``params``: bound constants (retry budgets, quotas, tick budgets);
+- ``state``: initial variable values (ints);
+- ``actions``: ``{name, guard, update: {var: expr}}`` protocol steps;
+- ``faults``: the same plus ``site``/``action`` (a SITE_ACTIONS pair)
+  and ``metric`` (the per-reason fallback counter the recovery path
+  increments — must exist in METRIC_DOCS);
+- ``invariants``: ``{rule: GM1..GM4, name, expr}`` — checked on every
+  reachable state;
+- ``terminal``: the predicate every stuck state (no enabled transition)
+  must satisfy, or it is a deadlock (GM401).
+
+Suppressions (both REQUIRE a non-empty reason or they are inert,
+graftlint's escape semantics):
+
+- ``# graftmodel: ok(<reason>)`` on the finding line suppresses any GM
+  rule there;
+- ``# graftmodel: ignore[GM101](<reason>)`` suppresses only the named
+  rule(s).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from dataclasses import dataclass, field
+
+from tools.graftlint.core import (Finding, Project, SourceFile,  # noqa: F401
+                                  load_project, read_baseline, split_new,
+                                  stale_entries, write_baseline)
+from tools.graftlint.registry import _literal_dict as literal_strdict
+
+BASELINE_NAME = "graftmodel_baseline.txt"
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*graftmodel:\s*"
+    r"(?:(ok)|ignore\[([A-Z]+\d+(?:\s*,\s*[A-Z]+\d+)*)\])"
+    r"\(([^)]*)\)"
+)
+
+# The registry module (runtime/faults.py) and the registries graftmodel
+# reads from it, parsed with graftlint's registry parser so the tools can
+# never disagree on what a registry contains.
+REGISTRY_MODULE = "runtime/faults.py"
+MODELS_NAME = "PROTOCOL_MODELS"
+SITE_ACTIONS_NAME = "SITE_ACTIONS"
+FAULT_SITES_NAME = "FAULT_SITES"
+METRICS_MODULE = "core/observability.py"
+METRICS_NAME = "METRIC_DOCS"
+
+# Exploration bounds: a model is supposed to be FINITE by construction
+# (budget counters in its guards); these are divergence backstops, not
+# tuning knobs — tripping either is a GM404 finding.
+MAX_STATES = 300_000
+VAR_BOUND = 10_000
+
+_MODEL_KEYS = {"name", "doc", "params", "state", "actions", "faults",
+               "invariants", "terminal"}
+_INVARIANT_RULES = ("GM1", "GM2", "GM3", "GM4")
+_RULE_OF_TAG = {"GM1": "GM101", "GM2": "GM201", "GM3": "GM301",
+                "GM4": "GM402"}
+
+
+def suppressed(sf: SourceFile, rule: str, line: int) -> bool:
+    """Whether ``rule`` is suppressed on ``line`` (trailing comment, or a
+    standalone comment directly above).  A suppression with an EMPTY
+    reason is deliberately inert: accepted protocol debt must say why."""
+    for m in _SUPPRESS_RE.finditer(sf._comment_for(line)):
+        if not m.group(3).strip():
+            continue  # reasonless suppressions don't count
+        if m.group(1):
+            return True
+        if rule in re.split(r"\s*,\s*", m.group(2)):
+            return True
+    return False
+
+
+@dataclass
+class ModelDecl:
+    """One discovered ``*_MODEL`` literal: parsed data plus the source
+    line of every element a finding may attach to."""
+
+    sf: SourceFile
+    var: str                     # the assigned name, e.g. "LEDGER_MODEL"
+    data: dict
+    line: int                    # the assignment line
+    # element key -> source line: "actions[3]", "faults[0]",
+    # "invariants[2]" (findings attach to the element, suppressions too).
+    lines: dict[str, int] = field(default_factory=dict)
+
+    @property
+    def name(self) -> str:
+        n = self.data.get("name")
+        return n if isinstance(n, str) else self.var
+
+    def element_line(self, key: str) -> int:
+        return self.lines.get(key, self.line)
+
+
+def _element_lines(value: ast.Dict) -> dict[str, int]:
+    out: dict[str, int] = {}
+    for k, v in zip(value.keys, value.values):
+        if not (isinstance(k, ast.Constant) and isinstance(k.value, str)):
+            continue
+        if k.value in ("actions", "faults", "invariants") \
+                and isinstance(v, (ast.List, ast.Tuple)):
+            for i, elt in enumerate(v.elts):
+                out[f"{k.value}[{i}]"] = elt.lineno
+        else:
+            out[k.value] = v.lineno
+    return out
+
+
+def discover_models(project: Project) -> tuple[list[ModelDecl],
+                                               list[Finding]]:
+    """Every module-level ``*_MODEL = {...}`` literal in the shipped
+    package.  A ``*_MODEL`` assignment that is not a pure literal is a
+    GM504 finding — the whole point of the declaration is that a tool
+    can read it without importing anything."""
+    decls: list[ModelDecl] = []
+    findings: list[Finding] = []
+    for sf in project.package_files():
+        for node in sf.tree.body:
+            targets = (node.targets if isinstance(node, ast.Assign)
+                       else [node.target] if isinstance(node, ast.AnnAssign)
+                       else [])
+            for t in targets:
+                if not (isinstance(t, ast.Name)
+                        and t.id.endswith("_MODEL")):
+                    continue
+                try:
+                    data = ast.literal_eval(node.value)
+                except (ValueError, TypeError):
+                    findings.append(Finding(
+                        "GM504", sf.rel, node.lineno,
+                        f"model '{t.id}' is not a pure literal — the "
+                        f"checker must read it without importing",
+                    ))
+                    continue
+                if not isinstance(data, dict):
+                    findings.append(Finding(
+                        "GM504", sf.rel, node.lineno,
+                        f"model '{t.id}' must be a dict literal",
+                    ))
+                    continue
+                decls.append(ModelDecl(
+                    sf=sf, var=t.id, data=data, line=node.lineno,
+                    lines=_element_lines(node.value)
+                    if isinstance(node.value, ast.Dict) else {},
+                ))
+    decls.sort(key=lambda d: (d.sf.rel, d.line))
+    return decls, findings
+
+
+def validate_model(decl: ModelDecl) -> list[Finding]:
+    """GM504: schema errors — missing/unknown keys, non-compiling guard
+    or update expressions, updates to undeclared variables, invariant
+    rule tags outside GM1..GM4, fault edges without site/action."""
+    out: list[Finding] = []
+    d = decl.data
+
+    def bad(msg: str, key: str | None = None) -> None:
+        out.append(Finding(
+            "GM504", decl.sf.rel,
+            decl.element_line(key) if key else decl.line,
+            f"model '{decl.name}': {msg}"))
+
+    missing = _MODEL_KEYS - set(d)
+    if missing:
+        bad(f"missing keys {sorted(missing)}")
+        return out
+    unknown = set(d) - _MODEL_KEYS
+    if unknown:
+        bad(f"unknown keys {sorted(unknown)}")
+    if not (isinstance(d["state"], dict) and d["state"]
+            and all(isinstance(k, str) and isinstance(v, int)
+                    and not isinstance(v, bool)
+                    for k, v in d["state"].items())):
+        bad("'state' must be a non-empty {var: int} dict", "state")
+        return out
+    if not (isinstance(d["params"], dict)
+            and all(isinstance(k, str) and isinstance(v, int)
+                    for k, v in d["params"].items())):
+        bad("'params' must be a {name: int} dict", "params")
+        return out
+    shadow = set(d["state"]) & set(d["params"])
+    if shadow:
+        bad(f"state vars shadow params: {sorted(shadow)}", "state")
+
+    def check_expr(expr, what: str, key: str) -> None:
+        if not isinstance(expr, str):
+            bad(f"{what} must be a str expression", key)
+            return
+        try:
+            compile(expr, "<graftmodel>", "eval")
+        except SyntaxError as e:
+            bad(f"{what} does not compile: {e.msg}", key)
+
+    seen_names: set[str] = set()
+    for kind in ("actions", "faults"):
+        if not isinstance(d[kind], list):
+            bad(f"'{kind}' must be a list", kind)
+            return out
+        for i, tr in enumerate(d[kind]):
+            key = f"{kind}[{i}]"
+            if not isinstance(tr, dict) or not isinstance(
+                    tr.get("name"), str):
+                bad(f"{kind}[{i}] must be a dict with a 'name'", key)
+                continue
+            tname = tr["name"]
+            if tname in seen_names:
+                bad(f"duplicate transition name '{tname}'", key)
+            seen_names.add(tname)
+            check_expr(tr.get("guard"), f"transition '{tname}' guard", key)
+            upd = tr.get("update")
+            if not isinstance(upd, dict):
+                bad(f"transition '{tname}' needs an 'update' dict", key)
+                continue
+            for var, expr in upd.items():
+                if var not in d["state"]:
+                    bad(f"transition '{tname}' updates undeclared "
+                        f"variable '{var}'", key)
+                check_expr(expr, f"transition '{tname}' update of "
+                                 f"'{var}'", key)
+            if kind == "faults":
+                if not (isinstance(tr.get("site"), str)
+                        and isinstance(tr.get("action"), str)):
+                    bad(f"fault edge '{tname}' needs 'site' and "
+                        f"'action'", key)
+    if not isinstance(d["invariants"], list):
+        bad("'invariants' must be a list", "invariants")
+        return out
+    for i, inv in enumerate(d["invariants"]):
+        key = f"invariants[{i}]"
+        if not isinstance(inv, dict) or not isinstance(
+                inv.get("name"), str):
+            bad(f"invariants[{i}] must be a dict with a 'name'", key)
+            continue
+        if inv.get("rule") not in _INVARIANT_RULES:
+            bad(f"invariant '{inv['name']}' rule tag must be one of "
+                f"{_INVARIANT_RULES}", key)
+        check_expr(inv.get("expr"), f"invariant '{inv['name']}'", key)
+    check_expr(d["terminal"], "'terminal'", "terminal")
+    return out
+
+
+# -- registries --------------------------------------------------------------
+
+def _find_module(project: Project, suffix: str) -> SourceFile | None:
+    return next((f for f in project.files if f.rel.endswith(suffix)), None)
+
+
+@dataclass
+class Registries:
+    faults_sf: SourceFile | None
+    metrics_sf: SourceFile | None
+    protocol_models: dict[str, str]
+    site_actions: dict[str, str]
+    fault_sites: dict[str, str]
+    metric_docs: dict[str, str]
+    # registry entry key -> source line (for findings/suppressions)
+    model_lines: dict[str, int] = field(default_factory=dict)
+    site_lines: dict[str, int] = field(default_factory=dict)
+
+
+def _entry_lines(sf: SourceFile, name: str) -> dict[str, int]:
+    for node in sf.tree.body:
+        targets = (node.targets if isinstance(node, ast.Assign)
+                   else [node.target] if isinstance(node, ast.AnnAssign)
+                   else [])
+        for t in targets:
+            if isinstance(t, ast.Name) and t.id == name \
+                    and isinstance(node.value, ast.Dict):
+                return {k.value: k.lineno for k in node.value.keys
+                        if isinstance(k, ast.Constant)
+                        and isinstance(k.value, str)}
+    return {}
+
+
+def load_registries(project: Project) -> Registries:
+    faults = _find_module(project, REGISTRY_MODULE)
+    metrics = _find_module(project, METRICS_MODULE)
+    return Registries(
+        faults_sf=faults,
+        metrics_sf=metrics,
+        protocol_models=(literal_strdict(faults, MODELS_NAME) or {}
+                         if faults else {}),
+        site_actions=(literal_strdict(faults, SITE_ACTIONS_NAME) or {}
+                      if faults else {}),
+        fault_sites=(literal_strdict(faults, FAULT_SITES_NAME) or {}
+                     if faults else {}),
+        metric_docs=(literal_strdict(metrics, METRICS_NAME) or {}
+                     if metrics else {}),
+        model_lines=_entry_lines(faults, MODELS_NAME) if faults else {},
+        site_lines=_entry_lines(faults, SITE_ACTIONS_NAME)
+        if faults else {},
+    )
+
+
+def metric_registered(name: str, registry: dict[str, str]) -> bool:
+    """GL302's matching: a literal entry, or a ``*`` pattern entry that
+    the name matches (``router.handoff_fallbacks.verify`` is covered by
+    ``router.handoff_fallbacks.*``)."""
+    import fnmatch
+
+    if name in registry:
+        return True
+    return any("*" in key and fnmatch.fnmatchcase(name, key)
+               for key in registry)

@@ -1,0 +1,171 @@
+"""GMD01 — README table drift.
+
+The README's "Protocol model checking" section carries TWO generated
+tables between marker comments (the established convention):
+
+- ``<!-- graftmodel:models:begin/end -->`` — the checked models, rendered
+  from PROTOCOL_MODELS plus each discovered ``*_MODEL`` literal (where it
+  lives, how big it is, its one-line doc);
+- ``<!-- graftmodel:rules:begin/end -->`` — the GM rule families,
+  rendered from :data:`RULE_DOCS`.
+
+``python -m tools.graftmodel --write-docs`` regenerates both; GMD01
+fails the gate when either diverges — a model added without a README row
+(or a README row outliving its model) is registry drift in prose form.
+"""
+
+from __future__ import annotations
+
+import re
+from pathlib import Path
+
+from .core import Finding, ModelDecl, Registries
+
+RULE_DRIFT = "GMD01"
+
+# rule id -> (family, one-line contract).  The README rules table renders
+# from this dict; keep entries in rule order.
+RULE_DOCS: dict[str, tuple[str, str]] = {
+    "GM101": ("GM1 ledger accounting",
+              "no reachable state violates a GM1-tagged invariant "
+              "(quota conservation, charge-iff-placed, no lost refund, "
+              "bounded backstop metering) — reported with the shortest "
+              "counterexample trace"),
+    "GM201": ("GM2 parcel ownership",
+              "no reachable state violates a GM2-tagged invariant "
+              "(every parked swap/spill parcel owned by exactly one "
+              "queued resume, page budget conserved and never "
+              "oversubscribed)"),
+    "GM301": ("GM3 at-most-once adoption",
+              "no reachable state violates a GM3-tagged invariant "
+              "(a KV handoff or directory pull is adopted at most once, "
+              "every fallback counted exactly once)"),
+    "GM302": ("GM3 at-most-once adoption",
+              "every fault edge declares the per-reason fallback metric "
+              "its recovery path increments"),
+    "GM401": ("GM4 liveness",
+              "no deadlock: every stuck state (no enabled transition) "
+              "satisfies the model's terminal predicate"),
+    "GM402": ("GM4 liveness",
+              "no reachable state violates a GM4-tagged invariant "
+              "(fleet size within [MIN, MAX], scale-down only via "
+              "drain, retries/streaks bounded)"),
+    "GM403": ("GM4 liveness",
+              "every declared transition is enabled somewhere in the "
+              "explored space — a guard that can never fire is model "
+              "rot"),
+    "GM404": ("GM4 liveness",
+              "exploration terminates within the divergence backstops — "
+              "an unbounded counter makes 'exhaustive' a lie"),
+    "GM501": ("GM5 model-code drift",
+              "every fault edge's site:action pair is declared in "
+              "FAULT_SITES / SITE_ACTIONS — the model only drills "
+              "faults the fault plane can inject"),
+    "GM502": ("GM5 model-code drift",
+              "every fault edge's metric is declared in METRIC_DOCS "
+              "(wildcard patterns match)"),
+    "GM503": ("GM5 model-code drift",
+              "PROTOCOL_MODELS and *_MODEL literals agree both "
+              "directions; SITE_ACTIONS and FAULT_SITES keys agree both "
+              "directions; SITE_ACTIONS tokens stay inside the ACTIONS "
+              "grammar; model names are unique"),
+    "GM504": ("GM5 model-code drift",
+              "every *_MODEL assignment is a pure dict literal matching "
+              "the schema (state/params typed, guards and updates "
+              "compile, no undeclared variables, invariant tags in "
+              "GM1..GM4)"),
+    "GM601": ("GM6 drill coverage",
+              "every SITE_ACTIONS pair is injected by at least one "
+              "tier-1 test (spec strings or plane.add with literal "
+              "args) — a declared-but-never-drilled fault is an "
+              "untested recovery path"),
+    "GMD01": ("GMD docs",
+              "the README models and GM-rules tables match the "
+              "registries and RULE_DOCS — run python -m tools.graftmodel "
+              "--write-docs"),
+}
+
+_MODELS_RE = re.compile(
+    r"<!-- graftmodel:models:begin -->\n(.*?)<!-- graftmodel:models:end -->",
+    re.S,
+)
+_RULES_RE = re.compile(
+    r"<!-- graftmodel:rules:begin -->\n(.*?)<!-- graftmodel:rules:end -->",
+    re.S,
+)
+
+
+def render_models_table(decls: list[ModelDecl],
+                        regs: Registries) -> str:
+    by_name = {d.name: d for d in decls}
+    lines = ["| model | declared in | machine | checks |",
+             "| --- | --- | --- | --- |"]
+    for key in regs.protocol_models:
+        d = by_name.get(key)
+        if d is None:
+            lines.append(f"| `{key}` | *(unregistered — GM503)* | | |")
+            continue
+        data = d.data
+        size = (f"{len(data.get('actions', []))} actions + "
+                f"{len(data.get('faults', []))} faults, "
+                f"{len(data.get('invariants', []))} invariants")
+        doc = data.get("doc", "") if isinstance(data.get("doc"), str) else ""
+        lines.append(f"| `{key}` | `{d.sf.rel}` (`{d.var}`) | {size} "
+                     f"| {doc} |")
+    return "\n".join(lines)
+
+
+def render_rules_table() -> str:
+    lines = ["| rule | family | checks |", "| --- | --- | --- |"]
+    lines += [f"| {rule} | {fam} | {doc} |"
+              for rule, (fam, doc) in RULE_DOCS.items()]
+    return "\n".join(lines)
+
+
+def check_docs(root: Path, decls: list[ModelDecl],
+               regs: Registries) -> list[Finding]:
+    readme = root / "README.md"
+    if not readme.exists():
+        return []
+    text = readme.read_text(encoding="utf-8")
+    out: list[Finding] = []
+    for marker_re, tag, want in (
+            (_MODELS_RE, "models", render_models_table(decls, regs)),
+            (_RULES_RE, "rules", render_rules_table())):
+        m = marker_re.search(text)
+        if m is None:
+            out.append(Finding(
+                RULE_DRIFT, "README.md", 1,
+                f"missing '<!-- graftmodel:{tag}:begin/end -->' block — "
+                f"run python -m tools.graftmodel --write-docs",
+            ))
+        elif m.group(1).strip() != want.strip():
+            line = text[: m.start()].count("\n") + 1
+            out.append(Finding(
+                RULE_DRIFT, "README.md", line,
+                f"graftmodel {tag} table is stale — run python -m "
+                f"tools.graftmodel --write-docs",
+            ))
+    return out
+
+
+def write_docs(root: Path, decls: list[ModelDecl],
+               regs: Registries) -> bool:
+    readme = root / "README.md"
+    if not readme.exists():
+        return False
+    text = readme.read_text(encoding="utf-8")
+    wrote = False
+    for marker_re, tag, body in (
+            (_MODELS_RE, "models", render_models_table(decls, regs)),
+            (_RULES_RE, "rules", render_rules_table())):
+        if marker_re.search(text) is None:
+            continue
+        block = (f"<!-- graftmodel:{tag}:begin -->\n{body}\n"
+                 f"<!-- graftmodel:{tag}:end -->")
+        # Callable replacement: table text must never be read as re escapes.
+        text = marker_re.sub(lambda _m: block, text)
+        wrote = True
+    if wrote:
+        readme.write_text(text, encoding="utf-8")
+    return wrote

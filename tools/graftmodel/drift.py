@@ -1,0 +1,134 @@
+"""GM5 — model <-> code drift, both directions.
+
+The models are only worth trusting while they stay pinned to the
+registries the running code actually uses:
+
+- GM501: a fault edge names a ``site:action`` that FAULT_SITES /
+  SITE_ACTIONS (runtime/faults.py) does not declare — the model drills
+  a fault the fault plane cannot inject;
+- GM502: a fault edge's fallback metric is not declared in METRIC_DOCS
+  (``*`` patterns match, GL302's semantics) — the modeled recovery
+  path counts into a counter that does not exist;
+- GM503: registry drift both directions — a PROTOCOL_MODELS entry with
+  no ``*_MODEL`` declaration (dead registry entry), a model literal
+  missing from PROTOCOL_MODELS, duplicate model names, SITE_ACTIONS
+  keys that mismatch FAULT_SITES keys (either direction), and
+  SITE_ACTIONS action tokens outside the fault plane's ACTIONS
+  grammar;
+- GM504: a ``*_MODEL`` assignment that is not a pure literal, or fails
+  the schema (emitted by discovery/validation in core.py, reported
+  through this family).
+"""
+
+from __future__ import annotations
+
+from .core import Finding, ModelDecl, Registries, metric_registered
+
+RULE_UNDECLARED_FAULT = "GM501"
+RULE_UNKNOWN_METRIC = "GM502"
+RULE_REGISTRY = "GM503"
+
+
+def _known_actions(regs: Registries) -> set[str] | None:
+    if regs.faults_sf is None:
+        return None
+    from tools.graftlint.registry import _literal_strset
+
+    return _literal_strset(regs.faults_sf, "ACTIONS")
+
+
+def check(decls: list[ModelDecl], regs: Registries) -> list[Finding]:
+    out: list[Finding] = []
+
+    # -- GM501/GM502: fault edges vs the fault plane and METRIC_DOCS ----
+    for decl in decls:
+        for i, tr in enumerate(decl.data.get("faults", [])):
+            if not isinstance(tr, dict):
+                continue
+            line = decl.element_line(f"faults[{i}]")
+            name = tr.get("name", f"faults[{i}]")
+            site, action = tr.get("site"), tr.get("action")
+            if isinstance(site, str) and isinstance(action, str):
+                declared = regs.site_actions.get(site)
+                if site not in regs.fault_sites:
+                    out.append(Finding(
+                        RULE_UNDECLARED_FAULT, decl.sf.rel, line,
+                        f"model '{decl.name}': fault edge '{name}' uses "
+                        f"site '{site}' not declared in FAULT_SITES",
+                    ))
+                elif declared is None or action not in {
+                        a.strip() for a in declared.split(",")}:
+                    out.append(Finding(
+                        RULE_UNDECLARED_FAULT, decl.sf.rel, line,
+                        f"model '{decl.name}': fault edge '{name}' uses "
+                        f"action '{site}:{action}' not declared in "
+                        f"SITE_ACTIONS",
+                    ))
+            metric = tr.get("metric")
+            if isinstance(metric, str) and metric.strip() \
+                    and not metric_registered(metric, regs.metric_docs):
+                out.append(Finding(
+                    RULE_UNKNOWN_METRIC, decl.sf.rel, line,
+                    f"model '{decl.name}': fault edge '{name}' metric "
+                    f"'{metric}' is not declared in METRIC_DOCS",
+                ))
+
+    # -- GM503: PROTOCOL_MODELS <-> model literals, both directions -----
+    by_name: dict[str, list[ModelDecl]] = {}
+    for decl in decls:
+        by_name.setdefault(decl.name, []).append(decl)
+    for mname, group in sorted(by_name.items()):
+        for dup in group[1:]:
+            out.append(Finding(
+                RULE_REGISTRY, dup.sf.rel, dup.line,
+                f"duplicate model name '{mname}' (also declared in "
+                f"{group[0].sf.rel}:{group[0].line})",
+            ))
+    if regs.faults_sf is not None:
+        frel = regs.faults_sf.rel
+        for key in regs.protocol_models:
+            if key not in by_name:
+                out.append(Finding(
+                    RULE_REGISTRY, frel,
+                    regs.model_lines.get(key, 1),
+                    f"PROTOCOL_MODELS entry '{key}' has no *_MODEL "
+                    f"declaration with that name (dead registry entry)",
+                ))
+        for mname, group in sorted(by_name.items()):
+            if mname not in regs.protocol_models:
+                out.append(Finding(
+                    RULE_REGISTRY, group[0].sf.rel, group[0].line,
+                    f"model '{mname}' is not registered in "
+                    f"PROTOCOL_MODELS (runtime/faults.py)",
+                ))
+
+        # -- SITE_ACTIONS <-> FAULT_SITES, both directions --------------
+        actions = _known_actions(regs)
+        for site, acts in regs.site_actions.items():
+            sline = regs.site_lines.get(site, 1)
+            if site not in regs.fault_sites:
+                out.append(Finding(
+                    RULE_REGISTRY, frel, sline,
+                    f"SITE_ACTIONS site '{site}' is not declared in "
+                    f"FAULT_SITES",
+                ))
+            if actions is not None:
+                unknown = sorted(
+                    {a.strip() for a in acts.split(",")} - actions)
+                if unknown:
+                    out.append(Finding(
+                        RULE_REGISTRY, frel, sline,
+                        f"SITE_ACTIONS['{site}'] declares actions "
+                        f"{unknown} outside the fault plane's ACTIONS "
+                        f"grammar",
+                    ))
+        for site in regs.fault_sites:
+            if site not in regs.site_actions:
+                out.append(Finding(
+                    RULE_REGISTRY, frel,
+                    regs.site_lines.get(site, 1),
+                    f"FAULT_SITES site '{site}' has no SITE_ACTIONS "
+                    f"declaration — every site must declare the actions "
+                    f"its call site handles",
+                ))
+    return out

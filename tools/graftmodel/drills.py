@@ -1,0 +1,79 @@
+"""GM6 — drill coverage: every declared fault pair is exercised.
+
+SITE_ACTIONS is the contract between the fault plane and the test
+suite: each ``site -> actions`` entry says "the code around this site
+handles these failure modes".  graftmodel proves the *protocol* survives
+each fault action; GM601 closes the other half of the loop by requiring
+that at least one tier-1 test actually injects each declared pair — a
+declared-but-never-drilled pair is an untested recovery path wearing a
+tested one's label.
+
+The scan is static (same spirit as the rest of the tier): it walks the
+test tree's ASTs for the two injection idioms —
+
+- fault-plane spec strings: ``"xfer.send/KV:corrupt@2"`` inside any
+  string literal (comma-separated specs, ``/qualifier`` and ``@when``
+  ignored);
+- programmatic rules: ``plane.add("xfer.send", "corrupt", ...)`` with
+  literal string arguments.
+
+Only sites present in FAULT_SITES count — tests also drill synthetic
+sites (``"s:drop"``) to test the plane itself, and those are not
+coverage of any declared pair.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from .core import Finding, Project, Registries
+
+RULE_UNDRILLED = "GM601"
+
+_SPEC_RE = re.compile(
+    r"([a-z_][a-z0-9_.]*)(?:/[A-Za-z0-9_*+-]+)?:([a-z]+)")
+
+
+def drilled_pairs(project: Project,
+                  regs: Registries) -> dict[tuple[str, str], str]:
+    """``(site, action) -> "rel:line"`` of one test that injects it."""
+    out: dict[tuple[str, str], str] = {}
+
+    def record(site: str, action: str, rel: str, line: int) -> None:
+        if site in regs.fault_sites:
+            out.setdefault((site, action), f"{rel}:{line}")
+
+    for sf in project.test_files():
+        for node in ast.walk(sf.tree):
+            if isinstance(node, ast.Constant) and isinstance(node.value, str):
+                for m in _SPEC_RE.finditer(node.value):
+                    record(m.group(1), m.group(2), sf.rel, node.lineno)
+            elif isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Attribute) \
+                    and node.func.attr == "add" \
+                    and len(node.args) >= 2 \
+                    and all(isinstance(a, ast.Constant)
+                            and isinstance(a.value, str)
+                            for a in node.args[:2]):
+                record(node.args[0].value, node.args[1].value,
+                       sf.rel, node.lineno)
+    return out
+
+
+def check(project: Project, regs: Registries) -> list[Finding]:
+    if regs.faults_sf is None:
+        return []
+    drilled = drilled_pairs(project, regs)
+    out: list[Finding] = []
+    for site, acts in regs.site_actions.items():
+        for action in sorted(a.strip() for a in acts.split(",") if a.strip()):
+            if (site, action) in drilled:
+                continue
+            out.append(Finding(
+                RULE_UNDRILLED, regs.faults_sf.rel,
+                regs.site_lines.get(site, 1),
+                f"declared fault pair '{site}:{action}' is never injected "
+                f"by any test — write a drill or stop declaring the pair",
+            ))
+    return out

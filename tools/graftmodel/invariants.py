@@ -1,0 +1,66 @@
+"""GM1-GM3 — safety invariants over the explored state space, plus the
+structural fallback-metric law.
+
+- GM101: an invariant tagged ``GM1`` (ledger accounting: no
+  double-charge, no lost refund, backstop-bounded metering) fails on a
+  reachable state — reported with the shortest counterexample trace;
+- GM201: an invariant tagged ``GM2`` (parcel ownership: every parked
+  parcel owned by exactly one queued resume, budget conserved) fails;
+- GM301: an invariant tagged ``GM3`` (at-most-once adoption, fallbacks
+  counted exactly once) fails;
+- GM302: a fault edge declares no ``metric`` — every failure edge must
+  name the per-reason fallback counter its recovery path increments
+  (GM502 then checks the name against METRIC_DOCS).
+
+The three exploration rules share one BFS per model (run by
+``run_project``); a violation message carries the violating state and
+the shortest transition trace that reaches it, so the report IS the
+reproduction.
+"""
+
+from __future__ import annotations
+
+from .core import Finding, ModelDecl, _RULE_OF_TAG
+from .machine import ExploreResult, render_state, render_trace
+
+RULE_NO_METRIC = "GM302"
+
+_FAMILY_TAGS = {"GM1", "GM2", "GM3"}
+
+
+def check_explored(
+        explored: list[tuple[ModelDecl, object, ExploreResult]],
+) -> list[Finding]:
+    out: list[Finding] = []
+    for decl, _cm, res in explored:
+        for v in res.violations:
+            if v.kind != "invariant" or v.rule_tag not in _FAMILY_TAGS:
+                continue
+            out.append(Finding(
+                _RULE_OF_TAG[v.rule_tag], decl.sf.rel,
+                decl.element_line(v.key),
+                f"model '{decl.name}': invariant '{v.name}' violated at "
+                f"state [{render_state(v.state)}] — trace: "
+                f"{render_trace(v.trace)}",
+            ))
+    return out
+
+
+def check_metrics_declared(decls: list[ModelDecl]) -> list[Finding]:
+    out: list[Finding] = []
+    for decl in decls:
+        for i, tr in enumerate(decl.data.get("faults", [])):
+            if not isinstance(tr, dict):
+                continue
+            metric = tr.get("metric")
+            if isinstance(metric, str) and metric.strip():
+                continue
+            out.append(Finding(
+                RULE_NO_METRIC, decl.sf.rel,
+                decl.element_line(f"faults[{i}]"),
+                f"model '{decl.name}': fault edge "
+                f"'{tr.get('name', f'faults[{i}]')}' declares no fallback "
+                f"metric — every failure edge must name the per-reason "
+                f"counter its recovery path increments",
+            ))
+    return out

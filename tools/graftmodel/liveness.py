@@ -1,0 +1,75 @@
+"""GM4 — liveness and boundedness over the explored space.
+
+- GM401: deadlock — a stuck state (no enabled transition) that fails
+  the model's ``terminal`` predicate.  A bypassed request parked
+  forever, a parcel nobody resumes, a drain that never finishes: each
+  is a stuck non-terminal state, and the shortest trace to it is the
+  reproduction;
+- GM402: an invariant tagged ``GM4`` fails (size within [MIN, MAX],
+  downs only via drain, bounded retries/streaks);
+- GM403: a transition never enabled anywhere in the explored space —
+  dead model entries are model rot exactly like dead registry entries
+  (graftlint's GL305), and a guard that can never fire usually means
+  the model no longer matches the code;
+- GM404: the exploration tripped a divergence backstop (MAX_STATES or
+  a variable leaving its bound) — the model is not finite, so nothing
+  "exhaustive" can be claimed about it.  GM403 is skipped for such a
+  model (the unexplored remainder could enable anything).
+"""
+
+from __future__ import annotations
+
+from .core import Finding, ModelDecl
+from .machine import ExploreResult, render_state, render_trace
+
+RULE_DEADLOCK = "GM401"
+RULE_INVARIANT = "GM402"
+RULE_DEAD = "GM403"
+RULE_UNBOUNDED = "GM404"
+
+
+def check_explored(
+        explored: list[tuple[ModelDecl, object, ExploreResult]],
+) -> list[Finding]:
+    out: list[Finding] = []
+    for decl, _cm, res in explored:
+        for v in res.violations:
+            if v.kind == "deadlock":
+                out.append(Finding(
+                    RULE_DEADLOCK, decl.sf.rel,
+                    decl.element_line("terminal"),
+                    f"model '{decl.name}': deadlock — stuck state "
+                    f"[{render_state(v.state)}] fails the terminal "
+                    f"predicate — trace: {render_trace(v.trace)}",
+                ))
+            elif v.kind == "invariant" and v.rule_tag == "GM4":
+                out.append(Finding(
+                    RULE_INVARIANT, decl.sf.rel,
+                    decl.element_line(v.key),
+                    f"model '{decl.name}': invariant '{v.name}' violated "
+                    f"at state [{render_state(v.state)}] — trace: "
+                    f"{render_trace(v.trace)}",
+                ))
+        if res.overflow:
+            out.append(Finding(
+                RULE_UNBOUNDED, decl.sf.rel, decl.line,
+                f"model '{decl.name}': exploration exceeded the state "
+                f"bound after {res.states} states — bound every counter "
+                f"with a budget param or the space is not exhaustive",
+            ))
+        elif res.diverged:
+            out.append(Finding(
+                RULE_UNBOUNDED, decl.sf.rel, decl.line,
+                f"model '{decl.name}': {res.diverged} — bound every "
+                f"counter with a budget param",
+            ))
+        else:
+            for tr in res.never_enabled:
+                out.append(Finding(
+                    RULE_DEAD, decl.sf.rel,
+                    decl.element_line(tr.key),
+                    f"model '{decl.name}': transition '{tr.name}' is "
+                    f"never enabled anywhere in the explored space "
+                    f"(dead model entry — the guard can never fire)",
+                ))
+    return out

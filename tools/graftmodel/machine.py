@@ -1,0 +1,195 @@
+"""The explicit-state explorer: exhaustive BFS over a model's bounded
+interleaving space.
+
+One compiled model = the composition of its protocol actions and its
+declared fault actions; one state = the tuple of state-variable values.
+BFS from the initial state explores EVERY enabled transition of every
+reachable state — exhaustive, not sampled, which is the entire point:
+a chaos storm answers "did this ordering break?", the explorer answers
+"is there ANY ordering that breaks?".  BFS also makes every reported
+trace a shortest counterexample, and fixed transition order makes runs
+byte-deterministic (baseline-stable messages).
+
+Guards and updates are compiled once per model and evaluated with empty
+``__builtins__`` over ``params`` + the state — a model cannot reach the
+filesystem, the clock, or the repo under analysis.  Updates all read the
+PRE-state (simultaneous assignment, the TLA+ convention).
+
+Divergence backstops (GM404, not tuning knobs): exploration stops at
+``MAX_STATES`` states, and any variable leaving ``[-VAR_BOUND,
+VAR_BOUND]`` aborts — a model with an unbounded counter is a bug in the
+model, and silently truncating the space would turn "exhaustively
+verified" into a lie.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from dataclasses import dataclass, field
+
+from .core import MAX_STATES, VAR_BOUND, ModelDecl
+
+_EMPTY_BUILTINS = {"__builtins__": {}}
+_TRACE_SHOWN = 14  # max transitions rendered in a counterexample trace
+
+
+@dataclass
+class Transition:
+    name: str
+    kind: str                    # "action" | "fault"
+    index: int                   # position within its decl list
+    guard: object                # code object
+    updates: list[tuple[str, object]]
+    site: str | None = None
+    action: str | None = None
+    metric: str | None = None
+
+    @property
+    def key(self) -> str:
+        return f"{self.kind}s[{self.index}]"
+
+
+@dataclass
+class CompiledModel:
+    decl: ModelDecl
+    params: dict[str, int]
+    var_names: tuple[str, ...]   # fixed order = state tuple order
+    start: tuple[int, ...]
+    transitions: list[Transition]
+    invariants: list[tuple[str, str, object, str]]  # (rule, name, code, key)
+    terminal: object
+
+
+def compile_model(decl: ModelDecl) -> CompiledModel:
+    """Assumes the decl already passed :func:`core.validate_model`."""
+    d = decl.data
+    var_names = tuple(sorted(d["state"]))
+    transitions: list[Transition] = []
+    for kind in ("action", "fault"):
+        for i, tr in enumerate(d[f"{kind}s"]):
+            transitions.append(Transition(
+                name=tr["name"], kind=kind, index=i,
+                guard=compile(tr["guard"], "<graftmodel>", "eval"),
+                updates=[(v, compile(e, "<graftmodel>", "eval"))
+                         for v, e in tr["update"].items()],
+                site=tr.get("site"), action=tr.get("action"),
+                metric=tr.get("metric"),
+            ))
+    invariants = [
+        (inv["rule"], inv["name"],
+         compile(inv["expr"], "<graftmodel>", "eval"),
+         f"invariants[{i}]")
+        for i, inv in enumerate(d["invariants"])
+    ]
+    return CompiledModel(
+        decl=decl, params=dict(d["params"]), var_names=var_names,
+        start=tuple(d["state"][v] for v in var_names),
+        transitions=transitions, invariants=invariants,
+        terminal=compile(d["terminal"], "<graftmodel>", "eval"),
+    )
+
+
+@dataclass
+class Violation:
+    kind: str                    # "invariant" | "deadlock"
+    rule_tag: str                # invariant rule tag ("GM1"...) or ""
+    name: str                    # invariant name or ""
+    key: str                     # decl element key for line/suppression
+    state: dict[str, int]
+    trace: list[str]
+
+
+@dataclass
+class ExploreResult:
+    states: int = 0
+    fired: int = 0               # transition firings (state x transition)
+    violations: list[Violation] = field(default_factory=list)
+    never_enabled: list[Transition] = field(default_factory=list)
+    overflow: bool = False       # MAX_STATES exceeded
+    diverged: str | None = None  # "var 'x' left [-N, N] via 'name'"
+
+
+def _trace(parents: dict, state: tuple) -> list[str]:
+    out: list[str] = []
+    cur = state
+    while parents.get(cur) is not None:
+        cur, name = parents[cur]
+        out.append(name)
+    out.reverse()
+    if len(out) > _TRACE_SHOWN:
+        out = [f"... {len(out) - _TRACE_SHOWN} more"] + out[-_TRACE_SHOWN:]
+    return out
+
+
+def explore(cm: CompiledModel, max_states: int = MAX_STATES) -> ExploreResult:
+    """Exhaustive BFS.  Reports the FIRST (shortest-trace) violation per
+    invariant and the first deadlock — one counterexample per law is
+    actionable; ten thousand are noise."""
+    res = ExploreResult()
+    names = cm.var_names
+    parents: dict[tuple, tuple | None] = {cm.start: None}
+    queue: deque[tuple] = deque([cm.start])
+    seen_inv: set[str] = set()
+    enabled_ever: set[str] = set()
+    deadlocked = False
+
+    while queue:
+        s = queue.popleft()
+        env = dict(cm.params)
+        env.update(zip(names, s))
+        for rule, iname, code, key in cm.invariants:
+            if iname not in seen_inv and not eval(code, _EMPTY_BUILTINS, env):
+                seen_inv.add(iname)
+                res.violations.append(Violation(
+                    kind="invariant", rule_tag=rule, name=iname, key=key,
+                    state=dict(zip(names, s)), trace=_trace(parents, s)))
+        any_enabled = False
+        for tr in cm.transitions:
+            if not eval(tr.guard, _EMPTY_BUILTINS, env):
+                continue
+            any_enabled = True
+            enabled_ever.add(tr.name)
+            res.fired += 1
+            nxt = dict(zip(names, s))
+            for var, code in tr.updates:
+                val = nxt[var] = eval(code, _EMPTY_BUILTINS, env)
+                if not isinstance(val, int) or isinstance(val, bool) \
+                        or abs(val) > VAR_BOUND:
+                    res.diverged = (f"variable '{var}' left "
+                                    f"[-{VAR_BOUND}, {VAR_BOUND}] (or went "
+                                    f"non-int) via '{tr.name}'")
+                    res.states = len(parents)
+                    res.never_enabled = [
+                        t for t in cm.transitions
+                        if t.name not in enabled_ever]
+                    return res
+            ns = tuple(nxt[v] for v in names)
+            if ns not in parents:
+                if len(parents) >= max_states:
+                    res.overflow = True
+                    res.states = len(parents)
+                    res.never_enabled = [
+                        t for t in cm.transitions
+                        if t.name not in enabled_ever]
+                    return res
+                parents[ns] = (s, tr.name)
+                queue.append(ns)
+        if not any_enabled and not deadlocked \
+                and not eval(cm.terminal, _EMPTY_BUILTINS, env):
+            deadlocked = True
+            res.violations.append(Violation(
+                kind="deadlock", rule_tag="", name="", key="terminal",
+                state=dict(zip(names, s)), trace=_trace(parents, s)))
+
+    res.states = len(parents)
+    res.never_enabled = [t for t in cm.transitions
+                         if t.name not in enabled_ever]
+    return res
+
+
+def render_state(state: dict[str, int]) -> str:
+    return " ".join(f"{k}={v}" for k, v in sorted(state.items()))
+
+
+def render_trace(trace: list[str]) -> str:
+    return " -> ".join(trace) if trace else "<initial state>"
